@@ -1,0 +1,207 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the PaddlePaddle
+API surface (usage: ``import paddle_tpu as paddle``).
+
+Built per SURVEY.md: tensors over jax.Array, tape autograd for eager,
+jax.jit for the performance path, one jax.sharding.Mesh for the Fleet
+distributed stack, Pallas for fused kernels.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# paddle dtype semantics: integer tensors are int64 by default. jax's
+# x64-disabled mode silently demotes them to int32, so enable x64 and keep
+# the FLOAT default at float32 ourselves (Tensor/as_array cast f64 -> default
+# dtype unless the user explicitly asks for float64).
+_jax.config.update("jax_enable_x64", True)
+
+# --- framework core ---
+from .framework import config as _config
+from .framework import device as _device_mod
+from .framework import dtype as _dtype_mod
+from .framework import random as _random_mod
+from .framework.config import (
+    get_default_dtype,
+    get_flags,
+    set_default_dtype,
+    set_flags,
+)
+from .framework.device import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_distribute,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .framework.dtype import (  # noqa: F401
+    DType,
+    bfloat16,
+    bool_ as bool,  # noqa: A001  (paddle exports paddle.bool)
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .framework.random import get_rng_state, seed, set_rng_state
+
+# --- tensor + autograd ---
+from .tensor import Parameter, Tensor, to_tensor
+from .autograd.tape import (
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+# --- ops: re-export everything at top level (paddle.* op surface) ---
+from . import ops as _ops
+from .ops.activation import *  # noqa: F401,F403
+from .ops.creation import (  # noqa: F401
+    arange,
+    assign,
+    clone,
+    complex,  # noqa: A001
+    diag,
+    diag_embed,
+    diagflat,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    one_hot,
+    ones,
+    ones_like,
+    polar,
+    tril,
+    tril_indices,
+    triu,
+    triu_indices,
+    zeros,
+    zeros_like,
+)
+from .ops.math import *  # noqa: F401,F403
+from .ops.reduction import *  # noqa: F401,F403
+from .ops.manipulation import *  # noqa: F401,F403
+from .ops.logic import *  # noqa: F401,F403
+from .ops.search import *  # noqa: F401,F403
+from .ops.linalg import (  # noqa: F401
+    bincount,
+    bmm,
+    cdist,
+    cross,
+    dist,
+    dot,
+    einsum,
+    histogram,
+    histogramdd,
+    matmul,
+    matrix_transpose,
+    mm,
+    mv,
+    norm,
+    tensordot,
+)
+from .ops.random_ops import (  # noqa: F401
+    bernoulli,
+    binomial,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_normal,
+    uniform,
+)
+
+# --- subsystems ---
+from . import autograd  # noqa: F401
+from . import amp  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def numel(x, name=None):
+    return to_tensor(x.size, dtype="int64")
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+def in_dynamic_mode():
+    from .jit import api as _jit_api
+
+    return not _jit_api.in_to_static_trace()
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu runs eager + jit (to_static); legacy static graph mode is "
+        "covered by paddle_tpu.static's Program/Executor shim over jax.jit"
+    )
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0
+
+
+def device_count():
+    return _device_mod.device_count()
+
+
+def version():
+    return __version__

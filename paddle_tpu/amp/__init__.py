@@ -1,0 +1,62 @@
+"""AMP (python/paddle/amp parity — SURVEY.md §2.2): auto_cast O1/O2,
+GradScaler, decorate. On TPU the preferred dtype is bfloat16 (no loss scaling
+required; GradScaler kept for API parity and fp16 experiments)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, as_array
+from ..framework import amp_state as _state
+from .grad_scaler import GradScaler  # noqa: F401
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.amp_dtype, _state.level,
+            _state.white_list, _state.black_list)
+    _state.enabled = bool(enable)
+    _state.amp_dtype = _dtype.to_np_dtype(dtype)
+    _state.level = level
+    if custom_white_list:
+        _state.white_list = _state.white_list | set(custom_white_list)
+    if custom_black_list:
+        _state.black_list = _state.black_list | set(custom_black_list)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.amp_dtype, _state.level,
+         _state.white_list, _state.black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low-precision dtype (master weights kept
+    by multi-precision optimizers)."""
+    nd = _dtype.to_np_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if _dtype.is_floating_dtype(p._data.dtype):
+                    p._rebind(p._data.astype(nd))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,18 @@
+"""Autograd public API (python/paddle/autograd parity — SURVEY.md §2.2)."""
+from .tape import (  # noqa: F401
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+
+def __getattr__(name):
+    # lazy: py_layer imports Tensor, which imports this package's tape module
+    if name in ("PyLayer", "PyLayerContext"):
+        from . import py_layer
+
+        return getattr(py_layer, name)
+    raise AttributeError(name)

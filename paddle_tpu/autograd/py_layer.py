@@ -1,0 +1,83 @@
+"""PyLayer: user-defined autograd ops (python/paddle/autograd/py_layer.py
+parity). The user's static `forward`/`backward` run eagerly; `backward` is
+registered on the tape as the vjp of the forward outputs."""
+from __future__ import annotations
+
+from typing import Any
+
+from ..tensor import Tensor, as_array
+from . import tape as _tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.update(id(t) for t in tensors)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = _tape.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        if record:
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                grad_in = [Tensor(c, stop_gradient=True) for c in cots]
+                with _tape.no_grad():
+                    gres = cls.backward(ctx, *grad_in)
+                if not isinstance(gres, (tuple, list)):
+                    gres = (gres,)
+                out_grads = []
+                for g in gres:
+                    if g is None:
+                        out_grads.append(None)
+                    else:
+                        out_grads.append(as_array(g))
+                return tuple(out_grads)
+
+            avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+            node = _tape.TapeNode(
+                tuple(_tape.InputRef(t) for t in tensor_inputs),
+                vjp_fn, avals, name=cls.__name__,
+            )
+            for i, o in enumerate(outs):
+                if id(o) not in ctx.non_differentiable:
+                    o.stop_gradient = False
+                    o._tape_node = node
+                    o._tape_out_idx = i
+        return tuple(outs) if multi else outs[0]

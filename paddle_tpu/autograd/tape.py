@@ -1,0 +1,323 @@
+"""Eager autograd: a tape of per-op `jax.vjp` closures.
+
+Reference parity: the eager engine — `GradNodeBase`, `egr::Backward`,
+`GradTensorHolder` accumulation, gradient hooks
+(ref: paddle/fluid/eager/backward.cc, grad_node_info.h — SURVEY.md §2.1,
+§3.2). TPU-native design (SURVEY.md §7 phase 1): instead of generated C++
+GradNodes, each differentiable op records one TapeNode holding the vjp
+closure returned by `jax.vjp`. `backward()` drains nodes in reverse creation
+order (creation order is a topological order), exactly the reference's
+ready-queue walk but in ~100 lines.
+
+Eager mode is the debug path; the performance path jits the whole step
+(SURVEY.md §3.1 "TPU lesson").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+_node_counter = [0]
+
+
+def grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+class no_grad:
+    """paddle.no_grad: context manager AND decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self.mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+class InputRef:
+    """Snapshot of an input tensor's tape position at record time.
+
+    In-place ops rebind the SAME Python Tensor to their own output; without
+    the snapshot, backward would follow the live `_tape_node` into a cycle
+    (the node would appear to be its own producer)."""
+
+    __slots__ = ("tensor", "node", "out_idx", "stop_gradient")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._tape_node
+        self.out_idx = tensor._tape_out_idx
+        self.stop_gradient = tensor.stop_gradient
+
+
+class TapeNode:
+    """One recorded differentiable op (≡ a GradNode in the reference)."""
+
+    __slots__ = (
+        "id",
+        "inputs",
+        "vjp_fn",
+        "out_avals",
+        "n_outputs",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, inputs, vjp_fn, out_avals, name=""):
+        _node_counter[0] += 1
+        self.id = _node_counter[0]
+        self.inputs = inputs  # tuple of Tensor-or-None, aligned with vjp inputs
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals  # list of (shape, dtype) per output
+        self.n_outputs = len(out_avals)
+        self.name = name
+
+    def __repr__(self):
+        return f"TapeNode({self.name}, id={self.id})"
+
+
+def _zeros_like_aval(aval):
+    shape, dtype = aval
+    if np.issubdtype(np.dtype(dtype), np.integer) or np.dtype(dtype) == np.bool_:
+        # Integer/bool outputs take float0 cotangents in jax.
+        return np.zeros(shape, dtype=jax.dtypes.float0)
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, dtype=dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from `tensors` (paddle.autograd.backward).
+
+    Walks TapeNodes in decreasing id (a reverse topological order),
+    calling each node's vjp closure once with the accumulated output
+    cotangents, scattering the results into input tensors' `.grad` (leaves)
+    or pending cotangent buffers (interior nodes) — the reference's
+    ready-queue/GradTensorHolder dance (SURVEY.md §3.2).
+    """
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # node id -> {out_idx: cotangent}
+    pending: dict = {}
+    # heap over node ids for reverse-topological drain
+    import heapq
+
+    heap: List[int] = []
+    nodes: dict = {}
+
+    def _seed(t: "Tensor", g):
+        node = t._tape_node
+        if node is None:
+            # leaf with requires-grad: paddle seeds grad directly (scalar -> 1)
+            if not t.stop_gradient:
+                if g is None:
+                    g = jnp.ones(t._data.shape, dtype=t._data.dtype)
+                elif hasattr(g, "_data"):
+                    g = g._data
+                _accumulate_leaf(t, g)
+            return
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t.shape)}"
+                )
+            g = jnp.ones(t._data.shape, dtype=t._data.dtype)
+        elif isinstance(g, Tensor):
+            g = g._data
+        _accumulate_into_node(node, t._tape_out_idx, g)
+
+    def _accumulate_into_node(node: TapeNode, out_idx: int, cot):
+        if node.id not in pending:
+            pending[node.id] = {}
+            nodes[node.id] = node
+            heapq.heappush(heap, -node.id)
+        slot = pending[node.id]
+        if out_idx in slot:
+            slot[out_idx] = slot[out_idx] + cot
+        else:
+            slot[out_idx] = cot
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._tape_node is None:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True and no "
+                "recorded graph"
+            )
+        _seed(t, g)
+
+    while heap:
+        nid = -heapq.heappop(heap)
+        node = nodes.pop(nid)
+        slots = pending.pop(nid)
+        cotangents = []
+        for i in range(node.n_outputs):
+            if i in slots:
+                cotangents.append(slots[i])
+            else:
+                cotangents.append(_zeros_like_aval(node.out_avals[i]))
+        cots = tuple(cotangents) if node.n_outputs > 1 else cotangents[0]
+        in_grads = node.vjp_fn(cots)
+        for ref, g in zip(node.inputs, in_grads):
+            if ref is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if ref.stop_gradient:
+                continue
+            inp = ref.tensor
+            # tensor-level hooks fire as the grad flows through (ref:
+            # Tensor.register_hook semantics)
+            for hook in inp._grad_hooks:
+                out = hook(_wrap_grad(inp, g))
+                if out is not None:
+                    g = out._data if hasattr(out, "_data") else out
+            if ref.node is not None:
+                _accumulate_into_node(ref.node, ref.out_idx, g)
+            else:
+                _accumulate_leaf(inp, g)
+            if inp._retain_grads and ref.node is not None:
+                _accumulate_leaf(inp, g)
+        if not retain_graph:
+            node.vjp_fn = _used_up
+
+    return None
+
+
+def _used_up(*a, **k):  # pragma: no cover
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "pass retain_graph=True if this is intended."
+    )
+
+
+def _wrap_grad(like, g):
+    from ..tensor import Tensor
+
+    return Tensor(g, stop_gradient=True)
+
+
+def _accumulate_leaf(t, g):
+    from ..tensor import Tensor
+
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad: gradients of outputs w.r.t. inputs, returned (not stored).
+
+    Implemented by running the tape walk but collecting into a side dict for
+    `inputs` instead of `.grad`. `create_graph=True` (higher-order eager
+    grads) is not implemented yet — raise rather than silently return a
+    disconnected graph; under jit, higher-order derivatives are available
+    through jax.grad composition.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported "
+            "yet; compose jax-level grads via the jit path instead"
+        )
+    from ..tensor import Tensor
+
+    single_out = isinstance(outputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    # Temporarily stash/clear .grad of inputs, run backward, collect, restore.
+    saved = [t.grad for t in inputs]
+    saved_retain = [t._retain_grads for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+    try:
+        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears to not have "
+                        "been used in the graph; set allow_unused=True to allow."
+                    )
+                results.append(None)
+            else:
+                g = t.grad
+                g.stop_gradient = not create_graph
+                results.append(g)
+    finally:
+        for t, s, r in zip(inputs, saved, saved_retain):
+            t.grad = s
+            t._retain_grads = r
+    return results[0] if single_in else results

@@ -1,0 +1,64 @@
+"""paddle.distributed (SURVEY.md §2.2 L7): collectives, fleet, mesh,
+parallel wrappers, launch, sharding, checkpoint."""
+from . import collective  # noqa: F401
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from . import mesh  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .env import get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh,
+    get_mesh,
+    init_mesh,
+    named_sharding,
+    set_mesh,
+)
+from .parallel import DataParallel  # noqa: F401
+from .sharding_utils import get_param_spec, mark_sharding, shard_tensor  # noqa: F401
+
+
+def is_initialized():
+    return env.is_initialized()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-host TPU: one process drives all chips; spawn runs func once.
+    Multi-host: use paddle.distributed.launch."""
+    func(*args)
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    @property
+    def world_size(self):
+        return env.get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def local_rank(self):
+        return env.get_rank()
+
+    @property
+    def nranks(self):
+        return env.get_world_size()

@@ -1,0 +1,168 @@
+"""Collective API (reference: python/paddle/distributed/communication —
+SURVEY.md §2.2 "Collective py API", §5 mapping table):
+
+    c_allreduce_sum -> lax.psum        c_allgather  -> lax.all_gather
+    c_reducescatter -> lax.psum_scatter send/recv    -> lax.ppermute
+    alltoall        -> lax.all_to_all   broadcast    -> convert + psum trick
+
+Eager semantics: each call runs a small shard_map'd program over the global
+mesh axis named by `group` ("dp"/"tp"/...; None = all axes). Tensors passed
+in are treated as *per-rank shards stacked on axis 0* when they carry a
+leading mesh dimension, matching the reference's one-process-per-rank view;
+in the common single-process case (world=1) every collective is an identity
+— the real use is inside jit where these lower to ICI collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, as_array
+from . import mesh as _mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axes_for_group(group):
+    m = _mesh.get_mesh(optional=True)
+    if m is None:
+        return None
+    if group is None:
+        return tuple(m.axis_names)
+    if isinstance(group, str):
+        return (group,) if group in m.axis_names else None
+    return None
+
+
+def _world(axes):
+    if axes is None:
+        return 1
+    m = _mesh.get_mesh()
+    return int(np.prod([m.shape[a] for a in axes]))
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all_reduce (eager identity at world=1; psum under jit)."""
+    axes = _axes_for_group(group)
+    if _world(axes) == 1:
+        if jax.core.trace_state_clean():
+            return tensor
+    a = as_array(tensor)
+    if not jax.core.trace_state_clean():
+        # inside a jit/shard_map trace: emit the collective directly
+        reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                   "min": jax.lax.pmin, "avg": jax.lax.pmean}[op]
+        tensor._rebind(reducer(a, axes))
+        return tensor
+    # eager multi-device: run a tiny shard_map program
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    m = _mesh.get_mesh()
+    reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+               "min": jax.lax.pmin, "avg": jax.lax.pmean}[op]
+    fn = shard_map(lambda x: reducer(x, axes), mesh=m,
+                   in_specs=P(), out_specs=P())
+    tensor._rebind(fn(a))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axes = _axes_for_group(group)
+    if _world(axes) == 1:
+        tensor_list.append(Tensor(as_array(tensor)))
+        return tensor_list
+    raise NotImplementedError(
+        "eager multi-rank all_gather: use the jit path (sharding constraints)"
+    )
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._rebind(as_array(tensor_list[src]))
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axes = _axes_for_group(group)
+    if _world(axes) == 1:
+        tensor._rebind(as_array(tensor_list[0]))
+        return tensor
+    raise NotImplementedError("eager multi-rank reduce_scatter: jit path only")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if out_tensor_list is None:
+        out_tensor_list = []
+    out_tensor_list.extend(Tensor(as_array(t)) for t in in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point eager send: multi-host eager is jit-path-only "
+        "(SURVEY.md §7 hard part #5); PP uses ppermute inside the compiled "
+        "schedule"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError("see send()")
+
+
+def barrier(group=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    return None
+
+
+def get_group(id=0):
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    as_array(tensor).block_until_ready()
+
+
+# jit-path collectives (used inside shard_map'd/pjit'd programs)
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather_jit(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def psum_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all_jit(x, axis_name, split_axis, concat_axis, tiled=True):
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
+                              tiled=tiled)

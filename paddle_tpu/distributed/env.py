@@ -1,0 +1,65 @@
+"""Process environment (reference: env-var contract of
+paddle.distributed.launch — PADDLE_TRAINER_ID etc., SURVEY.md §3.5).
+
+On TPU, multi-host process identity comes from jax.distributed /
+jax.process_index(); the PADDLE_* env vars are honored when present so
+launch-style scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env parity.
+
+    Single-host: no-op (one process sees all local devices).
+    Multi-host: jax.distributed.initialize from env
+    (MASTER_ADDR/PADDLE_MASTER or coordinator discovery).
+    """
+    global _initialized
+    if _initialized:
+        return
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n_procs > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
+            "MASTER_ADDR")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if coordinator:
+            port = os.environ.get("MASTER_PORT", "8476")
+            addr = coordinator if ":" in coordinator else f"{coordinator}:{port}"
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=n_procs,
+                process_id=rank,
+            )
+    _initialized = True
+
+
+def get_rank():
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    return jax.process_index()
+
+
+def get_world_size():
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    # data-parallel world size = number of mesh 'dp' slots if a mesh is live,
+    # else process count (1 on single host even with many chips: collectives
+    # under jit span local devices transparently)
+    from . import mesh as _mesh
+
+    m = _mesh.get_mesh(optional=True)
+    if m is not None and "dp" in m.axis_names:
+        return int(m.shape["dp"])
+    return jax.process_count()
+
+
+def is_initialized():
+    return _initialized

@@ -1,0 +1,15 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet — SURVEY.md §2.2
+"Fleet facade"): fleet.init / distributed_model / distributed_optimizer /
+DistributedStrategy, over the single global Mesh."""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet,
+    distributed_model,
+    distributed_optimizer,
+    fleet,
+    get_hybrid_communicate_group,
+    init,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

@@ -1,0 +1,56 @@
+"""DistributedStrategy (reference: the protobuf-backed strategy object,
+distributed_strategy.proto — SURVEY.md §5 "Config / flag system"). Here a
+plain typed config object with the same toggle names."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (reference: strategy.hybrid_configs)
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 65536.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "sharding_degree": 1,
+            "stage": 1,
+        }
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {
+            "tensor_parallel_degree": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.localsgd = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __repr__(self):
+        keys = ("hybrid_configs", "amp", "recompute", "sharding", "pipeline")
+        return "DistributedStrategy(" + ", ".join(
+            f"{k}={getattr(self, k)}" for k in keys) + ")"

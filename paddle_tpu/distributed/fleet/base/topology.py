@@ -1,0 +1,3 @@
+"""Topology re-export (the implementation lives in distributed.mesh — the
+mesh IS the topology; SURVEY.md §2.2 "Topology / HybridCommunicateGroup")."""
+from ...mesh import CommunicateTopology, HybridCommunicateGroup  # noqa: F401

@@ -1,0 +1,108 @@
+"""fleet singleton (reference: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init builds the global Mesh from strategy.hybrid_configs (the analog
+of HybridCommunicateGroup construction in §3.4), distributed_model wraps the
+network per parallel mode, distributed_optimizer attaches hybrid grad sync +
+sharding.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import nn as _nn
+from .. import env as _env
+from .. import mesh as _mesh
+from ..parallel import DataParallel
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dp = int(hc.get("dp_degree", 1))
+        tp = int(hc.get("mp_degree", 1))
+        pp = int(hc.get("pp_degree", 1))
+        sharding = int(hc.get("sharding_degree", 1))
+        sep = int(hc.get("sep_degree", 1))
+        _env.init_parallel_env()
+        _mesh.init_mesh(dp=dp, tp=tp, pp=pp, sharding=sharding, sep=sep)
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "model"), (dp, pp, sharding, tp)
+        )
+        self._hcg = HybridCommunicateGroup(topo, _mesh.get_mesh())
+        self._is_initialized = True
+        return self
+
+    @property
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        strategy = self._strategy or DistributedStrategy()
+        hc = strategy.hybrid_configs
+        pp = int(hc.get("pp_degree", 1))
+        tp = int(hc.get("mp_degree", 1))
+        if pp > 1:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, self._hcg, strategy)
+        if tp > 1:
+            from .meta_parallel.tensor_parallel import TensorParallel
+
+            return TensorParallel(model, self._hcg, strategy)
+        if int(hc.get("dp_degree", 1)) > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        strat = strategy or self._strategy or DistributedStrategy()
+        hc = strat.hybrid_configs
+        sharding_degree = int(hc.get("sharding_degree", 1))
+        if sharding_degree > 1:
+            from .meta_parallel.sharding.sharding_optimizer import (
+                DygraphShardingOptimizer,
+            )
+
+            return DygraphShardingOptimizer(optimizer, self._hcg)
+        from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, self._hcg, strat)
+
+    # checkpoint helpers (sharded save/load — SURVEY.md §5)
+    def save(self, dirname, **configs):
+        raise NotImplementedError("use distributed.checkpoint.save")
+
+    def load_model(self, path, mode=0):
+        raise NotImplementedError("use distributed.checkpoint.load")
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()

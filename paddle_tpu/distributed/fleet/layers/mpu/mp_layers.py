@@ -1,0 +1,140 @@
+"""Megatron-style TP layers (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py — SURVEY.md §2.3
+"TP").
+
+TPU-native (SURVEY.md §7 phase 6): weights are created FULL-SIZE with
+sharding specs on the `tp` mesh axis; under jit, GSPMD partitions the matmul
+and inserts the identity-fwd/allreduce-bwd collectives the reference
+implements by hand (_c_identity/_mp_allreduce). This keeps the layer API and
+checkpoint shapes identical to the reference while letting XLA schedule the
+comms. ParallelCrossEntropy uses an explicit shard_map (the reference's
+c_softmax_with_cross_entropy).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..... import nn as _nn
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer_base import Layer
+from .....tensor import Tensor, _apply_op, as_array
+from .... import mesh as _mesh
+from ....sharding_utils import mark_sharding, shard_tensor
+
+
+class ColumnParallelLinear(Layer):
+    """Y = XW, W sharded on columns over 'tp'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self._out_features = out_features
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        mark_sharding(self.weight, None, "tp")
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+            mark_sharding(self.bias, "tp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = shard_tensor(out, None, None, None)  # replicated
+        else:
+            out = shard_tensor(out, None, None, "tp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Y = XW, W sharded on rows over 'tp'; forward ends with the tp
+    allreduce (GSPMD inserts it from the contraction over a sharded dim)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        mark_sharding(self.weight, "tp", None)
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features],
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_tensor(x, None, None, "tp")
+        out = F.linear(x, self.weight, self.bias)
+        return shard_tensor(out, None, None, None)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with vocab dim sharded over 'tp' (reference:
+    c_embedding_op — out-of-range ids contribute zeros, psum combines)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        mark_sharding(self.weight, "tp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return shard_tensor(out, None, None, None)
+
+
+class ParallelCrossEntropy(Layer):
+    """TP-sharded softmax CE (reference: c_softmax_with_cross_entropy_op).
+
+    Under jit with a tp-sharded logits tensor, the shard_map computes local
+    max/sum and psums them — the exact algorithm of the reference kernel; at tp=1
+    it reduces to plain CE.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        tp = _mesh.axis_size("tp")
+        if tp <= 1 or jax.core.trace_state_clean():
+            loss = F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+            from .....ops.manipulation import unsqueeze
+
+            return unsqueeze(loss, -1)
+        # inside jit with tp>1: explicit stable parallel CE
+        def f(logits, lab):
+            lmax = jax.lax.pmax(jnp.max(logits, axis=-1, keepdims=True), "tp")
+            shifted = logits - lmax
+            sumexp = jax.lax.psum(
+                jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True), "tp")
+            logz = jnp.log(sumexp)
+            vocab_shard = logits.shape[-1]
+            rank = jax.lax.axis_index("tp")
+            lo = rank * vocab_shard
+            local = lab - lo
+            in_range = (local >= 0) & (local < vocab_shard)
+            safe = jnp.clip(local, 0, vocab_shard - 1)
+            picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+            picked = jnp.where(in_range[..., None], picked, 0.0)
+            picked = jax.lax.psum(picked, "tp")
+            return logz - picked
+
+        return _apply_op(f, input, label, _name="parallel_cross_entropy")

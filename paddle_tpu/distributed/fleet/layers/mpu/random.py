@@ -1,0 +1,25 @@
+"""TP RNG tracker (reference: fleet/layers/mpu/random.py) — implementation
+lives in framework.random (SURVEY.md §7 hard part #4)."""
+from .....framework.random import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+
+
+def determinate_seed(rng_name):
+    from .....framework import random as _r
+
+    return _r.get_seed()
+
+
+def dropout(x, p=0.5, axis=None, rng_name="local_seed", training=True,
+            mode="upscale_in_train", name=None):
+    """Dropout drawing keys from a named tracker state (per-TP-rank seeds)."""
+    from .....nn import functional as F
+
+    tracker = get_rng_state_tracker()
+    if rng_name in tracker.states_:
+        with tracker.rng_state(rng_name):
+            return F.dropout(x, p=p, axis=axis, training=training, mode=mode)
+    return F.dropout(x, p=p, axis=axis, training=training, mode=mode)

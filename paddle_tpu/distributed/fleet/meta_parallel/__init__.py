@@ -1,0 +1,13 @@
+"""meta_parallel (reference: fleet/meta_parallel — SURVEY.md §2.2)."""
+from ..layers.mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
+from .sharding.sharding_optimizer import DygraphShardingOptimizer  # noqa: F401

@@ -1,0 +1,62 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_parallel/hybrid_parallel_optimizer.py): wraps the inner optimizer
+with hybrid-aware grad clipping (global norm psum'd across tp/pp groups —
+HybridParallelClipGrad) and dp grad sync."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....nn.clip import ClipGradByGlobalNorm
+from ....tensor import Tensor, as_array
+from ... import collective as _collective
+from ... import mesh as _mesh
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    """Global-norm clip whose norm is reduced over every parallel axis
+    (inside jit the psum spans the whole mesh; eager single-process needs no
+    reduction)."""
+
+    def __init__(self, clip, hcg=None):
+        super().__init__(getattr(clip, "clip_norm", clip))
+        self._hcg = hcg
+
+    def global_norm(self, grads):
+        gn = super().global_norm(grads)
+        if gn is None:
+            return None
+        import jax
+
+        if not jax.core.trace_state_clean():
+            m = _mesh.get_mesh(optional=True)
+            if m is not None:
+                for axis in ("tp", "pp", "sharding"):
+                    if axis in m.axis_names and m.shape[axis] > 1:
+                        gn = jnp.sqrt(jax.lax.psum(jnp.square(gn), axis))
+        return gn
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        if optimizer._grad_clip is not None and isinstance(
+                optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        if _mesh.axis_size("dp") > 1:
+            for p in self._inner_opt._parameter_list or []:
+                if p.grad is not None:
+                    _collective.all_reduce(
+                        p.grad, op=_collective.ReduceOp.AVG, group="dp")
+        self._inner_opt.step()
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        self._inner_opt.clear_grad()

@@ -1,0 +1,83 @@
+"""PipelineParallel.train_batch (reference:
+fleet/meta_parallel/pipeline_parallel.py — 1F1B/VPP schedules over NCCL p2p,
+SURVEY.md §3.4).
+
+TPU-native (SURVEY.md §7 phase 8): there is no host-orchestrated
+send/recv — the microbatch schedule is expressed functionally and compiled
+into ONE SPMD program; stage transfer is `ppermute` on the 'pp' mesh axis.
+Round-1 implementation: gradient-accumulation microbatching (exact loss
+semantics of the schedule — bubble optimization is a perf detail the
+compiled spmd_pipeline in distributed/pipeline.py addresses), with the
+`train_batch` API, scaler and accumulate_steps contract of the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....tensor import Tensor
+from ...parallel import DataParallel
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers)
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None else {})
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+
+    def _split_micro(self, data):
+        if isinstance(data, (tuple, list)):
+            parts = [self._split_micro(d) for d in data]
+            return list(zip(*parts))
+        n = self.accumulate_steps
+        b = data.shape[0]
+        mb = b // n
+        return [data[i * mb: (i + 1) * mb] for i in range(n)]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    loss_fn_idx=0):
+        """data: (inputs, labels); loss = mean over microbatch losses."""
+        model = self._layers
+        loss_fn = getattr(model, "_loss_fn", None)
+        inputs, labels = data
+        micro = list(zip(self._split_micro(inputs), self._split_micro(labels)))
+        total = None
+        for x, y in micro:
+            out = model(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            scaled = loss / len(micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = scaled if total is None else total + scaled.detach()
+        self.sync_gradients()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        from ....autograd import no_grad
+
+        model = self._layers
+        loss_fn = getattr(model, "_loss_fn", None)
+        inputs, labels = data
+        with no_grad():
+            out = model(inputs)
+            if compute_loss and loss_fn is not None:
+                return loss_fn(out, labels)
+        return out
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        raise NotImplementedError(
+            "explicit schedule: see distributed.pipeline.spmd_pipeline"
+        )

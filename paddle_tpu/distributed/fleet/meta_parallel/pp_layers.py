@@ -1,0 +1,120 @@
+"""PipelineLayer (reference: fleet/meta_parallel/parallel_layers/pp_layers.py
+— SURVEY.md §2.2 "PP"): LayerDesc-based layer list with stage partitioning
+(uniform / layer:N seg methods) and SharedLayerDesc for tied embeddings.
+
+TPU-native: partitioning assigns each segment a pp-stage id; the SPMD
+pipeline schedule (pipeline_parallel.py) runs stages inside one jitted
+program, so every process builds ALL stages (weights are pp-sharded arrays,
+not per-process modules)."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.container import LayerList
+from ....nn.layer_base import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+        self.descs = list(layers)
+        self._shared_layers = {}
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    layer = self._shared_layers[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared_layers[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = built
+        self._layer_list = LayerList(
+            [l for l, _ in built if isinstance(l, Layer)])
+        self._segments = self._partition(len(built), self._num_stages)
+
+    def _partition(self, n, stages) -> List[int]:
+        """Return stage id per layer index."""
+        if self._seg_method.startswith("layer:"):
+            name = self._seg_method.split(":", 1)[1]
+            marks = [
+                i for i, (l, _) in enumerate(self.run_function)
+                if type(l).__name__ == name
+            ]
+            if len(marks) >= stages:
+                per = len(marks) // stages
+                bounds = [marks[i * per] for i in range(stages)] + [n]
+                bounds[0] = 0
+            else:
+                bounds = np.linspace(0, n, stages + 1).astype(int).tolist()
+        else:
+            bounds = np.linspace(0, n, stages + 1).astype(int).tolist()
+        seg = []
+        for i in range(n):
+            for s in range(stages):
+                if bounds[s] <= i < bounds[s + 1]:
+                    seg.append(s)
+                    break
+        return seg
+
+    def get_stage_layers(self, stage_id):
+        return [
+            self.run_function[i]
+            for i in range(len(self.run_function))
+            if self._segments[i] == stage_id
+        ]
+
+    def forward(self, x):
+        for fn, fwd in self.run_function:
+            if fwd is not None:
+                x = fwd(fn, x)
+            else:
+                x = fn(x)
+        return x
+
+    @property
+    def parameters_by_stage(self):
+        out = {}
+        for i, (l, _) in enumerate(self.run_function):
+            if isinstance(l, Layer):
+                out.setdefault(self._segments[i], []).extend(l.parameters())
+        return out

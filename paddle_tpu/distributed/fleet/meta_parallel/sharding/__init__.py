@@ -1,0 +1,5 @@
+from .sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    group_sharded_parallel,
+    shard_spec_for,
+)

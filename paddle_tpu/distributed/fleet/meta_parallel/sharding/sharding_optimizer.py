@@ -1,0 +1,93 @@
+"""ZeRO sharding stages (reference:
+fleet/meta_parallel/sharding/{group_sharded_*} + DygraphShardingOptimizer —
+SURVEY.md §2.3 "Sharding/ZeRO 1–3").
+
+TPU-native (SURVEY.md §7 phase 7): under GSPMD, stage 1/2 are *sharding
+specs*, not runtime machinery — optimizer-state (S1) and gradients (S2) get
+PartitionSpec('sharding'-major flattening over the dp/sharding axis) inside
+the jitted train step; XLA emits reduce_scatter for grads and all_gather for
+the updated params, the exact comm pattern the reference hand-codes. Stage 3
+additionally shards the parameters themselves, gathering on use.
+
+This module provides:
+- DygraphShardingOptimizer: eager API-parity wrapper (single-process: exact
+  optimizer semantics; state sharded lazily under jit);
+- shard_spec_for(): spec chooser used by the pjit train step to lay out
+  param/grad/opt-state pytrees per stage.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .....tensor import Tensor
+from .... import mesh as _mesh
+
+
+def shard_spec_for(array_shape, stage: int, axis="sharding"):
+    """Choose the PartitionSpec for an optimizer-state/grad/param leaf.
+
+    Shards the largest dim divisible by the axis size; replicates scalars
+    and indivisible shapes (same fallback the reference uses for odd
+    shapes)."""
+    size = _mesh.axis_size(axis)
+    if size <= 1 or not array_shape:
+        return tuple([None] * len(array_shape))
+    dims = list(array_shape)
+    order = sorted(range(len(dims)), key=lambda i: -dims[i])
+    for i in order:
+        if dims[i] % size == 0:
+            spec = [None] * len(dims)
+            spec[i] = axis
+            return tuple(spec)
+    return tuple([None] * len(dims))
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 optimizer (reference: DygraphShardingOptimizer): each rank
+    owns a param-group slice of the optimizer states. Single-mesh variant:
+    `step()` delegates to the inner optimizer (numerics identical); the
+    sharded layout materializes when the step runs under pjit via
+    shard_spec_for."""
+
+    def __init__(self, optimizer, hcg=None, stage=1):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self.stage = stage
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        from ...meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+
+        if _mesh.axis_size("dp") > 1 or _mesh.axis_size("sharding") > 1:
+            from .... import collective as _collective
+
+            for p in self._inner_opt._parameter_list or []:
+                if p.grad is not None:
+                    _collective.all_reduce(
+                        p.grad, op=_collective.ReduceOp.AVG, group="dp")
+        self._inner_opt.step()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self._inner_opt.clear_grad()
+
+    def state_spec_pytree(self, params):
+        """name -> state-field -> PartitionSpec for pjit layout."""
+        specs = {}
+        for n, a in params.items():
+            specs[n] = shard_spec_for(tuple(a.shape), self.stage)
+        return specs
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False):
+    """reference: paddle.distributed.sharding.group_sharded_parallel.
+    level: 'os' (S1) | 'os_g' (S2) | 'p_g_os' (S3)."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    sharded_opt = DygraphShardingOptimizer(optimizer, stage=stage)
+    return model, sharded_opt, scaler

@@ -1,0 +1,16 @@
+"""TensorParallel wrapper (reference:
+fleet/meta_parallel/tensor_parallel.py): in the mesh design, TP layers carry
+their own sharding specs, so the wrapper's job is (a) broadcast-equivalent
+init determinism — all ranks share one process or one seed, (b) dp grad
+sync on backward (handled with the dp axis like DataParallel)."""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+from ...parallel import DataParallel
+
+
+class TensorParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers)
+        self._hcg = hcg
+        self._strategy = strategy

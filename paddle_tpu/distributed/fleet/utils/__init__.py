@@ -1,0 +1,11 @@
+"""Fleet utils (reference: fleet/utils — SURVEY.md §2.2 "Fleet utils")."""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .sequence_parallel_utils import (  # noqa: F401
+    AllGatherOp,
+    ColumnSequenceParallelLinear,
+    GatherOp,
+    ReduceScatterOp,
+    RowSequenceParallelLinear,
+    ScatterOp,
+    mark_as_sequence_parallel_parameter,
+)

@@ -1,0 +1,66 @@
+"""Activation recompute (reference: fleet/utils/recompute RecomputeFunction
+— SURVEY.md §2.2 "Fleet utils"). TPU-native: `jax.checkpoint`
+(rematerialization) — under jit XLA recomputes the segment in backward,
+trading FLOPs for HBM exactly as the reference's RecomputeFunction replays
+forward. Eager mode: runs the function through one taped op whose vjp
+replays the forward under jax.vjp (identical semantics)."""
+from __future__ import annotations
+
+import jax
+
+from ....tensor import Tensor, _apply_op, as_array
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    def f(*arrays):
+        it = iter(arrays)
+        call_args = [
+            Tensor(next(it)) if isinstance(a, Tensor) else a for a in args
+        ]
+        ck = jax.checkpoint(
+            lambda *arrs: _run(function, args, arrs, kwargs)
+        )
+        return ck(*arrays)
+
+    return _apply_op(f, *tensor_args, _name="recompute")
+
+
+def _run(function, template_args, arrays, kwargs):
+    it = iter(arrays)
+    call_args = [
+        Tensor(next(it)) if isinstance(a, Tensor) else a for a in template_args
+    ]
+    out = function(*call_args, **kwargs)
+    if isinstance(out, (tuple, list)):
+        return tuple(as_array(o) for o in out)
+    return as_array(out)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — segment a Sequential and recompute
+    each segment."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+
+    def seg_fn(seg):
+        def run(inp):
+            out = inp
+            for l in seg:
+                out = l(out)
+            return out
+
+        return run
+
+    i = 0
+    while i < n:
+        seg = layers[i: i + per]
+        x = recompute(seg_fn(seg), x, **kwargs)
+        i += per
+    return x

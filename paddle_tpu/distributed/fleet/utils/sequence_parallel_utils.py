@@ -1,0 +1,137 @@
+"""Sequence parallelism (reference:
+fleet/utils/sequence_parallel_utils.py — SURVEY.md §2.3 "SP", §5
+"Long-context"): Megatron-SP scatter/gather ops converting TP allreduces
+into reduce_scatter/all_gather pairs on the sequence dim.
+
+TPU-native: ScatterOp/GatherOp are sharding-constraint flips on the seq dim
+('sp'/'tp' axis) — GSPMD then emits exactly the reduce_scatter/all_gather
+pair. The explicit collective forms (AllGatherOp/ReduceScatterOp) are kept
+for shard_map code."""
+from __future__ import annotations
+
+import jax
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer_base import Layer
+from ....tensor import Tensor, _apply_op
+from ... import mesh as _mesh
+from ...sharding_utils import mark_sharding, shard_tensor
+
+
+def _sp_axis():
+    m = _mesh.get_mesh(optional=True)
+    if m is None:
+        return None
+    for name in ("sp", "sep", "tp"):
+        if name in m.axis_names and m.shape[name] > 1:
+            return name
+    return None
+
+
+class ScatterOp:
+    """Shard activations along seq dim (fwd scatter, bwd gather)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _sp_axis()
+        if ax is None:
+            return x
+        spec = [None] * len(x.shape)
+        spec[axis] = ax
+        return shard_tensor(x, *spec)
+
+
+class GatherOp:
+    """Gather activations along seq dim (fwd all_gather, bwd scatter)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _sp_axis()
+        if ax is None:
+            return x
+        spec = [None] * len(x.shape)
+        return shard_tensor(x, *spec)
+
+
+class AllGatherOp:
+    """Explicit all_gather for shard_map bodies (fwd ag, bwd rs)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _sp_axis()
+        if ax is None or jax.core.trace_state_clean():
+            return x
+        return _apply_op(
+            lambda a: jax.lax.all_gather(a, ax, axis=axis, tiled=True), x,
+            _name="sp_all_gather",
+        )
+
+
+class ReduceScatterOp:
+    """Explicit reduce_scatter (fwd rs, bwd ag)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax = _sp_axis()
+        if ax is None or jax.core.trace_state_clean():
+            return x
+        return _apply_op(
+            lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=axis,
+                                           tiled=True), x,
+            _name="sp_reduce_scatter",
+        )
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce
+                                               =False):
+    """In the mesh design, SP-parameter grad allreduce is emitted by GSPMD;
+    kept for API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column TP linear with seq-parallel input: all-gather seq -> matmul
+    (GSPMD derives the comm from the spec flip)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, None, "tp")
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        x = GatherOp.apply(x, axis=1)
+        out = F.linear(x, self.weight, self.bias)
+        return shard_tensor(out, None, None, "tp")
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row TP linear emitting seq-parallel output: matmul -> reduce-scatter
+    over seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, "tp", None)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return ScatterOp.apply(out, axis=1)

@@ -1,0 +1,219 @@
+"""The device mesh — the TPU-native HybridCommunicateGroup.
+
+Reference parity: python/paddle/distributed/fleet/base/topology.py
+(`CommunicateTopology`, `HybridCommunicateGroup` — SURVEY.md §2.2): a 4-D+
+process grid over (dp, pp, sharding, mp/tp [, sep]). Here the grid is ONE
+`jax.sharding.Mesh`; subgroup communicators disappear (collectives name a
+mesh axis), and topology-awareness becomes axis ordering: the fastest-varying
+axes (tp, sp) are placed innermost so they land on ICI neighbors; dp/pp are
+outermost (DCN-friendly across slices) — SURVEY.md §5 "Distributed
+communication backend".
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+# axis order: outermost (slowest-varying, DCN) -> innermost (fastest, ICI)
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "ep", "cp", "tp", "sp")
+
+
+def build_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sp: int = 1,
+               sharding: int = 1, ep: int = 1, cp: int = 1, sep: int = 1,
+               devices=None) -> Mesh:
+    """Build the hybrid mesh. Degrees with value 1 still get named axes so
+    sharding specs are stable across parallelism configs."""
+    sizes: Dict[str, int] = {
+        "pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "ep": ep,
+        "cp": cp, "tp": tp, "sp": sp,
+    }
+    axes = [a for a in AXIS_ORDER if sizes[a] > 1]
+    if not axes:
+        axes = ["dp"]
+    shape = [sizes[a] for a in axes]
+    devices = devices if devices is not None else np.asarray(jax.devices())
+    need = int(np.prod(shape))
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(axes, shape))} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    dev_grid = np.asarray(devices)[:need].reshape(shape)
+    return Mesh(dev_grid, axis_names=tuple(axes))
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+    return mesh
+
+
+def get_mesh(optional: bool = False) -> Optional[Mesh]:
+    if _global_mesh is None and not optional:
+        raise RuntimeError(
+            "no global mesh: call fleet.init / distributed.init_mesh first"
+        )
+    return _global_mesh
+
+
+def init_mesh(**degrees) -> Mesh:
+    return set_mesh(build_mesh(**degrees))
+
+
+def axis_size(name: str) -> int:
+    m = get_mesh(optional=True)
+    if m is None or name not in m.axis_names:
+        return 1
+    return int(m.shape[name])
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+class CommunicateTopology:
+    """Pure-arithmetic topology (reference parity; unit-testable without
+    processes — SURVEY.md §4.3 'fake-cluster mocks')."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world_size = int(np.prod(dims))
+        arr = np.arange(self._world_size).reshape(dims)
+        self._rank_to_coord = {}
+        self._coord_to_rank = {}
+        for coord in np.ndindex(*dims):
+            r = int(arr[coord])
+            self._rank_to_coord[r] = coord
+            self._coord_to_rank[coord] = r
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord_to_rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank_to_coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(
+            r for r, c in self._rank_to_coord.items() if c[axis] == index
+        )
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in np.ndindex(*other_dims):
+            group = []
+            for i in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, i)
+                group.append(self._coord_to_rank[tuple(coord)])
+            groups.append(group)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Reference-parity facade over the mesh + topology (fleet/base/topology
+    HybridCommunicateGroup). Rank queries work without real processes by
+    reading the mesh coordinates of the current process's position (rank 0
+    on single-host)."""
+
+    def __init__(self, topology: CommunicateTopology = None, mesh: Mesh = None):
+        from . import env as _env
+
+        self._topo = topology
+        self._mesh = mesh or get_mesh(optional=True)
+        self.global_rank = _env.get_rank()
+
+    def _axis(self, paddle_name):
+        return {"data": "dp", "pipe": "pp", "model": "tp",
+                "sharding": "sharding", "sep": "sep"}[paddle_name]
+
+    def _size(self, paddle_name):
+        if self._topo is not None:
+            return self._topo.get_dim(paddle_name)
+        return axis_size(self._axis(paddle_name))
+
+    def _rank_in(self, paddle_name):
+        if self._topo is not None:
+            coord = self._topo.get_coord(self.global_rank)
+            return coord[self._topo._parallel_names.index(paddle_name)]
+        return 0
+
+    # reference API surface
+    def get_data_parallel_world_size(self):
+        return self._size("data")
+
+    def get_data_parallel_rank(self):
+        return self._rank_in("data")
+
+    def get_model_parallel_world_size(self):
+        return self._size("model")
+
+    def get_model_parallel_rank(self):
+        return self._rank_in("model")
+
+    def get_pipe_parallel_world_size(self):
+        return self._size("pipe")
+
+    def get_stage_id(self):
+        return self._rank_in("pipe")
+
+    def get_sharding_parallel_world_size(self):
+        return self._size("sharding")
+
+    def get_sharding_parallel_rank(self):
+        return self._rank_in("sharding")
+
+    def get_parallel_mode(self):
+        if self._size("model") > 1 or self._size("pipe") > 1:
+            return "hybrid"
+        if self._size("sharding") > 1:
+            return "sharding"
+        return "data" if self._size("data") > 1 else "single"
+
+    # group handles are mesh-axis names in this framework
+    def get_data_parallel_group(self):
+        return "dp"
+
+    def get_model_parallel_group(self):
+        return "tp"
+
+    def get_pipe_parallel_group(self):
+        return "pp"
+
+    def get_sharding_parallel_group(self):
+        return "sharding"
+
+    def get_check_parallel_group(self, *a):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        if self._topo is None:
+            return stage_id
+        coord = list(self._topo.get_coord(self.global_rank))
+        coord[self._topo._parallel_names.index("pipe")] = stage_id
+        return self._topo._coord_to_rank[tuple(coord)]

@@ -1,0 +1,76 @@
+"""DataParallel + parallel helpers (reference:
+python/paddle/fluid/dygraph/parallel.py — SURVEY.md §2.2 "DP (dygraph)").
+
+TPU-native: no Reducer/bucketed-allreduce machinery — under jit the grads of
+a batch-sharded step are psum'd by XLA (compiler-overlapped with backward
+compute, the same overlap the reference gets from comm streams). The eager
+DataParallel wrapper keeps `no_sync`/API parity and performs grad psum after
+backward when a dp axis exists.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer_base import Layer
+from . import collective as _collective
+from . import env as _env
+from . import mesh as _mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._grad_sync_enabled = True
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        prev = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = prev
+
+    def sync_gradients(self):
+        """psum grads over the dp axis (called by optimizer pre-step or
+        manually; inside jit this lowers to one fused all-reduce)."""
+        if not self._grad_sync_enabled:
+            return
+        if _mesh.axis_size("dp") <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                _collective.all_reduce(p.grad, op=_collective.ReduceOp.AVG,
+                                       group="dp")
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        self.sync_gradients()
+
+
+def init_parallel_env():
+    _env.init_parallel_env()
+
+
+def get_rank(group=None):
+    return _env.get_rank()
+
+
+def get_world_size(group=None):
+    return _env.get_world_size()

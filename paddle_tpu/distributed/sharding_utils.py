@@ -1,0 +1,56 @@
+"""Sharding annotation helpers — the GSPMD interface (SURVEY.md §2.3
+"Auto parallel": jax sharding propagation IS the reference's
+DistAttr/ProcessMesh completion engine)."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..tensor import Tensor, as_array
+from . import mesh as _mesh
+
+
+def shard_tensor(x, *spec):
+    """Annotate a tensor with a PartitionSpec over the global mesh.
+
+    Under jit tracing: emits with_sharding_constraint (GSPMD propagates).
+    Eager with a live mesh: device_put to the NamedSharding.
+    No mesh: no-op. Spec entries name mesh axes or None.
+    """
+    m = _mesh.get_mesh(optional=True)
+    if m is None:
+        return x
+    # drop axis names the current mesh doesn't have (degree-1 configs)
+    clean = []
+    for s in spec:
+        if s is None:
+            clean.append(None)
+        elif isinstance(s, (tuple, list)):
+            keep = tuple(a for a in s if a in m.axis_names)
+            clean.append(keep if keep else None)
+        else:
+            clean.append(s if s in m.axis_names else None)
+    pspec = PartitionSpec(*clean)
+    a = as_array(x)
+    if not jax.core.trace_state_clean():
+        out = jax.lax.with_sharding_constraint(a, NamedSharding(m, pspec))
+    else:
+        out = jax.device_put(a, NamedSharding(m, pspec))
+    if isinstance(x, Tensor):
+        x._rebind(out, x._tape_node, x._tape_out_idx)
+        return x
+    return out
+
+
+def mark_sharding(param, *spec):
+    """Record the intended spec on a parameter; applied by the pjit train
+    step when laying out the weight pytree."""
+    param.sharding_spec = tuple(spec)
+    m = _mesh.get_mesh(optional=True)
+    if m is not None and jax.core.trace_state_clean():
+        shard_tensor(param, *spec)
+    return param
+
+
+def get_param_spec(param):
+    return getattr(param, "sharding_spec", None)

@@ -1,0 +1,20 @@
+"""AMP thread-global state consulted by the op-apply layer (the analog of the
+reference's AMP op white/black lists in python/paddle/amp/amp_lists.py)."""
+import numpy as np
+
+enabled = False
+amp_dtype = None
+level = "O1"
+
+# ops whose inputs are cast down (MXU-bound ops)
+white_list = {
+    "matmul", "bmm", "mm", "linear", "conv1d", "conv2d", "conv3d", "einsum",
+    "sdpa", "flash_attention", "addmm", "mv",
+}
+# ops kept in f32 for numerics
+black_list = {
+    "exp", "log", "pow", "square", "sqrt", "rsqrt", "softmax", "log_softmax",
+    "cross_entropy", "bce_with_logits", "mean", "sum", "var", "std", "norm",
+    "layer_norm", "batch_norm", "rms_norm", "logsumexp", "erf", "erfinv",
+    "cumsum", "prod",
+}

@@ -1,0 +1,97 @@
+"""Global framework configuration: default dtype, flags registry.
+
+Reference parity: paddle's gflags `FLAGS_*` registry settable via env and
+`paddle.set_flags` (ref: paddle/phi/core/flags.cc era registry; SURVEY.md §5
+"Config / flag system"). Here: one typed in-process registry seeded from
+`FLAGS_*` environment variables at import.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, type_, help_):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.help = help_
+        env = os.environ.get(name)
+        if env is not None:
+            self.value = _parse(env, type_)
+        else:
+            self.value = default
+
+
+def _parse(text: str, type_):
+    if type_ is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    return type_(text)
+
+
+_FLAGS: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = "", type_=None):
+    with _lock:
+        if name in _FLAGS:
+            return _FLAGS[name]
+        f = _Flag(name, default, type_ or type(default), help_)
+        _FLAGS[name] = f
+        return f
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _FLAGS[n].value for n in names if n in _FLAGS}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            define_flag(k, v)
+        else:
+            _FLAGS[k].value = _parse(v, _FLAGS[k].type) if isinstance(v, str) else v
+
+
+def get_flag(name: str, default=None):
+    f = _FLAGS.get(name)
+    return f.value if f is not None else default
+
+
+# Core flags mirroring the reference set (SURVEY.md §5).
+define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf.")
+define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
+define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")
+
+
+# ---------------------------------------------------------------------------
+# Default dtype (paddle.get_default_dtype / set_default_dtype)
+# ---------------------------------------------------------------------------
+_default_dtype_name = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype_name
+    from . import dtype as dtype_mod
+
+    nd = dtype_mod.to_np_dtype(d)
+    _default_dtype_name = dtype_mod.from_np_dtype(nd).name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype_name
+
+
+def get_default_dtype_obj():
+    from . import dtype as dtype_mod
+
+    return dtype_mod.DType._registry[_default_dtype_name]

@@ -1,0 +1,155 @@
+"""Dtype system: paddle-style dtype objects over jax/numpy dtypes.
+
+Reference parity: paddle exposes ``paddle.float32`` etc. and a
+``VarType``-based dtype on tensors (ref: paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py — paths per SURVEY.md, unverified).
+Here a ``DType`` is a thin comparable wrapper over a numpy dtype so that
+``x.dtype == paddle.float32``, ``== 'float32'`` and ``== np.float32`` all work.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # bfloat16 numpy dtype comes from ml_dtypes (a jax dependency)
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _FP8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _FP8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    _BFLOAT16 = np.dtype(np.float32)
+    _FP8_E4M3 = None
+    _FP8_E5M2 = None
+
+
+class DType:
+    """A paddle-style dtype: comparable with strings, numpy dtypes and itself."""
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __str__(self):
+        return f"paddle.{self.name}"
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            other = other.replace("paddle.", "")
+            if other in DType._registry:
+                return self.np_dtype == DType._registry[other].np_dtype
+            try:
+                return self.np_dtype == np.dtype(other)
+            except TypeError:
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        if result is NotImplemented:
+            return result
+        return not result
+
+    @property
+    def is_floating_point(self):
+        return np.issubdtype(self.np_dtype, np.floating) or self.name in (
+            "bfloat16",
+            "float8_e4m3fn",
+            "float8_e5m2",
+        )
+
+    @property
+    def is_integer(self):
+        return np.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self):
+        return np.issubdtype(self.np_dtype, np.complexfloating)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BFLOAT16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+if _FP8_E4M3 is not None:
+    float8_e4m3fn = DType("float8_e4m3fn", _FP8_E4M3)
+    float8_e5m2 = DType("float8_e5m2", _FP8_E5M2)
+
+
+def to_np_dtype(dtype) -> np.dtype:
+    """Convert any dtype-like (DType, str, np/jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype.np_dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name in DType._registry:
+            return DType._registry[name].np_dtype
+        return np.dtype(name)
+    return np.dtype(dtype)
+
+
+def from_np_dtype(np_dtype) -> DType:
+    """Convert a numpy/jax dtype back to a paddle-style DType."""
+    np_dtype = np.dtype(np_dtype)
+    for dt in DType._registry.values():
+        if dt.np_dtype == np_dtype:
+            return dt
+    return DType(np_dtype.name, np_dtype)
+
+
+def default_dtype() -> DType:
+    from . import config
+
+    return config.get_default_dtype_obj()
+
+
+_PROMOTION_ORDER = [
+    "bool",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+]
+
+
+def is_floating_dtype(dtype) -> bool:
+    d = to_np_dtype(dtype)
+    return np.issubdtype(d, np.floating) or d == _BFLOAT16

@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load: pickle protocol with tensors as numpy chunks.
+
+Reference parity: python/paddle/framework/io.py (SURVEY.md §5 "Checkpoint /
+resume"): nested state_dict containers with tensors serialized inside. The
+TPU-native distributed/async checkpoint path lives in
+paddle_tpu.distributed.checkpoint (orbax/tensorstore-style); this module is
+the single-process surface.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (numpy + metadata)."""
+
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, t: Tensor):
+        self.array = np.asarray(t._data)
+        self.stop_gradient = t.stop_gradient
+        self.name = t.name
+
+    def to_tensor(self) -> Tensor:
+        t = Tensor(self.array, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else obj.to_tensor()
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        un = [_unpack(v, return_numpy) for v in obj]
+        return tuple(un) if isinstance(obj, tuple) else un
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

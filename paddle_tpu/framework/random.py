@@ -1,0 +1,161 @@
+"""RNG management: paddle's global-seed semantics over jax key splitting.
+
+Reference parity: `paddle.seed`, `phi::Generator` per-device state
+(ref: paddle/phi/core/generator.cc — SURVEY.md §2.1 "Generator/RNG"), and
+Fleet's `get_rng_state_tracker` for TP-parallel dropout
+(ref: fleet/layers/mpu/random.py).
+
+Design (SURVEY.md §7 hard part #4): a stateful KeyStream wraps a jax PRNG key
+and a counter; every random op folds the counter into the key, so eager
+execution is reproducible from one seed. Under `to_static`/jit, the step
+function threads an explicit seed argument and installs a trace-local stream
+(`with_key_stream`), keeping the compiled program pure while preserving the
+stateful-looking API.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+_DEFAULT_SEED = 0
+
+
+class KeyStream:
+    """A stateful stream of PRNG keys derived from one root key."""
+
+    __slots__ = ("_key", "_counter")
+
+    def __init__(self, seed_or_key):
+        if isinstance(seed_or_key, (int, np.integer)):
+            self._key = jax.random.key(int(seed_or_key))
+        else:
+            self._key = seed_or_key
+        self._counter = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self._key, self._counter)
+        self._counter += 1
+        return k
+
+    def split(self, n: int):
+        return [self.next_key() for _ in range(n)]
+
+    def state(self):
+        return (self._key, self._counter)
+
+    def set_state(self, state):
+        self._key, self._counter = state
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stream_stack = []
+
+
+_tls = _TLS()
+_global_stream = KeyStream(_DEFAULT_SEED)
+_global_seed = _DEFAULT_SEED
+
+
+def seed(s: int):
+    """paddle.seed: reset the global generator. Returns the generator."""
+    global _global_stream, _global_seed
+    _global_seed = int(s)
+    _global_stream = KeyStream(int(s))
+    return _global_stream
+
+
+def get_seed() -> int:
+    return _global_seed
+
+
+def current_stream() -> KeyStream:
+    if _tls.stream_stack:
+        return _tls.stream_stack[-1]
+    return _global_stream
+
+
+def next_key():
+    """Next PRNG key from the active stream (trace-local under jit)."""
+    return current_stream().next_key()
+
+
+@contextlib.contextmanager
+def with_key_stream(stream_or_key):
+    """Install a trace-local key stream (used by the jit path and shard_map)."""
+    stream = (
+        stream_or_key
+        if isinstance(stream_or_key, KeyStream)
+        else KeyStream(stream_or_key)
+    )
+    _tls.stream_stack.append(stream)
+    try:
+        yield stream
+    finally:
+        _tls.stream_stack.pop()
+
+
+def get_rng_state():
+    """paddle.get_cuda_rng_state-style: opaque state blob list."""
+    return [current_stream().state()]
+
+
+def set_rng_state(state):
+    current_stream().set_state(state[0])
+
+
+class RNGStatesTracker:
+    """Fleet's rng-state tracker for tensor-parallel dropout.
+
+    Reference parity: fleet/layers/mpu/random.py `RNGStatesTracker` /
+    `get_rng_state_tracker` — named RNG states so TP ranks use a
+    *different* seed for dropout inside the model-parallel region
+    ("local_seed") and the *same* seed outside ("global_seed").
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def add(self, name, seed_):
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = KeyStream(int(seed_))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name="global_seed"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        _tls.stream_stack.append(self.states_[name])
+        try:
+            yield
+        finally:
+            _tls.stream_stack.pop()
+
+
+_MODEL_PARALLEL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _MODEL_PARALLEL_TRACKER
+
+
+def model_parallel_random_seed(seed_: int, tp_rank: int = 0):
+    """Set up global/local dropout seeds per TP rank (fleet parity)."""
+    global_seed = 100003 + seed_
+    local_seed = seed_ + 1024 + tp_rank * 100
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)

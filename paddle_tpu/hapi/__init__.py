@@ -1,0 +1,10 @@
+"""paddle.hapi (SURVEY.md §2.2 "HAPI")."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,215 @@
+"""paddle.Model — the Keras-like trainer (reference: python/paddle/hapi/model.py
+— SURVEY.md §2.2 "HAPI"). prepare/fit/evaluate/predict/save/load + callbacks.
+The inner step uses the fused jit train step when `prepare(jit=True)`
+(default), falling back to eager for debugging."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .. import jit as _jit
+from ..framework import io as _fio
+from ..io import DataLoader
+from ..metric import Metric
+from ..tensor import Tensor
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit_step = None
+        self._use_jit = True
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._use_jit = jit
+        return self
+
+    # ------------------------------------------------------------------
+    def _make_loss(self, out, label):
+        if self._loss is None:
+            return out
+        return self._loss(out, label)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs[0] if isinstance(inputs, (list, tuple)) and len(
+            inputs) == 1 else inputs
+        labels = labels[0] if isinstance(labels, (list, tuple)) and len(
+            labels) == 1 else labels
+        if self._use_jit:
+            if self._jit_step is None:
+                self._jit_step = _jit.train_step(
+                    self.network, self._loss, self._optimizer
+                )
+            loss = self._jit_step(inputs, labels)
+        else:
+            out = self.network(inputs)
+            loss = self._make_loss(out, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        from ..optimizer.lr import LRScheduler
+
+        if isinstance(self._optimizer._learning_rate, LRScheduler):
+            self._optimizer._learning_rate.step()
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs[0] if isinstance(inputs, (list, tuple)) and len(
+            inputs) == 1 else inputs
+        labels = labels[0] if isinstance(labels, (list, tuple)) and len(
+            labels) == 1 else labels
+        with no_grad():
+            out = self.network(inputs)
+            loss = self._make_loss(out, labels)
+            metrics = []
+            for m in self._metrics:
+                m.update(np.asarray(m.compute(out, labels)._data)
+                         if hasattr(m.compute(out, labels), "_data")
+                         else m.compute(out, labels))
+                metrics.append(m.accumulate())
+        return [float(loss.numpy())], metrics
+
+    def predict_batch(self, inputs):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs[0] if isinstance(inputs, (list, tuple)) and len(
+            inputs) == 1 else inputs
+        with no_grad():
+            out = self.network(inputs)
+        return [out.numpy()]
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        cbks = CallbackList(callbacks or [ProgBarLogger(log_freq,
+                                                        verbose=verbose)])
+        cbks.set_model(self)
+        cbks.set_params({
+            "epochs": epochs,
+            "steps": len(train_loader) if hasattr(train_loader, "__len__")
+            else None,
+            "verbose": verbose,
+            "metrics": ["loss"] + [
+                n for m in self._metrics
+                for n in (m.name() if isinstance(m.name(), list)
+                          else [m.name()])
+            ],
+        })
+        cbks.on_begin("train")
+        steps_done = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                loss = self.train_batch(x, y)
+                logs = {"loss": loss[0], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                steps_done += 1
+                if num_iters is not None and steps_done >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None
+                                      and steps_done >= num_iters):
+                break
+        cbks.on_end("train")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        if not isinstance(eval_data, DataLoader):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+            loss, _ = self.eval_batch(x, y)
+            losses.append(loss[0])
+        result = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                result[n] = v
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        if not isinstance(test_data, DataLoader):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch(x)[0])
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size)

@@ -1,0 +1,26 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    total_params = 0
+    trainable_params = 0
+    lines = [f"{'Layer':<40}{'Param #':>12}"]
+    for name, p in net.named_parameters():
+        n = int(np.prod(p._data.shape))
+        total_params += n
+        if not p.stop_gradient:
+            trainable_params += n
+        lines.append(f"{name:<40}{n:>12}")
+    lines.append("-" * 52)
+    lines.append(f"Total params: {total_params}")
+    lines.append(f"Trainable params: {trainable_params}")
+    print("\n".join(lines))
+    return {
+        "total_params": total_params,
+        "trainable_params": trainable_params,
+    }

@@ -1,0 +1,3 @@
+"""paddle.incubate (SURVEY.md §2.2 "Incubate fused API"): fused-op layers and
+experimental distributed models (MoE)."""
+from . import nn  # noqa: F401

@@ -1,0 +1,15 @@
+"""incubate.nn fused layers (reference: python/paddle/incubate/nn —
+FusedMultiTransformer etc., SURVEY.md §2.1 "Fused transformer ops").
+
+The serving-grade FusedMultiTransformer (paged KV cache, Pallas decode
+kernels) lives in paddle_tpu.incubate.nn.fused_transformer.
+"""
+from .fused_transformer import (  # noqa: F401
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+    fused_feedforward,
+    fused_multi_head_attention,
+)
+from .fused_linear import FusedLinear, fused_linear, fused_matmul_bias  # noqa: F401

@@ -1,0 +1,45 @@
+"""FusedLinear (reference: fused_gemm_epilogue / fused_matmul_bias —
+SURVEY.md §2.1). On TPU, XLA fuses matmul+bias+activation natively; these
+wrappers exist for API parity and to pin bf16 MXU-friendly dtypes."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.common_layers import Linear
+from ...tensor import _apply_op
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    def f(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [bias] if bias is not None else []
+    return _apply_op(f, x, y, *args, _name="matmul")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    return getattr(F, activation)(out)
+
+
+class FusedLinear(Linear):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__(in_features, out_features, weight_attr, bias_attr)
+
+    def forward(self, x):
+        return fused_linear(x, self.weight, self.bias)

@@ -1,0 +1,26 @@
+"""paddle.io (python/paddle/io parity — SURVEY.md §2.2 "DataLoader"):
+Dataset/IterableDataset/TensorDataset, Sampler/BatchSampler/
+DistributedBatchSampler, DataLoader (threaded prefetch; the multiprocess shm
+transport backed by the native C++ runtime lands with the dataloader
+extension — single-host threads saturate TPU input for the bench configs).
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

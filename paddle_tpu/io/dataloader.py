@@ -1,0 +1,129 @@
+"""DataLoader (python/paddle/io/dataloader parity — SURVEY.md §2.2).
+
+The reference uses worker subprocesses + shared-memory queues
+(_DataLoaderIterMultiProcess). TPU-native stance: the input pipeline's job is
+to keep the (single) host feed ahead of device steps — a thread pool with a
+bounded prefetch queue does that without pickling/shm overhead for the bench
+configs; `num_workers>0` selects threaded prefetch (GIL released inside numpy
+/ jax host ops). Collation produces numpy batches; transfer to device happens
+on first use (jax.device_put inside Tensor), letting XLA overlap H2D with
+compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(group)) for group in transposed]
+    return batch
+
+
+class _Iter:
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        self.iterable = isinstance(ds, IterableDataset)
+        if self.iterable:
+            self._it = iter(ds)
+        else:
+            self._batches = iter(loader.batch_sampler)
+        self._prefetch_q = None
+        if loader.num_workers > 0 and not self.iterable:
+            self._prefetch_q = queue.Queue(maxsize=max(2, loader.num_workers * 2))
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._producer, daemon=True)
+            self._thread.start()
+
+    def _load_batch(self, indices):
+        samples = [self.loader.dataset[i] for i in indices]
+        collate = self.loader.collate_fn or default_collate_fn
+        return collate(samples)
+
+    def _producer(self):
+        try:
+            for indices in self._batches:
+                if self._stop.is_set():
+                    return
+                self._prefetch_q.put(self._load_batch(indices))
+        finally:
+            self._prefetch_q.put(StopIteration)
+
+    def __next__(self):
+        if self.iterable:
+            batch = []
+            try:
+                for _ in range(self.loader.batch_size or 1):
+                    batch.append(next(self._it))
+            except StopIteration:
+                if not batch or self.loader.drop_last:
+                    raise
+            collate = self.loader.collate_fn or default_collate_fn
+            return collate(batch)
+        if self._prefetch_q is not None:
+            item = self._prefetch_q.get()
+            if item is StopIteration:
+                raise StopIteration
+            return item
+        indices = next(self._batches)
+        return self._load_batch(indices)
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        if self._prefetch_q is not None:
+            self._stop.set()
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not isinstance(dataset, IterableDataset):
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+        else:
+            self.batch_sampler = None
+
+    def __iter__(self):
+        return _Iter(self)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("DataLoader over IterableDataset has no len()")

@@ -1,0 +1,9 @@
+"""paddle.jit namespace (SURVEY.md §2.2 "JIT / dy2static")."""
+from .api import (  # noqa: F401
+    StaticFunction,
+    in_to_static_trace,
+    not_to_static,
+    to_static,
+    train_step,
+)
+from .save_load import load, save  # noqa: F401

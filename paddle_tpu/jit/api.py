@@ -1,0 +1,285 @@
+"""@to_static: the dygraph-to-compiled bridge.
+
+Reference parity: python/paddle/jit (ProgramTranslator, @to_static,
+jit.save/load — SURVEY.md §2.2 "JIT / dy2static"). TPU-native design
+(SURVEY.md §7 phase 4): instead of AST-rewriting Python into a ProgramDesc,
+the Layer/function is *functionalized* — parameters and buffers are swapped
+for jit tracers, the unmodified Python forward runs once under jax tracing,
+and XLA compiles the whole step. Python control flow unrolls at trace time
+(like the reference's static unrolling); data-dependent control flow uses
+lax.cond/scan, the same contract as the reference's cond/while_loop ops.
+
+Key properties:
+- program cache ≡ jax.jit's (shape, dtype)-keyed executable cache
+  (the reference's InterpreterCore cache — SURVEY.md §3.3);
+- RNG: each call draws a fresh seed from the eager KeyStream and threads it
+  in as an argument, so dropout differs per step without recompilation while
+  staying reproducible from paddle.seed (SURVEY.md §7 hard part #4);
+- mutable state (BN running stats): buffers are traced as inputs and their
+  post-forward values returned as outputs, then rebound — eager and jit
+  stay semantically identical (hard part #1);
+- training: `train_step()` fuses forward+loss+backward+optimizer update into
+  one jitted program with donated params/opt-state (SURVEY.md §3.1
+  "TPU lesson").
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, as_array
+
+_tls = threading.local()
+
+
+def in_to_static_trace() -> bool:
+    return getattr(_tls, "tracing", False)
+
+
+# ---------------------------------------------------------------------------
+# (args, kwargs) <-> (array leaves, hashable structure)
+# ---------------------------------------------------------------------------
+
+
+def _encode(obj, leaves):
+    if isinstance(obj, Tensor):
+        leaves.append(obj._data)
+        return ("__leaf__", len(leaves) - 1)
+    if isinstance(obj, (jax.Array, np.ndarray)):
+        leaves.append(jnp.asarray(obj))
+        return ("__leaf__", len(leaves) - 1)
+    if isinstance(obj, list):
+        return ("__list__", tuple(_encode(o, leaves) for o in obj))
+    if isinstance(obj, tuple):
+        return ("__tuple__", tuple(_encode(o, leaves) for o in obj))
+    if isinstance(obj, dict):
+        return (
+            "__dict__",
+            tuple(sorted((k, _encode(v, leaves)) for k, v in obj.items())),
+        )
+    return ("__const__", obj)
+
+
+def _decode(node, leaves, wrap):
+    tag, payload = node
+    if tag == "__leaf__":
+        arr = leaves[payload]
+        return Tensor(arr) if wrap else arr
+    if tag == "__list__":
+        return [_decode(o, leaves, wrap) for o in payload]
+    if tag == "__tuple__":
+        return tuple(_decode(o, leaves, wrap) for o in payload)
+    if tag == "__dict__":
+        return {k: _decode(v, leaves, wrap) for k, v in payload}
+    return payload
+
+
+def flatten_call(args, kwargs):
+    leaves: list = []
+    structure = _encode((tuple(args), dict(kwargs)), leaves)
+    return leaves, structure
+
+
+def unflatten_call(leaves, structure, wrap=True):
+    args, kwargs = _decode(structure, leaves, wrap)
+    return args, kwargs
+
+
+def flatten_out(out):
+    leaves: list = []
+    structure = _encode(out, leaves)
+    return leaves, structure
+
+
+def unflatten_out(leaves, structure, wrap=True):
+    return _decode(structure, leaves, wrap)
+
+
+# ---------------------------------------------------------------------------
+# StaticFunction (forward jit)
+# ---------------------------------------------------------------------------
+
+
+class _LayerScope:
+    """Swap a layer's param/buffer arrays for traced ones, restoring after."""
+
+    def __init__(self, layer: Optional[Layer], params, buffers):
+        self.layer = layer
+        self.params = params
+        self.buffers = buffers
+
+    def __enter__(self):
+        if self.layer is not None:
+            self.saved_p = {n: p._data for n, p in self.layer.named_parameters()}
+            self.saved_b = {n: b._data for n, b in self.layer.named_buffers()}
+            self.layer.load_pytree(self.params)
+            self.layer.load_pytree(self.buffers)
+        return self
+
+    def new_buffers(self):
+        return self.layer.buffers_pytree() if self.layer is not None else {}
+
+    def __exit__(self, *exc):
+        if self.layer is not None:
+            self.layer.load_pytree(self.saved_p)
+            self.layer.load_pytree(self.saved_b)
+        return False
+
+
+class StaticFunction:
+    """Compiled forward over a Layer or plain function."""
+
+    def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._out_structure = None
+        self._compiled = None
+        self._lock = threading.Lock()
+
+    def _build(self):
+        def pure_fn(params, buffers, seed, arg_leaves, structure):
+            stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+            _tls.tracing = True
+            try:
+                with _random.with_key_stream(stream), _LayerScope(
+                    self._layer, params, buffers
+                ) as scope:
+                    args, kwargs = unflatten_call(arg_leaves, structure)
+                    out = self._fn(*args, **kwargs)
+                    new_buffers = scope.new_buffers()
+            finally:
+                _tls.tracing = False
+            out_leaves, out_struct = flatten_out(out)
+            self._out_structure = out_struct
+            return out_leaves, new_buffers
+
+        self._compiled = jax.jit(pure_fn, static_argnames=("structure",))
+
+    def __call__(self, *args, **kwargs):
+        with self._lock:
+            if self._compiled is None:
+                self._build()
+        layer = self._layer
+        params = layer.parameters_pytree() if layer is not None else {}
+        buffers = layer.buffers_pytree() if layer is not None else {}
+        seed = jax.random.key_data(_random.next_key())
+        leaves, structure = flatten_call(args, kwargs)
+        out_leaves, new_buffers = self._compiled(
+            params, buffers, seed, leaves, structure
+        )
+        if layer is not None and new_buffers:
+            layer.load_pytree(new_buffers)
+        return unflatten_out(out_leaves, self._out_structure)
+
+    @property
+    def code(self):
+        return "<jax-traced program (StableHLO under jit)>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn.forward, layer=fn,
+                                    input_spec=input_spec)
+            fn.forward = static
+            return fn
+        layer = getattr(fn, "__self__", None)
+        if isinstance(layer, Layer):
+            return StaticFunction(fn, layer=layer, input_spec=input_spec)
+        static = StaticFunction(fn, layer=None, input_spec=input_spec)
+        functools.update_wrapper(static, fn)
+        return static
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._paddle_not_to_static = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# train_step: fused fwd+bwd+update
+# ---------------------------------------------------------------------------
+
+
+def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
+               model_call: Optional[Callable] = None):
+    """Build a compiled train step: step(inputs, *labels) -> loss.
+
+    `model_call(model, inputs)` defaults to `model(inputs)`;
+    `criterion(output, *labels)` computes the scalar loss. Params and
+    optimizer state are donated: XLA rewrites weights in place in HBM.
+    """
+    opt_state_holder = {"state": None}
+    call = model_call or (lambda m, x: m(x))
+
+    def pure_step(params, buffers, opt_state, lr, seed, arg_leaves, structure):
+        stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+
+        def compute_loss(p):
+            from ..autograd import tape as _tape
+
+            _tls.tracing = True
+            try:
+                # the eager tape is bypassed — jax.value_and_grad
+                # differentiates the traced jax ops directly
+                with _tape.no_grad(), _random.with_key_stream(
+                    stream
+                ), _LayerScope(model, p, buffers) as scope:
+                    args, kwargs = unflatten_call(arg_leaves, structure)
+                    out = call(model, args[0])
+                    loss_t = criterion(out, *args[1:], **kwargs)
+                    new_buffers = scope.new_buffers()
+            finally:
+                _tls.tracing = False
+            return as_array(loss_t), new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(params)
+        new_params, new_opt_state = optimizer.apply_gradients_functional(
+            params, grads, opt_state, lr
+        )
+        return loss, new_params, new_buffers, new_opt_state
+
+    jitted = jax.jit(
+        pure_step,
+        static_argnames=("structure",),
+        donate_argnums=(0, 2) if donate else (),
+    )
+
+    def step(*args, **kwargs):
+        params = model.parameters_pytree()
+        buffers = model.buffers_pytree()
+        if opt_state_holder["state"] is None:
+            opt_state_holder["state"] = optimizer.init_state_pytree(params)
+        lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
+        seed = jax.random.key_data(_random.next_key())
+        leaves, structure = flatten_call(args, kwargs)
+        loss, new_params, new_buffers, new_opt = jitted(
+            params, buffers, opt_state_holder["state"], lr, seed, leaves,
+            structure,
+        )
+        opt_state_holder["state"] = new_opt
+        model.load_pytree(new_params)
+        model.load_pytree(new_buffers)
+        optimizer._step_count += 1
+        return Tensor(loss)
+
+    step._opt_state_holder = opt_state_holder
+    step._pure_step = pure_step
+    return step

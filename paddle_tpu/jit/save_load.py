@@ -1,0 +1,99 @@
+"""paddle.jit.save/load.
+
+Reference parity: jit.save serializes a traced program + persistables
+(paddle/fluid/jit — SURVEY.md §2.1 "JIT runtime"). TPU-native: the exported
+artifact is `jax.export`ed StableHLO (portable, AOT-loadable) plus the
+state_dict. Loading returns a TranslatedLayer-alike that executes the
+exported program.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import io as _fio
+from ..framework import random as _random
+from ..nn.layer_base import Layer
+from ..tensor import Tensor
+from . import api as _api
+
+
+def save(layer: Layer, path: str, input_spec=None, **configs):
+    """Export layer.forward at the given input specs.
+
+    input_spec: list of example Tensors or jax.ShapeDtypeStruct.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (example inputs)")
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape),
+                                              s._data.dtype))
+        elif isinstance(s, jax.ShapeDtypeStruct):
+            specs.append(s)
+        else:
+            a = np.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+    params = layer.parameters_pytree()
+    buffers = layer.buffers_pytree()
+    fwd = layer.forward
+    if isinstance(fwd, _api.StaticFunction):
+        fwd = fwd._fn
+
+    def pure_fn(p, b, *xs):
+        with _random.with_key_stream(_random.KeyStream(0)), _api._LayerScope(
+            layer, p, b
+        ):
+            out = fwd(*[Tensor(x) for x in xs])
+        leaves, struct = _api.flatten_out(out)
+        return leaves
+
+    from jax import export as jexport
+
+    p_specs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype) for n, v in
+               params.items()}
+    b_specs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype) for n, v in
+               buffers.items()}
+    exported = jexport.export(jax.jit(pure_fn))(p_specs, b_specs, *specs)
+    blob = exported.serialize()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    _fio.save({"params": {n: Tensor(v) for n, v in params.items()},
+               "buffers": {n: Tensor(v) for n, v in buffers.items()}},
+              path + ".pdiparams")
+
+
+class TranslatedLayer(Layer):
+    """Executable loaded program (paddle.jit.TranslatedLayer parity)."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params = params
+        self._buffers_d = buffers
+
+    def forward(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        out = self._exported.call(self._params, self._buffers_d, *arrays)
+        outs = [Tensor(o) for o in out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load(path, **configs):
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    state = _fio.load(path + ".pdiparams")
+    params = {n: t._data for n, t in state["params"].items()}
+    buffers = {n: t._data for n, t in state["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)

@@ -1,0 +1,4 @@
+"""Pallas TPU kernel library — the Phi-fusion equivalent (SURVEY.md §2.1
+"Phi fusion kernels", §7 phase 9): flash attention, fused rope, rmsnorm,
+ring attention, paged-KV decode. Kernels fall back to interpret mode on CPU
+so the same tests run in CI without a TPU."""

@@ -1,0 +1,162 @@
+"""paddle.metric (python/paddle/metric parity — SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, as_array
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__.lower()
+
+    def name(self):
+        return self._name
+
+    def reset(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update(self, *args):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def accumulate(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        return pred, label
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        p = np.asarray(as_array(pred))
+        l = np.asarray(as_array(label))
+        if l.ndim == p.ndim and l.shape[-1] == 1:
+            l = l.squeeze(-1)
+        topk_idx = np.argsort(-p, axis=-1)[..., : self.maxk]
+        correct = topk_idx == l[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(as_array(correct)) if isinstance(correct, Tensor) \
+            else np.asarray(correct)
+        num_samples = int(np.prod(c.shape[:-1]))
+        accs = []
+        for i, k in enumerate(self.topk):
+            num_corrects = c[..., :k].sum()
+            self.total[i] += num_corrects
+            self.count[i] += num_samples
+            accs.append(float(num_corrects) / max(num_samples, 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [float(t / max(c, 1)) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return (
+            [f"{self._name}_top{k}" for k in self.topk]
+            if len(self.topk) > 1
+            else [self._name]
+        )
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_array(preds)).reshape(-1)
+        l = np.asarray(as_array(labels)).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_array(preds)).reshape(-1)
+        l = np.asarray(as_array(labels)).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(as_array(preds))
+        l = np.asarray(as_array(labels)).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        idx = np.minimum((p * self.num_thresholds).astype(np.int64),
+                         self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+
+    p = as_array(input)
+    l = as_array(label)
+    if l.ndim == p.ndim and l.shape[-1] == 1:
+        l = l.squeeze(-1)
+    import jax
+
+    _, topk_idx = jax.lax.top_k(p, k)
+    correct_any = (topk_idx == l[..., None]).any(axis=-1)
+    return Tensor(correct_any.astype(jnp.float32).mean())

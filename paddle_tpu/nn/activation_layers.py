@@ -1,0 +1,103 @@
+"""Activation layers (python/paddle/nn/layer/activation.py parity)."""
+from __future__ import annotations
+
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self.args = args
+            self.kwargs = {**fixed, **kwargs}
+            self.kwargs.pop("name", None)
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, *self.args, **self.kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+LogSigmoid = _simple("log_sigmoid")
+Tanh = _simple("tanh")
+Tanhshrink = _simple("tanhshrink")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Hardtanh = _simple("hardtanh")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+ELU = _simple("elu")
+CELU = _simple("celu")
+SELU = _simple("selu")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+LeakyReLU = _simple("leaky_relu")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+ThresholdedReLU = _simple("thresholded_relu")
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)

@@ -1,0 +1,107 @@
+"""Gradient clipping (python/paddle/nn/clip.py parity):
+ClipGradByValue / ClipGradByNorm / ClipGradByGlobalNorm.
+
+ClipGradByGlobalNorm is distributed-aware in the reference
+(HybridParallelClipGrad psums partial norms across TP/PP groups — SURVEY.md
+§2.2 "Optimizers"); here the hybrid variant lives in
+distributed.fleet.meta_parallel and reuses this base.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, as_array
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(as_array(g), self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            a = as_array(g)
+            n = jnp.sqrt(jnp.sum(jnp.square(a)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor(a * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(as_array(g).astype(jnp.float32)))
+              for g in grads if g is not None]
+        if not sq:
+            return None
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def _clip(self, params_grads):
+        gn = self.global_norm([g for _, g in params_grads])
+        if gn is None:
+            return params_grads
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            a = as_array(g)
+            out.append((p, Tensor((a.astype(jnp.float32) * scale).astype(a.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        norms = [jnp.max(jnp.abs(as_array(p.grad))) for p in params]
+        total = jnp.max(jnp.stack(norms))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(as_array(p.grad)), norm_type))
+                for p in params),
+            1.0 / norm_type,
+        )
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad = Tensor(as_array(p.grad) * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(as_array(p.grad), -clip_value, clip_value))

@@ -1,0 +1,93 @@
+"""Attention functionals.
+
+Reference parity: the flash-attn glue (paddle/phi/kernels/gpu/flash_attn_*,
+SURVEY.md §2.1 "Phi fusion kernels") and
+`paddle.nn.functional.scaled_dot_product_attention`. On TPU the fused path is
+a Pallas flash-attention kernel (paddle_tpu.kernels.flash_attention) gated by
+FLAGS_use_pallas_kernels; the fallback is one fused XLA softmax(QK^T)V.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import config as _config
+from ...tensor import Tensor, _apply_op, as_array
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
+                    key=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # -> [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        logits = jnp.where(cmask, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle layout: [batch, seq, num_heads, head_dim]."""
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...framework import random as _random
+
+        rng_key = _random.next_key()
+
+    use_pallas = _config.get_flag("FLAGS_use_pallas_kernels", True)
+    if use_pallas and dropout_p == 0.0 and attn_mask is None:
+        try:
+            from ...kernels import flash_attention as fa
+
+            def f(q, k, v):
+                return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+
+            return _apply_op(f, query, key, value, _name="flash_attention")
+        except Exception:
+            pass
+
+    if attn_mask is not None:
+
+        def f(q, k, v, m):
+            return _sdpa_reference(q, k, v, mask=m,
+                                   dropout_p=dropout_p if training else 0.0,
+                                   causal=is_causal, key=rng_key)
+
+        return _apply_op(f, query, key, value, attn_mask, _name="sdpa")
+
+    def f(q, k, v):
+        return _sdpa_reference(q, k, v, dropout_p=dropout_p if training else 0.0,
+                               causal=is_causal, key=rng_key)
+
+    return _apply_op(f, query, key, value, _name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,182 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference parity: python/paddle/nn/functional/conv.py (conv1d/2d/3d +
+transpose variants). TPU-native: convs lower straight to XLA convolution,
+which tiles onto the MXU; weight layout follows paddle ([out_c, in_c/g,
+*spatial]) and is mapped via dimension_numbers rather than transposed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import _apply_op
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """paddle padding: int | list[int] | list[pair] | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(int(x) for x in p) for p in padding]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+
+    def f(a, w, *maybe_b):
+        # paddle weight layout is [out_c, in_c/groups, *spatial]; lax wants
+        # rhs_spec-ordered. For OIW/OIHW/OIDHW specs that's already it.
+        if channel_last:
+            # move weight [O, I, *s] -> [*s, I, O]
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_b:
+            b = maybe_b[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (n + 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    if bias is not None:
+        return _apply_op(f, x, weight, bias, _name=f"conv{n}d")
+    return _apply_op(f, x, weight, _name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    out_pad = _norm_tuple(output_padding, n) if output_padding is not None else (0,) * n
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        pad_pairs = None
+    else:
+        pad_pairs = pad
+
+    def f(a, w, *maybe_b):
+        # gradient-based transpose conv: use conv_general_dilated with
+        # lhs_dilation = stride ("fractionally strided" conv).
+        # paddle weight layout [in_c, out_c/groups, *spatial]
+        if groups > 1:
+            ws = jnp.split(w, groups, axis=0)
+            xs = jnp.split(a, groups, axis=-1 if channel_last else 1)
+            outs = [_single(xi, wi) for xi, wi in zip(xs, ws)]
+            return _finish(jnp.concatenate(outs, axis=-1 if channel_last else 1),
+                           maybe_b)
+        return _finish(_single(a, w), maybe_b)
+
+    def _single(a, w):
+        # flip spatial dims and swap in/out channels -> regular conv kernel
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wt = jnp.swapaxes(wt, 0, 1)  # [out_c, in_c, *spatial]
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wt = jnp.transpose(wt, perm)
+        k = [
+            (w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)
+        ]
+        if pad_pairs is None:
+            raise NotImplementedError("string padding for conv_transpose")
+        tpad = [
+            (k[i] - 1 - pad_pairs[i][0], k[i] - 1 - pad_pairs[i][1] + out_pad[i])
+            for i in range(n)
+        ]
+        lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+        return jax.lax.conv_general_dilated(
+            a,
+            wt,
+            window_strides=(1,) * n,
+            padding=tpad,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        )
+
+    def _finish(out, maybe_b):
+        if maybe_b:
+            b = maybe_b[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (n + 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    if bias is not None:
+        return _apply_op(f, x, weight, bias, _name=f"conv{n}d_transpose")
+    return _apply_op(f, x, weight, _name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,269 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity):
+cross_entropy (soft/hard label, ignore_index, weight),
+softmax_with_cross_entropy, mse/l1/nll/bce/bce_with_logits/smooth_l1/kl_div/
+margin_ranking/hinge/square_error_cost."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, _apply_op, as_array
+
+
+def _reduce(out, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(out) / weight_sum
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """The reference's `c_softmax_with_cross_entropy`-compatible CE
+    (non-parallel path; the TP-parallel variant lives in
+    distributed.fleet.layers.mpu)."""
+
+    if soft_label:
+
+        def f(logits, lab, *w):
+            logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+                jnp.maximum(logits, 1e-30))
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                lab = (1 - label_smoothing) * lab + label_smoothing / k
+            out = -jnp.sum(lab * logp, axis=axis)
+            if w:
+                cw = jnp.sum(lab * w[0], axis=axis)
+                out = out * cw
+            return _reduce(out, reduction)
+
+        args = [weight] if weight is not None else []
+        return _apply_op(f, input, label, *args, _name="cross_entropy")
+
+    def f(logits, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        valid = lab_i != ignore_index
+        safe_lab = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_lab, axis), axis=axis
+        )
+        nll = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            smooth = -jnp.mean(logp, axis=axis)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+        if w:
+            cw = jnp.take(w[0], safe_lab)
+            nll = nll * cw
+            nll = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(jnp.sum(
+                    jnp.where(valid, cw, 0.0)), 1e-12)
+            return _reduce(nll, reduction)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(valid.astype(nll.dtype)), 1.0)
+        return _reduce(nll, reduction)
+
+    args = [weight] if weight is not None else []
+    return _apply_op(f, input, label, *args, _name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from ...ops.activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lab_i.ndim + 1
+                                     else safe, axis=1 if logp.ndim > 1 else 0)
+        nll = -jnp.squeeze(picked, axis=1) if picked.ndim > lab_i.ndim else -picked
+        if w:
+            cw = jnp.take(w[0], safe)
+            nll = jnp.where(valid, nll * cw, 0.0)
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.sum(jnp.where(valid, cw, 0.0))
+            return _reduce(nll, reduction)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+        return _reduce(nll, reduction)
+
+    args = [weight] if weight is not None else []
+    return _apply_op(f, input, label, *args, _name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _apply_op(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+        _name="mse_loss",
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _apply_op(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+        _name="l1_loss",
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, _name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+
+    args = [weight] if weight is not None else []
+    return _apply_op(f, input, label, *args, _name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable formulation
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            out = (1 - y) * z + log_w * (
+                jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val)) + max_val
+            )
+        else:
+            out = (1 - y) * z + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-z - max_val))
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+
+    args = [t for t in (weight, pos_weight) if t is not None]
+    return _apply_op(f, logit, label, *args, _name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, q):
+        if log_target:
+            out = jnp.exp(q) * (q - logp)
+        else:
+            out = q * (jnp.log(jnp.maximum(q, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, _name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, other, label, _name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        out = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, _name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input1, input2, label, _name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v + epsilon), p),
+                                     axis=-1), 1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        out = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, positive, negative, _name="triplet_margin_loss")
+
+
+def square_error_cost(input, label):
+    return _apply_op(lambda a, b: jnp.square(a - b), input, label,
+                     _name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return _apply_op(f, input, label, _name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            out = out / nrm[0]
+        return _reduce(out, reduction)
+
+    args = [normalizer] if normalizer is not None else []
+    return _apply_op(f, logit, label, *args, _name="sigmoid_focal_loss")

@@ -1,0 +1,176 @@
+"""Pooling functionals over lax.reduce_window
+(python/paddle/nn/functional/pooling.py parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import _apply_op, as_array
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(int(x) for x in p) for p in padding]
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, count_include_pad=True, average=False,
+          exclusive=True):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n)
+
+    def f(a):
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        if average:
+            ones = jnp.ones_like(a)
+            s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+            if exclusive and not count_include_pad:
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                            pads)
+                return s / cnt
+            denom = float(np.prod(ks))
+            if isinstance(pads, str) or all(p == (0, 0) for p in
+                                            (pad if not isinstance(pad, str) else [])):
+                return s / denom
+            if exclusive:
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                            pads)
+                return s / cnt
+            return s / denom
+        return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
+
+    return _apply_op(f, x, _name="pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, fmt,
+                ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
+                data_format, ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                 data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0, fmt,
+                 ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                 data_format, ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 data_format, ceil_mode, average=True, exclusive=exclusive)
+
+
+def _adaptive_start_end(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = np.ceil((np.arange(out_size) + 1) * in_size / out_size).astype(int)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    out_sizes = _norm_tuple(output_size, n)
+
+    def f(a):
+        spatial_off = 1 if channel_last else 2
+        out = a
+        for d in range(n):
+            in_size = out.shape[spatial_off + d]
+            o = out_sizes[d]
+            if o is None or o == in_size:
+                continue
+            if in_size % o == 0:
+                # even split: reshape + reduce (fast, jittable)
+                k = in_size // o
+                shape = list(out.shape)
+                shape[spatial_off + d: spatial_off + d + 1] = [o, k]
+                r = out.reshape(shape)
+                if mode == "max":
+                    out = r.max(axis=spatial_off + d + 1)
+                else:
+                    out = r.mean(axis=spatial_off + d + 1)
+            else:
+                starts, ends = _adaptive_start_end(in_size, o)
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e),
+                                               axis=spatial_off + d)
+                    if mode == "max":
+                        pieces.append(seg.max(axis=spatial_off + d, keepdims=True))
+                    else:
+                        pieces.append(seg.mean(axis=spatial_off + d, keepdims=True))
+                out = jnp.concatenate(pieces, axis=spatial_off + d)
+        return out
+
+    return _apply_op(f, x, _name=f"adaptive_{mode}_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

@@ -1,0 +1,197 @@
+"""Weight initializers (python/paddle/nn/initializer parity — SURVEY.md §2.2).
+
+Each initializer is a callable `(shape, np_dtype) -> jax array`, consuming
+keys from the global KeyStream so `paddle.seed` makes init reproducible.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+
+
+class Initializer:
+    def __call__(self, shape, dtype):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle layout [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def _compute_dtype(dtype):
+    # random sampling in f32 then cast (matches reference numeric behavior
+    # for bf16/f16 params)
+    d = np.dtype(dtype)
+    if d in (np.dtype(np.float16),) or d.itemsize < 4:
+        return np.float32
+    return d
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = np.asarray(
+            self.value.numpy() if hasattr(self.value, "numpy") else self.value
+        )
+        return jnp.asarray(arr.reshape(tuple(shape)), dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        return jax.random.uniform(
+            k, tuple(shape), dtype=_compute_dtype(dtype),
+            minval=self.low, maxval=self.high,
+        ).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        z = jax.random.normal(k, tuple(shape), dtype=_compute_dtype(dtype))
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        lo = (self.a - 0.0)  # bounds are in std units around mean in paddle 2.6+
+        z = jax.random.truncated_normal(
+            k, self.a, self.b, tuple(shape), dtype=_compute_dtype(dtype)
+        )
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = _random.next_key()
+        return jax.random.uniform(
+            k, tuple(shape), dtype=_compute_dtype(dtype), minval=-limit, maxval=limit
+        ).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = _random.next_key()
+        z = jax.random.normal(k, tuple(shape), dtype=_compute_dtype(dtype))
+        return (std * z).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return math.sqrt(2.0)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        k = _random.next_key()
+        return jax.random.uniform(
+            k, tuple(shape), dtype=_compute_dtype(dtype), minval=-limit, maxval=limit
+        ).astype(dtype)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        std = self._gain() / math.sqrt(fi)
+        k = _random.next_key()
+        z = jax.random.normal(k, tuple(shape), dtype=_compute_dtype(dtype))
+        return (std * z).astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        return jax.nn.initializers.orthogonal(scale=self.gain)(
+            k, tuple(shape), _compute_dtype(dtype)
+        ).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        arr = np.zeros(tuple(shape), dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        per = oc // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, ic)):
+                center = tuple(s // 2 for s in shape[2:])
+                arr[(g * per + i, i) + center] = 1.0
+        return jnp.asarray(arr, dtype=dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains[nonlinearity]

@@ -1,0 +1,382 @@
+"""nn.Layer: module base with parameter/buffer/sublayer registries.
+
+Reference parity: python/paddle/nn/layer/layers.py `Layer` (SURVEY.md §2.2
+"nn layers"): create_parameter, register_buffer, state_dict/set_state_dict,
+named_parameters/sublayers, train/eval, forward hooks, apply, to().
+
+TPU-native notes: parameters are Tensors over jax.Array; `parameters_pytree`
+exposes the whole module as a jax pytree (name->array dict) so `to_static` /
+pjit can functionalize a Layer without copying (SURVEY.md §7 phase 4).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import config as _config
+from ..framework import dtype as _dtype
+from ..tensor import Parameter, Tensor
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._dtype = dtype
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+
+    # ------------------------------------------------------------------
+    # attribute routing
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for d in (subs, bufs):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            for d in (params, bufs):
+                if d is not None:
+                    d.pop(name, None)
+            subs[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params.pop(name)
+                object.__setattr__(self, name, None)
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif bufs is not None and name in bufs:
+            if isinstance(value, Tensor) or value is None:
+                bufs[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Parameter:
+        from . import initializer as I
+
+        dtype = dtype or self._dtype or _config.get_default_dtype()
+        init = None
+        learning_rate = 1.0
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            from .param_attr import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer
+                learning_rate = attr.learning_rate
+                name = attr.name
+                trainable = attr.trainable
+            elif isinstance(attr, I.Initializer):
+                init = attr
+            elif isinstance(attr, str):
+                name = attr
+        if init is None:
+            init = default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(shape, _dtype.to_np_dtype(dtype))
+        p = Parameter(data, name=name, trainable=trainable)
+        p.optimize_attr = {"learning_rate": learning_rate}
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def children(self):
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix, include_self=False)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip("."),
+                                          include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            owner = self
+            if "." in name:
+                for part in name.split(".")[:-1]:
+                    owner = getattr(owner, part)
+            if short not in owner._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                tgt = own[k]
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                if tuple(arr.shape) != tuple(tgt._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: {arr.shape} vs "
+                        f"{tuple(tgt._data.shape)}"
+                    )
+                tgt.set_value(arr.astype(np.dtype(tgt._data.dtype)))
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # functionalization for jit/pjit (TPU-native addition)
+    # ------------------------------------------------------------------
+    def parameters_pytree(self) -> Dict[str, object]:
+        """name -> raw jax array pytree (for jit/pjit functionalization)."""
+        return {n: p._data for n, p in self.named_parameters()}
+
+    def buffers_pytree(self) -> Dict[str, object]:
+        return {n: b._data for n, b in self.named_buffers()}
+
+    def load_pytree(self, tree: Dict[str, object]):
+        params = dict(self.named_parameters())
+        bufs = dict(self.named_buffers())
+        for n, arr in tree.items():
+            if n in params:
+                params[n]._rebind(arr)
+            elif n in bufs:
+                bufs[n]._rebind(arr)
+        return self
+
+    # ------------------------------------------------------------------
+    # hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+
+        from ..framework import device as _device
+
+        for t in list(self.parameters()) + list(self.buffers()):
+            data = t._data
+            if dtype is not None and _dtype.is_floating_dtype(data.dtype):
+                data = data.astype(_dtype.to_np_dtype(dtype))
+            if device is not None:
+                place = (
+                    device
+                    if isinstance(device, _device.Place)
+                    else _device._parse_device(device)
+                )
+                data = jax.device_put(data, place.jax_device())
+            t._rebind(data)
+        if dtype is not None:
+            self._dtype = _dtype.from_np_dtype(_dtype.to_np_dtype(dtype)).name
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n".join(
+                "  " + line for line in mod_str.split("\n")
+            )
+            lines.append(f"  ({name}): {mod_str.strip()}")
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n" + "\n".join(lines) + "\n"
+        main += ")"
+        return main

@@ -1,0 +1,278 @@
+"""RNN layers (python/paddle/nn/layer/rnn.py parity): SimpleRNN/LSTM/GRU +
+cells. TPU-native: the time loop is one `lax.scan` (compiler-friendly static
+control flow — SURVEY.md "XLA semantics"), the whole multi-layer stack is a
+single tape op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, _apply_op, as_array
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+
+def _gates(mode):
+    return {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[mode]
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    gi = x_t @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gi + gh, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        ri, zi, ni = jnp.split(gi, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi + zh)
+        n = jnp.tanh(ni + r * nh)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    h_new = act(gi + gh)
+    return h_new, c
+
+
+def _rnn_forward(mode, num_layers, bidirectional, arrays, x, h0, c0,
+                 time_major=False):
+    """arrays: flat list [w_ih, w_hh, b_ih, b_hh] per (layer, direction)."""
+    ndir = 2 if bidirectional else 1
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # -> [time, batch, in]
+    t_steps, batch = x.shape[0], x.shape[1]
+    hidden = arrays[1].shape[1]
+
+    h_all, c_all = [], []
+    inp = x
+    idx = 0
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(ndir):
+            w_ih, w_hh, b_ih, b_hh = arrays[idx: idx + 4]
+            idx += 4
+            li = layer * ndir + d
+            h_init = h0[li]
+            c_init = c0[li] if c0 is not None else jnp.zeros_like(h_init)
+            seq = inp if d == 0 else jnp.flip(inp, axis=0)
+
+            def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                h, c = carry
+                h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (h2, c2), h2
+
+            (h_last, c_last), ys = jax.lax.scan(step, (h_init, c_init), seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs_dir.append(ys)
+            h_all.append(h_last)
+            c_all.append(c_last)
+        inp = jnp.concatenate(outs_dir, axis=-1) if ndir == 2 else outs_dir[0]
+    out = inp
+    if not time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    h_stack = jnp.stack(h_all, axis=0)
+    c_stack = jnp.stack(c_all, axis=0)
+    return out, h_stack, c_stack
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirectional else 1
+        g = _gates(mode)
+        std = 1.0 / np.sqrt(hidden_size)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                suffix = "_reverse" if d == 1 else ""
+                names = [
+                    f"weight_ih_l{layer}{suffix}",
+                    f"weight_hh_l{layer}{suffix}",
+                    f"bias_ih_l{layer}{suffix}",
+                    f"bias_hh_l{layer}{suffix}",
+                ]
+                shapes = [
+                    [g * hidden_size, in_sz],
+                    [g * hidden_size, hidden_size],
+                    [g * hidden_size],
+                    [g * hidden_size],
+                ]
+                for n, s in zip(names, shapes):
+                    p = self.create_parameter(
+                        shape=s, default_initializer=I.Uniform(-std, std)
+                    )
+                    self.add_parameter(n, p)
+                self._param_names.extend(names)
+
+    def _flat_params(self):
+        return [self._parameters[n] for n in self._param_names]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        a = as_array(inputs)
+        ndir = 2 if self.bidirectional else 1
+        n_states = self.num_layers * ndir
+        batch = a.shape[1] if self.time_major else a.shape[0]
+        if initial_states is None:
+            import jax.numpy as jnp2
+
+            h0 = Tensor(jnp2.zeros((n_states, batch, self.hidden_size),
+                                   dtype=a.dtype))
+            c0 = Tensor(jnp2.zeros((n_states, batch, self.hidden_size),
+                                   dtype=a.dtype)) if self.mode == "LSTM" else None
+        else:
+            if self.mode == "LSTM":
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+
+        params = self._flat_params()
+        mode = self.mode
+        nl, bd, tm = self.num_layers, self.bidirectional, self.time_major
+
+        if c0 is not None:
+
+            def f(x, h, c, *ws):
+                out, hs, cs = _rnn_forward(mode, nl, bd, list(ws), x, h, c, tm)
+                return out, hs, cs
+
+            out, h_n, c_n = _apply_op(f, inputs, h0, c0, *params, _name=mode)
+            return out, (h_n, c_n)
+
+        def f(x, h, *ws):
+            out, hs, _ = _rnn_forward(mode, nl, bd, list(ws), x, h, None, tm)
+            return out, hs
+
+        out, h_n = _apply_op(f, inputs, h0, *params, _name=mode)
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class _CellBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, **kw):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = _gates(mode)
+        std = 1.0 / np.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], is_bias=True, default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], is_bias=True, default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        a = as_array(inputs)
+        batch = a.shape[0]
+        if states is None:
+            z = Tensor(jnp.zeros((batch, self.hidden_size), dtype=a.dtype))
+            states = (z, Tensor(jnp.zeros((batch, self.hidden_size),
+                                          dtype=a.dtype))) if self.mode == "LSTM" else z
+        if self.mode == "LSTM":
+            h, c = states
+
+            def f(x, hh, cc, wi, wh, bi, bh):
+                return _cell_step(self.mode, x, hh, cc, wi, wh, bi, bh)
+
+            h2, c2 = _apply_op(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                               self.bias_ih, self.bias_hh, _name=self.mode)
+            return h2, (h2, c2)
+        h = states
+
+        def f(x, hh, wi, wh, bi, bh):
+            h2, _ = _cell_step(self.mode, x, hh, None if self.mode == "GRU" else hh,
+                               wi, wh, bi, bh)
+            return h2
+
+        h2 = _apply_op(f, inputs, h, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, _name=self.mode)
+        return h2, h2
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__("RNN_RELU" if activation == "relu" else "RNN_TANH",
+                         input_size, hidden_size, **kw)
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("LSTM", input_size, hidden_size, **kw)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("GRU", input_size, hidden_size, **kw)
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import flip, stack
+
+        a = as_array(inputs)
+        t_axis = 0 if self.time_major else 1
+        steps = a.shape[t_axis]
+        xs = [inputs[(slice(None),) * t_axis + (t,)] for t in range(steps)]
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x_t in xs:
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=t_axis)
+        return out, states

@@ -1,0 +1,135 @@
+"""Op registry: aggregates all functional ops and installs them as Tensor
+methods (the analog of the reference's monkey-patched tensor methods from
+python/paddle/tensor/__init__.py — SURVEY.md §2.2)."""
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    activation,
+    creation,
+    indexing,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random_ops,
+    reduction,
+    search,
+)
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _method_from(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    method.__name__ = fn.__name__
+    return method
+
+
+# Named tensor methods (x.add(y), x.reshape(...), x.sum(), ...)
+_METHOD_SOURCES = [math, reduction, manipulation, logic, linalg, search, activation]
+_SKIP = {"cast"}  # handled explicitly
+
+
+def _install_tensor_methods():
+    import types
+
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            if hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, _method_from(fn))
+
+    # dunder operators
+    import jax.numpy as jnp
+
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: (
+        logic.logical_and(s, o) if s.dtype == "bool" else math.bitwise_and(s, o)
+    )
+    Tensor.__or__ = lambda s, o: (
+        logic.logical_or(s, o) if s.dtype == "bool" else math.bitwise_or(s, o)
+    )
+    Tensor.__xor__ = lambda s, o: (
+        logic.logical_xor(s, o) if s.dtype == "bool" else math.bitwise_xor(s, o)
+    )
+    Tensor.__invert__ = lambda s: (
+        logic.logical_not(s) if s.dtype == "bool" else math.bitwise_not(s)
+    )
+    Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, o)
+    Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, o)
+
+    # in-place arithmetic (paddle x.add_(y) style + augmented assignment)
+    def _inplace(fn):
+        def m(self, other):
+            out = fn(self, other)
+            self._rebind(out._data, out._tape_node, out._tape_out_idx)
+            return self
+
+        return m
+
+    Tensor.add_ = _inplace(math.add)
+    Tensor.subtract_ = _inplace(math.subtract)
+    Tensor.multiply_ = _inplace(math.multiply)
+    Tensor.divide_ = _inplace(math.divide)
+    Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None: (
+        self._rebind(math.scale(self, scale, bias, bias_after_scale)._data) or self
+    )
+    Tensor.clip_ = _inplace(lambda s, *a, **k: math.clip(s, *a, **k))
+
+    def _clip_inplace(self, min=None, max=None, name=None):
+        out = math.clip(self, min, max)
+        self._rebind(out._data, out._tape_node, out._tape_out_idx)
+        return self
+
+    Tensor.clip_ = _clip_inplace
+    Tensor.exp_ = lambda self: (self._rebind(math.exp(self)._data) or self)
+    Tensor.sqrt_ = lambda self: (self._rebind(math.sqrt(self)._data) or self)
+    Tensor.reciprocal_ = lambda self: (
+        self._rebind(math.reciprocal(self)._data) or self
+    )
+    Tensor.floor_ = lambda self: (self._rebind(math.floor(self)._data) or self)
+    Tensor.ceil_ = lambda self: (self._rebind(math.ceil(self)._data) or self)
+    Tensor.round_ = lambda self: (self._rebind(math.round(self)._data) or self)
+    Tensor.tanh_ = lambda self: (self._rebind(math.tanh(self)._data) or self)
+    Tensor.uniform_ = random_ops.uniform_
+    Tensor.normal_ = random_ops.normal_
+    Tensor.exponential_ = random_ops.exponential_
+    Tensor.bernoulli_ = random_ops.bernoulli_
+
+    # a few names that collide with properties/builtins
+    Tensor.matmul = lambda s, y, transpose_x=False, transpose_y=False: linalg.matmul(
+        s, y, transpose_x, transpose_y
+    )
+    Tensor.numpy_method_sum = None
+
+
+_install_tensor_methods()

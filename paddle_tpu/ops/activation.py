@@ -1,0 +1,230 @@
+"""Activation ops (python/paddle/nn/functional/activation.py parity).
+
+All lower to jax.nn — XLA fuses these into surrounding matmuls on TPU
+(SURVEY.md: "fuse elementwise ops into matmuls").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def relu(x, name=None):
+    return _apply_op(jax.nn.relu, x, _name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._rebind(out._data, out._tape_node, out._tape_out_idx)
+    return x
+
+
+def relu6(x, name=None):
+    return _apply_op(jax.nn.relu6, x, _name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return _apply_op(
+        lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x, _name="gelu"
+    )
+
+
+def sigmoid(x, name=None):
+    return _apply_op(jax.nn.sigmoid, x, _name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return _apply_op(jax.nn.log_sigmoid, x, _name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return _apply_op(jnp.tanh, x, _name="tanh")
+
+
+def tanhshrink(x, name=None):
+    return _apply_op(lambda a: a - jnp.tanh(a), x, _name="tanhshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _apply_op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, _name="hardshrink"
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+        _name="softshrink",
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _apply_op(lambda a: jnp.clip(a, min, max), x, _name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _apply_op(
+        lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x, _name="hardsigmoid"
+    )
+
+
+def hardswish(x, name=None):
+    return _apply_op(
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, _name="hardswish"
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    return _apply_op(lambda a: jax.nn.elu(a, alpha=alpha), x, _name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _apply_op(lambda a: jax.nn.celu(a, alpha=alpha), x, _name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _apply_op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, _name="selu"
+    )
+
+
+def silu(x, name=None):
+    return _apply_op(jax.nn.silu, x, _name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return _apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, _name="mish")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _apply_op(
+        lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+        x,
+        _name="leaky_relu",
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        if data_format == "NCHW":
+            shape = [1, -1] + [1] * (a.ndim - 2)
+        else:
+            shape = [1] * (a.ndim - 1) + [-1]
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+
+    return _apply_op(f, x, weight, _name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from ..framework import random as _random
+
+        key = _random.next_key()
+
+        def f(a):
+            r = jax.random.uniform(key, a.shape, dtype=a.dtype, minval=lower,
+                                   maxval=upper)
+            return jnp.where(a >= 0, a, r * a)
+
+        return _apply_op(f, x, _name="rrelu")
+    mid = (lower + upper) / 2.0
+    return _apply_op(lambda a: jnp.where(a >= 0, a, mid * a), x, _name="rrelu")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _apply_op(
+        lambda a: jnp.where(a * beta > threshold, a,
+                            (1.0 / beta) * jax.nn.softplus(beta * a)),
+        x,
+        _name="softplus",
+    )
+
+
+def softsign(x, name=None):
+    return _apply_op(jax.nn.soft_sign, x, _name="softsign")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ..framework import dtype as _dtype
+
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+
+    def f(a):
+        if nd is not None:
+            a = a.astype(nd)
+        return jax.nn.softmax(a, axis=int(axis))
+
+    return _apply_op(f, x, _name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ..framework import dtype as _dtype
+
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+
+    def f(a):
+        if nd is not None:
+            a = a.astype(nd)
+        return jax.nn.log_softmax(a, axis=int(axis))
+
+    return _apply_op(f, x, _name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..framework import random as _random
+
+    key = _random.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[...].set(jax.nn.one_hot(jnp.squeeze(idx, axis),
+                                                  a.shape[axis], axis=axis,
+                                                  dtype=a.dtype))
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return _apply_op(f, x, _name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = int(axis) % a.ndim
+        c = a.shape[ax]
+        new_shape = list(a.shape)
+        new_shape[ax: ax + 1] = [groups, c // groups]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return _apply_op(f, x, _name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=int(axis))
+        return a1 * jax.nn.sigmoid(a2)
+
+    return _apply_op(f, x, _name="glu")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _apply_op(
+        lambda a: jnp.where(a > threshold, a, value), x, _name="thresholded_relu"
+    )

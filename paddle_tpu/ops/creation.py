@@ -1,0 +1,212 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (SURVEY.md §2.2 "Tensor
+API"): zeros/ones/full/arange/linspace/eye/empty + *_like variants, tril/triu,
+diag/diagflat, meshgrid, clone/assign. Random creation lives in random_ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import config as _config
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, _apply_op, as_array, to_tensor  # noqa: F401
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return _dtype.to_np_dtype(default or _config.get_default_dtype())
+    return _dtype.to_np_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return [int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), dtype=_resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), dtype=_resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = _config.get_default_dtype()  # paddle uses default float here
+        else:
+            dtype = _config.get_default_dtype()
+    return Tensor(
+        jnp.full(_shape_list(shape), fill_value, dtype=_resolve_dtype(dtype))
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    a = as_array(x)
+    return Tensor(jnp.zeros_like(a, dtype=_dtype.to_np_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    a = as_array(x)
+    return Tensor(jnp.ones_like(a, dtype=_dtype.to_np_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    a = as_array(x)
+    return Tensor(
+        jnp.full_like(a, fill_value, dtype=_dtype.to_np_dtype(dtype) if dtype else None)
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor args: pass python scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = _config.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dtype.to_np_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_resolve_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(start, stop, int(num), base=base, dtype=_resolve_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns),
+                          dtype=_resolve_dtype(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return _apply_op(lambda a: jnp.tril(a, k=int(diagonal)), x, _name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return _apply_op(lambda a: jnp.triu(a, k=int(diagonal)), x, _name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        _dtype.to_np_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        _dtype.to_np_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, dtype=a.dtype)
+            idx = jnp.arange(a.shape[0])
+            if offset >= 0:
+                return out.at[idx, idx + offset].set(a)
+            return out.at[idx - offset, idx].set(a)
+        return jnp.diag(a, k=int(offset))
+
+    return _apply_op(f, x, _name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return _apply_op(lambda a: jnp.diagflat(a, k=int(offset)), x, _name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def f(a):
+        base = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), dtype=a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            out = base.at[..., idx, idx + offset].set(a)
+        else:
+            base = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), dtype=a.dtype)
+            out = base.at[..., idx - offset, idx].set(a)
+        # move to requested dims
+        return out
+
+    return _apply_op(f, x, _name="diag_embed")
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    arrays = [as_array(t) for t in tensors]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = as_array(x)
+    if output is not None:
+        output._rebind(jnp.asarray(data, dtype=output._data.dtype)
+                       if hasattr(output, "_rebind") else data)
+        return output
+    return Tensor(data)
+
+
+def clone(x, name=None):
+    from . import math as _math
+
+    return _math._identity(x)
+
+
+def complex(real, imag, name=None):
+    return _apply_op(lambda r, i: jax.lax.complex(r, i), real, imag, _name="complex")
+
+
+import jax  # noqa: E402  (used by complex above)
+
+
+def polar(abs_t, angle, name=None):
+    return _apply_op(
+        lambda a, th: jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th)),
+        abs_t,
+        angle,
+        _name="polar",
+    )
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+
+    return Tensor(
+        jnn.one_hot(as_array(x), int(num_classes),
+                    dtype=_dtype.to_np_dtype(_config.get_default_dtype()))
+    )

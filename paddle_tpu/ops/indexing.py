@@ -1,0 +1,92 @@
+"""__getitem__/__setitem__ support with paddle semantics (Tensor indices,
+bool masks, slices). Advanced dynamic-shape cases (bool mask select) are
+eager-only, like the reference's dygraph."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _normalize_index(idx):
+    """Convert Tensors inside an index expression to jax arrays / ints."""
+    if isinstance(idx, Tensor):
+        if idx.ndim == 0:
+            return as_array(idx)
+        return as_array(idx)
+    if isinstance(idx, tuple):
+        return tuple(_normalize_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def _has_bool_mask(idx):
+    if isinstance(idx, tuple):
+        return any(_has_bool_mask(i) for i in idx)
+    if isinstance(idx, Tensor):
+        return idx.dtype == "bool"
+    if isinstance(idx, (jnp.ndarray, np.ndarray)):
+        return np.asarray(idx).dtype == np.bool_
+    return False
+
+
+def getitem(x, idx):
+    if _has_bool_mask(idx):
+        # dynamic output shape: materialize on host (eager-only path)
+        a = np.asarray(as_array(x))
+        nidx = idx
+        if isinstance(nidx, Tensor):
+            nidx = np.asarray(as_array(nidx))
+        elif isinstance(nidx, tuple):
+            nidx = tuple(
+                np.asarray(as_array(i)) if isinstance(i, Tensor) else i for i in nidx
+            )
+        return Tensor(jnp.asarray(a[nidx]))
+    nidx = _normalize_index(idx)
+    return _apply_op(lambda a: a[nidx], x, _name="getitem")
+
+
+def setitem_(x, idx, value):
+    nidx = _normalize_index(idx)
+    if _has_bool_mask(idx):
+        mask_val = nidx if not isinstance(nidx, tuple) else nidx
+        if isinstance(value, Tensor) or not np.isscalar(value):
+            v = as_array(value) if isinstance(value, Tensor) else jnp.asarray(value)
+            a = as_array(x)
+            if not isinstance(nidx, tuple) and v.ndim <= a.ndim:
+                m = jnp.broadcast_to(nidx, a.shape)
+                if v.ndim == 0 or v.size == 1:
+                    out = jnp.where(m, jnp.asarray(v, dtype=a.dtype), a)
+                    x._rebind(out)
+                    return x
+            # general host path
+            host = np.asarray(a).copy()
+            host[np.asarray(nidx) if not isinstance(nidx, tuple) else
+                 tuple(np.asarray(i) for i in nidx)] = np.asarray(v)
+            x._rebind(jnp.asarray(host))
+            return x
+        a = as_array(x)
+        m = jnp.broadcast_to(nidx, a.shape) if not isinstance(nidx, tuple) else None
+        if m is not None:
+            out = jnp.where(m, jnp.asarray(value, dtype=a.dtype), a)
+            x._rebind(out)
+            return x
+        host = np.asarray(a).copy()
+        host[tuple(np.asarray(i) for i in nidx)] = value
+        x._rebind(jnp.asarray(host))
+        return x
+
+    if isinstance(value, Tensor):
+        out = _apply_op(
+            lambda a, v: a.at[nidx].set(v.astype(a.dtype)), x, value, _name="setitem"
+        )
+    else:
+        out = _apply_op(
+            lambda a: a.at[nidx].set(jnp.asarray(value).astype(a.dtype)),
+            x,
+            _name="setitem",
+        )
+    x._rebind(out._data, out._tape_node, out._tape_out_idx)
+    return x

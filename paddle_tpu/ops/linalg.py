@@ -1,0 +1,342 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py + paddle.linalg parity).
+
+matmul/bmm/dot/mv/norm + decompositions (svd/qr/eigh/lu/cholesky), solves,
+inverses, einsum. Decompositions lower to lax.linalg — on TPU the MXU handles
+the inner matmuls; host fallbacks are avoided.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return _apply_op(f, x, y, _name="matmul")
+
+
+def bmm(x, y, name=None):
+    return _apply_op(jnp.matmul, x, y, _name="bmm")
+
+
+def mm(input, mat2, name=None):
+    return _apply_op(jnp.matmul, input, mat2, _name="mm")
+
+
+def dot(x, y, name=None):
+    return _apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y, _name="dot")
+
+
+def mv(x, vec, name=None):
+    return _apply_op(jnp.matmul, x, vec, _name="mv")
+
+
+def matrix_transpose(x, name=None):
+    return _apply_op(lambda a: jnp.swapaxes(a, -1, -2), x, _name="matrix_transpose")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = axis if axis is None else (
+        tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else int(axis))
+
+    def f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+            return r
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        pv = float(p)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), pv), axis=ax, keepdims=keepdim), 1.0 / pv
+        )
+
+    return _apply_op(f, x, _name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def f(a):
+        return jnp.linalg.norm(a, ord=None if p == "fro" else p,
+                               axis=tuple(axis), keepdims=keepdim)
+
+    return _apply_op(f, x, _name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+    return _apply_op(f, x, y, _name="dist")
+
+
+def cdist(x, y, p=2.0, name=None, compute_mode=None):
+    def f(a, b):
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == float("inf"):
+            return jnp.max(d, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p)
+
+    return _apply_op(f, x, y, _name="cdist")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=int(ax))
+
+    return _apply_op(f, x, y, _name="cross")
+
+
+def t(x, name=None):
+    from . import manipulation
+
+    return manipulation.t(x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return _apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                     _name="tensordot")
+
+
+def einsum(equation, *operands):
+    ops_ = list(operands)
+    if len(ops_) == 1 and isinstance(ops_[0], (list, tuple)):
+        ops_ = list(ops_[0])
+    return _apply_op(
+        lambda *arrs: jnp.einsum(equation, *arrs), *ops_, _name="einsum"
+    )
+
+
+def multi_dot(x, name=None):
+    return _apply_op(
+        lambda *arrs: jnp.linalg.multi_dot(arrs), *list(x), _name="multi_dot"
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    a = np.asarray(as_array(input)).reshape(-1)
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(a.min()), float(a.max())
+    w = np.asarray(as_array(weight)).reshape(-1) if weight is not None else None
+    h, _ = np.histogram(a, bins=int(bins), range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(h if density or w is not None else h.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(as_array(x))
+    w = np.asarray(as_array(weights)) if weights is not None else None
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = as_array(x)
+    if weights is not None:
+        return Tensor(jnp.bincount(a, weights=as_array(weights),
+                                   minlength=int(minlength)))
+    return Tensor(jnp.bincount(a, minlength=int(minlength)))
+
+
+# --- decompositions / solvers (paddle.linalg namespace) ---
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return _apply_op(f, x, _name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return _apply_op(f, x, y, _name="cholesky_solve")
+
+
+def inv(x, name=None):
+    return _apply_op(jnp.linalg.inv, x, _name="inv")
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return _apply_op(jnp.linalg.det, x, _name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return _apply_op(f, x, _name="slogdet")
+
+
+def matrix_power(x, n, name=None):
+    return _apply_op(lambda a: jnp.linalg.matrix_power(a, int(n)), x,
+                     _name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    a = as_array(x)
+    return Tensor(jnp.linalg.matrix_rank(a, rtol=tol))
+
+
+def svd(x, full_matrices=False, name=None):
+    out = _apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x,
+        _name="svd",
+    )
+    u, s, vh = out
+    from . import manipulation
+
+    # paddle returns V not V^H
+    return u, s, matrix_transpose(vh)
+
+
+def svdvals(x, name=None):
+    return _apply_op(
+        lambda a: jnp.linalg.svd(a, compute_uv=False), x, _name="svdvals"
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _apply_op(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        x,
+        _name="pinv",
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    out = _apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, _name="qr")
+    return out
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    a = as_array(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(a)
+    outs = [Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1)]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), dtype=jnp.int32)))
+    return tuple(outs)
+
+
+def eig(x, name=None):
+    a = np.asarray(as_array(x))
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    out = _apply_op(
+        lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x, _name="eigh"
+    )
+    return out
+
+
+def eigvals(x, name=None):
+    a = np.asarray(as_array(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _apply_op(jnp.linalg.eigvalsh, x, _name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return _apply_op(jnp.linalg.solve, x, y, _name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return _apply_op(f, x, y, _name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = as_array(x), as_array(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(as_array(x), rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(
+        jnp.cov(as_array(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                fweights=None if fweights is None else as_array(fweights),
+                aweights=None if aweights is None else as_array(aweights))
+    )
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = as_array(x)
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(
+        jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return q @ h
+
+        for i in range(t.shape[-1]):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return _apply_op(f, x, tau, _name="householder_product")

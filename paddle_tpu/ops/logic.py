@@ -1,0 +1,99 @@
+"""Comparison & logic ops (python/paddle/tensor/logic.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _cmp(fn, name):
+    def op(x, y, name_=None, name=None):
+        return Tensor(fn(as_array(x), as_array(y)))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+
+
+def equal_all(x, y, name=None):
+    a, b = as_array(x), as_array(y)
+    if a.shape != b.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(a == b))
+
+
+def logical_and(x, y, out=None, name=None):
+    return Tensor(jnp.logical_and(as_array(x), as_array(y)))
+
+
+def logical_or(x, y, out=None, name=None):
+    return Tensor(jnp.logical_or(as_array(x), as_array(y)))
+
+
+def logical_xor(x, y, out=None, name=None):
+    return Tensor(jnp.logical_xor(as_array(x), as_array(y)))
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(as_array(x)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.allclose(as_array(x), as_array(y), rtol=float(rtol), atol=float(atol),
+                     equal_nan=equal_nan)
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.isclose(as_array(x), as_array(y), rtol=float(rtol), atol=float(atol),
+                    equal_nan=equal_nan)
+    )
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from . import search
+
+        return search.nonzero(condition, as_tuple=True)
+    return _apply_op(
+        lambda c, a, b: jnp.where(c, a, b), condition, x, y, _name="where"
+    )
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._rebind(out._data, out._tape_node, out._tape_out_idx)
+    return x
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_array(x).size == 0))
+
+
+def isreal(x, name=None):
+    a = as_array(x)
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        return Tensor(jnp.imag(a) == 0)
+    return Tensor(jnp.ones(a.shape, dtype=bool))
+
+
+def in1d(x, test, name=None):
+    a, b = as_array(x), as_array(test)
+    return Tensor(jnp.isin(a, b))
+
+
+isin = in1d

@@ -1,0 +1,608 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py (SURVEY.md §2.2):
+reshape/transpose/concat/split/stack/squeeze/unsqueeze/flatten/tile/expand/
+flip/roll/gather/scatter/index_select/chunk/pad/unbind/take_along_axis/
+put_along_axis/repeat_interleave/...
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(i) if not isinstance(i, Tensor) else int(i.item()) for i in v]
+
+
+def reshape(x, shape, name=None):
+    shape = _int_list(shape)
+    return _apply_op(lambda a: jnp.reshape(a, shape), x, _name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._rebind(out._data, out._tape_node, out._tape_out_idx)
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    nd = _dtype.to_np_dtype(shape_or_dtype)
+    return Tensor(as_array(x).view(nd))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    perm = _int_list(perm)
+    return _apply_op(lambda a: jnp.transpose(a, perm), x, _name="transpose")
+
+
+def t(x, name=None):
+    def f(a):
+        if a.ndim < 2:
+            return a
+        if a.ndim == 2:
+            return a.T
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+
+    return _apply_op(f, x, _name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return _apply_op(
+        lambda a: jnp.moveaxis(a, _int_list(source), _int_list(destination)),
+        x,
+        _name="moveaxis",
+    )
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return _apply_op(
+        lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x, _name="swapaxes"
+    )
+
+
+transpose_ = transpose
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _apply_op(
+        lambda *arrs: jnp.concatenate(arrs, axis=int(axis)), *tensors, _name="concat"
+    )
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return _apply_op(
+        lambda *arrs: jnp.stack(arrs, axis=int(axis)), *tensors, _name="stack"
+    )
+
+
+def hstack(x, name=None):
+    return _apply_op(lambda *arrs: jnp.hstack(arrs), *list(x), _name="hstack")
+
+
+def vstack(x, name=None):
+    return _apply_op(lambda *arrs: jnp.vstack(arrs), *list(x), _name="vstack")
+
+
+def dstack(x, name=None):
+    return _apply_op(lambda *arrs: jnp.dstack(arrs), *list(x), _name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    a_shape = as_array(x).shape
+    dim = a_shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} on axis {axis} is not divisible by "
+                f"num {num_or_sections}"
+            )
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = _int_list(num_or_sections)
+        # paddle allows one -1 entry
+        if -1 in sections:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections[:-1]).tolist()
+
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+            for o, s in zip(offsets, sections)
+        )
+
+    out = _apply_op(f, x, _name="split")
+    return list(out)
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    """Like split but allows a ragged final chunk (paddle.chunk semantics)."""
+    chunks = int(chunks)
+    dim = as_array(x).shape[int(axis) if not isinstance(axis, Tensor)
+                            else int(axis.item())]
+    if dim % chunks == 0:
+        return split(x, chunks, axis=axis)
+    per = -(-dim // chunks)  # ceil
+    sections = [per] * (dim // per) + ([dim % per] if dim % per else [])
+    return split(x, sections, axis=axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    a = as_array(x)
+    pieces = np.array_split(np.arange(a.shape[int(axis)]),
+                            num_or_indices) if isinstance(num_or_indices, int) else None
+    if pieces is not None:
+        sections = [len(p) for p in pieces]
+        return split(x, sections, axis=axis)
+    idxs = _int_list(num_or_indices)
+    sections = []
+    prev = 0
+    for i in idxs:
+        sections.append(i - prev)
+        prev = i
+    sections.append(a.shape[int(axis)] - prev)
+    return split(x, sections, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = as_array(x).shape[int(axis)]
+
+    def f(a):
+        return tuple(jnp.take(a, i, axis=int(axis)) for i in range(n))
+
+    return list(_apply_op(f, x, _name="unbind"))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _int_list(axis)
+        if isinstance(axes, int):
+            axes = [axes]
+        axes = [ax % a.ndim for ax in axes]
+        axes = [ax for ax in axes if a.shape[ax] == 1]
+        return jnp.squeeze(a, axis=tuple(axes)) if axes else a
+
+    return _apply_op(f, x, _name="squeeze")
+
+
+squeeze_ = squeeze
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _int_list(axis)
+    if isinstance(axes, int):
+        axes = [axes]
+
+    def f(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return _apply_op(f, x, _name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape(1)
+        s = start_axis % nd
+        e = stop_axis % nd
+        new_shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
+        return a.reshape(new_shape)
+
+    return _apply_op(f, x, _name="flatten")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _int_list(repeat_times)
+    if isinstance(reps, int):
+        reps = [reps]
+    return _apply_op(lambda a: jnp.tile(a, reps), x, _name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _int_list(shape)
+
+    def f(a):
+        tgt = list(shape)
+        # -1 entries keep original size (paddle semantics)
+        a_shape = list(a.shape)
+        pad = len(tgt) - len(a_shape)
+        full = [1] * pad + a_shape
+        out_shape = [full[i] if tgt[i] == -1 else tgt[i] for i in range(len(tgt))]
+        return jnp.broadcast_to(a.reshape(full), out_shape)
+
+    return _apply_op(f, x, _name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(as_array(y).shape))
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _int_list(shape)
+    return _apply_op(lambda a: jnp.broadcast_to(a, shape), x, _name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [as_array(i) for i in inputs]
+    shape = np.broadcast_shapes(*[a.shape for a in arrays])
+    return [broadcast_to(i, list(shape)) for i in inputs]
+
+
+def flip(x, axis, name=None):
+    axes = _int_list(axis)
+    if isinstance(axes, int):
+        axes = [axes]
+    return _apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x, _name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _int_list(shifts)
+    ax = _int_list(axis) if axis is not None else None
+    return _apply_op(lambda a: jnp.roll(a, sh, axis=ax), x, _name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1).astype(jnp.int32), axis=int(axis))
+
+    return _apply_op(f, x, index, _name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return _apply_op(f, x, index, _name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle: overwrite=False means accumulate after zeroing target rows
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+
+    return _apply_op(f, x, index, updates, _name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._rebind(out._data, out._tape_node, out._tape_out_idx)
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return _apply_op(f, x, index, updates, _name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _int_list(shape)
+
+    def f(idx, upd):
+        zeros = jnp.zeros(shape, dtype=upd.dtype)
+        idx = idx.astype(jnp.int32)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return _apply_op(f, index, updates, _name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1).astype(jnp.int32), axis=int(axis))
+
+    return _apply_op(f, x, index, _name="index_select")
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)
+
+    return _apply_op(f, x, index, _name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        moved = jnp.moveaxis(a, int(axis), 0)
+        vmoved = jnp.moveaxis(v, int(axis), 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, int(axis))
+
+    return _apply_op(f, x, index, value, _name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_arrays = tuple(as_array(i) for i in indices)
+
+    def f(a, v, *idx):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(jnp.broadcast_to(v, a[idx].shape))
+
+    return _apply_op(f, x, value, *list(indices), _name="index_put")
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def f(a, idx):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        out = moved.at[idx.reshape(-1).astype(jnp.int32)].set(fill_value)
+        return jnp.moveaxis(out, 0, int(axis))
+
+    return _apply_op(f, x, index, _name="index_fill")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=int(axis))
+
+    return _apply_op(f, arr, indices, _name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        ax = int(axis) % a.ndim
+        # build open mesh of indices for other dims
+        others = jnp.indices(idx.shape)
+        full_idx = tuple(
+            idx if d == ax else others[d] for d in dims
+        )
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return _apply_op(f, arr, indices, values, _name="put_along_axis")
+
+
+def masked_select(x, mask, name=None):
+    a, m = as_array(x), as_array(mask)
+    m = jnp.broadcast_to(m, a.shape)
+    # dynamic-shape op: eager only (not jittable) — matches reference semantics
+    np_a = np.asarray(a)
+    np_m = np.asarray(m)
+    return Tensor(jnp.asarray(np_a[np_m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return _apply_op(
+            lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), x, mask, value,
+            _name="masked_fill",
+        )
+    return _apply_op(
+        lambda a, m: jnp.where(m, jnp.asarray(value, dtype=a.dtype), a), x, mask,
+        _name="masked_fill",
+    )
+
+
+def masked_scatter(x, mask, value, name=None):
+    a, m, v = as_array(x), as_array(mask), as_array(value)
+    m = np.asarray(jnp.broadcast_to(m, a.shape))
+    out = np.asarray(a).copy()
+    out[m] = np.asarray(v).reshape(-1)[: int(m.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = as_array(repeats)
+
+        def f(a, r):
+            return jnp.repeat(a, r, axis=axis if axis is None else int(axis),
+                              total_repeat_length=int(np.asarray(r).sum()))
+
+        return _apply_op(f, x, repeats, _name="repeat_interleave")
+    return _apply_op(
+        lambda a: jnp.repeat(a, int(repeats), axis=axis if axis is None else int(axis)),
+        x,
+        _name="repeat_interleave",
+    )
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad_list = _int_list(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            # full-rank paddle format: [(before,after) per dim] flattened? paddle
+            # uses [dim0_before, dim0_after, ...]
+            widths = [
+                (pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)
+            ]
+        else:
+            # partial spec applies to trailing spatial dims (torch/paddle NCHW
+            # convention: last dim first)
+            k = len(pad_list) // 2
+            widths = [(0, 0)] * nd
+            for i in range(k):
+                dim = nd - 1 - i
+                widths[dim] = (pad_list[2 * i], pad_list[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return _apply_op(f, x, _name="pad")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    a = np.asarray(as_array(x))
+    out = np.lib.stride_tricks.as_strided(
+        a.reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * a.itemsize for s in stride),
+    )
+    return Tensor(jnp.asarray(out))
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            dim = out.shape[ax]
+            s2 = s + dim if s < 0 else min(s, dim)
+            e2 = e + dim if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+
+    return _apply_op(f, input, _name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+    strides_l = _int_list(strides)
+
+    def f(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides_l):
+            idx[ax] = slice_builtin(s, e, st)
+        return a[tuple(idx)]
+
+    return _apply_op(f, x, _name="strided_slice")
+
+
+def slice_builtin(*args):
+    import builtins
+
+    return builtins.slice(*args)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _int_list(shape)
+    offsets = _int_list(offsets) if offsets is not None else [0] * len(shape)
+
+    def f(a):
+        idx = tuple(
+            slice_builtin(o, o + (s if s != -1 else a.shape[i] - o))
+            for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return a[idx]
+
+    return _apply_op(f, x, _name="crop")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(as_array(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(as_array(x))
+    if axis is None:
+        a = a.reshape(-1)
+        change = np.concatenate([[True], a[1:] != a[:-1]])
+        vals = a[change]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(change) - 1
+            outs.append(Tensor(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            counts = np.diff(np.append(idx, a.size))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, _name="rot90")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [_apply_op(jnp.atleast_1d, t, _name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [_apply_op(jnp.atleast_2d, t, _name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [_apply_op(jnp.atleast_3d, t, _name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (a >= lo) & (a < hi)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return Tensor(f(as_array(input)))

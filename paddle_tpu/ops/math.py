@@ -1,0 +1,355 @@
+"""Elementwise & scalar math ops.
+
+Reference parity: python/paddle/tensor/math.py + ops.py (SURVEY.md §2.2):
+binary arithmetic with broadcasting, unary math, cast, clip, cumulative ops,
+lerp, addmm, etc. Each op is one jnp/lax expression applied through the
+autograd tape (`_apply_op`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _binop(fn, name):
+    def op(x, y, name_=None, name=None):
+        return _apply_op(fn, x, y, _name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.divide, "divide")
+mod = _binop(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+pow = _binop(jnp.power, "pow")
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+hypot = _binop(jnp.hypot, "hypot")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+heaviside = _binop(jnp.heaviside, "heaviside")
+copysign = _binop(jnp.copysign, "copysign")
+nextafter = _binop(jnp.nextafter, "nextafter")
+gcd = _binop(jnp.gcd, "gcd")
+lcm = _binop(jnp.lcm, "lcm")
+ldexp = _binop(lambda x, i: jnp.ldexp(x, i.astype(jnp.int32)), "ldexp")
+
+bitwise_and = _binop(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _binop(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _binop(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _binop(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _binop(jnp.right_shift, "bitwise_right_shift")
+
+
+def bitwise_not(x, name=None):
+    return _apply_op(jnp.bitwise_not, x, _name="bitwise_not")
+
+
+def _unop(fn, name):
+    def op(x, name_=None, name=None):
+        return _apply_op(fn, x, _name=name)
+
+    op.__name__ = name
+    return op
+
+
+exp = _unop(jnp.exp, "exp")
+expm1 = _unop(jnp.expm1, "expm1")
+log = _unop(jnp.log, "log")
+log2 = _unop(jnp.log2, "log2")
+log10 = _unop(jnp.log10, "log10")
+log1p = _unop(jnp.log1p, "log1p")
+sqrt = _unop(jnp.sqrt, "sqrt")
+rsqrt = _unop(jax.lax.rsqrt, "rsqrt")
+square = _unop(jnp.square, "square")
+abs = _unop(jnp.abs, "abs")
+sign = _unop(jnp.sign, "sign")
+sgn = sign
+neg = _unop(jnp.negative, "neg")
+negative = neg
+reciprocal = _unop(jnp.reciprocal, "reciprocal")
+floor = _unop(jnp.floor, "floor")
+ceil = _unop(jnp.ceil, "ceil")
+trunc = _unop(jnp.trunc, "trunc")
+frac = _unop(lambda a: a - jnp.trunc(a), "frac")
+sin = _unop(jnp.sin, "sin")
+cos = _unop(jnp.cos, "cos")
+tan = _unop(jnp.tan, "tan")
+asin = _unop(jnp.arcsin, "asin")
+acos = _unop(jnp.arccos, "acos")
+atan = _unop(jnp.arctan, "atan")
+sinh = _unop(jnp.sinh, "sinh")
+cosh = _unop(jnp.cosh, "cosh")
+tanh = _unop(jnp.tanh, "tanh")
+asinh = _unop(jnp.arcsinh, "asinh")
+acosh = _unop(jnp.arccosh, "acosh")
+atanh = _unop(jnp.arctanh, "atanh")
+erf = _unop(jax.scipy.special.erf, "erf")
+erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unop(jax.scipy.special.gammaln, "lgamma")
+digamma = _unop(jax.scipy.special.digamma, "digamma")
+i0 = _unop(jnp.i0, "i0")
+angle = _unop(jnp.angle, "angle")
+conj = _unop(jnp.conjugate, "conj")
+real = _unop(jnp.real, "real")
+imag = _unop(jnp.imag, "imag")
+deg2rad = _unop(jnp.deg2rad, "deg2rad")
+rad2deg = _unop(jnp.rad2deg, "rad2deg")
+exponent = _unop(lambda a: jnp.frexp(a)[1].astype(a.dtype), "exponent")
+
+
+def _identity(x, name=None):
+    return _apply_op(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a,
+                     x, _name="identity")
+
+
+def round(x, decimals=0, name=None):
+    return _apply_op(lambda a: jnp.round(a, decimals=int(decimals)), x, _name="round")
+
+
+def cast(x, dtype):
+    nd = _dtype.to_np_dtype(dtype)
+    return _apply_op(lambda a: a.astype(nd), x, _name="cast")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = scale, bias
+    if isinstance(s, Tensor):
+        s = s._data
+    if bias_after_scale:
+        out = _apply_op(lambda a: a * s + b, x, _name="scale")
+    else:
+        out = _apply_op(lambda a: (a + b) * s, x, _name="scale")
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = as_array(min) if isinstance(min, Tensor) else min
+    hi = as_array(max) if isinstance(max, Tensor) else max
+    return _apply_op(lambda a: jnp.clip(a, lo, hi), x, _name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return _apply_op(lambda a, b, w: a + w * (b - a), x, y, weight, _name="lerp")
+    return _apply_op(lambda a, b: a + weight * (b - a), x, y, _name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x, _name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    arrays = [as_array(i) for i in inputs]
+
+    def f(idx, *arrs):
+        stacked = jnp.stack(arrs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+            axis=0,
+        )[0]
+
+    return _apply_op(f, index, *inputs, _name="multiplex")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=nd)
+        return jnp.cumsum(a, axis=int(axis), dtype=nd)
+
+    return _apply_op(f, x, _name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+
+    def f(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=nd)
+        return jnp.cumprod(a, axis=int(dim), dtype=nd)
+
+    return _apply_op(f, x, _name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        if axis is None:
+            a = a.reshape(-1)
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        return vals
+
+    values = _apply_op(f, x, _name="cummax")
+    # indices: argmax of running max
+    a = as_array(x)
+    ax = 0 if axis is None else int(axis)
+    if axis is None:
+        a = a.reshape(-1)
+    vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+    eq = a == vals
+    idx = jnp.arange(a.shape[ax]).reshape(
+        [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)]
+    )
+    run_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(eq, idx, -1), axis=ax
+    )
+    return values, Tensor(run_idx.astype(_dtype.to_np_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    neg = _apply_op(jnp.negative, x, _name="neg")
+    vals, idx = cummax(neg, axis=axis, dtype=dtype)
+    return _apply_op(jnp.negative, vals, _name="neg"), idx
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        if axis is None:
+            a = a.reshape(-1)
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return _apply_op(f, x, _name="logcumsumexp")
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(as_array(x)))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(as_array(x)))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(as_array(x)))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _apply_op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        _name="nan_to_num",
+    )
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _apply_op(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, _name="addmm"
+    )
+
+
+def inner(x, y, name=None):
+    return _apply_op(jnp.inner, x, y, _name="inner")
+
+
+def outer(x, y, name=None):
+    return _apply_op(
+        lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y, _name="outer"
+    )
+
+
+def kron(x, y, name=None):
+    return _apply_op(jnp.kron, x, y, _name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _apply_op(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        _name="trace",
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _apply_op(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        _name="diagonal",
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    extra = []
+    if prepend is not None:
+        extra.append(prepend)
+    if append is not None:
+        extra.append(append)
+
+    def f(a, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = (rest[1] if prepend is not None else rest[0]) if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return _apply_op(f, x, *extra, _name="diff")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, _name="rot90")
+
+
+def take(x, index, mode="raise", name=None):
+    def f(a, idx):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            idx = idx % flat.shape[0]
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        else:
+            idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        return flat[idx]
+
+    return _apply_op(f, x, index, _name="take")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    x._rebind(as_array(x) + value)
+    return x
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    out = jnp.count_nonzero(as_array(x), axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(jnp.int64))
+
+
+def gammaln(x, name=None):
+    return lgamma(x)
+
+
+def polygamma(x, n, name=None):
+    return _apply_op(lambda a: jax.scipy.special.polygamma(int(n), a), x,
+                     _name="polygamma")
+
+
+def igamma(x, a, name=None):
+    return _apply_op(lambda xx, aa: jax.scipy.special.gammaincc(xx, aa), x, a,
+                     _name="igamma")
+
+
+def igammac(x, a, name=None):
+    return _apply_op(lambda xx, aa: jax.scipy.special.gammainc(xx, aa), x, a,
+                     _name="igammac")

@@ -1,0 +1,180 @@
+"""Random sampling ops (python/paddle/tensor/random.py parity) over the
+stateful KeyStream (framework/random.py): rand/randn/randint/randperm/
+uniform/normal/bernoulli/multinomial/poisson/exponential_."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import config as _config
+from ..framework import dtype as _dtype
+from ..framework import random as _random
+from ..tensor import Tensor, as_array
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape]
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return _dtype.to_np_dtype(default or _config.get_default_dtype())
+    return _dtype.to_np_dtype(dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    key = _random.next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape_list(shape), dtype=_resolve_dtype(dtype))
+    )
+
+
+def randn(shape, dtype=None, name=None):
+    key = _random.next_key()
+    return Tensor(
+        jax.random.normal(key, _shape_list(shape), dtype=_resolve_dtype(dtype))
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.next_key()
+    return Tensor(
+        jax.random.uniform(
+            key, _shape_list(shape), dtype=_resolve_dtype(dtype),
+            minval=float(min), maxval=float(max),
+        )
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._rebind(out._data)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = _random.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_array(mean) if isinstance(mean, Tensor) else mean
+        s = as_array(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        z = jax.random.normal(key, shp,
+                              dtype=_resolve_dtype(None))
+        return Tensor(m + s * z)
+    shp = _shape_list(shape) if shape is not None else []
+    z = jax.random.normal(key, shp, dtype=_resolve_dtype(None))
+    return Tensor(mean + std * z)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = _random.next_key()
+    z = jax.random.normal(key, tuple(x.shape), dtype=x._data.dtype)
+    x._rebind(mean + std * z)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return Tensor(
+        jax.random.randint(
+            key, _shape_list(shape), int(low), int(high),
+            dtype=_dtype.to_np_dtype(dtype),
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.next_key()
+    return Tensor(
+        jax.random.permutation(key, int(n)).astype(_dtype.to_np_dtype(dtype))
+    )
+
+
+def bernoulli(x, name=None):
+    key = _random.next_key()
+    a = as_array(x)
+    return Tensor(
+        jax.random.bernoulli(key, a).astype(a.dtype)
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _random.next_key()
+    out = jax.random.bernoulli(key, p, shape=tuple(x.shape)).astype(x._data.dtype)
+    x._rebind(out)
+    return x
+
+
+def poisson(x, name=None):
+    key = _random.next_key()
+    a = as_array(x)
+    return Tensor(jax.random.poisson(key, a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.next_key()
+    a = as_array(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + a.shape[:-1])
+        if a.ndim == 1:
+            return Tensor(out.astype(jnp.int64))
+        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+    # without replacement: gumbel top-k trick
+    g = jax.random.gumbel(key, a.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _random.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), dtype=x._data.dtype)
+    x._rebind(-jnp.log1p(-u) / lam)
+    return x
+
+
+def binomial(count, prob, name=None):
+    key = _random.next_key()
+    c = as_array(count)
+    p = as_array(prob)
+    return Tensor(jax.random.binomial(key, c, p).astype(jnp.int64))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    key = _random.next_key()
+    out = loc + scale * jax.random.cauchy(key, tuple(x.shape), dtype=x._data.dtype)
+    x._rebind(out)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    key = _random.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), dtype=x._data.dtype)
+    x._rebind(jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs)))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    key = _random.next_key()
+    z = jax.random.normal(key, tuple(x.shape), dtype=x._data.dtype)
+    x._rebind(jnp.exp(mean + std * z))
+    return x

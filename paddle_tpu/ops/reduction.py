@@ -1,0 +1,160 @@
+"""Reduction ops.
+
+Reference parity: python/paddle/tensor/math.py + stat.py reductions
+(SURVEY.md §2.2): sum/mean/max/min/prod/all/any/logsumexp/amax/amin,
+var/std/median/quantile/nanmean/nansum, norm-style reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.sum(a, axis=ax, dtype=nd, keepdims=keepdim), x, _name="sum"
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.nansum(a, axis=ax, dtype=nd, keepdims=keepdim), x, _name="nansum"
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, _name="mean")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x, _name="nanmean"
+    )
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    nd = _dtype.to_np_dtype(dtype) if dtype else None
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.prod(a, axis=ax, dtype=nd, keepdims=keepdim), x, _name="prod"
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, _name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, _name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor(jnp.all(as_array(x), axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor(jnp.any(as_array(x), axis=ax, keepdims=keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        x,
+        _name="logsumexp",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        _name="var",
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        _name="std",
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=ax, keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        s = jnp.sort(a, axis=ax)
+        idx = (s.shape[ax] - 1) // 2
+        out = jnp.take(s, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return _apply_op(f, x, _name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return _apply_op(
+        lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, _name="nanmedian"
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qv = as_array(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return _apply_op(
+        lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim, method=interpolation),
+        x,
+        _name="quantile",
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    qv = as_array(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return _apply_op(
+        lambda a: jnp.nanquantile(a, qv, axis=ax, keepdims=keepdim),
+        x,
+        _name="nanquantile",
+    )

@@ -1,0 +1,135 @@
+"""Search/sort ops (python/paddle/tensor/search.py parity): argmax/argmin/
+argsort/sort/topk/nonzero/searchsorted/kthvalue/mode/bucketize."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = as_array(x)
+    if axis is None:
+        out = jnp.argmax(a.reshape(-1))
+        if keepdim:
+            out = out.reshape([1] * a.ndim)
+    else:
+        out = jnp.argmax(a, axis=int(axis), keepdims=keepdim)
+    return Tensor(out.astype(_dtype.to_np_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = as_array(x)
+    if axis is None:
+        out = jnp.argmin(a.reshape(-1))
+        if keepdim:
+            out = out.reshape([1] * a.ndim)
+    else:
+        out = jnp.argmin(a, axis=int(axis), keepdims=keepdim)
+    return Tensor(out.astype(_dtype.to_np_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = as_array(x)
+    out = jnp.argsort(-a if descending else a, axis=int(axis), stable=stable or descending)
+    return Tensor(out.astype(jnp.int64) if out.dtype != jnp.int64 else out)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=int(axis), stable=True)
+        if descending:
+            s = jnp.flip(s, axis=int(axis))
+        return s
+
+    return _apply_op(f, x, _name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    a = as_array(x)
+    ax = int(axis) % a.ndim if a.ndim else 0
+
+    def f(arr):
+        moved = jnp.moveaxis(arr, ax, -1)
+        vals, _ = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax)
+
+    values = _apply_op(f, x, _name="topk")
+    moved = jnp.moveaxis(a, ax, -1)
+    _, idx = jax.lax.top_k(moved if largest else -moved, k)
+    indices = Tensor(jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return values, indices
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    a = as_array(x)
+    ax = int(axis) % a.ndim
+
+    def f(arr):
+        s = jnp.sort(arr, axis=ax)
+        out = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    values = _apply_op(f, x, _name="kthvalue")
+    si = jnp.argsort(a, axis=ax)
+    idx = jnp.take(si, k - 1, axis=ax)
+    if keepdim:
+        idx = jnp.expand_dims(idx, ax)
+    return values, Tensor(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(as_array(x))
+    ax = int(axis) % a.ndim
+
+    def mode_1d(v):
+        vals, counts = np.unique(v, return_counts=True)
+        best = vals[np.argmax(counts)]
+        idx = np.where(v == best)[0][-1]
+        return best, idx
+
+    out_vals = np.apply_along_axis(lambda v: mode_1d(v)[0], ax, a)
+    out_idx = np.apply_along_axis(lambda v: mode_1d(v)[1], ax, a)
+    if keepdim:
+        out_vals = np.expand_dims(out_vals, ax)
+        out_idx = np.expand_dims(out_idx, ax)
+    return Tensor(jnp.asarray(out_vals)), Tensor(jnp.asarray(out_idx, dtype=jnp.int64))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(as_array(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None], dtype=jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return Tensor(f(as_array(sorted_sequence), as_array(values)))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_of_first(x, value):  # convenience, not in paddle
+    a = np.asarray(as_array(x))
+    idx = np.where(a == value)[0]
+    return int(idx[0]) if idx.size else -1

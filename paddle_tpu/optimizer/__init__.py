@@ -1,0 +1,16 @@
+"""paddle.optimizer namespace (SURVEY.md §2.2 "Optimizers")."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    L1Decay,
+    L2Decay,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+    SGD,
+)

@@ -1,0 +1,420 @@
+"""Optimizers (python/paddle/optimizer parity — SURVEY.md §2.2).
+
+Design: each optimizer keeps per-parameter accumulator state as raw jax
+arrays keyed by parameter identity, and exposes the update math as a pure
+function `_update_param(p, g, state, lr) -> (new_p, new_state)` so that:
+- eager `step()` applies it per parameter (reference dygraph semantics);
+- the jit path (`paddle_tpu.jit.to_static` training step) calls
+  `apply_gradients_functional` over pytrees inside the compiled program
+  (optimizer-state donation, no host round-trips).
+Weight decay follows paddle: `weight_decay` coef on Adam = L2 reg added to
+grad; AdamW = decoupled decay.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Parameter, Tensor, as_array
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", 0.0))
+        self._accumulators: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------------------------------------------------------------
+    def _init_state(self, p: Parameter) -> Dict[str, Any]:
+        return {}
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _state_for(self, p: Parameter):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p)
+        return self._accumulators[key]
+
+    def _decay_grad(self, p, g):
+        """paddle L2 regularization: grad += coef * param (non-decoupled)."""
+        if self._weight_decay:
+            return g + self._weight_decay * p
+        return g
+
+    # ------------------------------------------------------------------
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            state = self._state_for(p)
+            lr_scale = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            new_p, new_state = self._update_param(
+                as_array(p), as_array(g), state, lr * lr_scale,
+                param_name=p.name,
+            )
+            p._rebind(new_p)
+            self._accumulators[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------------------
+    # functional interface for the jit path
+    # ------------------------------------------------------------------
+    def init_state_pytree(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """params: name -> array. Returns name -> state dict."""
+
+        class _Shell:
+            def __init__(self, data):
+                self._data = data
+
+        return {n: self._init_state(_Shell(a)) for n, a in params.items()}
+
+    def apply_gradients_functional(self, params, grads, opt_state, lr):
+        """Pure pytree update (used inside jit). params/grads: name->array."""
+        new_params, new_state = {}, {}
+        for n, p in params.items():
+            g = grads.get(n)
+            if g is None:
+                new_params[n] = p
+                new_state[n] = opt_state[n]
+                continue
+            np_, ns = self._update_param(p, g, opt_state[n], lr, param_name=n)
+            new_params[n] = np_
+            new_state[n] = ns
+        return new_params, new_state
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = self._accumulators.get(id(p))
+                if st:
+                    for k, v in st.items():
+                        out[f"{p.name or i}_{k}"] = Tensor(v) if not isinstance(
+                            v, (int, float)) else v
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step", 0)
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = self._state_for(p)
+                for k in list(st.keys()):
+                    key = f"{p.name or i}_{k}"
+                    if key in state:
+                        v = state[key]
+                        st[k] = as_array(v) if isinstance(v, Tensor) else v
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p, g)
+        return p - lr * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p, g)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return p - lr * update.astype(p.dtype), {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_val)}
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p, g)
+        m = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _init_state(self, p):
+        st = {
+            "moment1": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "moment2": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), dtype=jnp.float32),
+            "beta2_pow": jnp.ones((), dtype=jnp.float32),
+        }
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master_weight"] = p._data.astype(jnp.float32)
+        return st
+
+    def _decoupled(self):
+        return False
+
+    def _decoupled_coeff(self, param_name):
+        return 0.0
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        master = state.get("master_weight")
+        work = master if master is not None else p.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if not self._decoupled():
+            if self._weight_decay:
+                g = g + self._weight_decay * work
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        if self._decoupled():
+            work = work * (1 - lr * self._decoupled_coeff(param_name))
+        work = work - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_state = {
+            "moment1": m1,
+            "moment2": m2,
+            "beta1_pow": b1p,
+            "beta2_pow": b2p,
+        }
+        if master is not None:
+            new_state["master_weight"] = work
+            return work.astype(p.dtype), new_state
+        return work.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay, "_coeff") \
+            else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def _decoupled_coeff(self, param_name):
+        """paddle semantics: apply_decay_param_fun(name) -> False skips
+        decay for that parameter (e.g. biases/LayerNorm)."""
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param_name)):
+            return 0.0
+        return self._coeff
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "inf_norm": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), dtype=jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p.astype(jnp.float32), g.astype(jnp.float32))
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - b1p)) * m / (u + self._epsilon)
+        return new_p.astype(p.dtype), {
+            "moment": m, "inf_norm": u, "beta1_pow": b1p,
+        }
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {
+            "mean_square": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "momentum": jnp.zeros(p._data.shape, dtype=jnp.float32),
+        }
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p._data.shape, dtype=jnp.float32)
+        return st
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p.astype(jnp.float32), g.astype(jnp.float32))
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_state(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "avg_squared_update": jnp.zeros(p._data.shape, dtype=jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        g = self._decay_grad(p.astype(jnp.float32), g.astype(jnp.float32))
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = (
+            jnp.sqrt(state["avg_squared_update"] + self._epsilon)
+            / jnp.sqrt(asg + self._epsilon)
+        ) * g
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * \
+            jnp.square(update)
+        return (p.astype(jnp.float32) - lr * update).astype(p.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu,
+        }
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._coeff = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "moment2": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), dtype=jnp.float32),
+            "beta2_pow": jnp.ones((), dtype=jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        pf = p.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        m1h = m1 / (1 - b1p)
+        m2h = m2 / (1 - b2p)
+        coeff = self._coeff
+        if self._exclude_fn is not None and self._exclude_fn(param_name):
+            coeff = 0.0
+        r = m1h / (jnp.sqrt(m2h) + self._epsilon) + coeff * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

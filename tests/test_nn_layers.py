@@ -1,0 +1,212 @@
+"""nn.Layer + layers tests (SURVEY.md §2.2 "nn layers")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestLayerBase:
+    def test_registry(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 4)
+                self.w = paddle.Parameter(_rand(2, 2))
+                self.register_buffer("buf", paddle.to_tensor(_rand(3)))
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert "w" in names and "fc.weight" in names and "fc.bias" in names
+        assert len(net.parameters()) == 3
+        assert len(list(net.buffers())) == 1
+        sd = net.state_dict()
+        assert "buf" in sd and "fc.weight" in sd
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(3, 4)
+        net2 = nn.Linear(3, 4)
+        net2.set_state_dict(net1.state_dict())
+        np.testing.assert_array_equal(net1.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_save_load(self, tmp_path):
+        net = nn.Linear(3, 4)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        np.testing.assert_array_equal(loaded["weight"].numpy(),
+                                      net.weight.numpy())
+
+    def test_forward_hooks(self):
+        net = nn.Linear(3, 3)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        net(paddle.to_tensor(_rand(2, 3)))
+        assert calls
+        h.remove()
+        net(paddle.to_tensor(_rand(2, 3)))
+        assert len(calls) == 1
+
+
+class TestCoreLayers:
+    def test_linear(self):
+        fc = nn.Linear(4, 3)
+        x = _rand(2, 4)
+        out = fc(paddle.to_tensor(x))
+        np.testing.assert_allclose(
+            out.numpy(), x @ fc.weight.numpy() + fc.bias.numpy(), rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 5, 9]))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[[1, 5, 9]])
+
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        out = conv(paddle.to_tensor(_rand(2, 3, 16, 16)))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = _rand(1, 1, 3, 3)
+        out = conv(paddle.to_tensor(x)).numpy()
+        w = conv.weight.numpy()[0, 0]
+        expect = np.zeros((1, 1, 2, 2), "float32")
+        for i in range(2):
+            for j in range(2):
+                expect[0, 0, i, j] = (x[0, 0, i:i + 2, j:j + 2] * w).sum()
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_pool(self):
+        x = _rand(1, 2, 4, 4)
+        out = nn.MaxPool2D(2, 2)(paddle.to_tensor(x))
+        expect = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), expect)
+        out = nn.AvgPool2D(2, 2)(paddle.to_tensor(x))
+        expect = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = _rand(4, 8)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out, (x - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = _rand(4, 3, 5, 5) * 2 + 1
+        bn.train()
+        out = bn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(paddle.to_tensor(x))
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = _rand(2, 8)
+        out = rn(paddle.to_tensor(x)).numpy()
+        expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        d.train()
+        out = d(x)
+        kept = (out.numpy() != 0).mean()
+        assert 0.3 < kept < 0.7
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_losses(self):
+        logits = _rand(4, 5)
+        labels = np.random.randint(0, 5, (4,))
+        loss = nn.CrossEntropyLoss()(paddle.to_tensor(logits),
+                                     paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+        a, b = _rand(3), _rand(3)
+        np.testing.assert_allclose(
+            float(nn.MSELoss()(paddle.to_tensor(a), paddle.to_tensor(b))),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(seq) == 3
+        out = seq(paddle.to_tensor(_rand(4, 2)))
+        assert out.shape == [4, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll.parameters()) == 6
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.to_tensor(_rand(3, 5, 4)))
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8]
+        assert c.shape == [2, 3, 8]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 6, direction="bidirect")
+        out, h = gru(paddle.to_tensor(_rand(2, 5, 4)))
+        assert out.shape == [2, 5, 12]
+        assert h.shape == [2, 2, 6]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(3, 4)
+        x = paddle.to_tensor(_rand(2, 5, 3), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(_rand(2, 5, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.to_tensor(_rand(2, 5, 16)))
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_cache_decode(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        mha.eval()
+        x = paddle.to_tensor(_rand(2, 1, 16))
+        cache = mha.gen_cache(x)
+        out, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 1
+        out, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 2

@@ -1,0 +1,317 @@
+"""Op tests vs numpy references (reference pattern: test_matmul_v2_op.py
+etc. — SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _rand(*shape, dtype="float32"):
+    return np.random.randn(*shape).astype(dtype)
+
+
+class TestElementwise(OpTest):
+    def test_add(self):
+        self.check_output(paddle.add, np.add, _rand(3, 4), _rand(3, 4))
+
+    def test_add_broadcast(self):
+        self.check_output(paddle.add, np.add, _rand(3, 4), _rand(4))
+
+    def test_subtract(self):
+        self.check_output(paddle.subtract, np.subtract, _rand(5), _rand(5))
+
+    def test_multiply(self):
+        self.check_output(paddle.multiply, np.multiply, _rand(2, 3), _rand(2, 3))
+
+    def test_divide(self):
+        self.check_output(paddle.divide, np.divide, _rand(4),
+                          np.abs(_rand(4)) + 1.0)
+
+    def test_pow(self):
+        self.check_output(paddle.pow, np.power, np.abs(_rand(4)) + 0.5,
+                          _rand(4))
+
+    def test_maximum_minimum(self):
+        self.check_output(paddle.maximum, np.maximum, _rand(6), _rand(6))
+        self.check_output(paddle.minimum, np.minimum, _rand(6), _rand(6))
+
+    def test_operators(self):
+        a, b = _rand(3), _rand(3)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x / (y + 10)).numpy(), a / (b + 10),
+                                   rtol=1e-5)
+        np.testing.assert_allclose((-x).numpy(), -a)
+        np.testing.assert_allclose((x + 1.5).numpy(), a + 1.5, rtol=1e-6)
+        np.testing.assert_allclose((2 * x).numpy(), 2 * a, rtol=1e-6)
+
+
+class TestUnary(OpTest):
+    def test_exp_log(self):
+        self.check_output(paddle.exp, np.exp, _rand(4))
+        self.check_output(paddle.log, np.log, np.abs(_rand(4)) + 0.5)
+
+    def test_sqrt_square(self):
+        self.check_output(paddle.sqrt, np.sqrt, np.abs(_rand(4)))
+        self.check_output(paddle.square, np.square, _rand(4))
+
+    def test_trig(self):
+        self.check_output(paddle.sin, np.sin, _rand(4))
+        self.check_output(paddle.cos, np.cos, _rand(4))
+        self.check_output(paddle.tanh, np.tanh, _rand(4))
+
+    def test_abs_sign_floor_ceil(self):
+        self.check_output(paddle.abs, np.abs, _rand(4))
+        self.check_output(paddle.sign, np.sign, _rand(4))
+        self.check_output(paddle.floor, np.floor, _rand(4) * 3)
+        self.check_output(paddle.ceil, np.ceil, _rand(4) * 3)
+
+    def test_clip(self):
+        x = _rand(10)
+        out = paddle.clip(paddle.to_tensor(x), -0.5, 0.5)
+        np.testing.assert_allclose(out.numpy(), np.clip(x, -0.5, 0.5))
+
+
+class TestMatmul(OpTest):
+    def test_matmul(self):
+        self.check_output(paddle.matmul, lambda a, b: a @ b, _rand(3, 4),
+                          _rand(4, 5))
+
+    def test_matmul_batched(self):
+        self.check_output(paddle.matmul, lambda a, b: a @ b, _rand(2, 3, 4),
+                          _rand(2, 4, 5))
+
+    def test_matmul_transpose(self):
+        a, b = _rand(4, 3), _rand(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_matmul_grad(self):
+        self.check_grad(paddle.matmul, _rand(3, 4), _rand(4, 5), arg_idx=0)
+        self.check_grad(paddle.matmul, _rand(3, 4), _rand(4, 5), arg_idx=1)
+
+
+class TestReduction(OpTest):
+    def test_sum(self):
+        x = _rand(3, 4)
+        self.check_output(lambda t: paddle.sum(t), lambda a: np.sum(a), x)
+        self.check_output(lambda t: paddle.sum(t, axis=1),
+                          lambda a: np.sum(a, axis=1), x)
+        self.check_output(lambda t: paddle.sum(t, axis=0, keepdim=True),
+                          lambda a: np.sum(a, axis=0, keepdims=True), x)
+
+    def test_mean_max_min_prod(self):
+        x = _rand(3, 4)
+        self.check_output(paddle.mean, np.mean, x)
+        self.check_output(lambda t: paddle.max(t, axis=1),
+                          lambda a: np.max(a, axis=1), x)
+        self.check_output(lambda t: paddle.min(t, axis=0),
+                          lambda a: np.min(a, axis=0), x)
+        self.check_output(paddle.prod, np.prod, _rand(5) * 0.5)
+
+    def test_var_std(self):
+        x = _rand(3, 4)
+        self.check_output(lambda t: paddle.var(t), lambda a: np.var(a, ddof=1),
+                          x)
+        self.check_output(lambda t: paddle.std(t, unbiased=False),
+                          lambda a: np.std(a), x)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        x = _rand(3, 4)
+        out = paddle.logsumexp(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(out.numpy(), np_lse(x, axis=1), rtol=1e-5)
+
+    def test_cumsum(self):
+        x = _rand(3, 4)
+        self.check_output(lambda t: paddle.cumsum(t, axis=1),
+                          lambda a: np.cumsum(a, axis=1), x)
+
+    def test_sum_grad(self):
+        self.check_grad(lambda t: paddle.sum(t, axis=1), _rand(3, 4))
+
+
+class TestManipulation(OpTest):
+    def test_reshape_transpose(self):
+        x = _rand(2, 3, 4)
+        self.check_output(lambda t: paddle.reshape(t, [6, 4]),
+                          lambda a: a.reshape(6, 4), x)
+        self.check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                          lambda a: a.transpose(2, 0, 1), x)
+
+    def test_concat_stack_split(self):
+        a, b = _rand(2, 3), _rand(2, 3)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), a[:, 1:2])
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = _rand(2, 1, 3)
+        np.testing.assert_allclose(
+            paddle.squeeze(paddle.to_tensor(x), 1).numpy(), x.squeeze(1))
+        np.testing.assert_allclose(
+            paddle.unsqueeze(paddle.to_tensor(x), 0).numpy(), x[None])
+        np.testing.assert_allclose(
+            paddle.flatten(paddle.to_tensor(x), 1).numpy(), x.reshape(2, 3))
+
+    def test_gather_scatter(self):
+        x = _rand(5, 3)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        upd = _rand(3, 3)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        expect = x.copy()
+        expect[idx] = upd
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_tile_expand(self):
+        x = _rand(1, 3)
+        np.testing.assert_allclose(
+            paddle.tile(paddle.to_tensor(x), [2, 2]).numpy(),
+            np.tile(x, (2, 2)))
+        np.testing.assert_allclose(
+            paddle.expand(paddle.to_tensor(x), [4, 3]).numpy(),
+            np.broadcast_to(x, (4, 3)))
+
+    def test_indexing(self):
+        x = _rand(4, 5)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, 2:].numpy(), x[1:3, 2:])
+        np.testing.assert_allclose(t[:, -1].numpy(), x[:, -1])
+        mask = x > 0
+        np.testing.assert_allclose(
+            t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_setitem(self):
+        x = _rand(4, 5)
+        t = paddle.to_tensor(x)
+        t[1] = 0.0
+        x[1] = 0.0
+        np.testing.assert_allclose(t.numpy(), x)
+
+
+class TestSearchSort(OpTest):
+    def test_argmax_argsort(self):
+        x = _rand(3, 4)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+            np.argmax(x, axis=1))
+        np.testing.assert_array_equal(
+            paddle.argsort(paddle.to_tensor(x), axis=1).numpy(),
+            np.argsort(x, axis=1))
+
+    def test_topk(self):
+        x = _rand(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+        expect = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), expect, rtol=1e-6)
+
+    def test_sort_where_nonzero(self):
+        x = _rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.sort(paddle.to_tensor(x), axis=1).numpy(),
+            np.sort(x, axis=1))
+        cond = x > 0
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                           paddle.to_tensor(-x))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, x, -x))
+
+
+class TestActivations(OpTest):
+    def test_relu_sigmoid_softmax(self):
+        x = _rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.nn.functional.relu(paddle.to_tensor(x)).numpy(),
+            np.maximum(x, 0))
+        np.testing.assert_allclose(
+            paddle.nn.functional.sigmoid(paddle.to_tensor(x)).numpy(),
+            1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = paddle.nn.functional.softmax(paddle.to_tensor(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_gelu_grad(self):
+        self.check_grad(paddle.nn.functional.gelu, _rand(3, 3))
+
+
+class TestLinalg(OpTest):
+    def test_inv_det_solve(self):
+        a = _rand(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.det(paddle.to_tensor(a)).numpy(),
+            np.linalg.det(a), rtol=1e-4)
+        b = _rand(4, 2)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a),
+                                paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), atol=1e-4)
+
+    def test_svd_qr_eigh(self):
+        a = _rand(5, 3)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()[None]) @ v.numpy().T, a, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+        sym = a.T @ a
+        w, vecs = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(
+            vecs.numpy() @ np.diag(w.numpy()) @ vecs.numpy().T, sym, atol=1e-3)
+
+    def test_norm_einsum(self):
+        a = _rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(a)).numpy(),
+            np.linalg.norm(a), rtol=1e-5)
+        b = _rand(4, 5)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                          paddle.to_tensor(b)).numpy(), a @ b, rtol=1e-5)
+
+
+class TestCreation(OpTest):
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([4]).numpy().sum() == 4
+        np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                      np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3,
+                                      dtype=np.float32))
+        x = _rand(3, 3)
+        np.testing.assert_allclose(paddle.tril(paddle.to_tensor(x)).numpy(),
+                                   np.tril(x))
+
+    def test_dtype(self):
+        assert paddle.zeros([2], dtype="int64").dtype == paddle.int64
+        assert paddle.ones([2]).dtype == paddle.float32
+        assert paddle.to_tensor([1, 2]).dtype == paddle.int64
+        assert paddle.to_tensor([1.0]).dtype == paddle.float32
+        t = paddle.to_tensor([1.0]).astype("bfloat16")
+        assert t.dtype == paddle.bfloat16
+
+    def test_random(self):
+        paddle.seed(7)
+        a = paddle.rand([100])
+        paddle.seed(7)
+        b = paddle.rand([100])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert 0 <= a.numpy().min() and a.numpy().max() <= 1
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))

@@ -1,0 +1,136 @@
+"""Optimizer + LR scheduler tests (SURVEY.md §2.2 "Optimizers")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+def _quad_problem():
+    """min ||w - target||^2 — every optimizer must drive w toward target."""
+    target = np.array([1.0, -2.0, 3.0], "float32")
+    w = paddle.Parameter(np.zeros(3, "float32"))
+    return w, target
+
+
+def _run(opt_cls, steps=200, lr=0.1, **kw):
+    w, target = _quad_problem()
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (optimizer.SGD, {}),
+        (optimizer.Momentum, {"momentum": 0.9}),
+        (optimizer.Adam, {}),
+        (optimizer.AdamW, {"weight_decay": 0.0}),
+        (optimizer.RMSProp, {}),
+        (optimizer.Adagrad, {}),
+        (optimizer.Adadelta, {"lr": None} if False else {}),
+        (optimizer.Lamb, {"lamb_weight_decay": 0.0}),
+    ])
+    def test_converges(self, cls, kw):
+        lr = {optimizer.Adadelta: 20.0, optimizer.Adagrad: 1.0}.get(cls, 0.1)
+        w, target = _run(cls, lr=lr, **kw)
+        np.testing.assert_allclose(w, target, atol=0.2)
+
+    def test_sgd_exact_update(self):
+        w = paddle.Parameter(np.array([1.0, 2.0], "float32"))
+        opt = optimizer.SGD(learning_rate=0.5, parameters=[w])
+        (w.sum()).backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.5, 1.5])
+
+    def test_adam_vs_reference_formula(self):
+        w = paddle.Parameter(np.array([1.0], "float32"))
+        opt = optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                             parameters=[w])
+        (w * 2).sum().backward()
+        opt.step()
+        # first step: m=0.1*2/(1-0.9)=2, v=0.001*4/(1-0.999)=4 -> update=
+        # lr * 2/sqrt(4) = 0.1
+        np.testing.assert_allclose(w.numpy(), [0.9], atol=1e-5)
+
+    def test_weight_decay_l2(self):
+        w = paddle.Parameter(np.array([1.0], "float32"))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[w],
+                            weight_decay=0.5)
+        paddle.sum(w * 0).backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], atol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        w = paddle.Parameter(np.array([3.0, 4.0], "float32"))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+        (w * paddle.to_tensor(np.array([3.0, 4.0], "float32"))).sum().backward()
+        # grad = [3,4], norm 5 -> clipped to [0.6, 0.8]
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [3 - 0.6, 4 - 0.8], rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        w = paddle.Parameter(_rand(3))
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w ** 2).sum().backward()
+        opt.step()
+        state = opt.state_dict()
+        w2 = paddle.Parameter(w.numpy())
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(state)
+        assert opt2._step_count == opt._step_count
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(sched())
+            sched.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        sched = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        v0 = sched()
+        for _ in range(10):
+            sched.step()
+        assert sched() < 1e-6 and abs(v0 - 1.0) < 1e-6
+
+    def test_warmup(self):
+        sched = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                          end_lr=0.1)
+        vals = [sched()]
+        for _ in range(5):
+            sched.step()
+            vals.append(sched())
+        np.testing.assert_allclose(vals[-1], 0.1)
+        assert vals[1] < vals[-1]
+
+    def test_optimizer_uses_scheduler(self):
+        w = paddle.Parameter(np.array([1.0], "float32"))
+        sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+        w.sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-6)
+        sched.step()
+        opt.clear_grad()
+        w.sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.89], rtol=1e-5)
+
+    def test_reduce_on_plateau(self):
+        sched = optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            sched.step(loss)
+        assert sched() < 0.1
