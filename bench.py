@@ -1,0 +1,108 @@
+"""Benchmark: flagship-model training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Primary metric (BASELINE.md): tokens/sec/chip on the LLaMA-family train
+step. vs_baseline is achieved-MFU / 0.45 (the north-star MFU gate) since
+the reference publishes no absolute numbers in this environment
+(BASELINE.md provenance note).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def model_flops_per_token(cfg, seq_len):
+    """6*N (fwd+bwd matmul flops per token per param) + attention term."""
+    h = cfg.hidden_size
+    l = cfg.num_hidden_layers
+    v = cfg.vocab_size
+    inter = cfg.intermediate_size
+    # params in matmuls per layer: qkv+o (4 h^2) + mlp (3 h*inter)
+    per_layer = 4 * h * h + 3 * h * inter
+    n_matmul = l * per_layer + v * h  # + lm_head
+    flops = 6 * n_matmul
+    # attention scores/values: 2 matmuls of [s,d]x[d,s]: 12 * s * h per token
+    flops += 12 * seq_len * h * l
+    return flops
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+
+    # size the model to the bench platform: big enough to exercise the MXU,
+    # small enough to compile fast on one v5 lite chip
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        batch, seq, iters = 8, 1024, 20
+    else:
+        cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
+                               seq=128)
+        batch, seq, iters = 4, 128, 5
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        # bf16 weights: MXU-native (SURVEY.md "MXU")
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = build_train_step(model, opt)
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    y = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup / compile
+    loss = step(x, y)
+    loss_val = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    final = float(loss)  # blocks
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tok_per_sec = tokens / dt
+    tok_per_sec_chip = tok_per_sec / max(n_dev, 1)
+
+    flops_per_tok = model_flops_per_token(cfg, seq)
+    achieved_flops = tok_per_sec * flops_per_tok
+    # v5 lite (v5e-class): ~394 TFLOPs bf16 per chip; CPU: no meaningful MFU
+    peak = 394e12 * n_dev if on_tpu else 1e12
+    mfu = achieved_flops / peak
+
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "devices": n_dev,
+            "backend": jax.default_backend(),
+            "batch": batch,
+            "seq": seq,
+            "hidden": cfg.hidden_size,
+            "layers": cfg.num_hidden_layers,
+            "loss_first": round(loss_val, 4),
+            "loss_last": round(final, 4),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,9 +17,11 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import jax_compat as _jc
 from ..tensor import Tensor, as_array
 from . import mesh as _mesh
 
@@ -54,10 +56,10 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce (eager identity at world=1; psum under jit)."""
     axes = _axes_for_group(group)
     if _world(axes) == 1:
-        if jax.core.trace_state_clean():
+        if not _jc.tracing():
             return tensor
     a = as_array(tensor)
-    if not jax.core.trace_state_clean():
+    if _jc.tracing():
         # inside a jit/shard_map trace: emit the collective directly
         reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
                    "min": jax.lax.pmin, "avg": jax.lax.pmean}[op]

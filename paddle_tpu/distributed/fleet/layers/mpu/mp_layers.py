@@ -24,6 +24,18 @@ from .... import mesh as _mesh
 from ....sharding_utils import mark_sharding, shard_tensor
 
 
+def _axis_bound(name: str) -> bool:
+    """True iff `name` is a bound SPMD axis (i.e. we're inside shard_map/pmap
+    over it) — distinguishes manual-collective code from GSPMD tracing."""
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
 class ColumnParallelLinear(Layer):
     """Y = XW, W sharded on columns over 'tp'."""
 
@@ -113,13 +125,15 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         tp = _mesh.axis_size("tp")
-        if tp <= 1 or jax.core.trace_state_clean():
+        if tp <= 1 or not _axis_bound("tp"):
+            # dense CE; under pjit with tp-sharded logits, GSPMD partitions
+            # this computation and inserts the max/sum psums itself
             loss = F.cross_entropy(input, label, reduction="none",
                                    ignore_index=self.ignore_index)
             from .....ops.manipulation import unsqueeze
 
             return unsqueeze(loss, -1)
-        # inside jit with tp>1: explicit stable parallel CE
+        # inside shard_map with a bound tp axis: explicit stable parallel CE
         def f(logits, lab):
             lmax = jax.lax.pmax(jnp.max(logits, axis=-1, keepdims=True), "tp")
             shifted = logits - lmax

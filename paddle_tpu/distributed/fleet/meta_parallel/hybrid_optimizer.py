@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ....framework import jax_compat as _jc
 from ....nn.clip import ClipGradByGlobalNorm
 from ....tensor import Tensor, as_array
 from ... import collective as _collective
@@ -27,7 +28,7 @@ class HybridParallelClipGrad(ClipGradByGlobalNorm):
             return None
         import jax
 
-        if not jax.core.trace_state_clean():
+        if _jc.tracing():
             m = _mesh.get_mesh(optional=True)
             if m is not None:
                 for axis in ("tp", "pp", "sharding"):

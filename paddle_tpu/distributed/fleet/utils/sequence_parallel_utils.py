@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import jax
 
+from ....framework import jax_compat as _jc
+
 from ....nn import functional as F
 from ....nn import initializer as I
 from ....nn.layer_base import Layer
@@ -60,7 +62,7 @@ class AllGatherOp:
     @staticmethod
     def apply(x, axis=0):
         ax = _sp_axis()
-        if ax is None or jax.core.trace_state_clean():
+        if ax is None or not _jc.tracing():
             return x
         return _apply_op(
             lambda a: jax.lax.all_gather(a, ax, axis=axis, tiled=True), x,
@@ -74,7 +76,7 @@ class ReduceScatterOp:
     @staticmethod
     def apply(x, axis=0):
         ax = _sp_axis()
-        if ax is None or jax.core.trace_state_clean():
+        if ax is None or not _jc.tracing():
             return x
         return _apply_op(
             lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=axis,

@@ -4,10 +4,29 @@ DistAttr/ProcessMesh completion engine)."""
 from __future__ import annotations
 
 import jax
+
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..framework import jax_compat as _jc
 from ..tensor import Tensor, as_array
 from . import mesh as _mesh
+
+
+def clean_spec(spec, mesh) -> PartitionSpec:
+    """Normalize a spec tuple against a mesh: drop axis names the mesh does
+    not have (degree-1 configs), filter tuple sub-axes."""
+    if spec is None:
+        return PartitionSpec()
+    clean = []
+    for s in spec:
+        if s is None:
+            clean.append(None)
+        elif isinstance(s, (tuple, list)):
+            keep = tuple(a for a in s if a in mesh.axis_names)
+            clean.append(keep if keep else None)
+        else:
+            clean.append(s if s in mesh.axis_names else None)
+    return PartitionSpec(*clean)
 
 
 def shard_tensor(x, *spec):
@@ -20,19 +39,9 @@ def shard_tensor(x, *spec):
     m = _mesh.get_mesh(optional=True)
     if m is None:
         return x
-    # drop axis names the current mesh doesn't have (degree-1 configs)
-    clean = []
-    for s in spec:
-        if s is None:
-            clean.append(None)
-        elif isinstance(s, (tuple, list)):
-            keep = tuple(a for a in s if a in m.axis_names)
-            clean.append(keep if keep else None)
-        else:
-            clean.append(s if s in m.axis_names else None)
-    pspec = PartitionSpec(*clean)
+    pspec = clean_spec(spec, m)
     a = as_array(x)
-    if not jax.core.trace_state_clean():
+    if _jc.tracing():
         out = jax.lax.with_sharding_constraint(a, NamedSharding(m, pspec))
     else:
         out = jax.device_put(a, NamedSharding(m, pspec))
@@ -47,7 +56,7 @@ def mark_sharding(param, *spec):
     step when laying out the weight pytree."""
     param.sharding_spec = tuple(spec)
     m = _mesh.get_mesh(optional=True)
-    if m is not None and jax.core.trace_state_clean():
+    if m is not None and not _jc.tracing():
         shard_tensor(param, *spec)
     return param
 

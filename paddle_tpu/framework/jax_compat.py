@@ -1,0 +1,10 @@
+"""Compat shims over jax internals that moved between releases."""
+try:
+    from jax._src.core import trace_state_clean
+except ImportError:  # pragma: no cover
+    from jax.core import trace_state_clean  # type: ignore
+
+
+def tracing() -> bool:
+    """True when called under a jax trace (jit/vjp/shard_map)."""
+    return not trace_state_clean()

@@ -1,0 +1,9 @@
+"""Flagship model family (BASELINE.md configs 3/4/5)."""
+from .llama import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+)
+from .trainer import build_train_step, place_model  # noqa: F401

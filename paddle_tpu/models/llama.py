@@ -1,0 +1,257 @@
+"""LLaMA-family causal LM — the flagship model (BASELINE.md configs 3/4:
+GPT-3 1.3B TP=4 and LLaMA-2-13B TP×PP×sharding).
+
+Reference parity: the PaddleNLP LLaMA trainer runs on the reference's fused
+stack (FusedMultiTransformer / flash_attn / fused_rope / rms_norm — SURVEY.md
+§2.1 "Fused transformer ops") over Fleet HybridParallel (mp_layers.py TP,
+sequence_parallel_utils SP). This model composes the same pieces from this
+framework: VocabParallelEmbedding / ColumnParallelLinear / RowParallelLinear
+(GSPMD tp specs), RMSNorm, fused rope, SDPA->flash-attention, with
+activations dp/sp-sharded. Degrees of parallelism come from the ambient mesh;
+at mesh=None everything runs dense single-chip.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.fleet.layers.mpu import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding_utils import shard_tensor
+from ..nn import functional as F
+from ..nn.functional.rope import apply_rope, rope_tables
+from ..tensor import Tensor, _apply_op, as_array
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_recompute: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig(hidden_size=4096, intermediate_size=11008,
+                           num_hidden_layers=32, num_attention_heads=32)
+
+    @staticmethod
+    def llama2_13b():
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_hidden_layers=40, num_attention_heads=40)
+
+    @staticmethod
+    def gpt3_1p3b():
+        return LlamaConfig(vocab_size=50304, hidden_size=2048,
+                           intermediate_size=8192, num_hidden_layers=24,
+                           num_attention_heads=16,
+                           max_position_embeddings=2048)
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, seq=128):
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           intermediate_size=hidden * 4,
+                           num_hidden_layers=layers,
+                           num_attention_heads=heads,
+                           num_key_value_heads=heads,
+                           max_position_embeddings=seq)
+
+
+class LlamaMLP(nn.Layer):
+    """gate/up column-parallel, down row-parallel (megatron split)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, has_bias=False,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_heads}) must be divisible "
+                f"by num_key_value_heads ({self.num_kv_heads})"
+            )
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.hidden_size = config.hidden_size
+        self.rope_theta = config.rope_theta
+        self.q_proj = ColumnParallelLinear(
+            config.hidden_size, self.num_heads * self.head_dim,
+            has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            config.hidden_size, self.num_kv_heads * self.head_dim,
+            has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            config.hidden_size, self.num_kv_heads * self.head_dim,
+            has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(
+            self.num_heads * self.head_dim, config.hidden_size,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden_states, attn_mask=None, position_offset=0):
+        from ..ops.manipulation import reshape
+
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        q = reshape(self.q_proj(hidden_states),
+                    [b, s, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(hidden_states),
+                    [b, s, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(hidden_states),
+                    [b, s, self.num_kv_heads, self.head_dim])
+        # heads are tp-sharded
+        q = shard_tensor(q, "dp", None, "tp", None)
+        k = shard_tensor(k, "dp", None, "tp", None)
+        v = shard_tensor(v, "dp", None, "tp", None)
+
+        cos, sin = rope_tables(s, self.head_dim, base=self.rope_theta,
+                               dtype=as_array(q).dtype,
+                               position_offset=position_offset)
+
+        def rope_fn(qq, kk):
+            return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
+
+        q, k = _apply_op(rope_fn, q, k, _name="fused_rope")
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            from ..ops.manipulation import repeat_interleave
+
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        if attn_mask is not None:
+            # fold the causal mask into the user mask (padding masks arrive
+            # as [b,1,1,s] bool/additive per the reference convention; the
+            # model stays causal either way)
+            ma = as_array(attn_mask)
+            causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+            if ma.dtype == jnp.bool_:
+                combined = Tensor(jnp.logical_and(
+                    jnp.broadcast_to(ma, ma.shape[:2] + (s, s)), causal))
+            else:
+                neg = jnp.finfo(ma.dtype).min
+                combined = Tensor(
+                    ma + jnp.where(causal, 0.0, neg).astype(ma.dtype))
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=combined, is_causal=False,
+                training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training)
+        out = reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self.use_recompute = config.use_recompute
+
+    def _inner(self, hidden_states, attn_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, attn_mask)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+    def forward(self, hidden_states, attn_mask=None):
+        if self.use_recompute and self.training:
+            from ..distributed.fleet.utils.recompute import recompute
+
+            return recompute(self._inner, hidden_states, attn_mask)
+        return self._inner(hidden_states, attn_mask)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        h = shard_tensor(h, "dp", ("sp", "sep"), None)
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Causal LM head; `compute_loss(logits-free)` keeps the vocab-parallel
+    CE fused with the lm_head matmul under GSPMD."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            # tied head reuses the [vocab, hidden] embedding weight via a
+            # transposed matmul (reference: SharedLayerDesc tied embeddings)
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.lm_head is None:
+            from ..ops.linalg import matmul
+
+            return matmul(h, self.llama.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    def compute_loss(self, logits, labels):
+        from ..ops.reduction import mean
+
+        loss = self.loss_fn(logits, labels)
+        return mean(loss)
+
+
+# GPT alias: same decoder architecture family, GPT-3-shaped config
+# (reference: PaddleNLP GPT trainer on the same fused stack)
+GPTConfig = LlamaConfig
+GPTForCausalLM = LlamaForCausalLM

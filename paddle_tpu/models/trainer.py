@@ -1,0 +1,102 @@
+"""Sharded train-step builder — the hybrid-parallel compiled step
+(SURVEY.md §3.4 mapped to one SPMD program; §7 phases 5-7).
+
+Takes the flagship model + optimizer and produces a pjit-compiled
+step(input_ids, labels) -> loss with:
+- params laid out per their GSPMD specs (tp/pp axes from the layer
+  definitions),
+- optimizer state ZeRO-sharded over the dp/sharding axis
+  (shard_spec_for — stage 1/2 semantics for free under GSPMD),
+- batch sharded over dp, activations seq-sharded over sp when present,
+- donated params/opt-state (in-place HBM update).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import jit as _jit
+from ..distributed import mesh as _mesh
+from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer import (
+    shard_spec_for,
+)
+from ..distributed.sharding_utils import clean_spec as _clean_spec
+from ..distributed.sharding_utils import get_param_spec
+from ..nn.layer_base import Layer
+from ..tensor import Tensor
+
+
+def place_model(model: Layer, mesh=None):
+    """Lay out parameters on the mesh per their recorded specs."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    if mesh is None:
+        return model
+    for name, p in model.named_parameters():
+        spec = _clean_spec(get_param_spec(p), mesh)
+        p._rebind(jax.device_put(p._data, NamedSharding(mesh, spec)))
+    for name, b in model.named_buffers():
+        b._rebind(jax.device_put(b._data, NamedSharding(mesh, P())))
+    return model
+
+
+def shard_opt_state(opt_state, params, model, mesh, zero_axis="dp"):
+    """ZeRO-1: shard optimizer moments over the data/sharding axis; scalars
+    replicated. Moment shapes == param shapes, so param specs compose with
+    the zero split on the largest replicated dim."""
+    named = dict(model.named_parameters())
+    out = {}
+    for name, state in opt_state.items():
+        pspec = _clean_spec(
+            get_param_spec(named[name]) if name in named else None, mesh)
+        new_state = {}
+        for k, v in state.items():
+            if not hasattr(v, "shape") or v.ndim == 0:
+                new_state[k] = jax.device_put(v, NamedSharding(mesh, P()))
+                continue
+            spec = list(pspec) + [None] * (v.ndim - len(list(pspec)))
+            if zero_axis in mesh.axis_names and mesh.shape[zero_axis] > 1:
+                for i, s in enumerate(spec):
+                    if s is None and v.shape[i] % mesh.shape[zero_axis] == 0:
+                        spec[i] = zero_axis
+                        break
+            new_state[k] = jax.device_put(
+                v, NamedSharding(mesh, P(*spec)))
+        out[name] = new_state
+    return out
+
+
+def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
+                     = None, mesh=None, donate=True):
+    """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
+
+    criterion defaults to model.compute_loss (vocab-parallel CE for the
+    flagship LM)."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    if criterion is None:
+        criterion = model.compute_loss
+    place_model(model, mesh)
+    step = _jit.train_step(model, criterion, optimizer, donate=donate)
+
+    if mesh is None:
+        return step
+
+    holder = step._opt_state_holder
+    data_sharding = NamedSharding(mesh, _clean_spec(("dp", None), mesh))
+
+    def sharded_step(input_ids, labels):
+        if holder["state"] is None:
+            params = model.parameters_pytree()
+            holder["state"] = shard_opt_state(
+                optimizer.init_state_pytree(params), params, model, mesh)
+        x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
+        y = labels._data if isinstance(labels, Tensor) else labels
+        x = jax.device_put(x, data_sharding)
+        y = jax.device_put(y, data_sharding)
+        return step(Tensor(x), Tensor(y))
+
+    sharded_step._inner = step
+    return sharded_step

@@ -1,0 +1,71 @@
+"""Rotary position embedding (reference: fused_rope Phi kernel,
+paddle/phi/kernels/fusion — SURVEY.md §2.1; python surface:
+paddle.incubate.nn.functional.fused_rotary_position_embedding).
+
+One fused XLA expression (negate/roll-free split formulation); XLA fuses it
+into the attention QK computation on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor import Tensor, _apply_op, as_array
+
+
+def rope_tables(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                position_offset=0):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    t = jnp.arange(position_offset, position_offset + seq_len,
+                   dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, neox=True):
+    """x: [..., seq, heads, head_dim] (paddle bshd layout); cos/sin:
+    [seq, head_dim/2]. neox=True: rotate-half split; False: interleaved
+    (GPT-J style) pairs."""
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    if neox:
+        d2 = x.shape[-1] // 2
+        x1 = x[..., :d2]
+        x2 = x[..., d2:]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.reshape(x.shape)
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style
+                                    =True, name=None):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity:
+    q/k: [batch, seq, num_heads, head_dim]."""
+    qa = as_array(q)
+    seq, hd = qa.shape[1], qa.shape[3]
+    if cos is None or sin is None:
+        cos_t, sin_t = rope_tables(seq, hd, dtype=qa.dtype)
+    else:
+        cos_t = as_array(cos).reshape(seq, -1)[:, : hd // 2]
+        sin_t = as_array(sin).reshape(seq, -1)[:, : hd // 2]
+
+    neox = bool(use_neox_rotary_style)
+    if v is not None:
+        # reference semantics: when v is passed it is rotated too
+        def f3(qq, kk, vv):
+            return (apply_rope(qq, cos_t, sin_t, neox),
+                    apply_rope(kk, cos_t, sin_t, neox),
+                    apply_rope(vv, cos_t, sin_t, neox))
+
+        q_out, k_out, v_out = _apply_op(f3, q, k, v, _name="fused_rope")
+        return q_out, k_out, v_out
+
+    def f(qq, kk):
+        return (apply_rope(qq, cos_t, sin_t, neox),
+                apply_rope(kk, cos_t, sin_t, neox))
+
+    q_out, k_out = _apply_op(f, q, k, _name="fused_rope")
+    return q_out, k_out, None
