@@ -1,0 +1,381 @@
+"""Pallas flash attention (TPU) — the Phi flash_attn kernel equivalent
+(reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu + third_party
+flashattn — SURVEY.md §2.1 "Phi fusion kernels", §7 phase 9).
+
+Layout: paddle bshd [batch, seq, heads, head_dim]. Forward is the online-
+softmax streaming kernel (never materializes [s, s]); backward recomputes
+p-blocks from the saved row logsumexp (standard flash backward, two kernels:
+dk/dv then dq). Grids put the contraction dim innermost so accumulators live
+in VMEM scratch across grid steps; blocks are MXU-aligned (128).
+
+On non-TPU backends the same kernels run in interpreter mode so CPU CI
+exercises identical code paths (SURVEY.md §7 "interpret-mode fallback").
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# pallas_call runs under x64-off so index maps / constants stay 32-bit
+# (the package enables jax x64 globally for paddle int64 semantics)
+_pc = pl.pallas_call
+
+import numpy as np
+
+NEG_INF = np.float32(-1e30)  # f32 scalar: x64 mode must not leak f64 into kernels
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, causal, block_q, block_k, n_kv, offset):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    run = True
+    if causal:
+        # block fully in the future -> skip (bottom-right aligned)
+        run = j * block_k <= (i + 1) * block_q - 1 + offset
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * alpha + pv
+        m_scr[:, :1] = m_new
+        l_scr[:, :1] = l_new
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_row = (m_scr[:, :1] + jnp.log(safe_l))[:, 0]
+        # (8, block_q) sublane-replicated layout satisfies TPU tiling
+        lse_ref[0] = jnp.broadcast_to(lse_row[None, :], lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    n_q = s_q // block_q
+    n_kv = s_kv // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
+    with jax.enable_x64(False):
+        out, lse = _pc(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, n_q, offset):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = j * block_k <= (i + 1) * block_q - 1 + offset
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        # dv += p^T do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, n_kv, offset):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = j * block_k <= (i + 1) * block_q - 1 + offset
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    q, k, v, out, lse = res
+    do = g
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    n_q = s_q // block_q
+    n_kv = s_kv // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [bh, s_q]
+    lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, s_q))
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_q=n_q, offset=s_kv - s_q)
+    with jax.enable_x64(False):
+        dk, dv = _pc(
+        dkv_kernel,
+        grid=(bh, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse8, delta8)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
+    with jax.enable_x64(False):
+        dq = _pc(
+        dq_kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse8, delta8)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP over [bh, s, d])
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_PALLAS_BWD_MIN_SEQ = 4096
+
+
+def _xla_ref_bwd(res, g, scale, causal):
+    """XLA-fused backward via recompute: at short sequence the O(s^2)
+    score matrix fits comfortably and XLA's fused softmax-grad beats the
+    streamed kernels; the Pallas backward takes over for long sequences
+    where s^2 memory is the binding constraint."""
+    q, k, v, _, _ = res
+
+    def ref(q_, k_, v_):
+        s_ = jax.lax.dot_general(
+            q_, k_, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            sq, sk = s_.shape[-2], s_.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+            s_ = jnp.where(mask, s_, NEG_INF)
+        p = jax.nn.softmax(s_, axis=-1).astype(q_.dtype)
+        return jax.lax.dot_general(
+            p, v_, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).astype(q_.dtype)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
+    s_q = res[0].shape[1]
+    if s_q < _PALLAS_BWD_MIN_SEQ:
+        return _xla_ref_bwd(res, g, scale, causal)
+    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def supports(seq_q, seq_kv, head_dim, block_q=DEFAULT_BLOCK_Q,
+             block_k=DEFAULT_BLOCK_K):
+    return (seq_q % block_q == 0 and seq_kv % block_k == 0
+            and head_dim % 128 == 0 and seq_q >= block_q
+            and seq_kv >= block_k)
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout) -> same shape.
+
+    Raises ValueError for unsupported shapes — callers (F.sdpa) catch and
+    fall back to the fused XLA path.
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if not supports(s_q, s_kv, d, block_q, block_k):
+        raise ValueError(
+            f"flash_attention: unsupported shape seq_q={s_q} seq_kv={s_kv} "
+            f"d={d} (need multiples of {block_q}/{block_k}/128)"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # bshd -> (b*h, s, d)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s_q, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s_kv, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s_kv, d)
+    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), block_q,
+                      block_k)
+    return jnp.swapaxes(out.reshape(b, h, s_q, d), 1, 2)

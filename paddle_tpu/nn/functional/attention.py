@@ -59,10 +59,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         try:
             from ...kernels import flash_attention as fa
 
-            def f(q, k, v):
-                return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+            s_q = as_array(query).shape[1]
+            s_kv = as_array(key).shape[1]
+            d = as_array(query).shape[3]
+            # measured on v5lite: pallas wins fwd-only from ~1k seq, and
+            # fwd+bwd from ~4k; below that XLA's fused attention grad wins
+            min_seq = 1024 if not training else fa._PALLAS_BWD_MIN_SEQ
+            if fa.supports(s_q, s_kv, d) and s_q >= min_seq:
 
-            return _apply_op(f, query, key, value, _name="flash_attention")
+                def f(q, k, v):
+                    return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+
+                return _apply_op(f, query, key, value,
+                                 _name="flash_attention")
         except Exception:
             pass
 

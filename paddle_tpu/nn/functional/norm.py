@@ -104,15 +104,33 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm — the reference ships this as a Phi fusion kernel
-    (paddle/phi/kernels/fusion rms_norm — SURVEY.md §2.1); here one fused
-    XLA expression (Pallas variant in paddle_tpu.kernels for long rows)."""
+    (paddle/phi/kernels/fusion rms_norm — SURVEY.md §2.1). Pallas fused
+    kernel when shapes allow (FLAGS_use_pallas_kernels), fused XLA
+    expression otherwise."""
+    from ...framework import config as _config
+
+    if weight is not None and _config.get_flag("FLAGS_use_pallas_kernels",
+                                               True):
+        try:
+            from ...kernels import rms_norm as _krms
+
+            a = as_array(x)
+            rows = int(np.prod(a.shape[:-1]))
+            if _krms.supports(rows, a.shape[-1]):
+                def fk(a_, w_):
+                    return _krms.rms_norm(a_, w_, epsilon)
+
+                return _apply_op(fk, x, weight, _name="rms_norm")
+        except Exception:
+            pass  # any kernel failure falls back to the fused XLA path
 
     def f(a, *w):
         var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
-        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        out = a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
         if w:
-            out = out * w[0]
-        return out
+            out = out * w[0].astype(jnp.float32)
+        # output dtype follows x, matching the Pallas kernel's contract
+        return out.astype(a.dtype)
 
     args = [weight] if weight is not None else []
     return _apply_op(f, x, *args, _name="rms_norm")
