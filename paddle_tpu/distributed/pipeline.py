@@ -1,0 +1,184 @@
+"""SPMD pipeline parallelism — the compiled 1F1B-family schedule.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py (SURVEY.md §2.3 "PP", §3.4): the reference
+runs a host-orchestrated 1F1B microbatch schedule with NCCL send/recv
+between per-process stage modules, plus the static-graph
+fleet_executor/Interceptor actor runtime (SURVEY.md §2.1 "Fleet executor").
+
+TPU-native design (SURVEY.md §7 phase 8): all of that machinery collapses
+into ONE jitted SPMD program:
+
+- stage weights are *stacked* arrays with a leading layer dim sharded over
+  the `pp` mesh axis (each pp rank holds its stage's contiguous block of
+  layers);
+- the microbatch schedule is a `lax.scan` over T = M + S - 1 ticks inside a
+  `shard_map` that is *manual over pp only* — tp/dp/sp stay GSPMD-auto, so
+  Megatron TP layers keep working unchanged inside a stage;
+- stage-to-stage transfer is `lax.ppermute` on the ICI ring — the
+  send_v2/recv_v2 mapping from SURVEY.md §5;
+- the backward schedule is NOT hand-written: differentiating through the
+  scan+ppermute yields the reverse pipeline (ppermute transposes to the
+  opposite rotation), and XLA overlaps compute with the permute traffic.
+  This is the compiler-scheduled analog of 1F1B's comm/compute overlap;
+- the warm-up/cool-down bubble exists as predicated no-op ticks (the
+  `where(stage == 0, fresh_input, rotated_state)` select), identical cost
+  shape to GPipe; interleaved/VPP-style bubble reduction = more microbatches
+  per tick, exposed via `num_microbatches`.
+
+The generic entry is `spmd_pipeline`; `stack_layer_params` builds the
+stacked parameter pytree from a homogeneous list of layers (the pp analog of
+`PipelineLayer`'s LayerDesc partitioning, which remains the user-facing
+segmentation API).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import mesh as _mesh
+from .sharding_utils import clean_spec as _clean_spec
+from .sharding_utils import get_param_spec
+
+
+def _pcast_varying(x, axis_name):
+    """Mark x as varying over the manual axis (scan carry requirement)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return x
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
+                  mesh=None, axis_name: str = "pp"):
+    """Run `stage_fn` as an S-stage pipeline over `axis_name`.
+
+    Args:
+      stage_fn: (local_stage_params, x) -> y. Must be the same computation
+        for every stage (homogeneous stages — e.g. a scan over the stage's
+        block of decoder layers). x and y must have identical shape/dtype
+        (the activation that flows through the pipeline).
+      stage_params: pytree whose leaves have a leading dim divisible by S;
+        leading dim is sharded over `axis_name` (each stage sees its block).
+      microbatches: [M, ...] array (or pytree of such) of per-microbatch
+        inputs to stage 0; replicated over `axis_name`.
+
+    Returns [M, ...] outputs of the last stage, broadcast to all stages.
+    """
+    mesh = mesh or _mesh.get_mesh()
+    S = int(mesh.shape[axis_name])
+    if S == 1:
+        def run_one(mb):
+            return stage_fn(stage_params, mb)
+
+        return jax.lax.map(run_one, microbatches)
+
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    T = M + S - 1
+
+    def inner(local_params, inputs):
+        stage = jax.lax.axis_index(axis_name)
+        zero = jax.tree_util.tree_map(
+            lambda x: _pcast_varying(jnp.zeros_like(x[0]), axis_name), inputs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(state, t):
+            idx = jnp.clip(t, 0, M - 1)
+            fresh = jax.tree_util.tree_map(lambda x: x[idx], inputs)
+            x = jax.tree_util.tree_map(
+                lambda f, s: jnp.where(stage == 0, f, s), fresh, state)
+            y = stage_fn(local_params, x)
+            nxt = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis_name, perm), y)
+            return nxt, y
+
+        _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
+        # ticks S-1 .. T-1 on the LAST stage hold the pipeline outputs
+        window = jax.tree_util.tree_map(lambda a: a[S - 1:], ys)
+        masked = jax.tree_util.tree_map(
+            lambda a: jnp.where(stage == S - 1, a, jnp.zeros_like(a)), window)
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, axis_name), masked)
+
+    # manual over pp only; tp/dp/sp remain GSPMD-auto inside the stage
+    stacked_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+    data_spec = jax.tree_util.tree_map(lambda _: P(), microbatches)
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(stacked_spec, data_spec),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), microbatches),
+        axis_names=frozenset({axis_name}),
+    )(stage_params, microbatches)
+
+
+# ---------------------------------------------------------------------------
+# stacked-parameter utilities (LayerDesc partitioning -> stacked arrays)
+# ---------------------------------------------------------------------------
+
+
+def stack_layer_params(layers: Sequence) -> Dict[str, jax.Array]:
+    """Stack the parameters of homogeneous layers: suffix -> [L, ...]."""
+    trees = [dict(l.named_parameters()) for l in layers]
+    names = list(trees[0].keys())
+    for t in trees[1:]:
+        if list(t.keys()) != names:
+            raise ValueError("pipeline stages must be homogeneous layers")
+    return {
+        n: jnp.stack([t[n]._data for t in trees]) for n in names
+    }
+
+
+def stacked_param_specs(layers: Sequence, mesh, axis_name: str = "pp"
+                        ) -> Dict[str, P]:
+    """Sharding spec per stacked suffix: ('pp', *layer-param spec)."""
+    out = {}
+    for n, p in layers[0].named_parameters():
+        inner = list(_clean_spec(get_param_spec(p), mesh))
+        out[n] = P(axis_name, *inner)
+    return out
+
+
+def unstack_into_layers(stacked: Dict[str, jax.Array], layers: Sequence):
+    """Write stacked arrays back into the per-layer modules (post-step)."""
+    for i, layer in enumerate(layers):
+        layer.load_pytree({n: a[i] for n, a in stacked.items()})
+
+
+def make_stage_fn(template_layer, n_names: List[str],
+                  call: Optional[Callable] = None):
+    """Build the homogeneous stage_fn: scan the stage's layer block through
+    `template_layer` with per-layer params swapped in.
+
+    template_layer is any one of the (identical-structure) layers; its
+    arrays are rebound to traced slices during the scan, so the SAME module
+    code runs for every layer of every stage.
+    """
+    from ..tensor import Tensor, as_array
+
+    call = call or (lambda mod, x: mod(x))
+
+    def stage_fn(local_params, x):
+        def body(h, layer_params):
+            template_layer.load_pytree(layer_params)
+            out = call(template_layer, Tensor(h))
+            return as_array(out), None
+
+        h, _ = jax.lax.scan(body, x, local_params)
+        return h
+
+    return stage_fn
+
+
+def microbatch(x, num_microbatches: int):
+    """[B, ...] -> [M, B//M, ...] (reference: PipelineParallel._split_micro)."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])

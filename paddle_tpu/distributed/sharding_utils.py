@@ -29,6 +29,21 @@ def clean_spec(spec, mesh) -> PartitionSpec:
     return PartitionSpec(*clean)
 
 
+def in_manual_region(mesh=None) -> bool:
+    """True iff tracing inside a shard_map with manual axes — sharding
+    constraints on values varying over a manual axis are rejected there
+    (the pipeline's partial-manual region), so annotations become no-ops
+    and GSPMD propagates layout from the already-sharded weights.
+
+    Uses the abstract mesh's axis types, so vmap/pmap axis names that
+    happen to collide with mesh axis names do NOT trigger this."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        return any("anual" in str(t) for t in am.axis_types)
+    except Exception:
+        return False
+
+
 def shard_tensor(x, *spec):
     """Annotate a tensor with a PartitionSpec over the global mesh.
 
@@ -38,6 +53,8 @@ def shard_tensor(x, *spec):
     """
     m = _mesh.get_mesh(optional=True)
     if m is None:
+        return x
+    if _jc.tracing() and in_manual_region():
         return x
     pspec = clean_spec(spec, m)
     a = as_array(x)

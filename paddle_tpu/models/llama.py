@@ -236,7 +236,9 @@ class LlamaForCausalLM(nn.Layer):
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, attn_mask=None):
-        h = self.llama(input_ids, attn_mask)
+        return self._head(self.llama(input_ids, attn_mask))
+
+    def _head(self, h):
         if self.lm_head is None:
             from ..ops.linalg import matmul
 
@@ -249,6 +251,22 @@ class LlamaForCausalLM(nn.Layer):
 
         loss = self.loss_fn(logits, labels)
         return mean(loss)
+
+    # ------------------------------------------------------------------
+    # pipeline decomposition (SURVEY.md §7 phase 8): embed / homogeneous
+    # decoder stack / head. The decoder layers are the pipelined stages
+    # (stacked, pp-sharded); embed+head run GSPMD on every pp rank (cheap,
+    # and it keeps the stages homogeneous — the SPMD-pipelining contract).
+    # ------------------------------------------------------------------
+    def pp_embed(self, input_ids):
+        h = self.llama.embed_tokens(input_ids)
+        return shard_tensor(h, "dp", ("sp", "sep"), None)
+
+    def pp_layers(self):
+        return list(self.llama.layers)
+
+    def pp_head(self, hidden):
+        return self._head(self.llama.norm(hidden))
 
 
 # GPT alias: same decoder architecture family, GPT-3-shaped config

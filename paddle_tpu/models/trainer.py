@@ -43,21 +43,21 @@ def place_model(model: Layer, mesh=None):
     return model
 
 
-def shard_opt_state(opt_state, params, model, mesh, zero_axis="dp"):
+def shard_opt_state(opt_state, param_specs, mesh, zero_axis="dp"):
     """ZeRO-1: shard optimizer moments over the data/sharding axis; scalars
     replicated. Moment shapes == param shapes, so param specs compose with
-    the zero split on the largest replicated dim."""
-    named = dict(model.named_parameters())
+    the zero split on the first replicated divisible dim.
+
+    param_specs: name -> PartitionSpec (or spec tuple) of the param."""
     out = {}
     for name, state in opt_state.items():
-        pspec = _clean_spec(
-            get_param_spec(named[name]) if name in named else None, mesh)
+        pspec = list(_clean_spec(param_specs.get(name), mesh))
         new_state = {}
         for k, v in state.items():
             if not hasattr(v, "shape") or v.ndim == 0:
                 new_state[k] = jax.device_put(v, NamedSharding(mesh, P()))
                 continue
-            spec = list(pspec) + [None] * (v.ndim - len(list(pspec)))
+            spec = pspec + [None] * (v.ndim - len(pspec))
             if zero_axis in mesh.axis_names and mesh.shape[zero_axis] > 1:
                 for i, s in enumerate(spec):
                     if s is None and v.shape[i] % mesh.shape[zero_axis] == 0:
@@ -69,15 +69,137 @@ def shard_opt_state(opt_state, params, model, mesh, zero_axis="dp"):
     return out
 
 
+def build_pipeline_train_step(model: Layer, optimizer,
+                              criterion: Optional[Callable] = None,
+                              mesh=None, num_microbatches: Optional[int]
+                              = None, donate=True):
+    """Pipeline-parallel compiled step (SURVEY.md §7 phase 8).
+
+    Decoder layers are stacked into [L, ...] arrays pp-sharded on the
+    leading dim and scheduled by distributed.pipeline.spmd_pipeline; embed
+    and head run under plain GSPMD on every rank. Params live in the step's
+    holder between steps (stacked form); `step.sync_to_model()` writes them
+    back into the module tree (for checkpointing/eval)."""
+    from ..autograd import tape as _tape
+    from ..distributed import pipeline as _pipe
+    from ..framework import random as _random
+    from ..jit.api import _LayerScope
+
+    mesh = mesh or _mesh.get_mesh()
+    if criterion is None:
+        criterion = model.compute_loss
+
+    layers = model.pp_layers()
+    S = int(mesh.shape["pp"])
+    if len(layers) % S:
+        raise ValueError(
+            f"{len(layers)} layers not divisible by pp={S}")
+    M = num_microbatches or S
+    template = layers[0]
+    layer_param_ids = {
+        id(p) for l in layers for _, p in l.named_parameters()}
+    rest_names = [n for n, p in model.named_parameters()
+                  if id(p) not in layer_param_ids]
+    stage_fn = _pipe.make_stage_fn(template, None)
+
+    # placement: stacked layer params [L, ...] with P('pp', ...); rest
+    # (embed/head/norm) per their GSPMD specs; buffers replicated. The
+    # module tree keeps its own arrays (source for sync_to_model shapes);
+    # the stacked holder copy is the training source of truth.
+    stacked_specs = _pipe.stacked_param_specs(layers, mesh)
+    stacked_names = list(stacked_specs)
+    flat_params = {}
+    flat_specs = {}
+    for n, a in _pipe.stack_layer_params(layers).items():
+        key = "pp_stacked::" + n
+        flat_params[key] = jax.device_put(
+            a, NamedSharding(mesh, stacked_specs[n]))
+        flat_specs[key] = stacked_specs[n]
+    named = dict(model.named_parameters())
+    for n in rest_names:
+        spec = _clean_spec(get_param_spec(named[n]), mesh)
+        flat_params[n] = jax.device_put(
+            named[n]._data, NamedSharding(mesh, spec))
+        flat_specs[n] = spec
+    repl = NamedSharding(mesh, P())
+    for _, b in model.named_buffers():
+        b._rebind(jax.device_put(b._data, repl))
+
+    def pure_step(params, buffers, opt_state, lr, seed, x, y):
+        stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+
+        def loss_of(params):
+            rest = {n: params[n] for n in rest_names}
+            stacked = {n: params["pp_stacked::" + n] for n in stacked_names}
+            with _tape.no_grad(), _random.with_key_stream(stream), \
+                    _LayerScope(model, rest, buffers) as scope:
+                h = model.pp_embed(Tensor(x))
+                h_arr = h._data
+                mb = _pipe.microbatch(h_arr, M)
+                outs = _pipe.spmd_pipeline(
+                    stage_fn, stacked, mb, mesh=mesh)
+                full = outs.reshape((h_arr.shape[0],) + h_arr.shape[1:])
+                logits = model.pp_head(Tensor(full))
+                loss = criterion(logits, Tensor(y))
+                new_buffers = scope.new_buffers()
+            return loss._data, new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = optimizer.apply_gradients_functional(
+            params, grads, opt_state, lr)
+        return loss, new_buffers, new_params, new_opt
+
+    jitted = jax.jit(pure_step, donate_argnums=(0, 2) if donate else ())
+    holder = {"params": flat_params, "opt_state": None}
+    data_sharding = NamedSharding(mesh, _clean_spec(("dp", None), mesh))
+
+    def step(input_ids, labels):
+        if holder["opt_state"] is None:
+            holder["opt_state"] = shard_opt_state(
+                optimizer.init_state_pytree(holder["params"]),
+                flat_specs, mesh)
+        x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
+        y = labels._data if isinstance(labels, Tensor) else labels
+        x = jax.device_put(jnp.asarray(x), data_sharding)
+        y = jax.device_put(jnp.asarray(y), data_sharding)
+        lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
+        seed = jax.random.key_data(_random.next_key())
+        loss, new_buffers, holder["params"], holder["opt_state"] = jitted(
+            holder["params"], model.buffers_pytree(), holder["opt_state"],
+            lr, seed, x, y)
+        if new_buffers:
+            model.load_pytree(new_buffers)
+        optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_model():
+        params = holder["params"]
+        _pipe.unstack_into_layers(
+            {n: params["pp_stacked::" + n] for n in stacked_names}, layers)
+        model.load_pytree({n: params[n] for n in rest_names})
+
+    step.sync_to_model = sync_to_model
+    step._holder = holder
+    return step
+
+
 def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
-                     = None, mesh=None, donate=True):
+                     = None, mesh=None, donate=True,
+                     num_microbatches: Optional[int] = None):
     """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
 
     criterion defaults to model.compute_loss (vocab-parallel CE for the
-    flagship LM)."""
+    flagship LM). If the mesh has a pp axis (size>1) and the model exposes
+    a pipeline decomposition, the SPMD pipeline schedule is used."""
     mesh = mesh or _mesh.get_mesh(optional=True)
     if criterion is None:
         criterion = model.compute_loss
+    if (mesh is not None and "pp" in mesh.axis_names
+            and int(mesh.shape["pp"]) > 1 and hasattr(model, "pp_layers")):
+        return build_pipeline_train_step(
+            model, optimizer, criterion=criterion, mesh=mesh,
+            num_microbatches=num_microbatches, donate=donate)
     place_model(model, mesh)
     step = _jit.train_step(model, criterion, optimizer, donate=donate)
 
@@ -90,8 +212,10 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
     def sharded_step(input_ids, labels):
         if holder["state"] is None:
             params = model.parameters_pytree()
+            specs = {n: get_param_spec(p)
+                     for n, p in model.named_parameters()}
             holder["state"] = shard_opt_state(
-                optimizer.init_state_pytree(params), params, model, mesh)
+                optimizer.init_state_pytree(params), specs, mesh)
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         y = labels._data if isinstance(labels, Tensor) else labels
         x = jax.device_put(x, data_sharding)
