@@ -28,7 +28,12 @@ from .mesh import (  # noqa: F401
     named_sharding,
     set_mesh,
 )
+from .context_parallel import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
 from .parallel import DataParallel  # noqa: F401
+from .pipeline import spmd_pipeline  # noqa: F401
 from .sharding_utils import get_param_spec, mark_sharding, shard_tensor  # noqa: F401
 
 

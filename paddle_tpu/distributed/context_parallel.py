@@ -1,0 +1,151 @@
+"""Context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+Reference status (SURVEY.md §2.3 "CP / ring attention / Ulysses"): NOT in
+the reference core at this era — PaddleNLP layers ring_flash_attention on
+top. This framework fills the gap natively (SURVEY.md §5 "Long-context",
+§7 phase 9): long sequences shard over a `cp` (or `sep`) mesh axis and
+attention runs as
+
+- **ring attention**: each cp rank holds a [b, s/cp, n, d] Q/K/V shard;
+  K/V blocks rotate around the ICI ring via `lax.ppermute` while each rank
+  accumulates its Q-block's online-softmax (flash-attention) statistics —
+  seq-length memory per chip drops cp-fold and comm overlaps compute;
+- **Ulysses**: `lax.all_to_all` re-shards seq-sharding into head-sharding,
+  runs dense local attention, and a2a's back — cheaper at moderate seq
+  lengths when heads % cp == 0.
+
+Both run inside a shard_map that is manual over the cp axis ONLY, so tp
+head-sharding and dp batch-sharding remain GSPMD-auto around them (the same
+partial-manual design as distributed/pipeline.py).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as _mesh
+
+_NEG = -1e30
+
+
+def _pick_axis(mesh, axis_name: Optional[str]) -> Optional[str]:
+    if axis_name is not None:
+        return axis_name if (mesh is not None
+                             and axis_name in mesh.axis_names) else None
+    if mesh is None:
+        return None
+    for a in ("cp", "sep"):
+        if a in mesh.axis_names and int(mesh.shape[a]) > 1:
+            return a
+    return None
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Per-rank ring attention. q/k/v: [b, s_loc, n, d] local seq shards
+    (paddle bshd layout). Must run inside a manual region over axis_name."""
+    cp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, n, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [b,n,s,d]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+
+    from .pipeline import _pcast_varying
+
+    qpos = idx * s_loc + jnp.arange(s_loc)
+    m0 = _pcast_varying(jnp.full((b, n, s_loc), _NEG, jnp.float32), axis_name)
+    l0 = _pcast_varying(jnp.zeros((b, n, s_loc), jnp.float32), axis_name)
+    o0 = _pcast_varying(jnp.zeros((b, n, s_loc, d), jnp.float32), axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(carry, r):
+        o, m, l, kc, vc = carry
+        j = (idx - r) % cp                      # kv block currently held
+        kpos = j * s_loc + jnp.arange(s_loc)
+        s = jnp.einsum("bnqd,bnkd->bnqk", qt, kc) * sc
+        if causal:
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        o = o * corr[..., None] + jnp.einsum("bnqk,bnkd->bnqd", p, vc)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, m_new, l, kc, vc), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, kt, vt),
+                                      jnp.arange(cp))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                            scale: Optional[float] = None):
+    """Ulysses: a2a seq-shard -> head-shard, dense local attention, a2a
+    back. q/k/v: [b, s_loc, n, d]; n % cp must be 0."""
+    cp = jax.lax.psum(1, axis_name)
+
+    def a2a_fwd(x):   # [b, s/cp, n, d] -> [b, s, n/cp, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    from ..nn.functional.attention import _sdpa_reference
+
+    qh, kh, vh = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+    out = _sdpa_reference(qh, kh, vh, causal=causal, scale=scale)
+    # out: [b, s, n/cp, d] -> back to seq-sharded layout
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _cp_call(local_fn, q, k, v, axis_name, mesh, causal, scale):
+    spec = P(None, axis_name)
+    fn = partial(local_fn, axis_name=axis_name, causal=causal, scale=scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis_name}),
+    )(q, k, v)
+
+
+def ring_attention(q, k, v, axis_name: Optional[str] = None,
+                   causal: bool = True, scale: Optional[float] = None,
+                   mesh=None):
+    """Context-parallel ring attention over the global mesh.
+
+    q/k/v: [b, s, n, d] global (GSPMD) arrays; s % cp == 0. Falls back to
+    dense attention when no cp/sep axis is live."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    axis = _pick_axis(mesh, axis_name)
+    if axis is None or int(mesh.shape[axis]) == 1:
+        from ..nn.functional.attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, causal=causal, scale=scale)
+    return _cp_call(ring_attention_local, q, k, v, axis, mesh, causal, scale)
+
+
+def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
+                      causal: bool = True, scale: Optional[float] = None,
+                      mesh=None):
+    """Ulysses (a2a head-parallel) attention over the global mesh."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    axis = _pick_axis(mesh, axis_name)
+    if axis is None or int(mesh.shape[axis]) == 1:
+        from ..nn.functional.attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, causal=causal, scale=scale)
+    return _cp_call(ulysses_attention_local, q, k, v, axis, mesh, causal,
+                    scale)
+
+
+def context_parallel_enabled(mesh=None) -> bool:
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    return _pick_axis(mesh, None) is not None

@@ -150,8 +150,7 @@ def unstack_into_layers(stacked: Dict[str, jax.Array], layers: Sequence):
         layer.load_pytree({n: a[i] for n, a in stacked.items()})
 
 
-def make_stage_fn(template_layer, n_names: List[str],
-                  call: Optional[Callable] = None):
+def make_stage_fn(template_layer, call: Optional[Callable] = None):
     """Build the homogeneous stage_fn: scan the stage's layer block through
     `template_layer` with per-layer params swapped in.
 

@@ -163,8 +163,18 @@ class LlamaAttention(nn.Layer):
                 q, k, v, attn_mask=combined, is_causal=False,
                 training=self.training)
         else:
-            out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, training=self.training)
+            from ..distributed import context_parallel as _cp
+            from ..distributed.sharding_utils import in_manual_region
+
+            if _cp.context_parallel_enabled() and not in_manual_region():
+                # long-context path: ring attention over the cp/sep axis
+                def ring_fn(qq, kk, vv):
+                    return _cp.ring_attention(qq, kk, vv, causal=True)
+
+                out = _apply_op(ring_fn, q, k, v, _name="ring_attention")
+            else:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, training=self.training)
         out = reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 

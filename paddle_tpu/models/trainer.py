@@ -100,7 +100,16 @@ def build_pipeline_train_step(model: Layer, optimizer,
         id(p) for l in layers for _, p in l.named_parameters()}
     rest_names = [n for n, p in model.named_parameters()
                   if id(p) not in layer_param_ids]
-    stage_fn = _pipe.make_stage_fn(template, None)
+    stage_fn = _pipe.make_stage_fn(template)
+    # stacked keys carry layer-0's FULL name so name-based optimizer rules
+    # (decay exclusion by 'norm'/'bias' suffix) keep working; per-layer
+    # distinctions necessarily collapse (all layers share one stacked array)
+    id_to_full = {id(p): n for n, p in model.named_parameters()}
+    full_of = {sfx: id_to_full[id(p)]
+               for sfx, p in template.named_parameters()}
+
+    def _skey(suffix):
+        return "pp_stacked::" + full_of[suffix]
 
     # placement: stacked layer params [L, ...] with P('pp', ...); rest
     # (embed/head/norm) per their GSPMD specs; buffers replicated. The
@@ -111,7 +120,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
     flat_params = {}
     flat_specs = {}
     for n, a in _pipe.stack_layer_params(layers).items():
-        key = "pp_stacked::" + n
+        key = _skey(n)
         flat_params[key] = jax.device_put(
             a, NamedSharding(mesh, stacked_specs[n]))
         flat_specs[key] = stacked_specs[n]
@@ -130,7 +139,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
         def loss_of(params):
             rest = {n: params[n] for n in rest_names}
-            stacked = {n: params["pp_stacked::" + n] for n in stacked_names}
+            stacked = {n: params[_skey(n)] for n in stacked_names}
             with _tape.no_grad(), _random.with_key_stream(stream), \
                     _LayerScope(model, rest, buffers) as scope:
                 h = model.pp_embed(Tensor(x))
@@ -176,7 +185,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
     def sync_to_model():
         params = holder["params"]
         _pipe.unstack_into_layers(
-            {n: params["pp_stacked::" + n] for n in stacked_names}, layers)
+            {n: params[_skey(n)] for n in stacked_names}, layers)
         model.load_pytree({n: params[n] for n in rest_names})
 
     step.sync_to_model = sync_to_model
