@@ -141,6 +141,7 @@ from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401

@@ -1,0 +1,128 @@
+"""paddle.inference — the deployment surface.
+
+Reference parity: paddle/fluid/inference AnalysisPredictor + Config +
+ZeroCopyTensor (SURVEY.md §2.1 "Inference engine"). TPU-native design: the
+offline IR-pass pipeline is XLA's job — the exported artifact is jit-saved
+StableHLO (paddle_tpu.jit.save), AOT-compiled at load; Config's IR/memory
+toggles are accepted no-ops. The LLM serving engine (paged KV cache +
+continuous batching — the fused_multi_transformer serving path) lives in
+`paddle_tpu.inference.serving`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .serving import ServingEngine  # noqa: F401
+
+
+class Config:
+    """paddle.inference.Config parity (GPU/IR knobs are accepted no-ops —
+    XLA owns those decisions on TPU)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_path = prog_file
+        self.params_path = params_file
+        self._memory_optim = True
+        self._ir_optim = True
+        self._device = "tpu"
+        self._device_id = 0
+        self._cpu_threads = 1
+
+    def set_model(self, prog_file, params_file=None):
+        self.model_path = prog_file
+        self.params_path = params_file
+
+    def model_dir(self):
+        return self.model_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def enable_tensorrt_engine(self, *a, **k):  # pragma: no cover
+        pass  # XLA compiles the whole program; no subgraph engine needed
+
+
+class PredictorTensor:
+    """ZeroCopyTensor parity: named input/output handle."""
+
+    def __init__(self, predictor, name, is_input):
+        self._predictor = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._predictor._feeds[self._name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the fed array
+
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._fetches[self._name])
+
+
+class Predictor:
+    """AnalysisPredictor parity over a jit-saved (StableHLO) program."""
+
+    def __init__(self, config: Config):
+        from .. import jit as _jit
+
+        self._config = config
+        self._layer = _jit.load(config.model_path)
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._fetches: Dict[str, np.ndarray] = {}
+        n_in = getattr(self._layer, "num_inputs", None)
+        self._input_names = [f"x{i}" for i in range(n_in)] \
+            if n_in else ["x0"]
+        self._output_names: List[str] = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(self, name, True)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(self, name, False)
+
+    def run(self, inputs=None):
+        if inputs is not None:  # positional list API
+            feeds = [np.asarray(x) for x in inputs]
+        else:
+            feeds = [self._feeds[n] for n in self._input_names
+                     if n in self._feeds]
+        outs = self._layer(*[Tensor(x) for x in feeds])
+        if isinstance(outs, (list, tuple)):
+            out_list = list(outs)
+        else:
+            out_list = [outs]
+        self._output_names = [f"out{i}" for i in range(len(out_list))]
+        self._fetches = {
+            n: np.asarray(o._data if isinstance(o, Tensor) else o)
+            for n, o in zip(self._output_names, out_list)}
+        return [self._fetches[n] for n in self._output_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,280 @@
+"""LLM serving engine: paged KV cache + continuous batching.
+
+Reference parity: the fused_multi_transformer_op serving configuration
+(SURVEY.md §2.1 "Fused transformer ops" — "the serving engine";
+BASELINE.md config 5). TPU-native design (vLLM-style split): the host owns
+the scheduler — slot admission, page accounting, EOS/eviction — while the
+device runs ONE jitted decode step for all active slots over the paged
+Pallas cache (kernels/paged_attention.py). Prefill runs per-request through
+the model's dense-cache path, then scatters K/V into that request's pages.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape as _tape
+from ..framework import random as _random
+from ..kernels import paged_attention as _pa
+from ..tensor import Tensor, as_array
+
+
+@dataclass
+class _Slot:
+    request_id: int = -1
+    tokens: list = field(default_factory=list)  # generated tokens
+    prompt_len: int = 0
+    context_len: int = 0  # tokens currently in the paged cache
+    max_new_tokens: int = 0
+    active: bool = False
+
+
+@dataclass
+class FinishedRequest:
+    request_id: int
+    prompt_ids: np.ndarray
+    output_ids: np.ndarray
+
+
+class ServingEngine:
+    """Continuous-batching decoder over a paged KV cache.
+
+    engine = ServingEngine(model, max_batch=8, max_seq_len=512)
+    rid = engine.add_request(prompt_ids, max_new_tokens=64)
+    finished = engine.run()          # or: engine.step() in a loop
+    """
+
+    def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
+                 decode_strategy="greedy_search", temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0):
+        if max_seq_len % page_size:
+            raise ValueError("max_seq_len must be a multiple of page_size")
+        self.model = model
+        self.cfg = model.config
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.page_size = page_size
+        self.pages_per_seq = max_seq_len // page_size
+        self.decode_strategy = decode_strategy
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_token_id = eos_token_id
+        n_pages = max_batch * self.pages_per_seq
+        self._free_pages = list(range(n_pages))
+        L = self.cfg.num_hidden_layers
+        kvh = self.cfg.num_key_value_heads
+        hd = self.cfg.hidden_size // self.cfg.num_attention_heads
+        self.k_pages = [jnp.zeros((kvh, n_pages, page_size, hd),
+                                  jnp.float32) for _ in range(L)]
+        self.v_pages = [jnp.zeros((kvh, n_pages, page_size, hd),
+                                  jnp.float32) for _ in range(L)]
+        self.block_tables = np.zeros((max_batch, self.pages_per_seq),
+                                     np.int32)
+        self.slots = [_Slot() for _ in range(max_batch)]
+        self._pending: List = []  # queued (rid, ids, max_new)
+        self._prompts: Dict[int, np.ndarray] = {}
+        self._next_rid = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, object] = {}
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens=32) -> int:
+        ids = np.asarray(as_array(prompt_ids)).reshape(-1).astype(np.int64)
+        if len(ids) + int(max_new_tokens) > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(ids)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({self.max_seq_len})")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._prompts[rid] = ids
+        self._pending.append((rid, ids, int(max_new_tokens)))
+        self._admit()
+        return rid
+
+    def _admit(self):
+        while self._pending:
+            slot_idx = next(
+                (i for i, s in enumerate(self.slots) if not s.active), None)
+            if slot_idx is None:
+                return
+            rid, ids, max_new = self._pending[0]
+            need = self.pages_per_seq
+            if len(self._free_pages) < need:
+                return
+            self._pending.pop(0)
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self.block_tables[slot_idx] = np.asarray(pages, np.int32)
+            s = self.slots[slot_idx]
+            s.request_id, s.tokens = rid, []
+            s.prompt_len = len(ids)
+            s.context_len = len(ids)
+            s.max_new_tokens = max_new
+            s.active = True
+            self._prefill(slot_idx, ids)
+
+    # ------------------------------------------------------------------
+    # prefill: dense-cache forward on the prompt, scatter K/V into pages
+    # ------------------------------------------------------------------
+    def _get_prefill_fn(self, plen):
+        fn = self._prefill_fns.get(plen)
+        if fn is not None:
+            return fn
+        model = self.model
+        from ..jit.api import _LayerScope
+
+        def pure_prefill(params, buffers, ids):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                caches = model.init_kv_caches(1, plen)
+                logits, caches = model.forward_cached(
+                    Tensor(ids), caches, 0)
+                last = as_array(logits)[:, -1, :]
+                ks = jnp.stack([as_array(k)[0] for k, v in caches])
+                vs = jnp.stack([as_array(v)[0] for k, v in caches])
+            return last, ks, vs  # ks: [L, plen, kvh, hd]
+
+        fn = self._prefill_fns[plen] = jax.jit(pure_prefill)
+        return fn
+
+    def _prefill(self, slot_idx, ids):
+        fn = self._get_prefill_fn(len(ids))
+        params = self.model.parameters_pytree()
+        buffers = self.model.buffers_pytree()
+        last, ks, vs = fn(params, buffers, jnp.asarray(ids)[None, :])
+        tables = jnp.asarray(self.block_tables[slot_idx])[None, :]
+        lens = jnp.asarray([len(ids)], jnp.int32)
+        for li in range(len(self.k_pages)):
+            self.k_pages[li], self.v_pages[li] = _pa.prefill_paged_kv_cache(
+                self.k_pages[li], self.v_pages[li],
+                ks[li][None], vs[li][None], tables, lens)
+        self.slots[slot_idx]._last_logits = np.asarray(last[0])
+
+    # ------------------------------------------------------------------
+    # decode step: one jitted forward for all slots
+    # ------------------------------------------------------------------
+    def _get_decode_fn(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model = self.model
+        from ..jit.api import _LayerScope
+        from ..models.generation import sample_logits
+
+        strategy = self.decode_strategy
+        temp, tk, tp = self.temperature, self.top_k, self.top_p
+
+        def pure_decode(params, buffers, k_pages, v_pages, tokens, tables,
+                        lens, active, seed):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                caches = list(zip(k_pages, v_pages))
+                logits, new_caches = model.forward_paged(
+                    Tensor(tokens[:, None]), caches, tables, lens,
+                    active=active)
+                key = jax.random.wrap_key_data(seed)
+                nxt, lp = sample_logits(as_array(logits)[:, 0], key,
+                                        strategy, temp, tk, tp)
+                nk = tuple(as_array(k) for k, v in new_caches)
+                nv = tuple(as_array(v) for k, v in new_caches)
+            return nxt, nk, nv
+
+        self._decode_fn = jax.jit(pure_decode, donate_argnums=(2, 3))
+        return self._decode_fn
+
+    def step(self) -> List[FinishedRequest]:
+        """Run one decode step for all active slots; returns requests that
+        finished this step."""
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        if not active:
+            return []
+        # first step for a slot consumes the prefill logits; afterwards the
+        # decode fn both samples (from last logits) and advances. To keep
+        # one compiled step, we sample on host for the prefill boundary.
+        tokens = np.zeros((self.max_batch,), np.int64)
+        first_eos = []
+        for i, s in enumerate(self.slots):
+            if not s.active:
+                continue
+            if not s.tokens:  # sample the first token from prefill logits
+                tok = self._host_sample(s._last_logits)
+                s.tokens.append(tok)
+                if self.eos_token_id is not None and \
+                        tok == self.eos_token_id:
+                    first_eos.append(i)
+            tokens[i] = s.tokens[-1]
+        for i in first_eos:
+            # request finished on its very first token; never decode it
+            active = [j for j in active if j != i]
+        finished_early = [self._finish(i) for i in first_eos]
+        if not active:
+            if finished_early:
+                self._admit()
+            return finished_early
+        lens = np.asarray([s.context_len if s.active else 0
+                           for s in self.slots], np.int32)
+        act_mask = np.asarray([s.active for s in self.slots], bool)
+        fn = self._get_decode_fn()
+        self._key, sk = jax.random.split(self._key)
+        params = self.model.parameters_pytree()
+        buffers = self.model.buffers_pytree()
+        nxt, nk, nv = fn(params, buffers, tuple(self.k_pages),
+                         tuple(self.v_pages), jnp.asarray(tokens),
+                         jnp.asarray(self.block_tables),
+                         jnp.asarray(lens), jnp.asarray(act_mask),
+                         jax.random.key_data(sk))
+        self.k_pages, self.v_pages = list(nk), list(nv)
+        nxt = np.asarray(nxt)
+        finished = finished_early
+        for i in active:
+            s = self.slots[i]
+            s.context_len += 1  # the token we just fed is now cached
+            tok = int(nxt[i])
+            done = False
+            if len(s.tokens) >= s.max_new_tokens:
+                done = True
+            elif s.context_len + 1 > self.max_seq_len:
+                done = True
+            else:
+                s.tokens.append(tok)
+                if self.eos_token_id is not None and \
+                        tok == self.eos_token_id:
+                    done = True
+            if done:
+                finished.append(self._finish(i))
+        if finished:
+            self._admit()
+        return finished
+
+    def _host_sample(self, logits):
+        from ..models.generation import sample_logits
+
+        self._key, sk = jax.random.split(self._key)
+        tok, _ = sample_logits(jnp.asarray(logits)[None], sk,
+                               self.decode_strategy, self.temperature,
+                               self.top_k, self.top_p)
+        return int(tok[0])
+
+    def _finish(self, slot_idx) -> FinishedRequest:
+        s = self.slots[slot_idx]
+        self._free_pages.extend(self.block_tables[slot_idx].tolist())
+        s.active = False
+        return FinishedRequest(
+            request_id=s.request_id,
+            prompt_ids=self._prompts.pop(s.request_id),
+            output_ids=np.asarray(s.tokens, np.int64))
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(s.active for s in self.slots)
+
+    def run(self, max_steps=10_000) -> List[FinishedRequest]:
+        out = []
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            out.extend(self.step())
+            steps += 1
+        return out

@@ -1,0 +1,229 @@
+"""Pallas paged-attention decode kernel + paged KV cache ops.
+
+Reference parity: the paged/blocked KV cache inside
+paddle/fluid/operators/fused/fused_multi_transformer_op (int8/cachekv
+variants) — SURVEY.md §2.1 "Fused transformer ops", §7 phase 10 (hard part
+#3: paged gather/scatter layouts on TPU).
+
+TPU-native design: KV lives in fixed-size pages `[kv_heads, n_pages,
+page_size, head_dim]`; each sequence owns a block table row. The decode
+kernel prefetches the block table as scalars (PrefetchScalarGridSpec) so the
+page index feeds the BlockSpec index_map — the gather happens in the
+pipeline DMA, never materializing a dense [b, s, h, d] cache. Online softmax
+accumulates across the page grid dimension in VMEM scratch.
+
+On non-TPU backends the kernel runs in interpreter mode (CPU CI parity),
+and `paged_attention_xla` is the dense-gather reference implementation used
+for testing and as a fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = np.float32(-1e30)
+
+_pc = pl.pallas_call
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# cache management (XLA scatter — one token per sequence per step)
+# ---------------------------------------------------------------------------
+
+
+def alloc_pages(n_pages, page_size, num_kv_heads, head_dim,
+                dtype=jnp.float32):
+    """Allocate empty K and V page pools."""
+    shape = (num_kv_heads, n_pages, page_size, head_dim)
+    return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+
+def update_paged_kv_cache(k_pages, v_pages, k_new, v_new, block_tables,
+                          context_lens, active=None):
+    """Scatter one new token per sequence into its page.
+
+    k_new/v_new: [batch, kv_heads, head_dim]; context_lens[b] is the number
+    of tokens already present (the new token lands at that position).
+    active: optional [batch] bool — False rows write nothing (their block
+    table row may be stale, e.g. a retired serving slot)."""
+    page_size = k_pages.shape[2]
+    page_ids = jnp.take_along_axis(
+        block_tables, (context_lens // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        # redirect inactive rows out of range; mode="drop" discards them
+        page_ids = jnp.where(active, page_ids, k_pages.shape[1])
+    slots = context_lens % page_size
+    k_pages = k_pages.at[:, page_ids, slots, :].set(
+        k_new.transpose(1, 0, 2), mode="drop")
+    v_pages = v_pages.at[:, page_ids, slots, :].set(
+        v_new.transpose(1, 0, 2), mode="drop")
+    return k_pages, v_pages
+
+
+def prefill_paged_kv_cache(k_pages, v_pages, k_seq, v_seq, block_tables,
+                           seq_lens):
+    """Scatter whole prompts into pages.
+
+    k_seq/v_seq: [batch, s, kv_heads, head_dim]; positions j >= seq_lens[b]
+    are dropped (padding)."""
+    b, s = k_seq.shape[0], k_seq.shape[1]
+    page_size = k_pages.shape[2]
+    pos = jnp.arange(s)[None, :]  # [1, s]
+    page_ids = jnp.take_along_axis(block_tables, pos // page_size,
+                                   axis=1)  # [b, s]
+    slots = jnp.broadcast_to(pos % page_size, (b, s))
+    valid = pos < seq_lens[:, None]
+    # drop invalid scatters by redirecting them out of range
+    page_ids = jnp.where(valid, page_ids, k_pages.shape[1])
+    kk = k_seq.transpose(2, 0, 1, 3).reshape(k_seq.shape[2], b * s, -1)
+    vv = v_seq.transpose(2, 0, 1, 3).reshape(v_seq.shape[2], b * s, -1)
+    k_pages = k_pages.at[:, page_ids.reshape(-1), slots.reshape(-1), :].set(
+        kk, mode="drop")
+    v_pages = v_pages.at[:, page_ids.reshape(-1), slots.reshape(-1), :].set(
+        vv, mode="drop")
+    return k_pages, v_pages
+
+
+# ---------------------------------------------------------------------------
+# decode attention
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc, *, page_size, scale, n_pages):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    ctx = lens_ref[b]
+
+    @pl.when(p * page_size < ctx)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+        kpos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < ctx, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
+                                                      keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * alpha + pv
+        m_scr[:, :1] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None):
+    """Single-token decode attention over a paged KV cache.
+
+    q: [batch, num_q_heads, head_dim]
+    k_pages/v_pages: [num_kv_heads, n_pages, page_size, head_dim]
+    block_tables: [batch, pages_per_seq] int32 (page indices)
+    context_lens: [batch] int32 — tokens valid in the cache (q attends over
+        these; the current token's K/V must already be written)
+    -> [batch, num_q_heads, head_dim]
+    """
+    b, n_q_heads, head_dim = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(head_dim))
+
+    # [b, kv_heads, group, d]; pad group to the sublane tile (8)
+    qg = q.reshape(b, n_kv_heads, group, head_dim)
+    gpad = max(8, ((group + 7) // 8) * 8)
+    if gpad != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - group), (0, 0)))
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=scale,
+        n_pages=pages_per_seq)
+
+    with jax.enable_x64(False):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_kv_heads, pages_per_seq),
+            in_specs=[
+                pl.BlockSpec((1, 1, gpad, head_dim),
+                             lambda b, h, p, lens, tables: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, head_dim),
+                             lambda b, h, p, lens, tables:
+                             (h, tables[b, p], 0, 0)),
+                pl.BlockSpec((1, 1, page_size, head_dim),
+                             lambda b, h, p, lens, tables:
+                             (h, tables[b, p], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gpad, head_dim),
+                lambda b, h, p, lens, tables: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gpad, 128), jnp.float32),
+                pltpu.VMEM((gpad, 128), jnp.float32),
+                pltpu.VMEM((gpad, head_dim), jnp.float32),
+            ],
+        )
+        out = _pc(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, n_kv_heads, gpad, head_dim),
+                                           q.dtype),
+            interpret=_interpret(),
+        )(context_lens.astype(jnp.int32),
+          block_tables.astype(jnp.int32),
+          qg, k_pages, v_pages)
+    return out[:, :, :group, :].reshape(b, n_q_heads, head_dim)
+
+
+def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                        scale=None):
+    """Dense-gather reference: materialize [b, S, kv_h, d] then masked
+    attention. Used for testing and as the non-TPU fallback path."""
+    b, n_q_heads, head_dim = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(head_dim))
+    # gather pages: [b, pages_per_seq] -> [kv_h, b, pages, ps, d]
+    k_dense = k_pages[:, block_tables]  # [kv_h, b, pages, ps, d]
+    v_dense = v_pages[:, block_tables]
+    S = block_tables.shape[1] * page_size
+    k_dense = k_dense.reshape(n_kv_heads, b, S, head_dim)
+    v_dense = v_dense.reshape(n_kv_heads, b, S, head_dim)
+    qf = q.reshape(b, n_kv_heads, group, head_dim).astype(jnp.float32)
+    s = jnp.einsum("bhgd,hbsd->bhgs", qf,
+                   k_dense.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, :] < context_lens[:, None]  # [b, S]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,hbsd->bhgd", p, v_dense.astype(jnp.float32))
+    return out.reshape(b, n_q_heads, head_dim).astype(q.dtype)

@@ -6,4 +6,5 @@ from .llama import (  # noqa: F401
     LlamaForCausalLM,
     LlamaModel,
 )
+from .generation import generate, sample_logits  # noqa: F401
 from .trainer import build_train_step, place_model  # noqa: F401

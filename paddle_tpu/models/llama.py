@@ -117,7 +117,8 @@ class LlamaAttention(nn.Layer):
             self.num_heads * self.head_dim, config.hidden_size,
             has_bias=False, input_is_parallel=True)
 
-    def forward(self, hidden_states, attn_mask=None, position_offset=0):
+    def forward(self, hidden_states, attn_mask=None, position_offset=0,
+                kv_cache=None):
         from ..ops.manipulation import reshape
 
         b, s = hidden_states.shape[0], hidden_states.shape[1]
@@ -140,6 +141,9 @@ class LlamaAttention(nn.Layer):
             return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
 
         q, k = _apply_op(rope_fn, q, k, _name="fused_rope")
+        if kv_cache is not None:
+            return self._cached_attention(q, k, v, kv_cache,
+                                          position_offset, b, s)
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             from ..ops.manipulation import repeat_interleave
@@ -178,6 +182,102 @@ class LlamaAttention(nn.Layer):
         out = reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 
+    def forward_paged(self, hidden_states, paged_cache, block_tables,
+                      context_lens, active=None):
+        """Single-token decode over a paged KV cache (serving path,
+        SURVEY.md §7 phase 10). hidden_states: [b, 1, hidden];
+        paged_cache: (k_pages, v_pages) [kv_heads, n_pages, page_size, d];
+        context_lens[b]: tokens already in the cache for that slot (the new
+        token lands there); active[b]=False rows skip the cache write
+        (retired serving slots with stale block tables). Returns
+        (out [b, 1, hidden], new_cache)."""
+        from ..kernels import paged_attention as _pa
+        from ..ops.manipulation import reshape
+
+        b = hidden_states.shape[0]
+        q = reshape(self.q_proj(hidden_states),
+                    [b, 1, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(hidden_states),
+                    [b, 1, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(hidden_states),
+                    [b, 1, self.num_kv_heads, self.head_dim])
+        k_pages, v_pages = paged_cache
+        theta = self.rope_theta
+        head_dim = self.head_dim
+        act = active if active is not None else True
+
+        def step(qq, kk, vv, kp, vp, tables, lens, act_mask):
+            # per-slot rope at position lens[b] (shared tables, rope.py)
+            cos, sin = rope_tables(1, head_dim, base=theta, dtype=qq.dtype,
+                                   position_offset=lens)
+            qq = apply_rope(qq, cos, sin)
+            kk = apply_rope(kk, cos, sin)
+            kp2, vp2 = _pa.update_paged_kv_cache(
+                kp, vp, kk[:, 0].astype(kp.dtype), vv[:, 0].astype(vp.dtype),
+                tables, lens, active=act_mask)
+            attn = _pa.paged_attention_xla if _pa._interpret() \
+                else _pa.paged_attention
+            out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
+            return out[:, None], kp2, vp2
+
+        import jax.numpy as _jnp
+
+        out, new_k, new_v = _apply_op(
+            step, q, k, v, Tensor(as_array(k_pages)),
+            Tensor(as_array(v_pages)), Tensor(as_array(block_tables)),
+            Tensor(as_array(context_lens)),
+            Tensor(_jnp.broadcast_to(_jnp.asarray(act, bool), (b,))),
+            _name="paged_attention")
+        out = reshape(out, [b, 1, self.num_heads * self.head_dim])
+        return self.o_proj(out), (new_k, new_v)
+
+    def _cached_attention(self, q, k, v, kv_cache, cur_len, b, s):
+        """Incremental decode/prefill over a dense preallocated KV cache
+        (SURVEY.md §7 phase 10; paged-cache serving path lives in
+        paddle_tpu.inference). kv_cache: (k_cache, v_cache) arrays of shape
+        [b, max_len, num_kv_heads, head_dim]; cur_len (traced ok) tokens are
+        already present; the s new tokens land at cur_len..cur_len+s-1."""
+        import jax.numpy as _jnp
+        from jax import lax as _lax
+
+        from ..ops.manipulation import reshape
+
+        rep = self.num_heads // self.num_kv_heads
+
+        def attend(qq, kk, vv, kc, vc):
+            cur = _jnp.asarray(cur_len, dtype=_jnp.int32)
+            z = _jnp.zeros((), _jnp.int32)
+            kc2 = _lax.dynamic_update_slice(
+                kc, kk.astype(kc.dtype), (z, cur, z, z))
+            vc2 = _lax.dynamic_update_slice(
+                vc, vv.astype(vc.dtype), (z, cur, z, z))
+            kr, vr = kc2, vc2
+            if rep != 1:
+                kr = _jnp.repeat(kr, rep, axis=2)
+                vr = _jnp.repeat(vr, rep, axis=2)
+            scale = 1.0 / math.sqrt(self.head_dim)
+            scores = _jnp.einsum(
+                "bshd,bThd->bhsT", qq.astype(_jnp.float32),
+                kr.astype(_jnp.float32)) * scale
+            S = kr.shape[1]
+            q_pos = cur + _jnp.arange(s)[:, None]
+            k_pos = _jnp.arange(S)[None, :]
+            mask = k_pos <= q_pos  # [s, S]
+            scores = _jnp.where(mask[None, None], scores,
+                                _jnp.float32(-1e30))
+            p = _jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+            p = p / p.sum(axis=-1, keepdims=True)
+            out = _jnp.einsum("bhsT,bThd->bshd", p,
+                              vr.astype(_jnp.float32))
+            return out.astype(qq.dtype), kc2, vc2
+
+        k_cache, v_cache = kv_cache
+        out, new_k, new_v = _apply_op(
+            attend, q, k, v, Tensor(as_array(k_cache)),
+            Tensor(as_array(v_cache)), _name="cached_attention")
+        out = reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out), (new_k, new_v)
+
 
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -207,6 +307,31 @@ class LlamaDecoderLayer(nn.Layer):
             return recompute(self._inner, hidden_states, attn_mask)
         return self._inner(hidden_states, attn_mask)
 
+    def forward_cached(self, hidden_states, kv_cache, cur_len):
+        """Decode/prefill step writing into a dense KV cache; returns
+        (hidden, new_kv_cache)."""
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h, new_cache = self.self_attn(h, position_offset=cur_len,
+                                      kv_cache=kv_cache)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2, new_cache
+
+    def forward_paged(self, hidden_states, paged_cache, block_tables,
+                      context_lens, active=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h, new_cache = self.self_attn.forward_paged(
+            h, paged_cache, block_tables, context_lens, active=active)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2, new_cache
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -225,6 +350,26 @@ class LlamaModel(nn.Layer):
         for layer in self.layers:
             h = layer(h, attn_mask)
         return self.norm(h)
+
+    def forward_cached(self, input_ids, caches, cur_len):
+        """caches: list of per-layer (k_cache, v_cache). Returns
+        (hidden, new_caches)."""
+        h = self.embed_tokens(input_ids)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            h, nc = layer.forward_cached(h, cache, cur_len)
+            new_caches.append(nc)
+        return self.norm(h), new_caches
+
+    def forward_paged(self, input_ids, paged_caches, block_tables,
+                      context_lens, active=None):
+        h = self.embed_tokens(input_ids)
+        new_caches = []
+        for layer, cache in zip(self.layers, paged_caches):
+            h, nc = layer.forward_paged(h, cache, block_tables,
+                                        context_lens, active=active)
+            new_caches.append(nc)
+        return self.norm(h), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -247,6 +392,42 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, attn_mask=None):
         return self._head(self.llama(input_ids, attn_mask))
+
+    def forward_cached(self, input_ids, caches, cur_len):
+        h, new_caches = self.llama.forward_cached(input_ids, caches,
+                                                  cur_len)
+        return self._head(h), new_caches
+
+    def forward_paged(self, input_ids, paged_caches, block_tables,
+                      context_lens, active=None):
+        h, new_caches = self.llama.forward_paged(
+            input_ids, paged_caches, block_tables, context_lens,
+            active=active)
+        return self._head(h), new_caches
+
+    def init_kv_caches(self, batch_size, max_length, dtype=None):
+        """Dense per-layer (k, v) caches for incremental decoding."""
+        import jax.numpy as _jnp
+
+        cfg = self.config
+        dt = dtype or _jnp.float32
+        shape = (batch_size, max_length, cfg.num_key_value_heads,
+                 cfg.hidden_size // cfg.num_attention_heads)
+        return [(_jnp.zeros(shape, dt), _jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def generate(self, input_ids, max_length=None, max_new_tokens=None,
+                 decode_strategy="greedy_search", temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0,
+                 seed=None):
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_length=max_length,
+                         max_new_tokens=max_new_tokens,
+                         decode_strategy=decode_strategy,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id,
+                         pad_token_id=pad_token_id, seed=seed)
 
     def _head(self, h):
         if self.lm_head is None:

@@ -14,20 +14,32 @@ from ...tensor import Tensor, _apply_op, as_array
 
 def rope_tables(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
                 position_offset=0):
+    """cos/sin tables. position_offset may be a scalar (shared offset,
+    traced ok) or a [batch] array (per-sequence decode positions) — the
+    latter yields [batch, seq, head_dim/2] tables."""
     inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
                                           dtype=jnp.float32) / head_dim))
-    t = jnp.arange(position_offset, position_offset + seq_len,
-                   dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)
+    # offset added after arange so traced (decode-time) offsets work
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    off = jnp.asarray(position_offset, dtype=jnp.float32)
+    if off.ndim == 1:  # per-batch positions
+        t = t[None, :] + off[:, None]  # [b, s]
+        freqs = t[..., None] * inv_freq  # [b, s, d/2]
+    else:
+        freqs = jnp.outer(t + off, inv_freq)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
 def apply_rope(x, cos, sin, neox=True):
     """x: [..., seq, heads, head_dim] (paddle bshd layout); cos/sin:
-    [seq, head_dim/2]. neox=True: rotate-half split; False: interleaved
-    (GPT-J style) pairs."""
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    [seq, head_dim/2] or batched [batch, seq, head_dim/2]. neox=True:
+    rotate-half split; False: interleaved (GPT-J style) pairs."""
+    if cos.ndim == 3:  # per-batch tables
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     if neox:
         d2 = x.shape[-1] // 2
         x1 = x[..., :d2]
