@@ -11,14 +11,13 @@ the model's dense-cache path, then scatters K/V into that request's pages.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..autograd import tape as _tape
-from ..framework import random as _random
 from ..kernels import paged_attention as _pa
 from ..tensor import Tensor, as_array
 
@@ -88,6 +87,8 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32) -> int:
         ids = np.asarray(as_array(prompt_ids)).reshape(-1).astype(np.int64)
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         if len(ids) + int(max_new_tokens) > self.max_seq_len:
             raise ValueError(
                 f"prompt ({len(ids)}) + max_new_tokens ({max_new_tokens}) "
@@ -123,31 +124,38 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # prefill: dense-cache forward on the prompt, scatter K/V into pages
     # ------------------------------------------------------------------
-    def _get_prefill_fn(self, plen):
-        fn = self._prefill_fns.get(plen)
+    def _get_prefill_fn(self, bucket):
+        """One compiled prefill per page-size bucket (prompts are padded up
+        to a page multiple), bounding compiles to max_seq_len/page_size."""
+        fn = self._prefill_fns.get(bucket)
         if fn is not None:
             return fn
         model = self.model
         from ..jit.api import _LayerScope
 
-        def pure_prefill(params, buffers, ids):
+        def pure_prefill(params, buffers, ids, true_len):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
-                caches = model.init_kv_caches(1, plen)
+                caches = model.init_kv_caches(1, bucket)
                 logits, caches = model.forward_cached(
                     Tensor(ids), caches, 0)
-                last = as_array(logits)[:, -1, :]
+                # causal mask => position true_len-1 ignores the padding
+                last = as_array(logits)[:, true_len - 1, :]
                 ks = jnp.stack([as_array(k)[0] for k, v in caches])
                 vs = jnp.stack([as_array(v)[0] for k, v in caches])
-            return last, ks, vs  # ks: [L, plen, kvh, hd]
+            return last, ks, vs  # ks: [L, bucket, kvh, hd]
 
-        fn = self._prefill_fns[plen] = jax.jit(pure_prefill)
+        fn = self._prefill_fns[bucket] = jax.jit(pure_prefill)
         return fn
 
     def _prefill(self, slot_idx, ids):
-        fn = self._get_prefill_fn(len(ids))
+        bucket = -(-len(ids) // self.page_size) * self.page_size
+        fn = self._get_prefill_fn(bucket)
         params = self.model.parameters_pytree()
         buffers = self.model.buffers_pytree()
-        last, ks, vs = fn(params, buffers, jnp.asarray(ids)[None, :])
+        padded = np.zeros((bucket,), np.int64)
+        padded[:len(ids)] = ids
+        last, ks, vs = fn(params, buffers, jnp.asarray(padded)[None, :],
+                          np.int32(len(ids)))
         tables = jnp.asarray(self.block_tables[slot_idx])[None, :]
         lens = jnp.asarray([len(ids)], jnp.int32)
         for li in range(len(self.k_pages)):
@@ -196,21 +204,22 @@ class ServingEngine:
         # decode fn both samples (from last logits) and advances. To keep
         # one compiled step, we sample on host for the prefill boundary.
         tokens = np.zeros((self.max_batch,), np.int64)
-        first_eos = []
+        first_done = []
         for i, s in enumerate(self.slots):
             if not s.active:
                 continue
             if not s.tokens:  # sample the first token from prefill logits
                 tok = self._host_sample(s._last_logits)
                 s.tokens.append(tok)
-                if self.eos_token_id is not None and \
-                        tok == self.eos_token_id:
-                    first_eos.append(i)
+                if (self.eos_token_id is not None
+                        and tok == self.eos_token_id) or \
+                        len(s.tokens) >= s.max_new_tokens:
+                    first_done.append(i)
             tokens[i] = s.tokens[-1]
-        for i in first_eos:
+        for i in first_done:
             # request finished on its very first token; never decode it
             active = [j for j in active if j != i]
-        finished_early = [self._finish(i) for i in first_eos]
+        finished_early = [self._finish(i) for i in first_done]
         if not active:
             if finished_early:
                 self._admit()
@@ -233,18 +242,12 @@ class ServingEngine:
         for i in active:
             s = self.slots[i]
             s.context_len += 1  # the token we just fed is now cached
-            tok = int(nxt[i])
-            done = False
-            if len(s.tokens) >= s.max_new_tokens:
-                done = True
-            elif s.context_len + 1 > self.max_seq_len:
-                done = True
-            else:
-                s.tokens.append(tok)
-                if self.eos_token_id is not None and \
-                        tok == self.eos_token_id:
-                    done = True
-            if done:
+            s.tokens.append(int(nxt[i]))
+            # finish at append time (slots at max_new never re-enter decode;
+            # add_request guarantees context_len stays <= max_seq_len)
+            if len(s.tokens) >= s.max_new_tokens or (
+                    self.eos_token_id is not None
+                    and s.tokens[-1] == self.eos_token_id):
                 finished.append(self._finish(i))
         if finished:
             self._admit()

@@ -80,6 +80,15 @@ class TranslatedLayer(Layer):
         self._params = params
         self._buffers_d = buffers
 
+    @property
+    def num_inputs(self):
+        """Positional data inputs of the saved program (excludes the params
+        and buffers pytrees that exported.call takes first)."""
+        import jax
+
+        args_tree = jax.tree_util.treedef_children(self._exported.in_tree)[0]
+        return len(jax.tree_util.treedef_children(args_tree)) - 2
+
     def forward(self, *inputs):
         arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                   for i in inputs]

@@ -13,7 +13,6 @@ capture). Sampling uses explicit jax.random keys.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
