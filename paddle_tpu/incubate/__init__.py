@@ -1,3 +1,4 @@
 """paddle.incubate (SURVEY.md §2.2 "Incubate fused API"): fused-op layers and
 experimental distributed models (MoE)."""
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

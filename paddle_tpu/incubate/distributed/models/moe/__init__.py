@@ -1,0 +1,45 @@
+"""Expert-parallel MoE (reference:
+python/paddle/incubate/distributed/models/moe — SURVEY.md §2.2 "EP").
+
+`global_scatter`/`global_gather` keep the reference's op names as shard_map
+helpers over `lax.all_to_all` with *static equal splits* — the jit-safe
+contract (the reference's uneven, count-driven NCCL a2a is replaced by
+capacity-padded dense routing; see moe_layer.py docstring).
+"""
+from __future__ import annotations
+
+import jax
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .moe_layer import ExpertFFN, MoELayer
+from . import routing
+
+__all__ = [
+    "MoELayer", "ExpertFFN", "BaseGate", "NaiveGate", "SwitchGate",
+    "GShardGate", "routing", "global_scatter", "global_gather",
+]
+
+
+def global_scatter(x, axis_name: str = "ep"):
+    """Inside shard_map: exchange equal token blocks so each rank holds the
+    tokens destined for its local experts. x: [E_global * C, d] per rank,
+    grouped by destination expert -> [E_local * C * ep, d].
+
+    Maps the reference op paddle/fluid/operators/collective/global_scatter_op
+    onto `lax.all_to_all` (SURVEY.md §5 mapping table)."""
+    ep = jax.lax.axis_size(axis_name)
+    e_g, d = x.shape
+    blocks = x.reshape(ep, e_g // ep, d)
+    out = jax.lax.all_to_all(blocks, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    return out.reshape(-1, d)
+
+
+def global_gather(x, axis_name: str = "ep"):
+    """Inverse of global_scatter (reference global_gather_op)."""
+    ep = jax.lax.axis_size(axis_name)
+    n, d = x.shape
+    blocks = x.reshape(ep, n // ep, d)
+    out = jax.lax.all_to_all(blocks, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    return out.reshape(-1, d)

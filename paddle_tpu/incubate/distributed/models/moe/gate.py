@@ -1,0 +1,81 @@
+"""MoE gates — NaiveGate / SwitchGate / GShardGate.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+(SURVEY.md §2.2 "EP"): each gate scores tokens against experts and picks
+top-k routing slots. Here a gate owns the router projection and returns
+*dense* dispatch/combine tensors (routing.py) instead of sparse counts —
+the jit-friendly formulation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .....nn import initializer as I
+from .....nn.layer_base import Layer
+from .....tensor import _apply_op
+from . import routing
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int,
+                 capacity_factor: float = 1.25,
+                 eval_capacity_factor: float = 2.0,
+                 normalize: str = "topk"):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.normalize = normalize
+        self.weight = self.create_parameter(
+            shape=[d_model, num_experts],
+            default_initializer=I.XavierUniform(),
+        )
+
+    def capacity(self, num_tokens: int) -> int:
+        factor = (self.capacity_factor if self.training
+                  else self.eval_capacity_factor)
+        return routing.expert_capacity(
+            num_tokens, self.num_experts, self.top_k, factor)
+
+    def forward(self, x):
+        """x: [n, d_model] Tensor -> (dispatch, combine, aux_loss) Tensors."""
+        n = int(x.shape[0])
+        cap = self.capacity(n)
+
+        def f(xa, wa):
+            logits = xa @ wa.astype(xa.dtype)
+            d, c, aux, _ = routing.topk_dispatch(
+                logits, self.top_k, cap, normalize=self.normalize)
+            return d.astype(xa.dtype), c.astype(xa.dtype), aux
+
+        return _apply_op(f, x, self.weight, _name="moe_gate")
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax gate (no capacity pressure by default)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, **kw):
+        kw.setdefault("capacity_factor", 4.0)
+        kw.setdefault("eval_capacity_factor", 4.0)
+        super().__init__(d_model, num_experts, top_k, **kw)
+
+
+class SwitchGate(BaseGate):
+    """Switch Transformer top-1 gate (full-softmax combine weight)."""
+
+    def __init__(self, d_model, num_experts, top_k=1,
+                 capacity_factor=1.25, **kw):
+        kw.setdefault("normalize", "all")
+        super().__init__(d_model, num_experts, 1,
+                         capacity_factor=capacity_factor, **kw)
+
+
+class GShardGate(BaseGate):
+    """GShard top-2 gate with capacity-limited dispatch."""
+
+    def __init__(self, d_model, num_experts, top_k=2,
+                 capacity_factor=1.25, **kw):
+        super().__init__(d_model, num_experts, 2,
+                         capacity_factor=capacity_factor, **kw)

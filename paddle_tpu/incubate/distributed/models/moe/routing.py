@@ -1,0 +1,99 @@
+"""MoE routing math — dense GShard-style dispatch/combine.
+
+Reference parity: python/paddle/incubate/distributed/models/moe (MoELayer +
+gates) and paddle/fluid/operators/collective/global_scatter_op /
+global_gather_op (SURVEY.md §2.2 "EP (expert parallel / MoE)").
+
+TPU-native design: the reference routes tokens with *sparse* host-computed
+counts (local_expert_count / global_expert_count) feeding an uneven NCCL
+all-to-all. That shape is hostile to XLA (dynamic sizes, host sync). Here
+routing is the GShard dense formulation: fixed expert capacity C, one-hot
+dispatch tensor [n, E, C] and combine tensor [n, E, C], so expert exchange
+is two static einsums that GSPMD turns into ICI all-to-alls when the expert
+dimension is sharded on the `ep` mesh axis. Everything is jit-traceable:
+no data-dependent shapes, top-k + cumsum position assignment on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert buffer size (tokens routed beyond it are dropped)."""
+    cap = int(capacity_factor * top_k * num_tokens / num_experts)
+    return max(cap, top_k)
+
+
+def _position_in_expert(expert_mask):
+    """expert_mask: [n, E] one-hot (for one routing slot). Returns the
+    running position of each token inside its expert's buffer ([n, E]),
+    0-indexed, counting only tokens assigned to that expert."""
+    return jnp.cumsum(expert_mask, axis=0) * expert_mask - expert_mask
+
+
+def topk_dispatch(logits, top_k: int, capacity: int,
+                  normalize: str = "topk"):
+    """Compute dense dispatch/combine tensors from router logits.
+
+    Args:
+      logits: [n, E] float router scores.
+      top_k: routing slots per token (1 = Switch, 2 = GShard).
+      capacity: per-expert buffer length C.
+      normalize: 'topk' renormalizes gate weights over the chosen k
+        (reference NaiveGate/GShardGate); 'all' uses the full-softmax
+        probability mass (Switch).
+
+    Returns (dispatch [n,E,C] float, combine [n,E,C] float,
+             aux_loss scalar, probs [n,E]).
+    aux_loss is the Switch/GShard load-balance loss
+    E * sum_e(mean_tokens(one_hot_top1_e) * mean_tokens(prob_e)).
+    """
+    n, num_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # top-k expert choice per token
+    topk_prob, topk_idx = jax.lax.top_k(probs, top_k)  # [n, k]
+    if normalize == "topk":
+        topk_w = topk_prob / jnp.clip(
+            jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    else:
+        topk_w = topk_prob
+
+    # load-balance aux loss from the top-1 assignment (GShard eq. (4))
+    top1_hot = jax.nn.one_hot(topk_idx[:, 0], num_experts)
+    density = jnp.mean(top1_hot, axis=0)           # fraction routed per expert
+    density_proxy = jnp.mean(probs, axis=0)        # mean router prob
+    aux_loss = jnp.sum(density * density_proxy) * (num_experts ** 2) / top_k
+
+    # capacity-limited positions, filling slot 0 first (higher priority)
+    dispatch = jnp.zeros((n, num_experts, capacity), dtype=probs.dtype)
+    combine = jnp.zeros((n, num_experts, capacity), dtype=probs.dtype)
+    used = jnp.zeros((num_experts,), dtype=jnp.int32)  # slots consumed so far
+    for slot in range(top_k):
+        e_hot = jax.nn.one_hot(topk_idx[:, slot], num_experts,
+                               dtype=probs.dtype)           # [n, E]
+        pos = _position_in_expert(e_hot) + used[None, :]     # [n, E]
+        keep = e_hot * (pos < capacity)
+        pos_idx = jnp.sum(pos * keep, axis=1).astype(jnp.int32)   # [n]
+        cap_hot = jax.nn.one_hot(pos_idx, capacity,
+                                 dtype=probs.dtype)          # [n, C]
+        d = keep[:, :, None] * cap_hot[:, None, :]           # [n, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * topk_w[:, slot][:, None, None]
+        used = used + jnp.sum(e_hot, axis=0).astype(jnp.int32)
+    return dispatch, combine, aux_loss, probs
+
+
+def dispatch_tokens(x, dispatch):
+    """x: [n, d], dispatch: [n, E, C] -> expert inputs [E, C, d].
+
+    With dispatch sharded over the `ep` mesh axis on E, GSPMD lowers this
+    einsum to the all-to-all the reference's global_scatter op performs.
+    """
+    return jnp.einsum("nec,nd->ecd", dispatch, x)
+
+
+def combine_tokens(expert_out, combine):
+    """expert_out: [E, C, d], combine: [n, E, C] -> [n, d] (global_gather)."""
+    return jnp.einsum("nec,ecd->nd", combine, expert_out)
