@@ -1,5 +1,6 @@
 """paddle.distributed (SURVEY.md §2.2 L7): collectives, fleet, mesh,
 parallel wrappers, launch, sharding, checkpoint."""
+from . import checkpoint  # noqa: F401
 from . import collective  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401

@@ -22,6 +22,7 @@ class Fleet:
         self._strategy: Optional[DistributedStrategy] = None
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._is_initialized = False
+        self._model = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
@@ -60,6 +61,7 @@ class Fleet:
         return self._hcg
 
     def distributed_model(self, model):
+        self._model = model
         strategy = self._strategy or DistributedStrategy()
         hc = strategy.hybrid_configs
         pp = int(hc.get("pp_degree", 1))
@@ -91,11 +93,21 @@ class Fleet:
         return HybridParallelOptimizer(optimizer, self._hcg, strat)
 
     # checkpoint helpers (sharded save/load — SURVEY.md §5)
-    def save(self, dirname, **configs):
-        raise NotImplementedError("use distributed.checkpoint.save")
+    def save(self, dirname, model=None, optimizer=None, **configs):
+        from .. import checkpoint as _ckpt
 
-    def load_model(self, path, mode=0):
-        raise NotImplementedError("use distributed.checkpoint.load")
+        model = model if model is not None else self._model
+        if model is None:
+            raise ValueError("fleet.save needs a model (none wrapped yet)")
+        _ckpt.save_model_state(model, optimizer, dirname, **configs)
+
+    def load_model(self, path, model=None, optimizer=None, **configs):
+        from .. import checkpoint as _ckpt
+
+        model = model if model is not None else self._model
+        if model is None:
+            raise ValueError("fleet.load_model needs a model")
+        return _ckpt.load_model_state(model, optimizer, path, **configs)
 
 
 fleet = Fleet()

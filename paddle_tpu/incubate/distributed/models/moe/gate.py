@@ -67,15 +67,18 @@ class SwitchGate(BaseGate):
 
     def __init__(self, d_model, num_experts, top_k=1,
                  capacity_factor=1.25, **kw):
+        if top_k != 1:
+            raise ValueError("SwitchGate is top-1 by definition; use "
+                             "GShardGate/NaiveGate for top_k > 1")
         kw.setdefault("normalize", "all")
         super().__init__(d_model, num_experts, 1,
                          capacity_factor=capacity_factor, **kw)
 
 
 class GShardGate(BaseGate):
-    """GShard top-2 gate with capacity-limited dispatch."""
+    """GShard top-k (default 2) gate with capacity-limited dispatch."""
 
     def __init__(self, d_model, num_experts, top_k=2,
                  capacity_factor=1.25, **kw):
-        super().__init__(d_model, num_experts, 2,
+        super().__init__(d_model, num_experts, top_k,
                          capacity_factor=capacity_factor, **kw)

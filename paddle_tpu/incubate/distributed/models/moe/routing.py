@@ -47,8 +47,9 @@ def topk_dispatch(logits, top_k: int, capacity: int,
 
     Returns (dispatch [n,E,C] float, combine [n,E,C] float,
              aux_loss scalar, probs [n,E]).
-    aux_loss is the Switch/GShard load-balance loss
-    E * sum_e(mean_tokens(one_hot_top1_e) * mean_tokens(prob_e)).
+    aux_loss is the standard Switch load-balance loss
+    E * sum_e(f_e * P_e) with f from the top-1 assignment — equal to 1.0
+    at perfect balance, > 1 under imbalance.
     """
     n, num_experts = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -64,7 +65,7 @@ def topk_dispatch(logits, top_k: int, capacity: int,
     top1_hot = jax.nn.one_hot(topk_idx[:, 0], num_experts)
     density = jnp.mean(top1_hot, axis=0)           # fraction routed per expert
     density_proxy = jnp.mean(probs, axis=0)        # mean router prob
-    aux_loss = jnp.sum(density * density_proxy) * (num_experts ** 2) / top_k
+    aux_loss = jnp.sum(density * density_proxy) * num_experts
 
     # capacity-limited positions, filling slot 0 first (higher priority)
     dispatch = jnp.zeros((n, num_experts, capacity), dtype=probs.dtype)
