@@ -34,18 +34,14 @@ from .context_parallel import (  # noqa: F401
     ulysses_attention,
 )
 from .parallel import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401
 from .pipeline import spmd_pipeline  # noqa: F401
 from .sharding_utils import get_param_spec, mark_sharding, shard_tensor  # noqa: F401
 
 
 def is_initialized():
     return env.is_initialized()
-
-
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """Single-host TPU: one process drives all chips; spawn runs func once.
-    Multi-host: use paddle.distributed.launch."""
-    func(*args)
 
 
 class ParallelEnv:

@@ -1,0 +1,3 @@
+"""Elastic training (reference: python/paddle/distributed/fleet/elastic —
+SURVEY.md §5 "Failure detection / elastic")."""
+from .manager import ElasticManager, ElasticStatus  # noqa: F401

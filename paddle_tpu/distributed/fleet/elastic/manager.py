@@ -1,0 +1,150 @@
+"""ElasticManager: heartbeat registry + membership watch + restart hooks.
+
+Reference parity: python/paddle/distributed/fleet/elastic/manager.py
+(SURVEY.md §5): the reference registers each node under an ETCD job prefix
+with TTL heartbeats, watches the peer set, and on node loss/join within
+[min, max] bounds rewrites endpoint lists and relaunches training
+(restart-from-checkpoint, never in-flight repair).
+
+TPU-native notes: zero-egress TPU pods have no etcd; the registry here is a
+pluggable Store — the bundled FileStore runs on any shared filesystem
+(GCS-fuse/NFS on real pods, tmpdir in tests) with the same TTL-heartbeat
+semantics. The restart philosophy is identical: on membership change the
+manager signals NEED_RESTART, the controller relaunches, and the training
+script resumes from distributed.checkpoint.CheckpointManager's latest step.
+PADDLE_ELASTIC_* env vars keep their reference meanings.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class ElasticStatus:
+    OK = "ok"
+    NEED_RESTART = "need_restart"
+    BELOW_MIN = "below_min"
+    EXIT = "exit"
+
+
+class FileStore:
+    """TTL-heartbeat KV on a shared directory (the etcd stand-in)."""
+
+    def __init__(self, root: str, job_id: str):
+        self.dir = os.path.join(root, f"elastic_{job_id}")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, key.replace("/", "__"))
+
+    def put(self, key: str, value: dict):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({**value, "ts": time.time()}, f)
+        os.replace(tmp, self._path(key))
+
+    def get_all(self, ttl: float) -> Dict[str, dict]:
+        now = time.time()
+        out = {}
+        for name in os.listdir(self.dir):
+            if name.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    v = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if now - v.get("ts", 0) <= ttl:
+                out[name] = v
+        return out
+
+    def delete(self, key: str):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class ElasticManager:
+    """Per-node membership agent.
+
+    mgr = ElasticManager(store_root, job_id, node_rank, endpoint,
+                         min_nodes=2, max_nodes=4)
+    mgr.start()                      # heartbeat thread
+    status = mgr.watch()             # OK / NEED_RESTART / BELOW_MIN
+    mgr.stop()
+    """
+
+    def __init__(self, store_root: str, job_id: str, node_rank: int,
+                 endpoint: str, min_nodes: int = 1,
+                 max_nodes: Optional[int] = None,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0):
+        self.store = FileStore(store_root, job_id)
+        self.node_rank = node_rank
+        self.endpoint = endpoint
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes or max(min_nodes, 1 << 16)
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._known: Optional[frozenset] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_env(cls, store_root: str):
+        """Build from the PADDLE_ELASTIC_* / PADDLE_* env contract."""
+        return cls(
+            store_root=store_root,
+            job_id=os.environ.get("PADDLE_JOB_ID", "default"),
+            node_rank=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+            endpoint=os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0"),
+            min_nodes=int(os.environ.get("PADDLE_ELASTIC_NP", "1")),
+            max_nodes=int(os.environ.get("PADDLE_ELASTIC_MAX_NP", "0")) or
+            None,
+            ttl=float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", "5")),
+        )
+
+    def _beat(self):
+        while not self._stop.is_set():
+            self.store.put(f"node/{self.node_rank}",
+                           {"endpoint": self.endpoint,
+                            "rank": self.node_rank})
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+        # first beat synchronously so watch() sees ourselves immediately
+        self.store.put(f"node/{self.node_rank}",
+                       {"endpoint": self.endpoint, "rank": self.node_rank})
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self.interval)
+        self.store.delete(f"node/{self.node_rank}")
+
+    # ------------------------------------------------------------------
+    def alive_nodes(self) -> List[dict]:
+        return sorted(self.store.get_all(self.ttl).values(),
+                      key=lambda v: v["rank"])
+
+    def endpoints(self) -> List[str]:
+        return [v["endpoint"] for v in self.alive_nodes()]
+
+    def watch(self) -> str:
+        """One membership check (call in the controller's watch loop)."""
+        alive = frozenset(v["rank"] for v in self.alive_nodes())
+        if len(alive) < self.min_nodes:
+            return ElasticStatus.BELOW_MIN
+        if self._known is None:
+            self._known = alive
+            return ElasticStatus.OK
+        if alive != self._known:
+            self._known = alive
+            return ElasticStatus.NEED_RESTART
+        return ElasticStatus.OK

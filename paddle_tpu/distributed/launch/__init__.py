@@ -1,0 +1,3 @@
+"""Launcher (reference: python/paddle/distributed/launch — SURVEY.md §3.5)."""
+from .context import JobContext, parse_args, rank_env  # noqa: F401
+from .controller import CollectiveController, Container  # noqa: F401

@@ -1,0 +1,15 @@
+"""`python -m paddle_tpu.distributed.launch` CLI (reference:
+python -m paddle.distributed.launch — SURVEY.md §3.5)."""
+import sys
+
+from .context import parse_args
+from .controller import CollectiveController
+
+
+def main(argv=None):
+    ctx = parse_args(argv)
+    sys.exit(CollectiveController(ctx).run())
+
+
+if __name__ == "__main__":
+    main()

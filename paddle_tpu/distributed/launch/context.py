@@ -1,0 +1,108 @@
+"""Launch context: CLI args + env -> a resolved job description.
+
+Reference parity: python/paddle/distributed/launch/context (SURVEY.md §3.5):
+`Context` parses --nnodes/--nproc_per_node/--master/--devices/--log_dir and
+the PADDLE_* env, producing the per-rank env contract. TPU-native notes: on
+TPU pods the natural unit is ONE process PER HOST (jax owns all local
+chips), so nproc_per_node defaults to 1; multi-proc-per-node remains for
+CPU tests and the reference's GPU-style flows.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class JobContext:
+    script: str = ""
+    script_args: List[str] = field(default_factory=list)
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    master: Optional[str] = None
+    log_dir: str = "log"
+    devices: Optional[str] = None
+    job_id: str = "default"
+    max_restarts: int = 0  # >0 enables elastic restart-from-failure
+    envs: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        # resolve the master exactly once — every rank_env() call must see
+        # the same MASTER_PORT or ranks can never rendezvous
+        if self.master is None:
+            self.master = f"127.0.0.1:{free_port()}"
+
+    @property
+    def world_size(self) -> int:
+        return self.nnodes * self.nproc_per_node
+
+    def rank_of(self, local_rank: int) -> int:
+        return self.node_rank * self.nproc_per_node + local_rank
+
+    def endpoints(self) -> List[str]:
+        host, port = self.master.split(":")
+        return [f"{host}:{int(port) + r}" for r in range(self.world_size)]
+
+
+def parse_args(argv=None) -> JobContext:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="multi-process / multi-node training launcher")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"))
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--devices", "--gpus", type=str, default=None)
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
+                                              "0")))
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    a = p.parse_args(argv)
+    if a.nnodes > 1 and not a.master:
+        raise SystemExit("--master host:port is required when --nnodes > 1")
+    return JobContext(
+        script=a.script, script_args=a.script_args, nnodes=a.nnodes,
+        node_rank=a.node_rank, nproc_per_node=a.nproc_per_node,
+        master=a.master, log_dir=a.log_dir, devices=a.devices,
+        job_id=a.job_id, max_restarts=a.max_restarts)
+
+
+def rank_env(ctx: JobContext, local_rank: int) -> dict:
+    """The PADDLE_* env contract (reference §3.5) for one worker."""
+    eps = ctx.endpoints()
+    rank = ctx.rank_of(local_rank)
+    master = ctx.master
+    env = dict(os.environ)
+    env.update(ctx.envs)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(ctx.world_size),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        "PADDLE_CURRENT_ENDPOINT": eps[rank],
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[1],
+        "PADDLE_JOB_ID": ctx.job_id,
+    })
+    if ctx.devices is not None:
+        devs = ctx.devices.split(",")
+        env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+    return env

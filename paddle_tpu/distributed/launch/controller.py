@@ -1,0 +1,110 @@
+"""Collective controller: pod build + watch loop + elastic restart.
+
+Reference parity: python/paddle/distributed/launch/controllers (SURVEY.md
+§3.5): `CollectiveController.build_pod` makes one Container per device,
+redirects per-rank logs to `<log_dir>/workerlog.N`, and a watch loop polls
+container status — teardown on failure, or (elastic, SURVEY.md §5 "Failure
+detection") relaunch up to max_restarts with the restart-from-checkpoint
+philosophy: the training script is expected to resume from its latest
+checkpoint (distributed.checkpoint.CheckpointManager).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .context import JobContext, rank_env
+
+
+@dataclass
+class Container:
+    local_rank: int
+    cmd: List[str]
+    env: dict
+    log_path: str
+    proc: Optional[subprocess.Popen] = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        logf = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=logf, stderr=subprocess.STDOUT)
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, grace: float = 5.0):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(grace)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+class CollectiveController:
+    def __init__(self, ctx: JobContext):
+        self.ctx = ctx
+        self.pod: List[Container] = []
+        self.pod_restarts = 0
+
+    def build_pod(self):
+        for lr in range(self.ctx.nproc_per_node):
+            rank = self.ctx.rank_of(lr)
+            log = os.path.join(self.ctx.log_dir, f"workerlog.{rank}")
+            cmd = [sys.executable, "-u", self.ctx.script,
+                   *self.ctx.script_args]
+            self.pod.append(Container(
+                local_rank=lr, cmd=cmd, env=rank_env(self.ctx, lr),
+                log_path=log))
+        return self.pod
+
+    def run(self, poll_interval: float = 0.5) -> int:
+        """Start everything; watch; return the job's exit code."""
+        if not self.pod:
+            self.build_pod()
+        for c in self.pod:
+            c.start()
+        try:
+            return self._watch(poll_interval)
+        except KeyboardInterrupt:
+            self._teardown()
+            return 130
+
+    def _watch(self, poll_interval: float) -> int:
+        while True:
+            statuses = [c.poll() for c in self.pod]
+            if all(s == 0 for s in statuses):
+                return 0
+            failed = next((s for s in statuses if s not in (None, 0)), None)
+            if failed is not None:
+                # collective jobs cannot be repaired one rank at a time —
+                # surviving ranks are parked inside collectives with stale
+                # rendezvous state. Restart the WHOLE pod (reference
+                # semantics: relaunch from the latest checkpoint).
+                if self.pod_restarts < self.ctx.max_restarts:
+                    self.pod_restarts += 1
+                    print(f"[launch] a rank exited {failed}; elastic pod "
+                          f"restart {self.pod_restarts}/"
+                          f"{self.ctx.max_restarts}", file=sys.stderr)
+                    self._teardown()
+                    for c in self.pod:
+                        c.start()
+                else:
+                    print(f"[launch] rank failed with exit code {failed}; "
+                          f"tearing down pod "
+                          f"(logs: {self.ctx.log_dir}/workerlog.*)",
+                          file=sys.stderr)
+                    self._teardown()
+                    return failed
+            time.sleep(poll_interval)
+
+    def _teardown(self):
+        for c in self.pod:
+            c.terminate()

@@ -1,0 +1,139 @@
+"""Launcher / elastic tests (SURVEY.md §3.5, §5 "Failure detection"):
+multi-process env contract, per-rank logs, failure teardown, elastic
+restart-from-failure, membership watch — all on localhost subprocesses
+(the reference's test_dist_base trick)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.launch.context import JobContext, rank_env
+from paddle_tpu.distributed.launch.controller import CollectiveController
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    out = sys.argv[1]
+    info = {k: os.environ.get(k) for k in (
+        "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+        "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT",
+        "PADDLE_LOCAL_RANK", "PADDLE_MASTER")}
+    with open(os.path.join(out, "env.%s.json" % info["PADDLE_TRAINER_ID"]),
+              "w") as f:
+        json.dump(info, f)
+    print("worker", info["PADDLE_TRAINER_ID"], "done")
+""")
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def test_env_contract(tmp_path):
+    ctx = JobContext(script="x.py", nnodes=2, node_rank=1, nproc_per_node=2,
+                     master="127.0.0.1:6170")
+    env = rank_env(ctx, local_rank=1)
+    assert env["PADDLE_TRAINER_ID"] == "3"
+    assert env["PADDLE_TRAINERS_NUM"] == "4"
+    eps = env["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 4 and eps[0] == "127.0.0.1:6170"
+    assert env["PADDLE_CURRENT_ENDPOINT"] == eps[3]
+    assert env["MASTER_ADDR"] == "127.0.0.1"
+
+
+def test_launch_two_workers(tmp_path):
+    import json
+
+    script = _write(tmp_path, "worker.py", WORKER)
+    ctx = JobContext(script=script, script_args=[str(tmp_path)],
+                     nproc_per_node=2, log_dir=str(tmp_path / "log"))
+    rc = CollectiveController(ctx).run(poll_interval=0.1)
+    assert rc == 0
+    for r in (0, 1):
+        with open(tmp_path / f"env.{r}.json") as f:
+            info = json.load(f)
+        assert info["PADDLE_TRAINER_ID"] == str(r)
+        assert info["PADDLE_TRAINERS_NUM"] == "2"
+        log = (tmp_path / "log" / f"workerlog.{r}").read_text()
+        assert f"worker {r} done" in log
+
+
+def test_launch_failure_teardown(tmp_path):
+    bad = _write(tmp_path, "bad.py", "import sys; sys.exit(3)\n")
+    ctx = JobContext(script=bad, nproc_per_node=2,
+                     log_dir=str(tmp_path / "log"))
+    rc = CollectiveController(ctx).run(poll_interval=0.1)
+    assert rc == 3
+
+
+def test_elastic_restart_recovers(tmp_path):
+    # fails on first attempt, succeeds on the retry (restart-from-checkpoint
+    # stand-in: the marker file is the "checkpoint")
+    script = _write(tmp_path, "flaky.py", textwrap.dedent(f"""
+        import os, sys
+        marker = os.path.join({str(tmp_path)!r}, "attempted")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(1)
+        print("recovered")
+    """))
+    ctx = JobContext(script=script, nproc_per_node=1, max_restarts=2,
+                     log_dir=str(tmp_path / "log"))
+    rc = CollectiveController(ctx).run(poll_interval=0.1)
+    assert rc == 0
+    assert "recovered" in (tmp_path / "log" / "workerlog.0").read_text()
+
+
+def test_elastic_manager_membership(tmp_path):
+    m0 = ElasticManager(str(tmp_path), "job", 0, "h0:1", min_nodes=1,
+                        heartbeat_interval=0.1, ttl=10.0)
+    m1 = ElasticManager(str(tmp_path), "job", 1, "h1:1", min_nodes=1,
+                        heartbeat_interval=0.1, ttl=10.0)
+    m0.start()
+    m1.start()
+    try:
+        assert m0.watch() == ElasticStatus.OK  # snapshot {0,1}
+        assert m0.endpoints() == ["h0:1", "h1:1"]
+        m1.stop()  # node 1 leaves
+        assert m0.watch() == ElasticStatus.NEED_RESTART
+        assert m0.watch() == ElasticStatus.OK  # new membership accepted
+    finally:
+        m0.stop()
+
+
+def test_elastic_below_min(tmp_path):
+    m0 = ElasticManager(str(tmp_path), "job2", 0, "h0:1", min_nodes=2,
+                        heartbeat_interval=0.1, ttl=10.0)
+    m0.start()
+    try:
+        assert m0.watch() == ElasticStatus.BELOW_MIN
+    finally:
+        m0.stop()
+
+
+def test_spawn_runs_ranks(tmp_path):
+    script = _write(tmp_path, "sp.py", textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        import os
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu.distributed as dist
+
+        def fn(out):
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            with open(os.path.join(out, "r" + rank), "w") as f:
+                f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+        if __name__ == "__main__":
+            dist.spawn(fn, args=({str(tmp_path)!r},), nprocs=2)
+    """))
+    r = subprocess.run([sys.executable, script], capture_output=True,
+                       text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "r0").read_text() == "2"
+    assert (tmp_path / "r1").read_text() == "2"
