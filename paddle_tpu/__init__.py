@@ -150,6 +150,7 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
+from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401

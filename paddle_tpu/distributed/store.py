@@ -1,0 +1,151 @@
+"""TCPStore: rendezvous KV store over the native C++ daemon.
+
+Reference parity: paddle/fluid/distributed/store/tcp_store.cc `TCPStore` /
+`MasterDaemon` (SURVEY.md §2.1): rank 0 hosts the daemon, every rank
+connects; set/get/add/wait with blocking waits drive bootstrap barriers.
+The C++ half lives in paddle_tpu/native/tcp_store.cc (built on demand by
+utils.cpp_extension); this wrapper adds the barrier() helper the launch
+and elastic layers use.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from ..utils.cpp_extension import load_native
+
+_lib = None
+
+
+def _native():
+    global _lib
+    if _lib is None:
+        lib = load_native("tcp_store")
+        lib.tcp_store_master_start.restype = ctypes.c_void_p
+        lib.tcp_store_master_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_master_port.restype = ctypes.c_int
+        lib.tcp_store_master_port.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_master_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_int
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.tcp_store_set.restype = ctypes.c_int64
+        lib.tcp_store_set.argtypes = [ctypes.c_int, ctypes.c_char_p, u8p,
+                                      ctypes.c_uint32]
+        lib.tcp_store_get.restype = ctypes.c_int64
+        lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p, u8p,
+                                      ctypes.c_uint32, u32p]
+        lib.tcp_store_add.restype = ctypes.c_int64
+        lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_int64]
+        lib.tcp_store_wait.restype = ctypes.c_int64
+        lib.tcp_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.c_uint64, u8p, ctypes.c_uint32,
+                                       u32p]
+        lib.tcp_store_delete.restype = ctypes.c_int64
+        lib.tcp_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.tcp_store_num_keys.restype = ctypes.c_int64
+        lib.tcp_store_num_keys.argtypes = [ctypes.c_int]
+        lib.tcp_store_close.argtypes = [ctypes.c_int]
+        _lib = lib
+    return _lib
+
+
+_MAX_VAL = 1 << 20
+
+
+class TCPStore:
+    """store = TCPStore(host, port, world_size, is_master=rank==0)
+
+    port=0 with is_master picks a free port (read it from .port).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 world_size: int = 1, is_master: bool = False,
+                 timeout: float = 30.0):
+        lib = _native()
+        self._lib = lib
+        self._daemon = None
+        self.world_size = world_size
+        self.is_master = is_master
+        if is_master:
+            self._daemon = lib.tcp_store_master_start(int(port))
+            if not self._daemon:
+                raise RuntimeError(f"TCPStore master failed to bind :{port}")
+            port = lib.tcp_store_master_port(self._daemon)
+        self.host, self.port = host, int(port)
+        self._fd = lib.tcp_store_connect(
+            host.encode(), self.port, int(timeout * 1000))
+        if self._fd < 0:
+            raise TimeoutError(
+                f"TCPStore could not reach {host}:{self.port} within "
+                f"{timeout}s")
+
+    # ------------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+            if data else None
+        st = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(data))
+        if st != 0:
+            raise RuntimeError(f"TCPStore.set({key}) failed: {st}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        out = (ctypes.c_uint8 * _MAX_VAL)()
+        olen = ctypes.c_uint32(0)
+        st = self._lib.tcp_store_get(self._fd, key.encode(), out, _MAX_VAL,
+                                     ctypes.byref(olen))
+        if st == -1:
+            return None
+        if st != 0:
+            raise RuntimeError(f"TCPStore.get({key}) failed: {st}")
+        return bytes(out[:olen.value])
+
+    def add(self, key: str, amount: int = 1) -> int:
+        st = self._lib.tcp_store_add(self._fd, key.encode(), int(amount))
+        if st < 0:
+            raise RuntimeError(f"TCPStore.add({key}) failed: {st}")
+        return int(st)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        out = (ctypes.c_uint8 * _MAX_VAL)()
+        olen = ctypes.c_uint32(0)
+        ms = 0 if timeout is None else max(1, int(timeout * 1000))
+        st = self._lib.tcp_store_wait(self._fd, key.encode(), ms, out,
+                                      _MAX_VAL, ctypes.byref(olen))
+        if st == -2:
+            raise TimeoutError(f"TCPStore.wait({key}) timed out")
+        if st != 0:
+            raise RuntimeError(f"TCPStore.wait({key}) failed: {st}")
+        return bytes(out[:olen.value])
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.tcp_store_delete(self._fd, key.encode()) > 0
+
+    def num_keys(self) -> int:
+        return int(self._lib.tcp_store_num_keys(self._fd))
+
+    # ------------------------------------------------------------------
+    def barrier(self, name: str, rank: int, timeout: float = 60.0):
+        """All world_size ranks block until everyone arrives."""
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == self.world_size:
+            self.set(f"__barrier/{name}/go", b"1")
+        self.wait(f"__barrier/{name}/go", timeout)
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.tcp_store_close(self._fd)
+            self._fd = -1
+        if self._daemon:
+            self._lib.tcp_store_master_stop(self._daemon)
+            self._daemon = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -24,19 +24,31 @@ _DEFAULT_SEED = 0
 
 
 class KeyStream:
-    """A stateful stream of PRNG keys derived from one root key."""
+    """A stateful stream of PRNG keys derived from one root key.
 
-    __slots__ = ("_key", "_counter")
+    The root key materializes lazily: creating a jax array at import time
+    would initialize the backend before the user can pick a platform
+    (and hang outright if the TPU plugin is unreachable)."""
+
+    __slots__ = ("_key", "_seed", "_counter")
 
     def __init__(self, seed_or_key):
         if isinstance(seed_or_key, (int, np.integer)):
-            self._key = jax.random.key(int(seed_or_key))
+            self._seed = int(seed_or_key)
+            self._key = None
         else:
+            self._seed = None
             self._key = seed_or_key
         self._counter = 0
 
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def next_key(self):
-        k = jax.random.fold_in(self._key, self._counter)
+        k = jax.random.fold_in(self.key, self._counter)
         self._counter += 1
         return k
 
@@ -44,10 +56,11 @@ class KeyStream:
         return [self.next_key() for _ in range(n)]
 
     def state(self):
-        return (self._key, self._counter)
+        return (self.key, self._counter)
 
     def set_state(self, state):
         self._key, self._counter = state
+        self._seed = None
 
 
 class _TLS(threading.local):

@@ -80,7 +80,7 @@ class MoELayer(Layer):
     and returning [E, C, d] is supplied.
     """
 
-    def __init__(self, d_model, d_hidden=None, num_experts=8, top_k=2,
+    def __init__(self, d_model, d_hidden=None, num_experts=8, top_k=None,
                  gate=None, experts=None, capacity_factor=1.25,
                  activation="gelu", group=None, recompute_interval=0,
                  name=None):
@@ -88,7 +88,10 @@ class MoELayer(Layer):
         self.d_model = d_model
         self.num_experts = num_experts
         if gate is None or isinstance(gate, str):
-            gate_cls = _GATES[gate or "gshard"]
+            gate_name = gate or "gshard"
+            gate_cls = _GATES[gate_name]
+            if top_k is None:  # per-gate default (switch is top-1)
+                top_k = 1 if gate_name == "switch" else 2
             gate = gate_cls(d_model, num_experts, top_k=top_k,
                             capacity_factor=capacity_factor)
         if not isinstance(gate, BaseGate):

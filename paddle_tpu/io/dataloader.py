@@ -1,16 +1,23 @@
 """DataLoader (python/paddle/io/dataloader parity — SURVEY.md §2.2).
 
 The reference uses worker subprocesses + shared-memory queues
-(_DataLoaderIterMultiProcess). TPU-native stance: the input pipeline's job is
-to keep the (single) host feed ahead of device steps — a thread pool with a
-bounded prefetch queue does that without pickling/shm overhead for the bench
-configs; `num_workers>0` selects threaded prefetch (GIL released inside numpy
-/ jax host ops). Collation produces numpy batches; transfer to device happens
-on first use (jax.device_put inside Tensor), letting XLA overlap H2D with
-compute.
+(_DataLoaderIterMultiProcess). Two modes here:
+
+- `num_workers>0` (default transport): threaded prefetch with a bounded
+  queue — enough to keep the single-host feed ahead of device steps for
+  numpy-light datasets (GIL released inside numpy).
+- `num_workers>0, use_shared_memory=True, multiprocess=True`: true worker
+  *processes* shipping pickled numpy batches through the native shm ring
+  (paddle_tpu/native/shm_ring.cc) — the reference's shm transport. Workers
+  do numpy-only collation (never touch jax in a forked child); the parent
+  re-wraps into Tensors. Batch order is preserved by round-robin reads.
+
+Collation produces numpy batches; transfer to device happens on first use
+(jax.device_put inside Tensor), letting XLA overlap H2D with compute.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Callable, List, Optional
@@ -23,21 +30,162 @@ from .sampler import BatchSampler
 
 
 def default_collate_fn(batch):
+    return _tensorize(numpy_collate_fn(batch))
+
+
+def numpy_collate_fn(batch):
+    """Worker-process collate: identical structure to default_collate_fn but
+    numpy leaves only (forked workers must not create jax arrays)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+        return np.stack([np.asarray(s._data) for s in batch])
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, (int, float)):
-        return Tensor(np.asarray(batch))
+        return np.asarray(batch)
     if isinstance(sample, (str, bytes)):
         return batch
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: numpy_collate_fn([d[k] for d in batch]) for k in sample}
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return [default_collate_fn(list(group)) for group in transposed]
+        return [numpy_collate_fn(list(group)) for group in transposed]
     return batch
+
+
+def _tensorize(obj):
+    if isinstance(obj, np.ndarray) and obj.dtype != object:
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _tensorize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_tensorize(v) for v in obj]
+    return obj
+
+
+_END = "__pdtpu_worker_end__"
+_ERR = "__pdtpu_worker_err__"
+
+
+def _mp_worker_loop(dataset, batch_lists, ring_name, collate, init_fn,
+                    worker_id):
+    """Runs in a forked child: numpy-only; ships pickled batches by shm."""
+    from .shm_queue import ShmRing
+
+    ring = ShmRing(ring_name, open_existing=True)
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        for indices in batch_lists:
+            samples = [dataset[i] for i in indices]
+            ring.put(collate(samples))
+        ring.put(_END)
+    except KeyboardInterrupt:  # parent teardown
+        pass
+    except Exception:  # ship the traceback; parent re-raises
+        import traceback
+
+        try:
+            ring.put((_ERR, worker_id, traceback.format_exc()), timeout=5)
+        except Exception:
+            pass
+    finally:
+        ring.close()
+
+
+class _MultiProcessIter:
+    """Worker processes + shm rings; yields batches in sampler order."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        from .shm_queue import ShmRing, ring_name
+
+        self.loader = loader
+        W = loader.num_workers
+        batches = list(loader.batch_sampler)
+        # round-robin assignment keeps order recoverable at read time
+        per_worker = [batches[w::W] for w in range(W)]
+        self._n_batches = len(batches)
+        collate = loader.collate_fn or numpy_collate_fn
+        self._wrap = loader.collate_fn is None  # tensorize default collate
+        cap = max(8 << 20, loader.shm_capacity)
+        ctx = mp.get_context("fork")
+        self.rings = []
+        self.procs = []
+        for w in range(W):
+            name = ring_name(f"pdtpu_dl{w}")
+            self.rings.append(ShmRing(name, capacity=cap))
+            p = ctx.Process(
+                target=_mp_worker_loop,
+                args=(loader.dataset, per_worker[w], name, collate,
+                      loader.worker_init_fn, w),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self._next = 0
+        self._done = [False] * W
+
+    def _get(self, w):
+        """Read from worker w's ring, noticing worker death (a worker that
+        dies without the _END sentinel must not hang training forever)."""
+        user_timeout = self.loader.timeout or None
+        import time as _time
+
+        deadline = None if user_timeout is None else \
+            _time.monotonic() + user_timeout
+        while True:
+            try:
+                return self.rings[w].get(timeout=1.0)
+            except TimeoutError:
+                if not self.procs[w].is_alive():
+                    code = self.procs[w].exitcode
+                    raise RuntimeError(
+                        f"DataLoader worker {w} died (exit code {code}) "
+                        f"without finishing its batches")
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise
+
+    def __next__(self):
+        while True:
+            if all(self._done):
+                raise StopIteration
+            w = self._next % len(self.rings)
+            if self._done[w]:
+                self._next += 1
+                continue
+            item = self._get(w)
+            if isinstance(item, str) and item == _END:
+                self._done[w] = True
+                self.procs[w].join()
+                self._next += 1
+                continue
+            if isinstance(item, tuple) and len(item) == 3 and \
+                    item[0] == _ERR:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader worker {item[1]} raised:\n{item[2]}")
+            self._next += 1
+            return _tensorize(item) if self._wrap else item
+
+    def __iter__(self):
+        return self
+
+    def close(self):
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            p.join(timeout=2)
+        for r in self.rings:
+            r.close()
+        self.procs, self.rings = [], []
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _Iter:
@@ -103,12 +251,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, multiprocess=False,
+                 shm_capacity=64 << 20):
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.use_shared_memory = use_shared_memory
+        self.multiprocess = multiprocess
+        self.shm_capacity = shm_capacity
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
@@ -121,6 +275,10 @@ class DataLoader:
             self.batch_sampler = None
 
     def __iter__(self):
+        if (self.multiprocess and self.num_workers > 0
+                and self.use_shared_memory
+                and self.batch_sampler is not None):
+            return _MultiProcessIter(self)
         return _Iter(self)
 
     def __len__(self):

@@ -1,0 +1,109 @@
+"""Python wrapper over the native shm ring (paddle_tpu/native/shm_ring.cc)
+— the DataLoader's worker→trainer transport (SURVEY.md §2.2 "DataLoader").
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Optional
+
+from ..utils.cpp_extension import load_native
+
+_lib = None
+
+
+def _native():
+    global _lib
+    if _lib is None:
+        lib = load_native("shm_ring")
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_write.restype = ctypes.c_int
+        lib.shm_ring_write.argtypes = [ctypes.c_void_p, u8p,
+                                       ctypes.c_uint32, ctypes.c_int]
+        lib.shm_ring_read.restype = ctypes.c_int64
+        lib.shm_ring_read.argtypes = [ctypes.c_void_p, u8p,
+                                      ctypes.c_uint64, ctypes.c_int]
+        lib.shm_ring_peek.restype = ctypes.c_int64
+        lib.shm_ring_peek.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+class ShmRing:
+    """SPSC shared-memory byte-blob queue.
+
+    Producer process:  ring = ShmRing(name, open_existing=True); ring.put(b)
+    Consumer process:  ring = ShmRing(name, capacity); b = ring.get()
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 open_existing: bool = False):
+        lib = _native()
+        self._lib = lib
+        self.name = name
+        if open_existing:
+            self._h = lib.shm_ring_open(name.encode())
+        else:
+            self._h = lib.shm_ring_create(name.encode(), int(capacity))
+        if not self._h:
+            raise RuntimeError(
+                f"shm ring '{name}' could not be "
+                f"{'opened' if open_existing else 'created'}")
+
+    def put_bytes(self, data: bytes, timeout: Optional[float] = None):
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        ms = -1 if timeout is None else max(1, int(timeout * 1000))
+        rc = self._lib.shm_ring_write(self._h, buf, len(data), ms)
+        if rc == -1:
+            raise TimeoutError(f"shm ring '{self.name}' full")
+        if rc == -2:
+            raise ValueError(
+                f"blob of {len(data)} bytes exceeds ring capacity")
+
+    def get_bytes(self, timeout: Optional[float] = None) -> bytes:
+        n = self._lib.shm_ring_peek(self._h)
+        if n < 0:
+            # blocking read with a small probe buffer would truncate; peek
+            # first, then size the buffer exactly
+            ms = -1 if timeout is None else max(1, int(timeout * 1000))
+            import time
+
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while n < 0:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"shm ring '{self.name}' empty")
+                time.sleep(0.0002)
+                n = self._lib.shm_ring_peek(self._h)
+        out = (ctypes.c_uint8 * n)()
+        got = self._lib.shm_ring_read(self._h, out, n, 0)
+        assert got == n, (got, n)
+        return bytes(out)
+
+    # pickle convenience
+    def put(self, obj, timeout: Optional[float] = None):
+        self.put_bytes(pickle.dumps(obj, protocol=4), timeout)
+
+    def get(self, timeout: Optional[float] = None):
+        return pickle.loads(self.get_bytes(timeout))
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def ring_name(prefix: str = "pdtpu") -> str:
+    return f"/{prefix}_{os.getpid()}_{os.urandom(4).hex()}"

@@ -1,0 +1,104 @@
+// Host event tracer: RecordEvent sink + chrome://tracing export.
+//
+// Reference parity: paddle/fluid/platform/profiler/ HostEventRecorder +
+// ChromeTracingLogger (SURVEY.md §5 "Tracing/profiling"): RAII RecordEvent
+// annotations recorded per-thread with ns timestamps, merged and exported
+// as chrome tracing JSON. Device timelines belong to jax.profiler (XPlane);
+// this covers the host side with negligible overhead (thread-local buffers,
+// one mutex touch per flush block, no Python in the record path).
+//
+// C ABI for ctypes (paddle_tpu/profiler uses it as the RecordEvent sink).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t start_ns;
+  uint64_t dur_ns;
+  uint64_t tid;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<Event> events;
+  bool enabled = false;
+};
+
+Tracer g_tracer;
+
+}  // namespace
+
+extern "C" {
+
+void host_tracer_enable() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.enabled = true;
+}
+
+void host_tracer_disable() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.enabled = false;
+}
+
+int host_tracer_enabled() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return g_tracer.enabled ? 1 : 0;
+}
+
+void host_tracer_record(const char* name, uint64_t start_ns,
+                        uint64_t dur_ns, uint64_t tid) {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  if (!g_tracer.enabled) return;
+  g_tracer.events.push_back(Event{name, start_ns, dur_ns, tid});
+}
+
+uint64_t host_tracer_count() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return g_tracer.events.size();
+}
+
+void host_tracer_clear() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.events.clear();
+}
+
+// Writes chrome tracing "traceEvents" JSON. Returns 0 ok, -1 io error.
+int host_tracer_export(const char* path, const char* process_name) {
+  std::vector<Event> events;
+  {
+    std::lock_guard<std::mutex> g(g_tracer.mu);
+    events = g_tracer.events;
+  }
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[\n");
+  fprintf(f,
+          "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":0,"
+          "\"args\":{\"name\":\"%s\"}}",
+          process_name ? process_name : "host");
+  for (const auto& e : events) {
+    std::string esc;
+    esc.reserve(e.name.size());
+    for (char c : e.name) {
+      if (c == '"' || c == '\\') esc.push_back('\\');
+      if (static_cast<unsigned char>(c) >= 0x20) esc.push_back(c);
+    }
+    fprintf(f,
+            ",\n{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+            "\"ts\":%.3f,\"dur\":%.3f}",
+            esc.c_str(), static_cast<unsigned long long>(e.tid),
+            e.start_ns / 1000.0, e.dur_ns / 1000.0);
+  }
+  fprintf(f, "\n]}\n");
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

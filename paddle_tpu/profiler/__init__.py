@@ -51,15 +51,57 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
     return scheduler
 
 
+def _native_tracer():
+    """The C++ host tracer (paddle_tpu/native/host_tracer.cc); None if the
+    toolchain is unavailable."""
+    global _tracer_lib
+    if _tracer_lib is False:
+        return None
+    if _tracer_lib is None:
+        try:
+            import ctypes
+
+            from ..utils.cpp_extension import load_native
+
+            lib = load_native("host_tracer")
+            lib.host_tracer_record.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64]
+            lib.host_tracer_count.restype = ctypes.c_uint64
+            lib.host_tracer_export.restype = ctypes.c_int
+            lib.host_tracer_export.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_char_p]
+            lib.host_tracer_enabled.restype = ctypes.c_int
+            _tracer_lib = lib
+        except Exception:
+            _tracer_lib = False
+            return None
+    return _tracer_lib
+
+
+_tracer_lib = None
+_tracing_active = False
+
+
 class _HostEventRecorder:
     """Host-side RecordEvent sink for summary tables (the analog of the
-    reference's HostEventRecorder)."""
+    reference's HostEventRecorder); mirrors events into the native tracer
+    when it is enabled."""
 
     def __init__(self):
         self.events = []
 
     def add(self, name, start, end):
         self.events.append((name, start, end))
+        # only touch (and lazily build) the native tracer while a Profiler
+        # is actively tracing — RecordEvent outside a profiling window must
+        # never pay a g++ JIT compile
+        if _tracing_active and _tracer_lib not in (None, False):
+            import threading
+
+            _tracer_lib.host_tracer_record(
+                name.encode(), int(start * 1e9), int((end - start) * 1e9),
+                threading.get_ident() & 0xFFFFFFFF)
 
     def summary(self):
         from collections import defaultdict
@@ -121,6 +163,11 @@ class Profiler:
         self.current_state = ProfilerState.CLOSED
 
     def start(self):
+        global _tracing_active
+        lib = _native_tracer()
+        if lib is not None:
+            lib.host_tracer_enable()
+        _tracing_active = True
         if not self.timer_only:
             os.makedirs(self.log_dir, exist_ok=True)
             try:
@@ -130,6 +177,11 @@ class Profiler:
                 self._active = False
 
     def stop(self):
+        global _tracing_active
+        _tracing_active = False
+        lib = _native_tracer()
+        if lib is not None:
+            lib.host_tracer_disable()
         if self._active:
             try:
                 jax.profiler.stop_trace()
@@ -151,7 +203,14 @@ class Profiler:
         return _recorder.summary()
 
     def export(self, path, format="json"):
-        pass
+        """Write the host-side chrome trace (the reference's
+        ChromeTracingLogger output; device XPlane lives in log_dir)."""
+        lib = _native_tracer()
+        if lib is None:
+            raise RuntimeError("native host tracer unavailable")
+        rc = lib.host_tracer_export(path.encode(), b"paddle_tpu host")
+        if rc != 0:
+            raise OSError(f"trace export to {path} failed")
 
     def __enter__(self):
         self.start()

@@ -23,6 +23,7 @@ from .autograd import tape as _tape
 from .framework import config as _config
 from .framework import device as _device
 from .framework import dtype as _dtype
+from .framework import jax_compat as _jc
 
 
 def _is_jax_value(x):
@@ -425,6 +426,22 @@ def _apply_op(fn, *inputs, _name: str = "", **static_kwargs):
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+
+    # FLAGS_check_nan_inf: the reference's per-op numeric sanitizer
+    # (paddle/fluid/framework/details/nan_inf_utils — SURVEY.md §5 "Race
+    # detection / sanitizers"): abort with op attribution on NaN/Inf.
+    # Eager-only; under jit use jax.config debug_nans.
+    if _config.get_flag("FLAGS_check_nan_inf") and not _jc.tracing():
+        for i, o in enumerate(outs):
+            # jnp.issubdtype, not np: bfloat16 must count as floating
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+                if not bool(jnp.isfinite(o).all()):
+                    raise RuntimeError(
+                        f"NaN/Inf detected in output {i} of op "
+                        f"'{_name or fn.__name__}' "
+                        f"(shape {tuple(o.shape)}, dtype {o.dtype}); set "
+                        f"FLAGS_check_nan_inf=0 to disable this check")
+
     wrapped = [Tensor(o, stop_gradient=not record) for o in outs]
 
     if record:

@@ -1,0 +1,2 @@
+"""paddle.utils (SURVEY.md §2.2): cpp_extension toolchain and helpers."""
+from . import cpp_extension  # noqa: F401

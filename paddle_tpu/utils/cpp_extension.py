@@ -1,0 +1,100 @@
+"""Custom C++ op/extension toolchain.
+
+Reference parity: python/paddle/utils/cpp_extension (SURVEY.md §2.2
+"Custom-op toolchain"): `load(name, sources)` JIT-compiles user C++ into a
+shared library at first use, caches by content hash, and returns a handle.
+TPU-native notes: there is no CUDA path — device compute belongs to
+XLA/Pallas; this toolchain exists for *host* runtime components (rendezvous
+store, shm dataloader transport, host tracer — SURVEY.md §2.1 right column)
+and user host-side ops. Libraries are loaded with ctypes; declare function
+signatures on the returned handle.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_lock = threading.Lock()
+_loaded: dict = {}
+
+DEFAULT_FLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_ext"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _hash_sources(sources: Sequence[str], flags: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         extra_include_paths: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> ctypes.CDLL:
+    """Compile `sources` into <name>.<hash>.so (cached) and dlopen it."""
+    sources = [os.path.abspath(s) for s in sources]
+    flags = DEFAULT_FLAGS + (extra_cflags or [])
+    for inc in extra_include_paths or []:
+        flags.append(f"-I{inc}")
+    tag = _hash_sources(sources, flags)
+    out_dir = build_directory or _build_dir()
+    so_path = os.path.join(out_dir, f"{name}.{tag}.so")
+    with _lock:
+        if so_path in _loaded:
+            return _loaded[so_path]
+        if not os.path.exists(so_path):
+            cmd = ["g++", *flags, *sources, "-o", so_path + ".tmp",
+                   *(extra_ldflags or [])]
+            if verbose:
+                print("[cpp_extension]", " ".join(cmd))
+            try:
+                subprocess.run(cmd, check=True, capture_output=not verbose)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"cpp_extension build of '{name}' failed:\n"
+                    f"{(e.stderr or b'').decode(errors='replace')}") from e
+            os.replace(so_path + ".tmp", so_path)
+        lib = ctypes.CDLL(so_path)
+        _loaded[so_path] = lib
+        return lib
+
+
+def load_native(name: str) -> ctypes.CDLL:
+    """Load one of the framework's bundled native components from
+    paddle_tpu/native/<name>.cc."""
+    src = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       "native", f"{name}.cc")
+    return load(f"paddle_tpu_{name}", [src])
+
+
+class CppExtension:
+    """setuptools-style descriptor (reference CppExtension); for AOT builds
+    via setup(). Kept minimal: name + sources + flags."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+        self.include_dirs = kwargs.get("include_dirs", [])
+
+
+def CUDAExtension(*args, **kwargs):  # pragma: no cover
+    raise RuntimeError(
+        "CUDAExtension is not supported on TPU: write device compute as "
+        "jax/Pallas ops (see paddle_tpu.kernels) and host code as "
+        "CppExtension")

@@ -72,6 +72,17 @@ def test_switch_gate_top1_capacity_drop():
     assert dropped >= n - E * max(1, 1)
 
 
+def test_moe_switch_gate_by_name():
+    """MoELayer(gate='switch') defaults to top-1 (regression: used to crash
+    forwarding top_k=2 into the top-1-only SwitchGate)."""
+    paddle.seed(0)
+    m = MoELayer(d_model=8, d_hidden=16, num_experts=4, gate="switch")
+    assert m.gate.top_k == 1
+    y = m(paddle.to_tensor(
+        np.random.RandomState(0).randn(6, 8).astype(np.float32)))
+    assert list(y.shape) == [6, 8]
+
+
 def test_moe_ep_mesh_parity():
     """Same MoE on an ep=4 mesh produces the single-device result."""
     import jax

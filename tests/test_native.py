@@ -1,0 +1,204 @@
+"""Native C++ runtime component tests (SURVEY.md §2.1 right column):
+TCPStore rendezvous, shm ring dataloader transport, host tracer, and the
+cpp_extension toolchain that builds them."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- toolchain
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "addmul.cc"
+    src.write_text("""
+        extern "C" long addmul(long a, long b, long c) { return a * b + c; }
+    """)
+    from paddle_tpu.utils.cpp_extension import load
+
+    lib = load("addmul", [str(src)], build_directory=str(tmp_path))
+    assert lib.addmul(3, 4, 5) == 17
+    # cache hit: second load returns without rebuilding
+    lib2 = load("addmul", [str(src)], build_directory=str(tmp_path))
+    assert lib2 is lib
+
+
+def test_cuda_extension_rejected():
+    from paddle_tpu.utils.cpp_extension import CUDAExtension
+
+    with pytest.raises(RuntimeError, match="Pallas"):
+        CUDAExtension(sources=["x.cu"])
+
+
+# ---------------------------------------------------------------- TCPStore
+def test_tcp_store_ops():
+    from paddle_tpu.distributed.store import TCPStore
+
+    m = TCPStore(is_master=True, world_size=2)
+    c = TCPStore(port=m.port, world_size=2)
+    try:
+        c.set("k", b"v1")
+        assert m.get("k") == b"v1"
+        assert m.get("missing") is None
+        assert c.add("ctr", 5) == 5
+        assert m.add("ctr", 2) == 7
+        assert m.num_keys() >= 2
+        assert m.delete_key("k") and m.get("k") is None
+    finally:
+        c.close()
+        m.close()
+
+
+def test_tcp_store_wait_and_barrier():
+    from paddle_tpu.distributed.store import TCPStore
+
+    m = TCPStore(is_master=True, world_size=2)
+    c = TCPStore(port=m.port, world_size=2)
+    try:
+        got = []
+        t = threading.Thread(target=lambda: got.append(c.wait("late", 10)))
+        t.start()
+        time.sleep(0.1)
+        m.set("late", b"ok")
+        t.join(5)
+        assert got == [b"ok"]
+        with pytest.raises(TimeoutError):
+            m.wait("never", timeout=0.2)
+
+        done = []
+        ts = [threading.Thread(
+            target=lambda s=s, r=r: (s.barrier("b", r), done.append(r)))
+            for r, s in enumerate((m, c))]
+        [t.start() for t in ts]
+        [t.join(5) for t in ts]
+        assert sorted(done) == [0, 1]
+    finally:
+        c.close()
+        m.close()
+
+
+# ---------------------------------------------------------------- shm ring
+def test_shm_ring_roundtrip_and_wraparound():
+    from paddle_tpu.io.shm_queue import ShmRing, ring_name
+
+    name = ring_name("t")
+    ring = ShmRing(name, capacity=1 << 12)  # tiny: force wraparound
+    wr = ShmRing(name, open_existing=True)
+    try:
+        rng = np.random.RandomState(0)
+        for i in range(50):
+            blob = rng.bytes(rng.randint(1, 900))
+            wr.put_bytes(blob)
+            assert ring.get_bytes(timeout=5) == blob
+        # pickle path
+        obj = {"x": np.arange(5), "y": [1, "two"]}
+        wr.put(obj)
+        out = ring.get(timeout=5)
+        np.testing.assert_array_equal(out["x"], obj["x"])
+        assert out["y"] == obj["y"]
+    finally:
+        wr.close()
+        ring.close()
+
+
+def test_shm_ring_cross_process():
+    import multiprocessing as mp
+
+    from paddle_tpu.io.shm_queue import ShmRing, ring_name
+
+    name = ring_name("xp")
+    ring = ShmRing(name, capacity=1 << 20)
+
+    def producer(nm):
+        from paddle_tpu.io.shm_queue import ShmRing as R
+
+        w = R(nm, open_existing=True)
+        for i in range(20):
+            w.put({"i": i, "arr": np.full((100,), i, np.float32)})
+        w.close()
+
+    p = mp.get_context("fork").Process(target=producer, args=(name,))
+    p.start()
+    try:
+        for i in range(20):
+            item = ring.get(timeout=30)
+            assert item["i"] == i
+            assert item["arr"][0] == i
+        p.join(10)
+        assert p.exitcode == 0
+    finally:
+        if p.is_alive():
+            p.terminate()
+        ring.close()
+
+
+def test_dataloader_multiprocess_parity():
+    """shm-worker DataLoader produces the same batches as in-process."""
+    from paddle_tpu.io import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 37
+
+        def __getitem__(self, i):
+            return (np.full((4,), i, np.float32), np.int64(i % 3))
+
+    serial = [
+        (np.asarray(x), np.asarray(y))
+        for x, y in DataLoader(DS(), batch_size=5, shuffle=False)]
+    mp_batches = [
+        (np.asarray(x), np.asarray(y))
+        for x, y in DataLoader(DS(), batch_size=5, shuffle=False,
+                               num_workers=2, multiprocess=True)]
+    assert len(serial) == len(mp_batches) == 8
+    for (sx, sy), (mx, my) in zip(serial, mp_batches):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+
+
+def test_dataloader_worker_error_propagates():
+    from paddle_tpu.io import DataLoader
+
+    class Bad:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("poison sample")
+            return np.zeros((2,), np.float32)
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=2, multiprocess=True)
+    with pytest.raises(RuntimeError, match="poison sample"):
+        list(dl)
+
+
+# ---------------------------------------------------------------- tracer
+def test_host_tracer_chrome_export(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    lib = profiler._native_tracer()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    lib.host_tracer_clear()
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    with profiler.RecordEvent("step_one"):
+        time.sleep(0.01)
+    with profiler.RecordEvent('quoted"name\\'):
+        pass
+    p.stop()
+    out = str(tmp_path / "trace.json")
+    p.export(out)
+    with open(out) as f:
+        trace = json.load(f)
+    names = [e.get("name") for e in trace["traceEvents"]]
+    assert "step_one" in names
+    ev = next(e for e in trace["traceEvents"] if e.get("name") == "step_one")
+    assert ev["dur"] >= 9_000  # µs
+    assert "summary" not in p.summary() or True
+    assert "step_one" in p.summary()
