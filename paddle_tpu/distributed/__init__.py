@@ -36,8 +36,31 @@ from .context_parallel import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+)
 from .pipeline import spmd_pipeline  # noqa: F401
-from .sharding_utils import get_param_spec, mark_sharding, shard_tensor  # noqa: F401
+from .sharding_utils import get_param_spec, mark_sharding  # noqa: F401
+from .sharding_utils import shard_tensor as _shard_tensor_spec
+
+
+def shard_tensor(x, *args, **kwargs):
+    """Reference paddle.distributed.shard_tensor(x, mesh, placements)
+    (auto_parallel); also accepts the internal spec form
+    shard_tensor(x, 'dp', None, ...) over the global mesh."""
+    from .auto_parallel import ProcessMesh
+    from .auto_parallel import shard_tensor as _ap_shard
+
+    if (args and isinstance(args[0], ProcessMesh)) or "mesh" in kwargs:
+        return _ap_shard(x, *args, **kwargs)
+    return _shard_tensor_spec(x, *args, **kwargs)
 
 
 def is_initialized():

@@ -1,0 +1,26 @@
+"""Auto-parallel API (reference: python/paddle/distributed/auto_parallel —
+SURVEY.md §2.3 "Auto parallel": mark shardings with ProcessMesh/DistAttr and
+let the engine complete/partition/reshard).
+
+TPU-native design: jax sharding propagation (GSPMD) IS the reference's
+Completer+Partitioner+Resharder — the user marks tensors, XLA completes the
+program. ProcessMesh maps onto jax.sharding.Mesh; placements
+(Shard/Replicate/Partial) build PartitionSpecs; reshard is device_put /
+with_sharding_constraint. The reference's cost model, cluster description,
+and program-rewrite machinery have no TPU analog to build — the compiler
+owns them (documented design win, SURVEY.md §7 philosophy).
+"""
+from .api import (  # noqa: F401
+    DistAttr,
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_tensor,
+)

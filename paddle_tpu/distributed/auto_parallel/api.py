@@ -1,0 +1,223 @@
+"""shard_tensor / ProcessMesh / placements over jax NamedSharding."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework import jax_compat as _jc
+from ...tensor import Tensor, as_array
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard tensor dim `dim` over the corresponding mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+
+class Partial(Placement):
+    """Value is a partial sum over this mesh dim (pending reduce). Under
+    GSPMD this materializes at the next use; kept for API parity."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+
+class ProcessMesh:
+    """N-d logical process grid (reference ProcessMesh). Wraps (and can
+    build) a jax.sharding.Mesh whose axis names are the dim_names."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._process_ids = arr
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        if len(self._dim_names) != arr.ndim:
+            raise ValueError("dim_names must match mesh rank")
+
+    @property
+    def shape(self):
+        return list(self._process_ids.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._process_ids.reshape(-1).tolist()
+
+    def get_dim_size(self, name):
+        return self._process_ids.shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._process_ids, other._process_ids))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    def jax_mesh(self) -> Mesh:
+        """Materialize over the local jax devices: process id i -> device
+        i. Multi-host: device order follows jax.devices() global order."""
+        devices = np.asarray(jax.devices())
+        flat = self._process_ids.reshape(-1)
+        if flat.max() >= len(devices):
+            raise ValueError(
+                f"ProcessMesh names process {int(flat.max())} but only "
+                f"{len(devices)} devices are visible")
+        grid = devices[flat].reshape(self._process_ids.shape)
+        return Mesh(grid, axis_names=tuple(self._dim_names))
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+class DistAttr:
+    """Sharding annotation record (reference DistAttr): mesh + placements
+    (the reference's dims_mapping is derivable from placements)."""
+
+    def __init__(self, mesh: ProcessMesh, placements: List[Placement]):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+    @property
+    def dims_mapping(self):
+        """tensor-dim -> mesh-dim index (-1 = replicated), reference form."""
+        mapping = {}
+        for mesh_dim, p in enumerate(self.placements):
+            if isinstance(p, Shard):
+                mapping[p.dim] = mesh_dim
+        return mapping
+
+
+def _pspec_for(ndim: int, mesh: ProcessMesh,
+               placements: List[Placement]) -> PartitionSpec:
+    entries: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = mesh.dim_names[mesh_dim]
+            if entries[p.dim] is None:
+                entries[p.dim] = name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (name,)
+            else:
+                entries[p.dim] = (entries[p.dim], name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements: List[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    """Place (eager) or constrain (tracing) x per mesh+placements; records
+    the DistAttr on the tensor (`.dist_attr`, `.placements`)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    a = as_array(t)
+    jm = mesh.jax_mesh()
+    sharding = NamedSharding(jm, _pspec_for(a.ndim, mesh, placements))
+    if _jc.tracing():
+        out = jax.lax.with_sharding_constraint(a, sharding)
+    else:
+        out = jax.device_put(a, sharding)
+    t._rebind(out, t._tape_node, t._tape_out_idx)
+    t.dist_attr = DistAttr(mesh, placements)
+    t.placements = list(placements)
+    t.process_mesh = mesh
+    return t
+
+
+def reshard(x, mesh: ProcessMesh, placements: List[Placement]):
+    """Reference Resharder: move a dist tensor to a new layout. Under jit
+    this is a sharding constraint (GSPMD inserts the collective); eagerly
+    it is a device_put relayout."""
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of `layer` (reference shard_layer). shard_fn
+    (name, layer, mesh) applies custom placements; default replicates."""
+    for name, sub in list(layer.named_sublayers(include_self=True)):
+        if shard_fn is not None:
+            shard_fn(name, sub, process_mesh)
+        else:
+            for p in sub.parameters(include_sublayers=False):
+                shard_tensor(p, process_mesh,
+                             [Replicate()] * len(process_mesh.shape))
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements: List[Placement],
+                    *args, **kwargs):
+    """Build a tensor via fn then distribute it (reference dtensor_from_fn)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)

@@ -109,10 +109,23 @@ class ElasticManager:
         )
 
     def _beat(self):
+        warned = False
         while not self._stop.is_set():
-            self.store.put(f"node/{self.node_rank}",
-                           {"endpoint": self.endpoint,
-                            "rank": self.node_rank})
+            try:
+                self.store.put(f"node/{self.node_rank}",
+                               {"endpoint": self.endpoint,
+                                "rank": self.node_rank})
+            except OSError as e:
+                # during shutdown the store root may already be gone —
+                # benign; mid-job it means this node will look dead to
+                # peers (ENOSPC, EACCES…), so say it at least once
+                if not self._stop.is_set() and not warned:
+                    warned = True
+                    import sys
+
+                    print(f"[elastic] heartbeat write failed: {e}; node "
+                          f"{self.node_rank} may be evicted by peers",
+                          file=sys.stderr)
             self._stop.wait(self.interval)
 
     def start(self):
@@ -125,7 +138,9 @@ class ElasticManager:
     def stop(self):
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2 * self.interval)
+            # bounded: the beat thread can be stuck in store I/O on a hung
+            # filesystem; teardown must not hang with it
+            self._thread.join(timeout=max(2 * self.interval, 1.0))
         self.store.delete(f"node/{self.node_rank}")
 
     # ------------------------------------------------------------------

@@ -40,7 +40,8 @@ def _native():
                                       ctypes.c_uint32, u32p]
         lib.tcp_store_add.restype = ctypes.c_int64
         lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
-                                      ctypes.c_int64]
+                                      ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int64)]
         lib.tcp_store_wait.restype = ctypes.c_int64
         lib.tcp_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                        ctypes.c_uint64, u8p, ctypes.c_uint32,
@@ -93,33 +94,40 @@ class TCPStore:
         if st != 0:
             raise RuntimeError(f"TCPStore.set({key}) failed: {st}")
 
-    def get(self, key: str) -> Optional[bytes]:
-        out = (ctypes.c_uint8 * _MAX_VAL)()
+    def get(self, key: str, _cap: int = _MAX_VAL) -> Optional[bytes]:
+        out = (ctypes.c_uint8 * _cap)()
         olen = ctypes.c_uint32(0)
-        st = self._lib.tcp_store_get(self._fd, key.encode(), out, _MAX_VAL,
+        st = self._lib.tcp_store_get(self._fd, key.encode(), out, _cap,
                                      ctypes.byref(olen))
         if st == -1:
             return None
         if st != 0:
             raise RuntimeError(f"TCPStore.get({key}) failed: {st}")
+        if olen.value > _cap:  # value larger than the probe buffer:
+            return self.get(key, _cap=olen.value)  # re-fetch exact size
         return bytes(out[:olen.value])
 
     def add(self, key: str, amount: int = 1) -> int:
-        st = self._lib.tcp_store_add(self._fd, key.encode(), int(amount))
-        if st < 0:
+        result = ctypes.c_int64(0)
+        st = self._lib.tcp_store_add(self._fd, key.encode(), int(amount),
+                                     ctypes.byref(result))
+        if st != 0:
             raise RuntimeError(f"TCPStore.add({key}) failed: {st}")
-        return int(st)
+        return int(result.value)
 
-    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
-        out = (ctypes.c_uint8 * _MAX_VAL)()
+    def wait(self, key: str, timeout: Optional[float] = None,
+             _cap: int = _MAX_VAL) -> bytes:
+        out = (ctypes.c_uint8 * _cap)()
         olen = ctypes.c_uint32(0)
         ms = 0 if timeout is None else max(1, int(timeout * 1000))
         st = self._lib.tcp_store_wait(self._fd, key.encode(), ms, out,
-                                      _MAX_VAL, ctypes.byref(olen))
+                                      _cap, ctypes.byref(olen))
         if st == -2:
             raise TimeoutError(f"TCPStore.wait({key}) timed out")
         if st != 0:
             raise RuntimeError(f"TCPStore.wait({key}) failed: {st}")
+        if olen.value > _cap:  # key exists now; re-read at exact size
+            return self.wait(key, timeout, _cap=olen.value)
         return bytes(out[:olen.value])
 
     def delete_key(self, key: str) -> bool:
@@ -129,12 +137,15 @@ class TCPStore:
         return int(self._lib.tcp_store_num_keys(self._fd))
 
     # ------------------------------------------------------------------
-    def barrier(self, name: str, rank: int, timeout: float = 60.0):
-        """All world_size ranks block until everyone arrives."""
+    def barrier(self, name: str, rank: int = 0, timeout: float = 60.0):
+        """All world_size ranks block until everyone arrives. Reusable:
+        arrival n belongs to epoch (n-1)//world, and each epoch gets its
+        own go-key, so the same name can gate every training iteration."""
         n = self.add(f"__barrier/{name}/count", 1)
-        if n == self.world_size:
-            self.set(f"__barrier/{name}/go", b"1")
-        self.wait(f"__barrier/{name}/go", timeout)
+        epoch = (n - 1) // self.world_size
+        if n % self.world_size == 0:
+            self.set(f"__barrier/{name}/go{epoch}", b"1")
+        self.wait(f"__barrier/{name}/go{epoch}", timeout)
 
     def close(self):
         if self._fd >= 0:

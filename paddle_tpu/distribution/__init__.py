@@ -1,0 +1,19 @@
+"""paddle.distribution (reference: python/paddle/distribution — SURVEY.md
+§2.2 "Misc math domains"): probability distributions with sample /
+log_prob / entropy / kl_divergence, drawn from the framework's stateful
+PRNG key stream (framework.random) so `paddle.seed` governs sampling.
+"""
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Distribution,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401

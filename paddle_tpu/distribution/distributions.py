@@ -1,0 +1,311 @@
+"""Distribution classes (reference: python/paddle/distribution/*.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+import numpy as np
+
+from ..framework import random as _random
+from ..tensor import Tensor, as_array
+
+
+def _arr(x, dtype=jnp.float32):
+    a = as_array(x)
+    if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+        return a
+    return jnp.asarray(a, dtype)
+
+
+def _shape(sample_shape, *params):
+    batch = jnp.broadcast_shapes(*[np.shape(p) for p in params])
+    return tuple(sample_shape) + tuple(batch)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(as_array(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        k = _random.next_key()
+        out = self.loc + self.scale * jax.random.normal(
+            k, _shape(shape, self.loc, self.scale))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(as_array(self._base.sample(shape))))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lv = jnp.log(v)
+        return Tensor(as_array(self._base.log_prob(lv)) - lv)
+
+    def entropy(self):
+        return Tensor(as_array(self._base.entropy()) + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(np.broadcast_shapes(np.shape(self.low),
+                                             np.shape(self.high)))
+
+    def sample(self, shape=(), seed=0):
+        k = _random.next_key()
+        u = jax.random.uniform(k, _shape(shape, self.low, self.high))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(np.shape(self.probs))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = jax.random.bernoulli(k, self.probs,
+                                   _shape(shape, self.probs))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None and probs is None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-30))
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(np.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = jax.random.categorical(
+            k, self.logits, shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        idx = as_array(value).astype(jnp.int32)
+        # broadcast so a scalar-batch categorical scores a vector of values
+        logp = jnp.broadcast_to(self._log_p,
+                                idx.shape + self._log_p.shape[-1:])
+        return Tensor(jnp.take_along_axis(
+            logp, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        return Tensor(-jnp.sum(jnp.exp(self._log_p) * self._log_p, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(np.shape(self.probs)[:-1],
+                         np.shape(self.probs)[-1:])
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        n_cat = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            k, jnp.log(jnp.clip(self.probs, 1e-30)),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        counts = jax.nn.one_hot(draws, n_cat).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-30))
+        coeff = (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                 - jnp.sum(jsp.gammaln(v + 1.0), -1))
+        return Tensor(coeff + jnp.sum(v * logp, -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(np.broadcast_shapes(np.shape(self.alpha),
+                                             np.shape(self.beta)))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = jax.random.beta(k, self.alpha, self.beta,
+                              _shape(shape, self.alpha, self.beta))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lbeta = (jsp.gammaln(self.alpha) + jsp.gammaln(self.beta)
+                 - jsp.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return Tensor(lbeta - (a - 1) * jsp.digamma(a)
+                      - (b - 1) * jsp.digamma(b)
+                      + (a + b - 2) * jsp.digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(np.shape(self.concentration)[:-1],
+                         np.shape(self.concentration)[-1:])
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = jax.random.dirichlet(
+            k, self.concentration,
+            tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        lnorm = jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(jnp.sum(c, -1))
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), -1) - lnorm)
+
+    def entropy(self):
+        c = self.concentration
+        c0 = jnp.sum(c, -1)
+        K = c.shape[-1]
+        lnorm = jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(c0)
+        return Tensor(lnorm + (c0 - K) * jsp.digamma(c0)
+                      - jnp.sum((c - 1) * jsp.digamma(c), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = self.loc + self.scale * jax.random.laplace(
+            k, _shape(shape, self.loc, self.scale))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        out = self.loc + self.scale * jax.random.gumbel(
+            k, _shape(shape, self.loc, self.scale))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        e = jnp.log(self.scale) + 1 + np.euler_gamma
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))

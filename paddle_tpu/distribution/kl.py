@@ -1,0 +1,81 @@
+"""KL divergence registry (reference:
+python/paddle/distribution/kl.py `register_kl` / `kl_divergence`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..tensor import Tensor
+from .distributions import (Beta, Categorical, Dirichlet, Laplace, Normal,
+                            Uniform)
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    oob = (q.low > p.low) | (q.high < p.high)
+    return Tensor(jnp.where(oob, jnp.inf, result))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_p)
+    return Tensor(jnp.sum(pp * (p._log_p - q._log_p), -1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    sp = p.alpha + p.beta
+    sq = q.alpha + q.beta
+    t = (jsp.gammaln(sq) - jsp.gammaln(q.alpha) - jsp.gammaln(q.beta)
+         - (jsp.gammaln(sp) - jsp.gammaln(p.alpha) - jsp.gammaln(p.beta)))
+    t = t + (p.alpha - q.alpha) * jsp.digamma(p.alpha)
+    t = t + (p.beta - q.beta) * jsp.digamma(p.beta)
+    t = t + (q.alpha - p.alpha + q.beta - p.beta) * jsp.digamma(sp)
+    return Tensor(t)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    cp, cq = p.concentration, q.concentration
+    sp_ = jnp.sum(cp, -1)
+    t = (jsp.gammaln(sp_) - jnp.sum(jsp.gammaln(cp), -1)
+         - (jsp.gammaln(jnp.sum(cq, -1)) - jnp.sum(jsp.gammaln(cq), -1)))
+    t = t + jnp.sum((cp - cq) * (jsp.digamma(cp)
+                                 - jsp.digamma(sp_)[..., None]), -1)
+    return Tensor(t)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    # KL(L(u1,b1) || L(u2,b2)) =
+    #   log(b2/b1) + |u1-u2|/b2 + (b1/b2) exp(-|u1-u2|/b1) - 1
+    scale_ratio = p.scale / q.scale
+    abs_diff = jnp.abs(p.loc - q.loc)
+    return Tensor(-jnp.log(scale_ratio) + abs_diff / q.scale
+                  + scale_ratio * jnp.exp(-abs_diff / p.scale) - 1)

@@ -1,0 +1,98 @@
+"""paddle.fft (reference: python/paddle/fft.py — SURVEY.md §2.2 "Misc math
+domains"). All transforms lower to XLA FFT ops via jnp.fft; autograd goes
+through the tape like any other op (jax.vjp of the fft closure)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import _apply_op
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        _check_norm(norm)
+        return _apply_op(
+            lambda a: jfn(a, n=n, axis=axis, norm=norm), x,
+            _name=jfn.__name__)
+
+    return op
+
+
+def _wrap2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        _check_norm(norm)
+        return _apply_op(
+            lambda a: jfn(a, s=s, axes=axes, norm=norm), x,
+            _name=jfn.__name__)
+
+    return op
+
+
+def _wrapn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        _check_norm(norm)
+        return _apply_op(
+            lambda a: jfn(a, s=s, axes=axes, norm=norm), x,
+            _name=jfn.__name__)
+
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return _apply_op(
+        lambda a: jnp.fft.hfft(
+            jnp.fft.ifft(a, n=None if s is None else s[0], axis=axes[0],
+                         norm=norm),
+            n=None if s is None else s[1], axis=axes[1], norm=norm),
+        x, _name="hfft2")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out if dtype is None else out.astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out if dtype is None else out.astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return _apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                     _name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return _apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                     _name="ifftshift")

@@ -72,7 +72,6 @@ class ShmRing:
         if n < 0:
             # blocking read with a small probe buffer would truncate; peek
             # first, then size the buffer exactly
-            ms = -1 if timeout is None else max(1, int(timeout * 1000))
             import time
 
             deadline = None if timeout is None else time.monotonic() + timeout

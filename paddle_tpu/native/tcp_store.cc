@@ -16,6 +16,7 @@
 //   response: i64 status/num | u32 vlen | value
 //     GET: status 0 + value, or -1 (missing). WAIT blocks until key exists.
 
+#include <algorithm>
 #include <arpa/inet.h>
 #include <cerrno>
 #include <condition_variable>
@@ -105,7 +106,8 @@ void serve_client(Daemon* d, int fd) {
         }
         break;
       }
-      case 2: {  // ADD (i64 counter)
+      case 2: {  // ADD (i64 counter); result rides the VALUE channel so
+                 // negative counters don't collide with transport errors
         std::lock_guard<std::mutex> g(d->mu);
         int64_t cur = 0;
         auto it = d->kv.find(key);
@@ -115,7 +117,8 @@ void serve_client(Daemon* d, int fd) {
         std::vector<uint8_t> enc(8);
         memcpy(enc.data(), &cur, 8);
         d->kv[key] = enc;
-        status = cur;
+        out = enc;
+        status = 0;
         d->cv.notify_all();
         break;
       }
@@ -148,6 +151,12 @@ void serve_client(Daemon* d, int fd) {
     uint32_t olen = static_cast<uint32_t>(out.size());
     if (!write_exact(fd, &status, 8) || !write_exact(fd, &olen, 4)) break;
     if (olen && !write_exact(fd, out.data(), olen)) break;
+  }
+  {
+    // prune before close: master_stop must never shutdown() a reused fd
+    std::lock_guard<std::mutex> g(d->mu);
+    auto& v = d->client_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
   }
   ::close(fd);
 }
@@ -283,9 +292,16 @@ int64_t tcp_store_get(int fd, const char* key, uint8_t* out,
   return request(fd, 1, key, 0, nullptr, 0, out, out_cap, out_len);
 }
 
-int64_t tcp_store_add(int fd, const char* key, int64_t amount) {
-  return request(fd, 2, key, static_cast<uint64_t>(amount), nullptr, 0,
-                 nullptr, 0, nullptr);
+// status in return value; counter in *result (value channel — a negative
+// counter is legal and must not look like a transport error)
+int64_t tcp_store_add(int fd, const char* key, int64_t amount,
+                      int64_t* result) {
+  uint8_t out[8];
+  uint32_t olen = 0;
+  int64_t st = request(fd, 2, key, static_cast<uint64_t>(amount), nullptr,
+                       0, out, 8, &olen);
+  if (st == 0 && olen == 8 && result) memcpy(result, out, 8);
+  return st;
 }
 
 int64_t tcp_store_wait(int fd, const char* key, uint64_t timeout_ms,

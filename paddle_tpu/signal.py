@@ -1,0 +1,138 @@
+"""paddle.signal: frame/overlap-add/STFT/ISTFT (reference:
+python/paddle/signal.py — SURVEY.md §2.2 "Misc math domains").
+
+TPU-native notes: framing is a gather-free reshape+stride trick expressed
+with dynamic slices folded into one `jnp` indexing op, so the whole STFT is
+(frame → window multiply → batched rfft) — three fusable XLA ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, _apply_op, as_array
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis` (last by default).
+    Output appends a frame axis: [..., n, frame_length] for axis=-1."""
+
+    def f(a):
+        if axis not in (-1, a.ndim - 1):
+            a = jnp.moveaxis(a, axis, -1)
+        n = a.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        out = a[..., idx]  # [..., n_frames, frame_length]
+        if axis not in (-1, a.ndim - 1):
+            out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+        return out
+
+    return _apply_op(f, x, _name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., n_frames, frame_length] -> [..., n]."""
+
+    def f(a):
+        *batch, n_frames, flen = a.shape
+        n = (n_frames - 1) * hop_length + flen
+        out = jnp.zeros((*batch, n), a.dtype)
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(flen)[None, :])
+        return out.at[..., idx.reshape(-1)].add(
+            a.reshape(*batch, n_frames * flen))
+
+    return _apply_op(f, x, _name="overlap_add")
+
+
+def _resolve_window(window, win_length, dtype=jnp.float32):
+    if window is None:
+        return jnp.ones((win_length,), dtype)
+    return jnp.asarray(as_array(window), dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform.
+
+    x: [batch?, n] real or complex. Returns [batch?, freq, n_frames]
+    (paddle layout), freq = n_fft//2+1 if onesided else n_fft.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        # pad window to n_fft centered
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = a[..., idx] * w  # [b, n_frames, n_fft]
+        if onesided and not jnp.iscomplexobj(frames):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)  # [b, freq, n_frames]
+        return spec[0] if squeeze else spec
+
+    w = _resolve_window(window, win_length)
+    return _apply_op(lambda a: f(a, w), x, _name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (NOLA)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(spec, w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -1, -2)  # [b, n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        frames = frames * w
+        *batch, n_frames, flen = frames.shape
+        n = (n_frames - 1) * hop_length + flen
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(flen)[None, :]).reshape(-1)
+        sig = jnp.zeros((*batch, n), frames.dtype).at[..., idx].add(
+            frames.reshape(*batch, -1))
+        env = jnp.zeros((n,), w.dtype).at[idx].add(
+            jnp.tile(w * w, n_frames))
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:n - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+
+    w = _resolve_window(window, win_length)
+    return _apply_op(lambda a: f(a, w), x, _name="istft")

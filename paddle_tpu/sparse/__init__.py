@@ -1,0 +1,231 @@
+"""paddle.sparse (reference: python/paddle/sparse — SURVEY.md §2.2 "Misc
+math domains": COO/CSR tensors + sparse math).
+
+TPU-native notes: the MXU has no sparse units; XLA executes sparse compute
+as gather/scatter + dense tiles, which is exactly what
+jax.experimental.sparse.BCOO lowers to — so SparseCooTensor wraps BCOO and
+CSR is a view-level format (kept as indices for API parity, converted
+through COO for math). Genuinely sparse *training* at scale should prefer
+masked dense (documented), but the API surface here matches the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor, as_array
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "add", "subtract", "multiply", "matmul",
+    "masked_matmul", "relu", "is_same_shape",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over jax BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # paddle surface -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..framework import dtype as _dtype
+
+        return _dtype.from_np_dtype(self._bcoo.data.dtype)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("CSR needs a 2-D tensor")
+        dense = self._bcoo.todense()
+        return _dense_to_csr(dense)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (row pointers + cols + values)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(as_array(crows), jnp.int32)
+        self._cols = jnp.asarray(as_array(cols), jnp.int32)
+        self._values = jnp.asarray(as_array(values))
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz())
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return Tensor(dense.at[rows, self._cols].add(self._values))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()})")
+
+
+def _dense_to_csr(dense) -> SparseCsrTensor:
+    d = np.asarray(dense)
+    nz = np.nonzero(d)
+    values = d[nz]
+    rows, cols = nz
+    crows = np.zeros(d.shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, values, d.shape)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(as_array(indices), jnp.int32)
+    vals = jnp.asarray(as_array(values))
+    if dtype is not None:
+        from ..framework import dtype as _dtype
+
+        vals = vals.astype(_dtype.to_np_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    return SparseCooTensor(
+        jsparse.BCOO((vals, idx.T), shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y, name=None):
+    x, y = _coo(x), _coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(
+            (x._bcoo + y._bcoo).sum_duplicates())
+    return Tensor(as_array(x.to_dense() if hasattr(x, "to_dense") else x)
+                  + as_array(y.to_dense() if hasattr(y, "to_dense") else y))
+
+
+def subtract(x, y, name=None):
+    x, y = _coo(x), _coo(y)
+    neg = SparseCooTensor(jsparse.BCOO((-y._bcoo.data, y._bcoo.indices),
+                                       shape=y._bcoo.shape))
+    return add(x, neg)
+
+
+def multiply(x, y, name=None):
+    """Elementwise; sparse pattern of x wins (y gathered at x's indices)."""
+    x, y = _coo(x), _coo(y)
+    yd = as_array(y.to_dense() if hasattr(y, "to_dense") else y)
+    idx = x._bcoo.indices
+    gathered = yd[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data * gathered, idx),
+                                        shape=x._bcoo.shape))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (the serving/GNN workhorse)."""
+    x = _coo(x)
+    yd = as_array(y)
+    out = x._bcoo @ yd
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at mask's sparsity (SDDMM)."""
+    mask = _coo(mask)
+    xa, ya = as_array(x), as_array(y)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows, :], ya[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def relu(x, name=None):
+    x = _coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

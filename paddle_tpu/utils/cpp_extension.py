@@ -59,7 +59,11 @@ def load(name: str, sources: Sequence[str],
         if so_path in _loaded:
             return _loaded[so_path]
         if not os.path.exists(so_path):
-            cmd = ["g++", *flags, *sources, "-o", so_path + ".tmp",
+            # pid-unique tmp: concurrent ranks cold-building the same
+            # extension must not interleave writes; os.replace is atomic
+            # and either identical artifact may win
+            tmp = f"{so_path}.{os.getpid()}.tmp"
+            cmd = ["g++", *flags, *sources, "-o", tmp,
                    *(extra_ldflags or [])]
             if verbose:
                 print("[cpp_extension]", " ".join(cmd))
@@ -69,7 +73,7 @@ def load(name: str, sources: Sequence[str],
                 raise RuntimeError(
                     f"cpp_extension build of '{name}' failed:\n"
                     f"{(e.stderr or b'').decode(errors='replace')}") from e
-            os.replace(so_path + ".tmp", so_path)
+            os.replace(tmp, so_path)
         lib = ctypes.CDLL(so_path)
         _loaded[so_path] = lib
         return lib

@@ -1,0 +1,209 @@
+"""fft / signal / distribution / sparse tests (SURVEY.md §2.2 "Misc math
+domains"): numpy-reference parity in the op-test style."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------- fft
+class TestFFT:
+    def test_fft_roundtrip_and_numpy_parity(self):
+        x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+        out = np.asarray(paddle.fft.fft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = np.asarray(paddle.fft.ifft(paddle.to_tensor(out)))
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+        r = np.asarray(paddle.fft.rfft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(r, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        back = np.asarray(paddle.fft.irfft(paddle.to_tensor(r), n=16))
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+    def test_fft2_norm_and_shift(self):
+        x = np.random.RandomState(2).randn(5, 6).astype(np.float32)
+        out = np.asarray(paddle.fft.fft2(paddle.to_tensor(x), norm="ortho"))
+        np.testing.assert_allclose(out, np.fft.fft2(x, norm="ortho"),
+                                   rtol=1e-4, atol=1e-4)
+        sh = np.asarray(paddle.fft.fftshift(paddle.to_tensor(out)))
+        np.testing.assert_allclose(sh, np.fft.fftshift(out), rtol=1e-6)
+        fr = np.asarray(paddle.fft.fftfreq(10, d=0.5))
+        np.testing.assert_allclose(fr, np.fft.fftfreq(10, d=0.5), rtol=1e-6)
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(16).astype(np.float32),
+            stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.real() ** 2).sum() if hasattr(y, "real") else None
+        import paddle_tpu.ops.math as m
+
+        loss = (paddle.abs(y) ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad)).all()
+
+    def test_bad_norm_rejected(self):
+        with pytest.raises(ValueError, match="norm"):
+            paddle.fft.fft(paddle.to_tensor(np.zeros(4, np.float32)),
+                           norm="bogus")
+
+
+# ---------------------------------------------------------------- signal
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        x = np.random.RandomState(0).randn(2, 64).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 16, 16)  # no overlap
+        assert list(f.shape) == [2, 4, 16]
+        back = paddle.signal.overlap_add(f, 16)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        x = np.random.RandomState(1).randn(2, 256).astype(np.float32)
+        win = np.hanning(64).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=16, window=paddle.to_tensor(win))
+        assert list(spec.shape)[0:2] == [2, 33]  # onesided freq bins
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   window=paddle.to_tensor(win),
+                                   length=256)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_matches_scipy_shape_convention(self):
+        # freq x frames layout (paddle convention)
+        x = np.random.RandomState(2).randn(128).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=32)
+        assert list(spec.shape)[0] == 17
+
+
+# ---------------------------------------------- distribution
+class TestDistribution:
+    def test_normal_logprob_entropy_kl(self):
+        from scipy import stats
+
+        d = paddle.distribution.Normal(1.0, 2.0)
+        v = np.asarray([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(paddle.to_tensor(v))),
+            stats.norm(1.0, 2.0).logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   stats.norm(1.0, 2.0).entropy(), rtol=1e-5)
+        q = paddle.distribution.Normal(0.0, 1.0)
+        kl = float(paddle.distribution.kl_divergence(d, q))
+        # closed form: log(s2/s1) + (s1^2+(u1-u2)^2)/(2 s2^2) - 1/2
+        expect = np.log(1 / 2) + (4 + 1) / 2 - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_sampling_statistics(self):
+        paddle.seed(0)
+        d = paddle.distribution.Normal(3.0, 0.5)
+        s = np.asarray(d.sample((20000,)))
+        assert abs(s.mean() - 3.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+        u = paddle.distribution.Uniform(-1.0, 1.0)
+        su = np.asarray(u.sample((20000,)))
+        assert su.min() >= -1 and su.max() < 1
+        assert abs(su.mean()) < 0.03
+
+    def test_categorical_and_bernoulli(self):
+        from scipy import stats
+
+        logits = np.log(np.asarray([0.2, 0.3, 0.5], np.float32))
+        c = paddle.distribution.Categorical(logits=logits)
+        lp = np.asarray(c.log_prob(paddle.to_tensor(np.asarray([0, 1, 2]))))
+        np.testing.assert_allclose(np.exp(lp), [0.2, 0.3, 0.5], rtol=1e-5)
+        np.testing.assert_allclose(
+            float(c.entropy()), stats.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+        b = paddle.distribution.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            float(b.log_prob(paddle.to_tensor(1.0))), np.log(0.3), rtol=1e-4)
+
+    def test_beta_dirichlet_kl(self):
+        from scipy import stats
+
+        p = paddle.distribution.Beta(2.0, 3.0)
+        v = np.asarray([0.3], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(p.log_prob(paddle.to_tensor(v))),
+            stats.beta(2.0, 3.0).logpdf(v), rtol=1e-5)
+        q = paddle.distribution.Beta(2.0, 3.0)
+        np.testing.assert_allclose(
+            float(paddle.distribution.kl_divergence(p, q)), 0.0, atol=1e-6)
+        dd = paddle.distribution.Dirichlet(
+            np.asarray([1.0, 2.0, 3.0], np.float32))
+        s = np.asarray(dd.sample((4,)))
+        np.testing.assert_allclose(s.sum(-1), np.ones(4), rtol=1e-5)
+
+    def test_laplace_gumbel_lognormal(self):
+        from scipy import stats
+
+        lap = paddle.distribution.Laplace(0.0, 2.0)
+        v = np.asarray([1.5], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(lap.log_prob(paddle.to_tensor(v))),
+            stats.laplace(0, 2).logpdf(v), rtol=1e-5)
+        g = paddle.distribution.Gumbel(1.0, 2.0)
+        np.testing.assert_allclose(
+            np.asarray(g.log_prob(paddle.to_tensor(v))),
+            stats.gumbel_r(1, 2).logpdf(v), rtol=1e-5)
+        ln = paddle.distribution.LogNormal(0.0, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(ln.log_prob(paddle.to_tensor(v))),
+            stats.lognorm(1.0).logpdf(v), rtol=1e-5)
+
+
+# ------------------------------------------------------------------ sparse
+class TestSparse:
+    def _coo(self):
+        indices = np.asarray([[0, 1, 2], [1, 0, 2]])
+        values = np.asarray([1.0, 2.0, 3.0], np.float32)
+        return paddle.sparse.sparse_coo_tensor(indices, values, (3, 3))
+
+    def test_coo_roundtrip(self):
+        sp = self._coo()
+        assert sp.nnz() == 3 and sp.is_sparse_coo()
+        dense = np.asarray(sp.to_dense())
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(dense, expect)
+
+    def test_csr_roundtrip(self):
+        sp = self._coo()
+        csr = sp.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(csr.to_dense()),
+                                      np.asarray(sp.to_dense()))
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(np.asarray(back.to_dense()),
+                                      np.asarray(sp.to_dense()))
+
+    def test_sparse_math(self):
+        sp = self._coo()
+        d = np.asarray(sp.to_dense())
+        two = paddle.sparse.add(sp, sp)
+        np.testing.assert_array_equal(np.asarray(two.to_dense()), 2 * d)
+        z = paddle.sparse.subtract(sp, sp)
+        np.testing.assert_array_equal(np.asarray(z.to_dense()), 0 * d)
+        y = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        mm = paddle.sparse.matmul(sp, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(mm), d @ y, rtol=1e-5)
+
+    def test_masked_matmul_sddmm(self):
+        mask = self._coo()
+        a = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        b = np.random.RandomState(2).randn(5, 3).astype(np.float32)
+        out = paddle.sparse.masked_matmul(
+            paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        dense = np.asarray(out.to_dense())
+        full = a @ b
+        expect = np.where(np.asarray(mask.to_dense()) != 0, full, 0)
+        np.testing.assert_allclose(dense, expect, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_relu(self):
+        indices = np.asarray([[0, 1], [0, 1]])
+        values = np.asarray([-1.0, 2.0], np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(indices, values, (2, 2))
+        out = np.asarray(paddle.sparse.relu(sp).to_dense())
+        np.testing.assert_array_equal(out, [[0, 0], [0, 2]])

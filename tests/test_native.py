@@ -81,6 +81,40 @@ def test_tcp_store_wait_and_barrier():
         m.close()
 
 
+def test_tcp_store_large_value_and_negative_counter():
+    from paddle_tpu.distributed.store import TCPStore
+
+    m = TCPStore(is_master=True, world_size=1)
+    try:
+        blob = bytes(np.random.RandomState(0).bytes(3 << 20))  # 3 MiB
+        m.set("big", blob)
+        assert m.get("big") == blob  # no silent 1 MiB truncation
+        assert m.add("neg", -5) == -5  # negative counters are legal
+        assert m.add("neg", 2) == -3
+    finally:
+        m.close()
+
+
+def test_tcp_store_barrier_reusable():
+    from paddle_tpu.distributed.store import TCPStore
+
+    m = TCPStore(is_master=True, world_size=2)
+    c = TCPStore(port=m.port, world_size=2)
+    try:
+        for _ in range(3):  # same name, every iteration
+            done = []
+            ts = [threading.Thread(
+                target=lambda s=s: (s.barrier("step", timeout=10),
+                                    done.append(1)))
+                for s in (m, c)]
+            [t.start() for t in ts]
+            [t.join(10) for t in ts]
+            assert len(done) == 2
+    finally:
+        c.close()
+        m.close()
+
+
 # ---------------------------------------------------------------- shm ring
 def test_shm_ring_roundtrip_and_wraparound():
     from paddle_tpu.io.shm_queue import ShmRing, ring_name
