@@ -141,6 +141,7 @@ from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import inference  # noqa: F401
@@ -154,6 +155,7 @@ from . import profiler  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 

@@ -1,0 +1,2 @@
+"""paddle.audio (SURVEY.md §2.2): features + functional."""
+from . import features, functional  # noqa: F401

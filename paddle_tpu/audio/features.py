@@ -1,0 +1,91 @@
+"""paddle.audio.features (reference: python/paddle/audio/features/layers.py):
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC as nn.Layers built
+on paddle_tpu.signal.stft — one fused XLA pipeline per feature."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal as _signal
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, _apply_op, as_array
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", F.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return _apply_op(
+            lambda s: jnp.abs(s) ** self.power, spec, _name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.register_buffer("fbank", F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, frames]
+        return _apply_op(
+            lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+            spec, self.fbank, _name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, pad_mode, n_mels, f_min,
+                                   f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self._mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels,
+                                                 dtype=dtype))
+
+    def forward(self, x):
+        mel = self._log_mel(x)  # [..., n_mels, frames]
+        return _apply_op(
+            lambda m, d: jnp.einsum("mk,...mt->...kt", d, m),
+            mel, self.dct, _name="mfcc")

@@ -1,0 +1,122 @@
+"""paddle.audio.functional (reference: python/paddle/audio/functional —
+SURVEY.md §2.2 "Misc math domains"): mel scales, filterbanks, DCT, dB."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, as_array
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = isinstance(freq, (int, float))
+    f = np.asarray(as_array(freq), np.float64) if not scalar else freq
+    if htk:
+        out = 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+        return float(out) if scalar else Tensor(out.astype(np.float32))
+    # slaney
+    f = np.asarray(f, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    mels = np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                    / logstep, mels)
+    return float(mels) if scalar else Tensor(mels.astype(np.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = np.asarray(as_array(mel), np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return float(out) if scalar else Tensor(out.astype(np.float32))
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    freqs = np.where(m >= min_log_mel,
+                     min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                     freqs)
+    return float(freqs) if scalar else Tensor(freqs.astype(np.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    return mel_to_hz(Tensor(mels.astype(np.float32)), htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.asarray(as_array(fft_frequencies(sr, n_fft)))
+    melfreqs = np.asarray(as_array(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk)))
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference layout: mel @ dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ..tensor import _apply_op
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(jnp.asarray(ref_value, log_spec.dtype), amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return _apply_op(f, spect, _name="power_to_db")
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """'hann'/'hamming'/'blackman'/('ones') periodic windows."""
+    n = win_length
+    t = np.arange(n)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / denom)
+             + 0.08 * np.cos(4 * math.pi * t / denom))
+    elif window in ("ones", "rect", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

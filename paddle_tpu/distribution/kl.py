@@ -50,10 +50,15 @@ def _kl_categorical(p, q):
 
 @register_kl(Beta, Beta)
 def _kl_beta(p, q):
+    # KL(p||q) = ln B(q) - ln B(p) + (pa-qa)ψ(pa) + (pb-qb)ψ(pb)
+    #            + (qa-pa+qb-pb)ψ(pa+pb)
     sp = p.alpha + p.beta
     sq = q.alpha + q.beta
-    t = (jsp.gammaln(sq) - jsp.gammaln(q.alpha) - jsp.gammaln(q.beta)
-         - (jsp.gammaln(sp) - jsp.gammaln(p.alpha) - jsp.gammaln(p.beta)))
+    ln_b_p = (jsp.gammaln(p.alpha) + jsp.gammaln(p.beta)
+              - jsp.gammaln(sp))
+    ln_b_q = (jsp.gammaln(q.alpha) + jsp.gammaln(q.beta)
+              - jsp.gammaln(sq))
+    t = ln_b_q - ln_b_p
     t = t + (p.alpha - q.alpha) * jsp.digamma(p.alpha)
     t = t + (p.beta - q.beta) * jsp.digamma(p.beta)
     t = t + (q.alpha - p.alpha + q.beta - p.beta) * jsp.digamma(sp)

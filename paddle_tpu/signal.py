@@ -14,35 +14,46 @@ from .tensor import Tensor, _apply_op, as_array
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice x into overlapping frames along `axis` (last by default).
-    Output appends a frame axis: [..., n, frame_length] for axis=-1."""
+    """Slice x into overlapping frames (reference layout):
+    axis=-1: [..., n] -> [..., frame_length, n_frames];
+    axis=0:  [n, ...] -> [frame_length, n_frames, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
 
     def f(a):
-        if axis not in (-1, a.ndim - 1):
-            a = jnp.moveaxis(a, axis, -1)
-        n = a.shape[-1]
+        sig = jnp.moveaxis(a, 0, -1) if axis == 0 else a
+        n = sig.shape[-1]
         n_frames = 1 + (n - frame_length) // hop_length
-        idx = (jnp.arange(n_frames)[:, None] * hop_length
-               + jnp.arange(frame_length)[None, :])
-        out = a[..., idx]  # [..., n_frames, frame_length]
-        if axis not in (-1, a.ndim - 1):
-            out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+        idx = (jnp.arange(frame_length)[:, None]
+               + jnp.arange(n_frames)[None, :] * hop_length)
+        out = sig[..., idx]  # [..., frame_length, n_frames]
+        if axis == 0:
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))
         return out
 
     return _apply_op(f, x, _name="frame")
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame: [..., n_frames, frame_length] -> [..., n]."""
+    """Inverse of frame (reference layout):
+    axis=-1: [..., frame_length, n_frames] -> [..., n];
+    axis=0:  [frame_length, n_frames, ...] -> [n, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
 
     def f(a):
-        *batch, n_frames, flen = a.shape
+        fr = jnp.moveaxis(a, (0, 1), (-2, -1)) if axis == 0 else a
+        *batch, flen, n_frames = fr.shape
+        fr = jnp.swapaxes(fr, -1, -2)  # [..., n_frames, frame_length]
         n = (n_frames - 1) * hop_length + flen
         out = jnp.zeros((*batch, n), a.dtype)
         idx = (jnp.arange(n_frames)[:, None] * hop_length
                + jnp.arange(flen)[None, :])
-        return out.at[..., idx.reshape(-1)].add(
-            a.reshape(*batch, n_frames * flen))
+        out = out.at[..., idx.reshape(-1)].add(
+            fr.reshape(*batch, n_frames * flen))
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
 
     return _apply_op(f, x, _name="overlap_add")
 
@@ -63,6 +74,10 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     """
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if onesided and jnp.iscomplexobj(as_array(x)):
+        raise ValueError(
+            "stft: onesided spectra are undefined for complex input; "
+            "pass onesided=False")
 
     def f(a, w):
         squeeze = a.ndim == 1

@@ -1,0 +1,110 @@
+"""paddle.text (reference: python/paddle/text — SURVEY.md §2.2 "Misc math
+domains"): ViterbiDecoder + dataset stubs.
+
+TPU-native notes: Viterbi runs as a lax.scan over time steps (static
+shapes, no host loop); the backtrace is a second scan over the argmax
+history. Reference text datasets (Imdb/Imikolov/WMT…) require downloads —
+unavailable in the zero-egress environment; UCIHousing ships a
+deterministic synthetic fallback like paddle_tpu.vision.datasets.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, _apply_op, as_array
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode.
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N]
+    (trans[i, j] = score of i -> j); lengths: [B]. Returns
+    (scores [B], paths [B, T]) with positions >= length zero-padded.
+    Tags N-2/N-1 act as BOS/EOS when include_bos_eos_tag.
+    """
+
+    def f(pot, trans):
+        B, T, N = pot.shape
+        lens = as_array(lengths).astype(jnp.int32)
+
+        init = pot[:, 0, :]
+        if include_bos_eos_tag:
+            init = init + trans[N - 2][None, :]  # BOS -> tag
+
+        def step(carry, t):
+            alpha, hist_dummy = carry
+            # alpha: [B, N] best score ending in tag j at t-1
+            scores = alpha[:, :, None] + trans[None, :, :]  # [B, i, j]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            best_score = jnp.max(scores, axis=1) + pot[:, t, :]
+            keep = (t < lens)[:, None]
+            alpha = jnp.where(keep, best_score, alpha)
+            return (alpha, None), best_prev
+
+        (alpha, _), history = jax.lax.scan(
+            step, (init, None), jnp.arange(1, T))
+        # history: [T-1, B, N]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]  # tag -> EOS
+
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+
+        def back(carry, t):
+            tag = carry  # [B]
+            prev = history[t]  # [B, N]
+            prev_tag = jnp.take_along_axis(
+                prev, tag[:, None], axis=1)[:, 0]
+            # before the sequence start the tag is frozen
+            prev_tag = jnp.where(t + 1 < lens, prev_tag, tag)
+            return prev_tag, tag
+
+        first, tags_rev = jax.lax.scan(
+            back, last_tag, jnp.arange(T - 2, -1, -1))
+        path = jnp.concatenate(
+            [first[None], jnp.flip(tags_rev, 0)], axis=0).T  # [B, T]
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+
+    return _apply_op(f, potentials, transition_params,
+                     _name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing:
+    """Boston-housing-style regression dataset; deterministic synthetic
+    fallback in the zero-egress environment (reference
+    paddle.text.datasets.UCIHousing)."""
+
+    def __init__(self, mode="train"):
+        rng = np.random.RandomState(42 if mode == "train" else 43)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
+            np.float32)[:, None]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

@@ -1,0 +1,290 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — SURVEY.md
+§2.2 "Vision"): detection primitives.
+
+TPU-native notes: every op here is expressed as dense gather/one-hot math
+with static shapes — nms runs its greedy suppression as a lax.fori_loop
+over a fixed box budget (compiles once, no host sync), roi_align samples
+with vectorized bilinear gathers (MXU-friendly batched interpolation), and
+deform_conv2d is bilinear-sample + im2col matmul.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def box_area(boxes):
+    return ((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU: [N,4] x [M,4] -> [N,M] (xyxy)."""
+
+    def f(b1, b2):
+        area1 = box_area(b1)[:, None]
+        area2 = box_area(b2)[None, :]
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.clip(area1 + area2 - inter, 1e-9)
+
+    return _apply_op(f, boxes1, boxes2, _name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS. Returns kept indices sorted by descending score.
+
+    Jit-safe core: suppression runs as lax.fori_loop over the full box set;
+    the data-dependent result size materializes only at the final host-side
+    compaction (the same place the reference syncs).
+    """
+    b = as_array(boxes)
+    s = (jnp.ones((b.shape[0],), b.dtype) if scores is None
+         else as_array(scores))
+    if category_idxs is not None:
+        # classic trick: offset boxes per category so nothing overlaps
+        cat = as_array(category_idxs).astype(b.dtype)
+        offset = (cat * (b.max() + 1.0))[:, None]
+        b = b + offset
+
+    n = b.shape[0]
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+
+    def body(i, keep):
+        # suppress j>i overlapping an alive i
+        alive_i = keep[i]
+        bi = b_sorted[i]
+        lt = jnp.maximum(bi[:2], b_sorted[:, :2])
+        rb = jnp.minimum(bi[2:], b_sorted[:, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        a_i = (bi[2] - bi[0]) * (bi[3] - bi[1])
+        a_j = (b_sorted[:, 2] - b_sorted[:, 0]) * \
+              (b_sorted[:, 3] - b_sorted[:, 1])
+        o = inter / jnp.clip(a_i + a_j - inter, 1e-9)
+        later = jnp.arange(n) > i
+        suppress = later & (o > iou_threshold) & alive_i
+        return keep & ~suppress
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept_sorted = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    return Tensor(jnp.asarray(kept_sorted, jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (NCHW). boxes: [R, 4] xyxy in input coords; boxes_num: [B]
+    rois per image. Output [R, C, oh, ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xa, ba):
+        B, C, H, W = xa.shape
+        R = ba.shape[0]
+        counts = as_array(boxes_num).astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(B), counts,
+                             total_repeat_length=R)
+        off = 0.5 if aligned else 0.0
+        x1 = ba[:, 0] * spatial_scale - off
+        y1 = ba[:, 1] * spatial_scale - off
+        x2 = ba[:, 2] * spatial_scale - off
+        y2 = ba[:, 3] * spatial_scale - off
+        rw = jnp.clip(x2 - x1, 1e-4)
+        rh = jnp.clip(y2 - y1, 1e-4)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, oh*sr, ow*sr]
+        gy = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
+              * rh[:, None] / (oh * sr))
+        gx = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :]
+              * rw[:, None] / (ow * sr))
+
+        def bilinear(img, ys, xs):
+            # img [C,H,W]; ys [hs], xs [ws] -> [C,hs,ws]
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(ys, 0, H - 1) - y0
+            wx = jnp.clip(xs, 0, W - 1) - x0
+            yi0, yi1 = y0.astype(int), y1_.astype(int)
+            xi0, xi1 = x0.astype(int), x1_.astype(int)
+            v00 = img[:, yi0][:, :, xi0]
+            v01 = img[:, yi0][:, :, xi1]
+            v10 = img[:, yi1][:, :, xi0]
+            v11 = img[:, yi1][:, :, xi1]
+            w00 = ((1 - wy)[:, None] * (1 - wx)[None, :])
+            w01 = ((1 - wy)[:, None] * wx[None, :])
+            w10 = (wy[:, None] * (1 - wx)[None, :])
+            w11 = (wy[:, None] * wx[None, :])
+            return v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+
+        def per_roi(r):
+            img = xa[img_idx[r]]
+            sampled = bilinear(img, gy[r], gx[r])  # [C, oh*sr, ow*sr]
+            return sampled.reshape(C, oh, sr, ow, sr).mean((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return _apply_op(f, x, boxes, _name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool via dense max over an upsampled align grid (TPU-friendly
+    approximation of the reference's integer binning)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xa, ba):
+        B, C, H, W = xa.shape
+        R = ba.shape[0]
+        counts = as_array(boxes_num).astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(B), counts, total_repeat_length=R)
+        x1 = jnp.floor(ba[:, 0] * spatial_scale)
+        y1 = jnp.floor(ba[:, 1] * spatial_scale)
+        x2 = jnp.ceil(ba[:, 2] * spatial_scale)
+        y2 = jnp.ceil(ba[:, 3] * spatial_scale)
+        sr = 2
+
+        def per_roi(r):
+            img = xa[img_idx[r]]
+            ys = y1[r] + (jnp.arange(oh * sr) + 0.5) * \
+                jnp.clip(y2[r] - y1[r], 1.0) / (oh * sr)
+            xs = x1[r] + (jnp.arange(ow * sr) + 0.5) * \
+                jnp.clip(x2[r] - x1[r], 1.0) / (ow * sr)
+            yi = jnp.clip(ys, 0, H - 1).astype(int)
+            xi = jnp.clip(xs, 0, W - 1).astype(int)
+            sampled = img[:, yi][:, :, xi]
+            return sampled.reshape(C, oh, sr, ow, sr).max((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return _apply_op(f, x, boxes, _name="roi_pool")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (mask=None -> v1). NCHW.
+
+    offset: [B, 2*dg*kh*kw, oh, ow]; mask: [B, dg*kh*kw, oh, ow].
+    Bilinear sampling at offset positions + einsum contraction.
+    """
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xa, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b_ = rest[-1] if bias is not None else None
+        B, C, H, W = xa.shape
+        Co, Cg, kh, kw = w.shape
+        oh = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        ow = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (padding[0],) * 2,
+                          (padding[1],) * 2))
+        Hp, Wp = xp.shape[2:]
+        # base sampling grid [oh, ow, kh, kw]
+        base_y = (jnp.arange(oh)[:, None, None, None] * stride[0]
+                  + jnp.arange(kh)[None, None, :, None] * dilation[0])
+        base_x = (jnp.arange(ow)[None, :, None, None] * stride[1]
+                  + jnp.arange(kw)[None, None, None, :] * dilation[1])
+        off = off.reshape(B, deformable_groups, kh, kw, 2, oh, ow)
+        oy = off[:, :, :, :, 0].transpose(0, 1, 4, 5, 2, 3)
+        ox = off[:, :, :, :, 1].transpose(0, 1, 4, 5, 2, 3)
+        # sample position per (b, dg, oh, ow, kh, kw)
+        sy = base_y[None, None] + oy
+        sx = base_x[None, None] + ox
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(img_dg, yi, xi):
+            # img_dg: [Cdg, Hp, Wp]; yi/xi: [...]
+            yi = jnp.clip(yi, 0, Hp - 1).astype(int)
+            xi = jnp.clip(xi, 0, Wp - 1).astype(int)
+            return img_dg[:, yi, xi]  # [Cdg, ...]
+
+        cg_per_dg = C // deformable_groups
+        outs = []
+        for b_i in range(B):
+            per_dg = []
+            for g_i in range(deformable_groups):
+                img = xp[b_i, g_i * cg_per_dg:(g_i + 1) * cg_per_dg]
+                syb, sxb = sy[b_i, g_i], sx[b_i, g_i]
+                y0b, x0b = jnp.floor(syb), jnp.floor(sxb)
+                wyb, wxb = syb - y0b, sxb - x0b
+                valid = ((syb > -1) & (syb < Hp) & (sxb > -1) & (sxb < Wp))
+                v = (gather(img, y0b, x0b) * ((1 - wyb) * (1 - wxb))
+                     + gather(img, y0b, x0b + 1) * ((1 - wyb) * wxb)
+                     + gather(img, y0b + 1, x0b) * (wyb * (1 - wxb))
+                     + gather(img, y0b + 1, x0b + 1) * (wyb * wxb))
+                v = v * valid
+                if m is not None:
+                    mk = m[b_i].reshape(deformable_groups, kh, kw, oh, ow)
+                    v = v * mk[g_i].transpose(2, 3, 0, 1)[None]
+                per_dg.append(v)  # [Cdg, oh, ow, kh, kw]
+            sampled = jnp.concatenate(per_dg, 0)  # [C, oh, ow, kh, kw]
+            out = jnp.einsum("cyxhw,ochw->oyx",
+                             sampled.astype(w.dtype), w)
+            outs.append(out)
+        out = jnp.stack(outs)
+        if b_ is not None:
+            out = out + b_[None, :, None, None]
+        return out
+
+    operands = [x, offset, weight]
+    if mask is not None:
+        operands.append(mask)
+    if bias is not None:
+        operands.append(bias)
+    return _apply_op(f, *operands, _name="deform_conv2d")
+
+
+class DeformConv2D:
+    """Layer wrapper for deform_conv2d (reference paddle.vision.ops)."""
+
+    def __new__(cls, *a, **k):
+        from ..nn.layer_base import Layer
+        from ..nn import initializer as I
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) \
+                    if isinstance(kernel_size, int) else tuple(kernel_size)
+                self._args = dict(stride=stride, padding=padding,
+                                  dilation=dilation,
+                                  deformable_groups=deformable_groups,
+                                  groups=groups)
+                self.weight = self.create_parameter(
+                    shape=[out_channels, in_channels // groups, *ks],
+                    attr=weight_attr, default_initializer=I.XavierNormal())
+                self.bias = None if bias_attr is False else \
+                    self.create_parameter(shape=[out_channels],
+                                          is_bias=True)
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     mask=mask, **self._args)
+
+        return _DeformConv2D(*a, **k)

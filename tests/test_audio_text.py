@@ -1,0 +1,108 @@
+"""paddle.audio / paddle.text tests: mel pipeline vs librosa-style numpy
+references, Viterbi vs brute-force decode."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudio:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio import functional as F
+
+        for htk in (False, True):
+            f = np.asarray([0.0, 440.0, 1000.0, 4000.0], np.float32)
+            mel = F.hz_to_mel(paddle.to_tensor(f), htk)
+            back = np.asarray(F.mel_to_hz(mel, htk))
+            np.testing.assert_allclose(back, f, rtol=1e-3, atol=1e-2)
+        assert abs(F.hz_to_mel(1000.0, htk=True) - 1000.0) < 1.0
+
+    def test_fbank_rows_cover_spectrum(self):
+        from paddle_tpu.audio import functional as F
+
+        fb = np.asarray(F.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(1) > 0).all()  # every filter hits some bins
+
+    def test_dct_orthonormal(self):
+        from paddle_tpu.audio import functional as F
+
+        d = np.asarray(F.create_dct(13, 40))
+        # ortho DCT columns are orthonormal
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+    def test_spectrogram_parseval(self):
+        from paddle_tpu.audio.features import Spectrogram
+
+        x = paddle.to_tensor(
+            np.sin(2 * math.pi * 440 * np.arange(4096) / 16000)
+            .astype(np.float32))
+        spec = np.asarray(Spectrogram(n_fft=512, window="hann")(x))
+        assert spec.shape[0] == 257
+        # a pure 440 Hz tone peaks at bin 440/16000*512 ~= 14
+        peak = spec.mean(axis=1).argmax()
+        assert abs(int(peak) - 14) <= 1
+
+    def test_mel_and_mfcc_shapes(self):
+        from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                               MelSpectrogram)
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8000).astype(np.float32))
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert list(mel.shape)[:2] == [2, 40]
+        logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert np.isfinite(np.asarray(logmel)).all()
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert list(mfcc.shape)[:2] == [2, 13]
+
+
+class TestViterbi:
+    def _brute_force(self, pot, trans, length, bos, eos):
+        best, best_score = None, -np.inf
+        N = pot.shape[-1]
+        for path in itertools.product(range(N), repeat=length):
+            s = trans[bos, path[0]] + pot[0, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+            s += trans[path[-1], eos]
+            if s > best_score:
+                best, best_score = path, s
+        return list(best), best_score
+
+    def test_viterbi_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 4  # tags 2,3 are BOS,EOS
+        pot = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lengths = np.asarray([5, 3, 4], np.int32)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths))
+        scores, paths = np.asarray(scores), np.asarray(paths)
+        for b in range(B):
+            ref_path, ref_score = self._brute_force(
+                pot[b], trans, int(lengths[b]), N - 2, N - 1)
+            np.testing.assert_allclose(scores[b], ref_score, rtol=1e-5)
+            assert paths[b, :lengths[b]].tolist() == ref_path
+            assert (paths[b, lengths[b]:] == 0).all()
+
+    def test_viterbi_layer(self):
+        rng = np.random.RandomState(1)
+        trans = rng.randn(4, 4).astype(np.float32)
+        dec = paddle.text.ViterbiDecoder(trans)
+        pot = rng.randn(2, 6, 4).astype(np.float32)
+        scores, paths = dec(paddle.to_tensor(pot),
+                            paddle.to_tensor(np.asarray([6, 6], np.int32)))
+        assert list(np.asarray(paths).shape) == [2, 6]
+
+    def test_ucihousing(self):
+        ds = paddle.text.UCIHousing("train")
+        assert len(ds) == 404
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)

@@ -1,0 +1,134 @@
+"""vision.ops tests (SURVEY.md §2.2 "Vision"): nms / roi_align /
+deform_conv2d against numpy references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a1 + a2 - inter) > thresh:
+                suppressed[j] = True
+    return keep
+
+
+def test_nms_matches_numpy():
+    rng = np.random.RandomState(0)
+    xy = rng.rand(40, 2) * 60
+    wh = rng.rand(40, 2) * 30 + 1
+    boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    scores = rng.rand(40).astype(np.float32)
+    got = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.4,
+                             scores=paddle.to_tensor(scores)))
+    expect = _np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(sorted(got.tolist()), sorted(expect))
+    # kept indices come back ordered by descending score
+    assert list(got) == sorted(got, key=lambda i: -scores[i])
+
+
+def test_nms_categories_and_topk():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10, 10],
+                        [0, 0, 10, 10]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    cats = np.asarray([0, 0, 1])
+    got = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.5,
+                             scores=paddle.to_tensor(scores),
+                             category_idxs=paddle.to_tensor(cats)))
+    # box 1 suppressed by box 0 (same cat); box 2 survives (other cat)
+    assert sorted(got.tolist()) == [0, 2]
+    got2 = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.5,
+                              scores=paddle.to_tensor(scores),
+                              category_idxs=paddle.to_tensor(cats),
+                              top_k=1))
+    assert got2.tolist() == [0]
+
+
+def test_box_iou():
+    b1 = np.asarray([[0, 0, 10, 10]], np.float32)
+    b2 = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                    np.float32)
+    iou = np.asarray(ops.box_iou(paddle.to_tensor(b1), paddle.to_tensor(b2)))
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+def test_roi_align_constant_region():
+    # constant image -> every roi output equals that constant
+    x = np.full((1, 3, 16, 16), 7.0, np.float32)
+    boxes = np.asarray([[2, 2, 10, 10], [0, 0, 16, 16]], np.float32)
+    out = np.asarray(ops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.asarray([2], np.int32)), output_size=4))
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_ramp():
+    # image = x-coordinate ramp; roi centered samples average the ramp
+    H = W = 16
+    img = np.tile(np.arange(W, dtype=np.float32), (H, 1))[None, None]
+    boxes = np.asarray([[4, 4, 12, 12]], np.float32)
+    out = np.asarray(ops.roi_align(
+        paddle.to_tensor(img), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.asarray([1], np.int32)), output_size=2))
+    # output columns should increase left->right, mean ~ roi center x
+    assert out[0, 0, 0, 0] < out[0, 0, 0, 1]
+    np.testing.assert_allclose(out.mean(), 7.5, atol=0.5)
+
+
+def test_roi_pool_shape_and_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 3, 3] = 9.0
+    boxes = np.asarray([[0, 0, 8, 8]], np.float32)
+    out = np.asarray(ops.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.asarray([1], np.int32)), output_size=2))
+    assert out.shape == (1, 1, 2, 2)
+    assert out.max() == 9.0
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    """With zero offsets (and no mask) deform_conv2d == regular conv2d."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    got = np.asarray(ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w)))
+    import paddle_tpu.nn.functional as F
+
+    ref = np.asarray(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_mask_scales():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(2, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    mask = np.full((1, 9, 4, 4), 0.5, np.float32)
+    got = np.asarray(ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        mask=paddle.to_tensor(mask)))
+    import paddle_tpu.nn.functional as F
+
+    ref = 0.5 * np.asarray(F.conv2d(paddle.to_tensor(x),
+                                    paddle.to_tensor(w)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
