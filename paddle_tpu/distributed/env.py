@@ -29,6 +29,7 @@ def init_parallel_env():
         coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
             "MASTER_ADDR")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        _gather_endpoints(rank, n_procs)
         if coordinator:
             port = os.environ.get("MASTER_PORT", "8476")
             addr = coordinator if ":" in coordinator else f"{coordinator}:{port}"
@@ -37,6 +38,33 @@ def init_parallel_env():
                 process_id=rank,
             )
     _initialized = True
+
+
+def _gather_endpoints(rank: int, world: int, timeout: float = None) -> None:
+    """Publish this rank's real endpoint to the launch master's TCPStore
+    and rebuild PADDLE_TRAINER_ENDPOINTS from every rank's registration —
+    the launcher can only synthesize placeholder entries for peer nodes
+    (launch/context.py endpoints()); the store holds the truth."""
+    store_ep = os.environ.get("PADDLE_STORE_ENDPOINT")
+    my_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    if not store_ep or not my_ep:
+        return
+    if timeout is None:
+        timeout = float(os.environ.get("PADDLE_STORE_TIMEOUT", "30"))
+    try:
+        from .store import TCPStore
+
+        host, port = store_ep.rsplit(":", 1)
+        store = TCPStore(host, int(port), world_size=world, timeout=timeout)
+        store.set(f"{job}/ep/{rank}", my_ep)
+        eps = [store.wait(f"{job}/ep/{r}", timeout=timeout).decode()
+               for r in range(world)]
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
+    except Exception:
+        # best-effort: single-node jobs and tests without a store master
+        # keep the synthesized list
+        pass
 
 
 def get_rank():

@@ -49,9 +49,45 @@ class JobContext:
     def rank_of(self, local_rank: int) -> int:
         return self.node_rank * self.nproc_per_node + local_rank
 
+    def local_host(self) -> str:
+        """This node's address as peers can reach it. Single-node jobs (and
+        loopback masters) stay on the master host; multi-node jobs resolve
+        the pod's own IP — the master's address is NOT where non-master
+        ranks live (reference launcher records each pod's own IP)."""
+        host = self.master.split(":")[0]
+        if self.nnodes == 1 or host in ("127.0.0.1", "localhost"):
+            return host
+        # The outbound-route trick, not gethostbyname(gethostname()): on
+        # Debian-style /etc/hosts the latter returns 127.0.1.1, which would
+        # publish an unreachable loopback address to peers.
+        try:
+            import socket
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((host, 1))  # no packet sent; just picks a route
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            return host
+
+    def store_port(self) -> int:
+        """Rendezvous TCPStore port: master_port + world_size by convention
+        (ports master_port..master_port+world-1 are the rank endpoints)."""
+        return int(self.master.split(":")[1]) + self.world_size
+
     def endpoints(self) -> List[str]:
+        """Endpoint registry. This node's ranks are authoritative (built
+        from local_host()); peer nodes' entries are placeholders on the
+        master host — workers re-gather the real list through the TCPStore
+        at rendezvous (env.init_parallel_env)."""
         host, port = self.master.split(":")
-        return [f"{host}:{int(port) + r}" for r in range(self.world_size)]
+        lh = self.local_host()
+        return [
+            f"{lh if r // self.nproc_per_node == self.node_rank else host}"
+            f":{int(port) + r}"
+            for r in range(self.world_size)
+        ]
 
 
 def parse_args(argv=None) -> JobContext:
@@ -102,6 +138,10 @@ def rank_env(ctx: JobContext, local_rank: int) -> dict:
         "MASTER_PORT": master.split(":")[1],
         "PADDLE_JOB_ID": ctx.job_id,
     })
+    # the controller blanks this in ctx.envs when its store failed to bind,
+    # so workers skip the gather instead of stalling in connect retries
+    env.setdefault("PADDLE_STORE_ENDPOINT",
+                   f"{master.split(':')[0]}:{ctx.store_port()}")
     if ctx.devices is not None:
         devs = ctx.devices.split(",")
         env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]

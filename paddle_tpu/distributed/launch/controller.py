@@ -53,6 +53,26 @@ class CollectiveController:
         self.ctx = ctx
         self.pod: List[Container] = []
         self.pod_restarts = 0
+        self._store = None
+        if ctx.node_rank == 0:
+            # Rendezvous store for the job (reference: the launch master's
+            # TCPStore). Port is the deterministic convention
+            # master_port + world_size, so non-master pods can derive it
+            # without extra coordination; workers use it to publish their
+            # real endpoints (env.init_parallel_env gather).
+            try:
+                from ..store import TCPStore
+
+                self._store = TCPStore(
+                    "127.0.0.1", ctx.store_port(), is_master=True,
+                    world_size=ctx.world_size)
+            except Exception as e:  # port taken / native build issue:
+                # launch still works; blank the endpoint so this pod's
+                # workers skip the gather instead of stalling in connect
+                # retries against a store that will never answer
+                print(f"[launch] TCPStore master unavailable: {e}",
+                      file=sys.stderr)
+                ctx.envs["PADDLE_STORE_ENDPOINT"] = ""
 
     def build_pod(self):
         for lr in range(self.ctx.nproc_per_node):

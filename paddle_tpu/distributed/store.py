@@ -78,8 +78,16 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore master failed to bind :{port}")
             port = lib.tcp_store_master_port(self._daemon)
         self.host, self.port = host, int(port)
+        # the native client resolves IPv4 literals only (inet_pton);
+        # resolve hostnames here
+        try:
+            import socket as _socket
+
+            ip = _socket.gethostbyname(host)
+        except OSError:
+            ip = host
         self._fd = lib.tcp_store_connect(
-            host.encode(), self.port, int(timeout * 1000))
+            ip.encode(), self.port, int(timeout * 1000))
         if self._fd < 0:
             raise TimeoutError(
                 f"TCPStore could not reach {host}:{self.port} within "

@@ -18,6 +18,7 @@ Collation produces numpy batches; transfer to device happens on first use
 from __future__ import annotations
 
 import os
+import pickle
 import queue
 import threading
 from typing import Any, Callable, List, Optional
@@ -110,7 +111,10 @@ class _MultiProcessIter:
         collate = loader.collate_fn or numpy_collate_fn
         self._wrap = loader.collate_fn is None  # tensorize default collate
         cap = max(8 << 20, loader.shm_capacity)
-        ctx = mp.get_context("fork")
+        try:
+            ctx = mp.get_context(loader.mp_start_method)
+        except ValueError:
+            ctx = mp.get_context("spawn")
         self.rings = []
         self.procs = []
         for w in range(W):
@@ -121,7 +125,17 @@ class _MultiProcessIter:
                 args=(loader.dataset, per_worker[w], name, collate,
                       loader.worker_init_fn, w),
                 daemon=True)
-            p.start()
+            try:
+                p.start()
+            except (pickle.PicklingError, AttributeError, TypeError) as e:
+                for r in self.rings:
+                    r.close()
+                raise RuntimeError(
+                    "DataLoader worker spawn failed to pickle the dataset/"
+                    "collate_fn/worker_init_fn (required under the default "
+                    "'forkserver' start method). Define them at module "
+                    "level, or pass mp_start_method='fork' and accept the "
+                    "fork-after-threads hazard.") from e
             self.procs.append(p)
         self._next = 0
         self._done = [False] * W
@@ -252,7 +266,7 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False, multiprocess=False,
-                 shm_capacity=64 << 20):
+                 shm_capacity=64 << 20, mp_start_method=None):
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.num_workers = num_workers
@@ -263,6 +277,12 @@ class DataLoader:
         self.shm_capacity = shm_capacity
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        # Default forkserver: the trainer process typically holds live
+        # JAX/XLA + BLAS threads, and fork()ing a multithreaded process can
+        # deadlock the child on inherited locks. forkserver/spawn ship the
+        # dataset by pickle; pass mp_start_method="fork" explicitly for
+        # unpicklable datasets (and accept the fork-after-threads hazard).
+        self.mp_start_method = mp_start_method or "forkserver"
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)

@@ -106,7 +106,7 @@ int shm_ring_write(void* handle, const uint8_t* buf, uint32_t len,
   auto* r = static_cast<Ring*>(handle);
   const uint64_t cap = r->hdr->capacity;
   const uint64_t need = 4ull + len;
-  if (need + 4 > cap) return -2;  // +4: room for a possible pad marker
+  if (need > cap) return -2;  // after a pad, a full-capacity run is available
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   for (;;) {
@@ -114,20 +114,20 @@ int shm_ring_write(void* handle, const uint8_t* buf, uint32_t len,
     uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
     uint64_t avail = cap - (head - tail);
     uint64_t cont = contiguous(r, head);
-    uint64_t needed = need;
-    bool pad = false;
-    if (cont < need) {  // blob would wrap: pad to end, start at offset 0
-      pad = true;
-      needed = cont + need;
-    }
-    if (avail >= needed) {
-      if (pad) {
+    if (cont < need) {
+      // Blob would wrap. Commit the pad as a SEPARATE step once the pad
+      // region itself fits, so the reader can drain it while we wait for
+      // the blob's own `need` bytes — waiting for cont+need at once can
+      // exceed capacity and deadlock (blobs > ~half the ring).
+      if (avail >= cont) {
         if (cont >= 4) {
           uint32_t marker = kPad;
           memcpy(r->data + pos(r, head), &marker, 4);
         }
-        head += cont;
+        r->hdr->head.store(head + cont, std::memory_order_release);
+        continue;
       }
+    } else if (avail >= need) {
       memcpy(r->data + pos(r, head), &len, 4);
       memcpy(r->data + pos(r, head) + 4, buf, len);
       r->hdr->head.store(head + need, std::memory_order_release);

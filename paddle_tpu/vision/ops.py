@@ -191,6 +191,11 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         b_ = rest[-1] if bias is not None else None
         B, C, H, W = xa.shape
         Co, Cg, kh, kw = w.shape
+        if C % groups or Co % groups or Cg != C // groups:
+            raise ValueError(
+                f"deform_conv2d: weight in-channels ({Cg}) must equal "
+                f"C//groups ({C}//{groups}) and Co ({Co}) divisible by "
+                f"groups")
         oh = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
             // stride[0] + 1
         ow = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
@@ -241,8 +246,17 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                     v = v * mk[g_i].transpose(2, 3, 0, 1)[None]
                 per_dg.append(v)  # [Cdg, oh, ow, kh, kw]
             sampled = jnp.concatenate(per_dg, 0)  # [C, oh, ow, kh, kw]
-            out = jnp.einsum("cyxhw,ochw->oyx",
-                             sampled.astype(w.dtype), w)
+            if groups == 1:
+                out = jnp.einsum("cyxhw,ochw->oyx",
+                                 sampled.astype(w.dtype), w)
+            else:
+                # grouped contraction: weight Cg = C // groups; contract
+                # each group's channels against its own output slice
+                sg = sampled.astype(w.dtype).reshape(
+                    groups, C // groups, oh, ow, kh, kw)
+                wg = w.reshape(groups, Co // groups, Cg, kh, kw)
+                out = jnp.einsum("gcyxhw,gochw->goyx", sg, wg)
+                out = out.reshape(Co, oh, ow)
             outs.append(out)
         out = jnp.stack(outs)
         if b_ is not None:

@@ -56,7 +56,8 @@ class TestSignal:
     def test_frame_overlap_add_inverse(self):
         x = np.random.RandomState(0).randn(2, 64).astype(np.float32)
         f = paddle.signal.frame(paddle.to_tensor(x), 16, 16)  # no overlap
-        assert list(f.shape) == [2, 4, 16]
+        # reference layout: [..., frame_length, n_frames]
+        assert list(f.shape) == [2, 16, 4]
         back = paddle.signal.overlap_add(f, 16)
         np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
 

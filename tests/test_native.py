@@ -139,6 +139,44 @@ def test_shm_ring_roundtrip_and_wraparound():
         ring.close()
 
 
+def test_shm_ring_large_blob_wrap_no_deadlock():
+    """Blob > half the ring capacity at a wrapping head position: the pad
+    must commit as its own step (reader drains it) instead of the writer
+    waiting for cont+need > capacity forever."""
+    import threading
+
+    from paddle_tpu.io.shm_queue import ShmRing, ring_name
+
+    name = ring_name("bigblob")
+    ring = ShmRing(name, capacity=1 << 12)  # 4096
+    wr = ShmRing(name, open_existing=True)
+    try:
+        # advance head off the ring start so the big blob must wrap
+        small = b"s" * 900
+        wr.put_bytes(small)
+        assert ring.get_bytes(timeout=5) == small
+
+        big = bytes(np.random.RandomState(3).bytes(3500))
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(ring.get_bytes(timeout=15)))
+        t.start()
+        wr.put_bytes(big, timeout=15)  # deadlocked before the fix
+        t.join(timeout=15)
+        assert not t.is_alive() and got and got[0] == big
+
+        # ring still healthy afterwards
+        wr.put_bytes(b"after")
+        assert ring.get_bytes(timeout=5) == b"after"
+
+        # a blob that can never fit is rejected up front
+        with pytest.raises(ValueError, match="capacity"):
+            wr.put_bytes(b"x" * 5000)
+    finally:
+        wr.close()
+        ring.close()
+
+
 def test_shm_ring_cross_process():
     import multiprocessing as mp
 
@@ -170,17 +208,30 @@ def test_shm_ring_cross_process():
         ring.close()
 
 
+# module-level: worker datasets must pickle under the forkserver default
+class _ParityDS:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return (np.full((4,), i, np.float32), np.int64(i % 3))
+
+
+class _PoisonDS:
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        if i == 7:
+            raise ValueError("poison sample")
+        return np.zeros((2,), np.float32)
+
+
 def test_dataloader_multiprocess_parity():
     """shm-worker DataLoader produces the same batches as in-process."""
     from paddle_tpu.io import DataLoader
 
-    class DS:
-        def __len__(self):
-            return 37
-
-        def __getitem__(self, i):
-            return (np.full((4,), i, np.float32), np.int64(i % 3))
-
+    DS = _ParityDS
     serial = [
         (np.asarray(x), np.asarray(y))
         for x, y in DataLoader(DS(), batch_size=5, shuffle=False)]
@@ -197,16 +248,8 @@ def test_dataloader_multiprocess_parity():
 def test_dataloader_worker_error_propagates():
     from paddle_tpu.io import DataLoader
 
-    class Bad:
-        def __len__(self):
-            return 10
-
-        def __getitem__(self, i):
-            if i == 7:
-                raise ValueError("poison sample")
-            return np.zeros((2,), np.float32)
-
-    dl = DataLoader(Bad(), batch_size=2, num_workers=2, multiprocess=True)
+    dl = DataLoader(_PoisonDS(), batch_size=2, num_workers=2,
+                    multiprocess=True)
     with pytest.raises(RuntimeError, match="poison sample"):
         list(dl)
 

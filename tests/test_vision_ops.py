@@ -118,6 +118,30 @@ def test_deform_conv_zero_offset_equals_conv():
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_deform_conv_groups_matches_grouped_conv():
+    """groups>1 with zero offsets == grouped conv2d."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(6, 2, 3, 3).astype(np.float32)  # groups=2, Cg=2
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    got = np.asarray(ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        groups=2))
+    import paddle_tpu.nn.functional as F
+
+    ref = np.asarray(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                              groups=2))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_bad_groups_rejected():
+    x = paddle.to_tensor(np.zeros((1, 4, 8, 8), np.float32))
+    w = paddle.to_tensor(np.zeros((6, 4, 3, 3), np.float32))  # Cg != C//2
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    with pytest.raises(ValueError, match="groups"):
+        ops.deform_conv2d(x, off, w, groups=2)
+
+
 def test_deform_conv_mask_scales():
     rng = np.random.RandomState(1)
     x = rng.randn(1, 2, 6, 6).astype(np.float32)
