@@ -23,6 +23,38 @@ from .....tensor import Tensor
 from .... import mesh as _mesh
 
 
+def zero_axis_for(mesh) -> str:
+    """The axis ZeRO shards over: a dedicated 'sharding' axis when the mesh
+    has one (degree>1), else the dp axis (reference: sharding group ==
+    sharding_degree ranks inside the dp group)."""
+    if mesh is not None and "sharding" in mesh.axis_names \
+            and int(mesh.shape["sharding"]) > 1:
+        return "sharding"
+    return "dp"
+
+
+def zero_extend_spec(shape, base_spec, mesh, axis=None):
+    """Extend a param's compute PartitionSpec with the ZeRO axis on the
+    first replicated dim divisible by the axis size. This is the STORED /
+    GRAD layout for S2/S3 (and the optimizer-state layout for S1+): under
+    GSPMD, constraining grads to it makes XLA emit reduce_scatter instead
+    of all_reduce, and constraining stored params to it is stage-3 param
+    partitioning (reference group_sharded_stage3's param slices)."""
+    axis = axis or zero_axis_for(mesh)
+    if mesh is None or axis not in mesh.axis_names:
+        return tuple(base_spec or [None] * len(shape))
+    size = int(mesh.shape[axis])
+    spec = list(base_spec or [])
+    spec += [None] * (len(shape) - len(spec))
+    if size <= 1 or not shape:
+        return tuple(spec)
+    for i, s in enumerate(spec):
+        if s is None and shape[i] % size == 0:
+            spec[i] = axis
+            return tuple(spec)
+    return tuple(spec)
+
+
 def shard_spec_for(array_shape, stage: int, axis="sharding"):
     """Choose the PartitionSpec for an optimizer-state/grad/param leaf.
 
@@ -58,8 +90,12 @@ class DygraphShardingOptimizer:
         return getattr(self._inner_opt, item)
 
     def step(self):
-        from ...meta_parallel.hybrid_optimizer import HybridParallelOptimizer
-
+        # Eager single-controller path: there are no per-rank grad shards to
+        # scatter — grads are averaged over dp and the inner optimizer runs
+        # with exact numerics. The stage's LAYOUT semantics (grad
+        # reduce_scatter, param partitioning) materialize under the jitted
+        # step: models.trainer.build_train_step reads self.stage and
+        # constrains grads/params/opt-state per stage (jit.train_step).
         if _mesh.axis_size("dp") > 1 or _mesh.axis_size("sharding") > 1:
             from .... import collective as _collective
 

@@ -217,15 +217,56 @@ def not_to_static(fn):
 
 
 def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
-               model_call: Optional[Callable] = None):
+               model_call: Optional[Callable] = None, sharding_stage=0,
+               mesh=None):
     """Build a compiled train step: step(inputs, *labels) -> loss.
 
     `model_call(model, inputs)` defaults to `model(inputs)`;
     `criterion(output, *labels)` computes the scalar loss. Params and
     optimizer state are donated: XLA rewrites weights in place in HBM.
+
+    sharding_stage (reference group_sharded_stage{2,3}, SURVEY.md §2.3):
+      0/1 — params+grads replicated over the ZeRO axis (opt-state layout
+            is the caller's concern: trainer.shard_opt_state);
+      2   — grads constrained to the zero-extended spec inside the step
+            (XLA lowers the dp grad reduction to reduce_scatter, and the
+            weight update math runs shard-local);
+      3   — params are STORED zero-sharded; the forward constrains them
+            back to their compute spec (all-gather on use), and updated
+            params are constrained to the stored layout again.
     """
     opt_state_holder = {"state": None}
     call = model_call or (lambda m, x: m(x))
+
+    grad_shardings = {}
+    stored_shardings = {}
+    compute_shardings = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer \
+            import zero_extend_spec
+        from ..distributed.sharding_utils import clean_spec, get_param_spec
+
+        for n, p in model.named_parameters():
+            cspec = clean_spec(get_param_spec(p), mesh)
+            zspec = zero_extend_spec(tuple(p.shape), tuple(cspec), mesh)
+            compute_shardings[n] = NamedSharding(mesh, cspec)
+            zsh = NamedSharding(mesh, P(*zspec))
+            if sharding_stage >= 2:
+                grad_shardings[n] = zsh
+            # stored layout between steps: zero-sharded at S3, the compute
+            # layout otherwise. Without this constraint XLA propagates the
+            # (dp-sharded) optimizer-moment layout into the updated params
+            # and every stage silently becomes S3.
+            stored_shardings[n] = zsh if sharding_stage >= 3 \
+                else compute_shardings[n]
+
+    def _constrain(tree, shardings):
+        if not shardings:
+            return tree
+        return {n: jax.lax.with_sharding_constraint(a, shardings[n])
+                if n in shardings else a for n, a in tree.items()}
 
     def pure_step(params, buffers, opt_state, lr, seed, arg_leaves, structure):
         stream = _random.KeyStream(jax.random.wrap_key_data(seed))
@@ -233,6 +274,12 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
         def compute_loss(p):
             from ..autograd import tape as _tape
 
+            if sharding_stage >= 3:
+                # gather-on-use: stored shards -> full compute layout. The
+                # vjp of this constraint lands the cotangents back on the
+                # stored (zero-sharded) layout — grads reduce_scatter for
+                # free.
+                p = _constrain(p, compute_shardings)
             _tls.tracing = True
             try:
                 # the eager tape is bypassed — jax.value_and_grad
@@ -251,9 +298,13 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
         (loss, new_buffers), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(params)
+        if sharding_stage >= 2:
+            grads = _constrain(grads, grad_shardings)
         new_params, new_opt_state = optimizer.apply_gradients_functional(
             params, grads, opt_state, lr
         )
+        if stored_shardings:
+            new_params = _constrain(new_params, stored_shardings)
         return loss, new_params, new_buffers, new_opt_state
 
     jitted = jax.jit(
@@ -282,4 +333,7 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
 
     step._opt_state_holder = opt_state_holder
     step._pure_step = pure_step
+    step._sharding_stage = sharding_stage
+    step._grad_shardings = grad_shardings
+    step._stored_shardings = stored_shardings
     return step

@@ -23,6 +23,8 @@ from .. import jit as _jit
 from ..distributed import mesh as _mesh
 from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer import (
     shard_spec_for,
+    zero_axis_for,
+    zero_extend_spec,
 )
 from ..distributed.sharding_utils import clean_spec as _clean_spec
 from ..distributed.sharding_utils import get_param_spec
@@ -43,26 +45,23 @@ def place_model(model: Layer, mesh=None):
     return model
 
 
-def shard_opt_state(opt_state, param_specs, mesh, zero_axis="dp"):
-    """ZeRO-1: shard optimizer moments over the data/sharding axis; scalars
-    replicated. Moment shapes == param shapes, so param specs compose with
-    the zero split on the first replicated divisible dim.
+def shard_opt_state(opt_state, param_specs, mesh, zero_axis=None):
+    """ZeRO-1: shard optimizer moments over the zero axis ('sharding' when
+    the mesh has one, else 'dp' — zero_axis_for); scalars replicated.
+    Moment shapes == param shapes, so param specs compose with the zero
+    split via zero_extend_spec.
 
     param_specs: name -> PartitionSpec (or spec tuple) of the param."""
+    zero_axis = zero_axis or zero_axis_for(mesh)
     out = {}
     for name, state in opt_state.items():
-        pspec = list(_clean_spec(param_specs.get(name), mesh))
+        pspec = tuple(_clean_spec(param_specs.get(name), mesh))
         new_state = {}
         for k, v in state.items():
             if not hasattr(v, "shape") or v.ndim == 0:
                 new_state[k] = jax.device_put(v, NamedSharding(mesh, P()))
                 continue
-            spec = pspec + [None] * (v.ndim - len(pspec))
-            if zero_axis in mesh.axis_names and mesh.shape[zero_axis] > 1:
-                for i, s in enumerate(spec):
-                    if s is None and v.shape[i] % mesh.shape[zero_axis] == 0:
-                        spec[i] = zero_axis
-                        break
+            spec = zero_extend_spec(v.shape, pspec, mesh, axis=zero_axis)
             new_state[k] = jax.device_put(
                 v, NamedSharding(mesh, P(*spec)))
         out[name] = new_state
@@ -72,7 +71,8 @@ def shard_opt_state(opt_state, param_specs, mesh, zero_axis="dp"):
 def build_pipeline_train_step(model: Layer, optimizer,
                               criterion: Optional[Callable] = None,
                               mesh=None, num_microbatches: Optional[int]
-                              = None, donate=True):
+                              = None, donate=True,
+                              sharding_stage: int = 1):
     """Pipeline-parallel compiled step (SURVEY.md §7 phase 8).
 
     Decoder layers are stacked into [L, ...] arrays pp-sharded on the
@@ -134,10 +134,37 @@ def build_pipeline_train_step(model: Layer, optimizer,
     for _, b in model.named_buffers():
         b._rebind(jax.device_put(b._data, repl))
 
+    # ZeRO layouts over the pipeline step's flat param dict (stage
+    # semantics as in jit.train_step): grads constrained zero-sharded at
+    # S2+, params STORED zero-sharded and gathered on use at S3, and the
+    # updated params pinned to the stored layout at every stage so XLA
+    # can't drift them into the moment layout.
+    compute_shardings = {n: NamedSharding(mesh, P(*s) if not isinstance(
+        s, P) else s) for n, s in flat_specs.items()}
+    zero_shardings = {}
+    for n, s in flat_specs.items():
+        base = tuple(s) if not isinstance(s, P) else tuple(s)
+        zspec = zero_extend_spec(flat_params[n].shape, base, mesh)
+        zero_shardings[n] = NamedSharding(mesh, P(*zspec))
+    grad_shardings = zero_shardings if sharding_stage >= 2 else {}
+    stored_shardings = zero_shardings if sharding_stage >= 3 \
+        else compute_shardings
+    if sharding_stage >= 3:
+        flat_params = {n: jax.device_put(a, stored_shardings[n])
+                       for n, a in flat_params.items()}
+
+    def _constrain(tree, shardings):
+        if not shardings:
+            return tree
+        return {n: jax.lax.with_sharding_constraint(a, shardings[n])
+                if n in shardings else a for n, a in tree.items()}
+
     def pure_step(params, buffers, opt_state, lr, seed, x, y):
         stream = _random.KeyStream(jax.random.wrap_key_data(seed))
 
         def loss_of(params):
+            if sharding_stage >= 3:
+                params = _constrain(params, compute_shardings)
             rest = {n: params[n] for n in rest_names}
             stacked = {n: params[_skey(n)] for n in stacked_names}
             with _tape.no_grad(), _random.with_key_stream(stream), \
@@ -155,8 +182,11 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        if sharding_stage >= 2:
+            grads = _constrain(grads, grad_shardings)
         new_params, new_opt = optimizer.apply_gradients_functional(
             params, grads, opt_state, lr)
+        new_params = _constrain(new_params, stored_shardings)
         return loss, new_buffers, new_params, new_opt
 
     jitted = jax.jit(pure_step, donate_argnums=(0, 2) if donate else ())
@@ -195,25 +225,45 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
 def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                      = None, mesh=None, donate=True,
-                     num_microbatches: Optional[int] = None):
+                     num_microbatches: Optional[int] = None,
+                     sharding_stage: Optional[int] = None):
     """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
 
     criterion defaults to model.compute_loss (vocab-parallel CE for the
     flagship LM). If the mesh has a pp axis (size>1) and the model exposes
-    a pipeline decomposition, the SPMD pipeline schedule is used."""
+    a pipeline decomposition, the SPMD pipeline schedule is used.
+
+    sharding_stage: ZeRO stage (1/2/3) over the sharding/dp axis; defaults
+    to the optimizer wrapper's .stage (DygraphShardingOptimizer /
+    group_sharded_parallel) or 1. See jit.train_step for the stage
+    semantics."""
+    if sharding_stage is None:
+        sharding_stage = getattr(optimizer, "stage", 1)
+    # unwrap the eager sharding facade: under jit the stage IS the layout
+    inner_opt = getattr(optimizer, "_inner_opt", optimizer)
     mesh = mesh or _mesh.get_mesh(optional=True)
     if criterion is None:
         criterion = model.compute_loss
     if (mesh is not None and "pp" in mesh.axis_names
             and int(mesh.shape["pp"]) > 1 and hasattr(model, "pp_layers")):
         return build_pipeline_train_step(
-            model, optimizer, criterion=criterion, mesh=mesh,
-            num_microbatches=num_microbatches, donate=donate)
-    place_model(model, mesh)
-    step = _jit.train_step(model, criterion, optimizer, donate=donate)
+            model, inner_opt, criterion=criterion, mesh=mesh,
+            num_microbatches=num_microbatches, donate=donate,
+            sharding_stage=sharding_stage)
+    step = _jit.train_step(model, criterion, inner_opt, donate=donate,
+                           sharding_stage=sharding_stage, mesh=mesh)
 
     if mesh is None:
+        place_model(model, mesh)  # records specs even meshless (no-op put)
         return step
+
+    # lay params out ONCE in their between-steps (stored) layout: the
+    # zero-sharded spec at stage 3, the compute spec otherwise
+    for name, p in model.named_parameters():
+        p._rebind(jax.device_put(p._data, step._stored_shardings[name]))
+    repl = NamedSharding(mesh, P())
+    for _, b in model.named_buffers():
+        b._rebind(jax.device_put(b._data, repl))
 
     holder = step._opt_state_holder
     data_sharding = NamedSharding(mesh, _clean_spec(("dp", None), mesh))
@@ -224,7 +274,7 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
             specs = {n: get_param_spec(p)
                      for n, p in model.named_parameters()}
             holder["state"] = shard_opt_state(
-                optimizer.init_state_pytree(params), specs, mesh)
+                inner_opt.init_state_pytree(params), specs, mesh)
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         y = labels._data if isinstance(labels, Tensor) else labels
         x = jax.device_put(x, data_sharding)

@@ -1,0 +1,174 @@
+"""ZeRO sharding stages 1/2/3 (reference:
+fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py, SURVEY.md §2.3):
+the stages must produce DIFFERENT layouts (grads / stored params /
+optimizer state over the zero axis) while keeping loss numerics identical.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
+
+
+def _build(stage, dp=8):
+    paddle.seed(0)
+    mesh = mesh_mod.init_mesh(dp=dp)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, seq=16)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = build_train_step(model, opt, mesh=mesh, sharding_stage=stage)
+    return model, opt, step, mesh
+
+
+def _data(dp=8):
+    rng = np.random.RandomState(7)
+    x = paddle.to_tensor(rng.randint(0, 128, (dp, 16)))
+    y = paddle.to_tensor(rng.randint(0, 128, (dp, 16)))
+    return x, y
+
+
+def _spec_axes(arr):
+    """Flattened set of mesh axes appearing in an array's sharding spec."""
+    spec = getattr(arr.sharding, "spec", None)
+    if spec is None:
+        return set()
+    axes = set()
+    for s in spec:
+        if s is None:
+            continue
+        if isinstance(s, (tuple, list)):
+            axes.update(s)
+        else:
+            axes.add(s)
+    return axes
+
+
+@pytest.fixture(autouse=True)
+def _teardown_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _run(stage, n_steps=3):
+    model, opt, step, mesh = _build(stage)
+    x, y = _data()
+    losses = [float(step(x, y)) for _ in range(n_steps)]
+    return model, step, losses
+
+
+class TestStageLayouts:
+    def test_stage1_params_replicated_state_sharded(self):
+        model, step, losses = _run(1)
+        inner = step._inner
+        assert inner._sharding_stage == 1
+        assert not inner._grad_shardings  # no grad constraint at S1
+        for n, p in model.named_parameters():
+            assert "dp" not in _spec_axes(p._data), n
+        st = inner._opt_state_holder["state"]
+        sharded = [k for name, fields in st.items()
+                   for k, v in fields.items()
+                   if hasattr(v, "sharding") and "dp" in _spec_axes(v)]
+        assert sharded, "S1 must shard optimizer moments over dp"
+
+    def test_stage2_grads_constrained_params_replicated(self):
+        model, step, losses = _run(2)
+        inner = step._inner
+        assert inner._grad_shardings, "S2 must constrain grads"
+        # grad layout: at least one grad leaf carries the zero axis
+        grad_axes = set()
+        for sh in inner._grad_shardings.values():
+            for s in sh.spec:
+                if s is not None:
+                    grad_axes.add(s)
+        assert "dp" in grad_axes
+        # params remain replicated over dp between steps (stored == compute)
+        for n, p in model.named_parameters():
+            assert "dp" not in _spec_axes(p._data), n
+
+    def test_stage3_params_stored_sharded(self):
+        model, step, losses = _run(3)
+        inner = step._inner
+        assert inner._stored_shardings
+        sharded = [n for n, p in model.named_parameters()
+                   if "dp" in _spec_axes(p._data)]
+        assert sharded, "S3 must store params zero-sharded between steps"
+        # big 2D matmul weights specifically must be sharded
+        big = [n for n, p in model.named_parameters()
+               if p._data.ndim >= 2 and "dp" in _spec_axes(p._data)]
+        assert big
+
+    def test_layouts_differ_by_stage(self):
+        """The VERDICT gate: the three stages must produce genuinely
+        different layouts, not one behavior under three names. (The
+        reduce-scatter itself can't be grepped from CPU HLO — the CPU
+        partitioner lowers it to all-reduce+slice — so the constraint
+        shardings are the observable.)"""
+        per_stage = {}
+        for stage in (1, 2, 3):
+            model, step, _ = _run(stage, n_steps=1)
+            inner = step._inner
+            n_sharded_params = sum(
+                1 for _, p in model.named_parameters()
+                if "dp" in _spec_axes(p._data))
+            per_stage[stage] = (bool(inner._grad_shardings),
+                                n_sharded_params)
+            mesh_mod.set_mesh(None)
+        assert per_stage[1] != per_stage[2] != per_stage[3]
+        assert per_stage[1][0] is False and per_stage[2][0] is True
+        assert per_stage[1][1] == per_stage[2][1] == 0
+        assert per_stage[3][1] > 0
+
+
+class TestStageParity:
+    def test_loss_parity_across_stages(self):
+        ref = None
+        for stage in (1, 2, 3):
+            _, _, losses = _run(stage)
+            assert all(np.isfinite(l) for l in losses)
+            assert losses[-1] < losses[0]
+            if ref is None:
+                ref = losses
+            else:
+                np.testing.assert_allclose(losses, ref, rtol=2e-4,
+                                           atol=2e-4)
+
+    def test_pipeline_path_honors_stage3(self):
+        """pp>1 + ZeRO-3: stacked layer params stored zero-sharded."""
+        paddle.seed(0)
+        mesh = mesh_mod.init_mesh(pp=2, dp=4)
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               seq=16)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = build_train_step(model, opt, mesh=mesh, sharding_stage=3)
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.randint(0, 128, (4, 16)))
+        y = paddle.to_tensor(rng.randint(0, 128, (4, 16)))
+        l0, l1 = float(step(x, y)), float(step(x, y))
+        assert np.isfinite(l1) and l1 < l0
+        sharded = [n for n, a in step._holder["params"].items()
+                   if "dp" in _spec_axes(a)]
+        assert sharded, "pipeline stage-3 must store params dp-sharded"
+
+    def test_group_sharded_parallel_levels_map_to_stages(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding. \
+            sharding_optimizer import group_sharded_parallel
+
+        mesh = mesh_mod.init_mesh(dp=8)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               seq=16)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, sopt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+        assert sopt.stage == 3
+        step = build_train_step(model, sopt, mesh=mesh)
+        assert step._inner._sharding_stage == 3
+        x, y = _data()
+        l0, l1 = float(step(x, y)), float(step(x, y))
+        assert np.isfinite(l1) and l1 < l0
