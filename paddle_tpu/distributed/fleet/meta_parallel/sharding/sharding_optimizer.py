@@ -55,6 +55,34 @@ def zero_extend_spec(shape, base_spec, mesh, axis=None):
     return tuple(spec)
 
 
+def stage_shardings(named_shape_specs, mesh, sharding_stage):
+    """The one place that encodes ZeRO-stage layout semantics for the
+    jitted train steps (jit.train_step and the pipeline trainer both use
+    it — keep them in sync by construction).
+
+    named_shape_specs: name -> (shape tuple, compute spec tuple).
+    Returns (compute, grad, stored) dicts of NamedSharding:
+      compute — the param's GSPMD layout while being used;
+      grad    — zero-extended at stage >= 2 (XLA lowers the dp grad
+                reduction to reduce_scatter), else empty (no constraint);
+      stored  — zero-extended at stage >= 3 (param partitioning,
+                gather-on-use), else the compute layout. Pinning updated
+                params to `stored` stops XLA from drifting them into the
+                optimizer-moment layout.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    compute, grad, stored = {}, {}, {}
+    for n, (shape, cspec) in named_shape_specs.items():
+        cspec = tuple(cspec)
+        compute[n] = NamedSharding(mesh, P(*cspec))
+        zsh = NamedSharding(mesh, P(*zero_extend_spec(shape, cspec, mesh)))
+        if sharding_stage >= 2:
+            grad[n] = zsh
+        stored[n] = zsh if sharding_stage >= 3 else compute[n]
+    return compute, grad, stored
+
+
 def shard_spec_for(array_shape, stage: int, axis="sharding"):
     """Choose the PartitionSpec for an optimizer-state/grad/param leaf.
 

@@ -67,7 +67,10 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
       microbatches: [M, ...] array (or pytree of such) of per-microbatch
         inputs to stage 0; replicated over `axis_name`.
 
-    Returns [M, ...] outputs of the last stage, broadcast to all stages.
+    Returns [M, ...] outputs of the last stage, read out of the schedule as
+    a one-shard gather of the last stage's pp-sharded tick window (a
+    consumer on another device pays one transfer on access; there is no
+    all-reduce of the output volume).
     """
     mesh = mesh or _mesh.get_mesh()
     S = int(mesh.shape[axis_name])
@@ -97,24 +100,194 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
             return nxt, y
 
         _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
-        # ticks S-1 .. T-1 on the LAST stage hold the pipeline outputs
-        window = jax.tree_util.tree_map(lambda a: a[S - 1:], ys)
-        masked = jax.tree_util.tree_map(
-            lambda a: jnp.where(stage == S - 1, a, jnp.zeros_like(a)), window)
-        return jax.tree_util.tree_map(
-            lambda a: jax.lax.psum(a, axis_name), masked)
+        # ticks S-1 .. T-1 on the LAST stage hold the pipeline outputs;
+        # emit them pp-stacked ([1, M, ...] per stage) so the caller reads
+        # the last stage's shard directly — a one-shard gather, NOT an
+        # all-reduce of the full output volume
+        window = jax.tree_util.tree_map(lambda a: a[S - 1:][None], ys)
+        return window
 
     # manual over pp only; tp/dp/sp remain GSPMD-auto inside the stage
     stacked_spec = jax.tree_util.tree_map(
         lambda _: P(axis_name), stage_params)
     data_spec = jax.tree_util.tree_map(lambda _: P(), microbatches)
-    return jax.shard_map(
+    stacked_out = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(stacked_spec, data_spec),
-        out_specs=jax.tree_util.tree_map(lambda _: P(), microbatches),
+        out_specs=jax.tree_util.tree_map(
+            lambda _: P(axis_name), microbatches),
         axis_names=frozenset({axis_name}),
     )(stage_params, microbatches)
+    return jax.tree_util.tree_map(lambda a: a[-1], stacked_out)
+
+
+def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
+                       head_params, targets, *, mesh=None,
+                       axis_name: str = "pp"):
+    """Interleaved 1F1B train schedule in ONE compiled scan.
+
+    The reference's host-orchestrated 1F1B (`PipelineParallel.train_batch`,
+    fleet/meta_parallel/pipeline_parallel.py — SURVEY.md §2.3 "PP", §3.4)
+    keeps at most S microbatches in flight per stage so activation memory is
+    O(S), not O(M). This is the SPMD-compiled equivalent: a single
+    `lax.scan` over T = M + 2(S-1) ticks where every tick performs one
+    forward AND one backward microbatch step per stage (predicated during
+    fill/drain), with
+
+    - forward activations flowing via `ppermute` (+1 ring),
+    - loss + initial cotangent produced at the LAST stage the same tick its
+      forward microbatch arrives (head_fn runs inside the schedule),
+    - cotangents flowing via the reverse `ppermute` (-1 ring) — the
+      send_backward/recv_backward of pp_utils/p2p_communication.py,
+    - a circular buffer of 2S-1 stage-INPUT activations per stage; the
+      backward recomputes the stage forward from the saved input (remat),
+      so in-flight memory is O(S) microbatch inputs — the 1F1B memory
+      contract (GPipe-via-autodiff stores O(M) full per-layer residuals),
+    - per-stage grad accumulation in f32, emitted pp-sharded (no grad
+      all-reduce over pp; each stage owns its block's grads).
+
+    Args:
+      stage_fn: (local_stage_params, x) -> y, homogeneous across stages.
+      stage_params: stacked pytree, leading dim sharded over `axis_name`.
+      microbatches: [M, ...] array pytree — per-microbatch inputs to stage 0.
+      head_fn: (head_params, y, target_mb) -> scalar mean loss of one
+        microbatch. Runs at the last stage inside the schedule (tp/dp stay
+        GSPMD-auto).
+      head_params: pytree (embed/norm/lm-head weights), replicated over pp.
+      targets: [M, ...] array pytree of per-microbatch labels.
+
+    Returns (loss, d_stage_params, d_head_params, d_inputs):
+      loss — scalar mean over all microbatches;
+      d_stage_params — grads of stage_params (pp-sharded like the input);
+      d_head_params — grads of head_params (from the last stage);
+      d_inputs — [M, ...] cotangents w.r.t. microbatches (from stage 0),
+        for the caller to backprop into the embedding.
+    """
+    mesh = mesh or _mesh.get_mesh()
+    S = int(mesh.shape[axis_name])
+    tm = jax.tree_util.tree_map
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    inv_m = np.float32(1.0 / M)
+
+    if S == 1:
+        def one(m):
+            mb = tm(lambda x: x[m], microbatches)
+            tgt = tm(lambda t: t[m], targets)
+
+            def loss_of(sp, hp, x):
+                return head_fn(hp, stage_fn(sp, x), tgt)
+
+            loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+            d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
+            return loss_m, d_sp, d_hp, d_x
+
+        losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+        d_sp = tm(lambda a: jnp.sum(a, axis=0), d_sps)
+        d_hp = tm(lambda a: jnp.sum(a, axis=0), d_hps)
+        return jnp.mean(losses), d_sp, d_hp, d_xs
+
+    T = M + 2 * (S - 1)
+    B = 2 * S - 1  # max in-flight stage inputs (1F1B bound)
+
+    def inner(local_params, inputs, head_params, targets):
+        stage = jax.lax.axis_index(axis_name)
+        is_last = stage == S - 1
+        # head_params arrive pp-INVARIANT; vjp of an invariant input
+        # against a pp-varying output inserts an implicit psum over pp,
+        # which would fold every stage's (masked-out) head cotangent into
+        # d_hp_m. Cast to varying so cotangents stay per-device and the
+        # explicit masked psum below is the only cross-stage reduction.
+        head_params = tm(lambda p: _pcast_varying(p, axis_name), head_params)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+        mb_zero = tm(lambda x: _pcast_varying(
+            jnp.zeros_like(x[0]), axis_name), inputs)
+        buf0 = tm(lambda x: _pcast_varying(
+            jnp.zeros((B,) + x.shape[1:], x.dtype), axis_name), inputs)
+        dp0 = tm(lambda p: _pcast_varying(
+            jnp.zeros(p.shape, jnp.float32), axis_name), local_params)
+        dh0 = tm(lambda p: _pcast_varying(
+            jnp.zeros(p.shape, jnp.float32), axis_name), head_params)
+        loss0 = _pcast_varying(jnp.zeros((), jnp.float32), axis_name)
+
+        def tick(carry, t):
+            buf, fwd_c, bwd_c, d_params, d_head, loss_acc = carry
+
+            # ---- forward slot ----
+            m_f = t - stage
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            idx_f = jnp.clip(m_f, 0, M - 1)
+            fresh = tm(lambda x: x[idx_f], inputs)
+            x = tm(lambda f, c: jnp.where(stage == 0, f, c), fresh, fwd_c)
+            slot_f = idx_f % B
+            buf = tm(lambda b_, x_: b_.at[slot_f].set(
+                jnp.where(fwd_valid, x_, b_[slot_f])), buf, x)
+            y = stage_fn(local_params, x)
+
+            # ---- head (+ initial cotangent) at the last stage ----
+            tgt = tm(lambda a: a[idx_f], targets)
+
+            def head_loss(hp, y_):
+                return head_fn(hp, y_, tgt)
+
+            loss_m, head_vjp = jax.vjp(head_loss, head_params, y)
+            d_hp_m, d_y = head_vjp(_pcast_varying(
+                jnp.asarray(inv_m, loss_m.dtype), axis_name))
+            head_valid = is_last & fwd_valid
+            loss_acc = loss_acc + jnp.where(
+                head_valid, loss_m.astype(jnp.float32), 0.0)
+            d_head = tm(lambda a, g: a + jnp.where(
+                head_valid, g.astype(jnp.float32), 0.0), d_head, d_hp_m)
+
+            # ---- backward slot (remat from the saved stage input) ----
+            m_b = t - (2 * S - 2 - stage)
+            bwd_valid = (m_b >= 0) & (m_b < M)
+            idx_b = jnp.clip(m_b, 0, M - 1)
+            slot_b = idx_b % B
+            x_saved = tm(lambda b_: b_[slot_b], buf)
+            g_in = tm(lambda dy, c: jnp.where(is_last, dy, c), d_y, bwd_c)
+            _, stage_vjp = jax.vjp(stage_fn, local_params, x_saved)
+            d_p_m, d_x = stage_vjp(g_in)
+            d_params = tm(lambda a, g: a + jnp.where(
+                bwd_valid, g.astype(jnp.float32), 0.0), d_params, d_p_m)
+            d_x = tm(lambda g: jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+                     d_x)
+
+            # ---- ring transfers ----
+            fwd_c = tm(lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
+            bwd_c = tm(lambda a: jax.lax.ppermute(a, axis_name, bwd_perm),
+                       d_x)
+            return (buf, fwd_c, bwd_c, d_params, d_head, loss_acc), d_x
+
+        init = (buf0, mb_zero, mb_zero, dp0, dh0, loss0)
+        carry, dxs = jax.lax.scan(tick, init, jnp.arange(T))
+        _, _, _, d_params, d_head, loss_acc = carry
+
+        # stage 0 emits d_inputs on ticks 2S-2 .. T-1 (microbatch order)
+        d_inputs = tm(lambda a: a[2 * S - 2:][None], dxs)
+        loss = jax.lax.psum(loss_acc, axis_name) * inv_m  # mean over M
+        d_head = tm(lambda a: jax.lax.psum(a, axis_name), d_head)
+        d_params = tm(lambda a, p: a.astype(p.dtype), d_params, local_params)
+        return loss, d_params, d_head, d_inputs
+
+    stacked_spec = tm(lambda _: P(axis_name), stage_params)
+    data_spec = tm(lambda _: P(), microbatches)
+    head_spec = tm(lambda _: P(), head_params)
+    tgt_spec = tm(lambda _: P(), targets)
+    loss, d_params, d_head, d_inputs_stacked = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec),
+        out_specs=(P(), stacked_spec, head_spec,
+                   tm(lambda _: P(axis_name), microbatches)),
+        axis_names=frozenset({axis_name}),
+    )(stage_params, microbatches, head_params, targets)
+    d_head = tm(lambda a, p: a.astype(p.dtype), d_head, head_params)
+    # stage 0's shard holds the input cotangents — one-shard gather
+    d_inputs = tm(lambda a: a[0], d_inputs_stacked)
+    return loss, d_params, d_head, d_inputs
 
 
 # ---------------------------------------------------------------------------

@@ -242,25 +242,19 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
     stored_shardings = {}
     compute_shardings = {}
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer \
-            import zero_extend_spec
+            import stage_shardings
         from ..distributed.sharding_utils import clean_spec, get_param_spec
 
-        for n, p in model.named_parameters():
-            cspec = clean_spec(get_param_spec(p), mesh)
-            zspec = zero_extend_spec(tuple(p.shape), tuple(cspec), mesh)
-            compute_shardings[n] = NamedSharding(mesh, cspec)
-            zsh = NamedSharding(mesh, P(*zspec))
-            if sharding_stage >= 2:
-                grad_shardings[n] = zsh
-            # stored layout between steps: zero-sharded at S3, the compute
-            # layout otherwise. Without this constraint XLA propagates the
-            # (dp-sharded) optimizer-moment layout into the updated params
-            # and every stage silently becomes S3.
-            stored_shardings[n] = zsh if sharding_stage >= 3 \
-                else compute_shardings[n]
+        # single source of ZeRO-stage layout semantics (grads
+        # zero-extended at S2+, params stored zero-sharded at S3 with
+        # gather-on-use, pinned to the stored layout between steps)
+        compute_shardings, grad_shardings, stored_shardings = \
+            stage_shardings(
+                {n: (tuple(p.shape),
+                     tuple(clean_spec(get_param_spec(p), mesh)))
+                 for n, p in model.named_parameters()},
+                mesh, sharding_stage)
 
     def _constrain(tree, shardings):
         if not shardings:

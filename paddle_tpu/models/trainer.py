@@ -23,6 +23,7 @@ from .. import jit as _jit
 from ..distributed import mesh as _mesh
 from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer import (
     shard_spec_for,
+    stage_shardings,
     zero_axis_for,
     zero_extend_spec,
 )
@@ -72,14 +73,31 @@ def build_pipeline_train_step(model: Layer, optimizer,
                               criterion: Optional[Callable] = None,
                               mesh=None, num_microbatches: Optional[int]
                               = None, donate=True,
-                              sharding_stage: int = 1):
+                              sharding_stage: int = 1,
+                              schedule: Optional[str] = None):
     """Pipeline-parallel compiled step (SURVEY.md §7 phase 8).
 
     Decoder layers are stacked into [L, ...] arrays pp-sharded on the
-    leading dim and scheduled by distributed.pipeline.spmd_pipeline; embed
-    and head run under plain GSPMD on every rank. Params live in the step's
-    holder between steps (stacked form); `step.sync_to_model()` writes them
-    back into the module tree (for checkpointing/eval)."""
+    leading dim and scheduled by distributed.pipeline; embed runs under
+    plain GSPMD on every rank. Params live in the step's holder between
+    steps (stacked form); `step.sync_to_model()` writes them back into the
+    module tree (for checkpointing/eval).
+
+    schedule (reference PipelineParallel.train_batch schedules —
+    fleet/meta_parallel/pipeline_parallel.py, SURVEY.md §2.3 "PP");
+    default None resolves to "1f1b", or "gpipe" when the model has
+    buffers (the 1f1b path does not track buffer updates):
+      "1f1b"  — interleaved fwd/bwd one-scan schedule
+                (pipeline.spmd_pipeline_1f1b): head+loss computed at the
+                last stage inside the schedule, cotangents ppermute
+                backward, O(pp) in-flight activation memory via
+                input-remat. Buffer (BN-stat) updates inside pipelined
+                stages are not tracked on this path.
+      "gpipe" — forward scan + autodiff reverse (all-M residuals live
+                through the backward; higher memory, no remat).
+    num_microbatches defaults to the largest count <= 2*pp dividing the
+    batch (the reference guidance is M >> pp to amortize the (pp-1)-tick
+    fill/drain bubble; raise it explicitly for big batches)."""
     from ..autograd import tape as _tape
     from ..distributed import pipeline as _pipe
     from ..framework import random as _random
@@ -94,7 +112,25 @@ def build_pipeline_train_step(model: Layer, optimizer,
     if len(layers) % S:
         raise ValueError(
             f"{len(layers)} layers not divisible by pp={S}")
-    M = num_microbatches or S
+    if schedule is None:
+        # the 1f1b path does not track buffer (BN-stat) updates inside the
+        # schedule; models with buffers keep the autodiff path by default
+        schedule = "gpipe" if dict(model.named_buffers()) else "1f1b"
+    # default M: the largest count <= 2*pp dividing the CURRENT batch,
+    # re-derived per call (jit retraces per input shape, so a trailing
+    # partial batch picks a valid M instead of crashing); the reference
+    # guidance is M >> pp to amortize the fill/drain bubble
+    mb_holder = {"M": num_microbatches}
+
+    def _resolve_m(batch):
+        if num_microbatches is None:
+            m = 1
+            for cand in range(min(2 * S, batch), 0, -1):
+                if batch % cand == 0:
+                    m = cand
+                    break
+            mb_holder["M"] = m
+        return mb_holder["M"]
     template = layers[0]
     layer_param_ids = {
         id(p) for l in layers for _, p in l.named_parameters()}
@@ -134,21 +170,11 @@ def build_pipeline_train_step(model: Layer, optimizer,
     for _, b in model.named_buffers():
         b._rebind(jax.device_put(b._data, repl))
 
-    # ZeRO layouts over the pipeline step's flat param dict (stage
-    # semantics as in jit.train_step): grads constrained zero-sharded at
-    # S2+, params STORED zero-sharded and gathered on use at S3, and the
-    # updated params pinned to the stored layout at every stage so XLA
-    # can't drift them into the moment layout.
-    compute_shardings = {n: NamedSharding(mesh, P(*s) if not isinstance(
-        s, P) else s) for n, s in flat_specs.items()}
-    zero_shardings = {}
-    for n, s in flat_specs.items():
-        base = tuple(s) if not isinstance(s, P) else tuple(s)
-        zspec = zero_extend_spec(flat_params[n].shape, base, mesh)
-        zero_shardings[n] = NamedSharding(mesh, P(*zspec))
-    grad_shardings = zero_shardings if sharding_stage >= 2 else {}
-    stored_shardings = zero_shardings if sharding_stage >= 3 \
-        else compute_shardings
+    # ZeRO layouts over the pipeline step's flat param dict (single source
+    # of stage semantics: sharding_optimizer.stage_shardings)
+    compute_shardings, grad_shardings, stored_shardings = stage_shardings(
+        {n: (tuple(flat_params[n].shape), tuple(s))
+         for n, s in flat_specs.items()}, mesh, sharding_stage)
     if sharding_stage >= 3:
         flat_params = {n: jax.device_put(a, stored_shardings[n])
                        for n, a in flat_params.items()}
@@ -159,9 +185,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
         return {n: jax.lax.with_sharding_constraint(a, shardings[n])
                 if n in shardings else a for n, a in tree.items()}
 
-    def pure_step(params, buffers, opt_state, lr, seed, x, y):
-        stream = _random.KeyStream(jax.random.wrap_key_data(seed))
-
+    def _gpipe_loss_and_grads(params, buffers, stream, x, y):
         def loss_of(params):
             if sharding_stage >= 3:
                 params = _constrain(params, compute_shardings)
@@ -171,7 +195,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
                     _LayerScope(model, rest, buffers) as scope:
                 h = model.pp_embed(Tensor(x))
                 h_arr = h._data
-                mb = _pipe.microbatch(h_arr, M)
+                mb = _pipe.microbatch(h_arr, mb_holder["M"])
                 outs = _pipe.spmd_pipeline(
                     stage_fn, stacked, mb, mesh=mesh)
                 full = outs.reshape((h_arr.shape[0],) + h_arr.shape[1:])
@@ -182,6 +206,45 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        return loss, new_buffers, grads
+
+    def _1f1b_loss_and_grads(params, buffers, stream, x, y):
+        if sharding_stage >= 3:
+            params = _constrain(params, compute_shardings)
+        rest = {n: params[n] for n in rest_names}
+        stacked = {n: params[_skey(n)] for n in stacked_names}
+        with _tape.no_grad(), _random.with_key_stream(stream):
+            def embed_fn(rest_p):
+                with _LayerScope(model, rest_p, buffers):
+                    h = model.pp_embed(Tensor(x))
+                return h._data
+
+            def head_fn(rest_p, y_act, tgt):
+                # runs at the LAST stage inside the pp-manual shard_map;
+                # tp/dp stay GSPMD-auto, and ParallelCrossEntropy takes its
+                # dense-CE branch (tp axis not bound), so GSPMD inserts the
+                # vocab-parallel max/sum collectives itself
+                with _LayerScope(model, rest_p, buffers):
+                    logits = model.pp_head(Tensor(y_act))
+                    loss = criterion(logits, Tensor(tgt))
+                return loss._data
+
+            h, embed_vjp = jax.vjp(embed_fn, rest)
+            mb = _pipe.microbatch(h, mb_holder["M"])
+            tgts = _pipe.microbatch(y, mb_holder["M"])
+            loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_1f1b(
+                stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh)
+            (d_rest_embed,) = embed_vjp(d_mb.reshape(h.shape))
+        grads = {_skey(n): d_stacked[n] for n in stacked_names}
+        for n in rest_names:
+            grads[n] = d_rest_embed[n] + d_rest_head[n]
+        return loss, {}, grads
+
+    def pure_step(params, buffers, opt_state, lr, seed, x, y):
+        stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+        fn = _1f1b_loss_and_grads if schedule == "1f1b" \
+            else _gpipe_loss_and_grads
+        loss, new_buffers, grads = fn(params, buffers, stream, x, y)
         if sharding_stage >= 2:
             grads = _constrain(grads, grad_shardings)
         new_params, new_opt = optimizer.apply_gradients_functional(
@@ -200,6 +263,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
                 flat_specs, mesh)
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         y = labels._data if isinstance(labels, Tensor) else labels
+        _resolve_m(int(x.shape[0]))
         x = jax.device_put(jnp.asarray(x), data_sharding)
         y = jax.device_put(jnp.asarray(y), data_sharding)
         lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
@@ -226,7 +290,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
 def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                      = None, mesh=None, donate=True,
                      num_microbatches: Optional[int] = None,
-                     sharding_stage: Optional[int] = None):
+                     sharding_stage: Optional[int] = None,
+                     pipeline_schedule: Optional[str] = None):
     """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
 
     criterion defaults to model.compute_loss (vocab-parallel CE for the
@@ -249,12 +314,11 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         return build_pipeline_train_step(
             model, inner_opt, criterion=criterion, mesh=mesh,
             num_microbatches=num_microbatches, donate=donate,
-            sharding_stage=sharding_stage)
+            sharding_stage=sharding_stage, schedule=pipeline_schedule)
     step = _jit.train_step(model, criterion, inner_opt, donate=donate,
                            sharding_stage=sharding_stage, mesh=mesh)
 
     if mesh is None:
-        place_model(model, mesh)  # records specs even meshless (no-op put)
         return step
 
     # lay params out ONCE in their between-steps (stored) layout: the
