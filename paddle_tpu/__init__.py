@@ -93,6 +93,7 @@ from .ops.creation import (  # noqa: F401
     tril_indices,
     triu,
     triu_indices,
+    vander,
     zeros,
     zeros_like,
 )
@@ -105,22 +106,27 @@ from .ops.linalg import (  # noqa: F401
     bincount,
     bmm,
     cdist,
+    corrcoef,
+    cov,
     cross,
     dist,
     dot,
     einsum,
     histogram,
+    histogram_bin_edges,
     histogramdd,
     matmul,
     matrix_transpose,
     mm,
     mv,
     norm,
+    pdist,
     tensordot,
 )
 from .ops.random_ops import (  # noqa: F401
     bernoulli,
     binomial,
+    geometric_,
     multinomial,
     normal,
     poisson,
@@ -129,6 +135,7 @@ from .ops.random_ops import (  # noqa: F401
     randint_like,
     randn,
     randperm,
+    standard_gamma,
     standard_normal,
     uniform,
 )

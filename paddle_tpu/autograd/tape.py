@@ -108,10 +108,13 @@ class TapeNode:
         "out_avals",
         "n_outputs",
         "name",
+        "primal_fn",
+        "in_arrays",
         "__weakref__",
     )
 
-    def __init__(self, inputs, vjp_fn, out_avals, name=""):
+    def __init__(self, inputs, vjp_fn, out_avals, name="", primal_fn=None,
+                 in_arrays=None):
         _node_counter[0] += 1
         self.id = _node_counter[0]
         self.inputs = inputs  # tuple of Tensor-or-None, aligned with vjp inputs
@@ -119,6 +122,13 @@ class TapeNode:
         self.out_avals = out_avals  # list of (shape, dtype) per output
         self.n_outputs = len(out_avals)
         self.name = name
+        # create_graph support: the pure primal callable + the operand
+        # arrays as recorded (constants for non-Tensor slots). The
+        # double-grad walk re-derives the vjp as a fresh RECORDED op over
+        # (original inputs, cotangents) so second-order grads flow through
+        # the residuals (reference: double-grad nodes of the eager engine).
+        self.primal_fn = primal_fn
+        self.in_arrays = in_arrays
 
     def __repr__(self):
         return f"TapeNode({self.name}, id={self.id})"
@@ -134,7 +144,91 @@ def _zeros_like_aval(aval):
     return jnp.zeros(shape, dtype=dtype)
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
+def _vjp_as_recorded_op(node: "TapeNode", cots):
+    """create_graph path: re-derive node's vjp as a RECORDED grad op so the
+    gradient computation is itself taped (residual dependence — d²/dx²
+    flows through the original inputs).
+
+    The grad node is built by hand rather than through `_apply_op`: its
+    input refs REUSE the node's record-time InputRefs, so (a) the values
+    fed to the re-derivation are the RECORDED arrays (in-place rebinds of
+    the same Python Tensor after recording don't corrupt first-order
+    grads), and (b) leaf/interior routing follows the record-time graph."""
+    from ..tensor import Tensor
+
+    if node.primal_fn is None:
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name or '?'}' is not "
+            "supported: the node has no re-derivable primal (PyLayer / "
+            "custom-vjp nodes). Use jax-level grad composition for "
+            "higher-order derivatives through custom ops.")
+
+    tensor_slots = [i for i, r in enumerate(node.inputs) if r is not None]
+    n_slots = len(tensor_slots)
+    primal, aux = node.primal_fn, node.in_arrays
+    n_out = node.n_outputs
+
+    # float0 cotangents (integer outputs) are not traceable operands —
+    # close over them as constants; trace the inexact ones
+    const_cots = {}
+    traced_cots = []  # (output index, Tensor)
+    for i, c in enumerate(cots):
+        arr = c._data if isinstance(c, Tensor) else c
+        if isinstance(arr, np.ndarray) and arr.dtype == jax.dtypes.float0:
+            const_cots[i] = arr
+        else:
+            traced_cots.append((i, c if isinstance(c, Tensor) else Tensor(c)))
+
+    def grad_op(*args):
+        import jax as _jax
+
+        xs = list(aux)
+        for slot, a in zip(tensor_slots, args[:n_slots]):
+            xs[slot] = a
+        cs = [None] * n_out
+        for (i, _), a in zip(traced_cots, args[n_slots:]):
+            cs[i] = a
+        for i, a in const_cots.items():
+            cs[i] = a
+        _, vjp = _jax.vjp(primal, *xs)
+        gs = vjp(tuple(cs) if n_out > 1 else cs[0])
+        if n_slots == 1:
+            # single-output ops take a LEAF cotangent in backward(); a
+            # 1-tuple here would break the second-order vjp structure
+            return gs[tensor_slots[0]]
+        return tuple(gs[i] for i in tensor_slots)
+
+    in_arrays = tuple([aux[i] for i in tensor_slots]
+                      + [t._data for _, t in traced_cots])
+    record = is_grad_enabled() and (
+        any(not node.inputs[i].stop_gradient for i in tensor_slots)
+        or any(not t.stop_gradient or t._tape_node is not None
+               for _, t in traced_cots))
+    if record:
+        out, vjp_fn = jax.vjp(grad_op, *in_arrays)
+    else:
+        out = grad_op(*in_arrays)
+        vjp_fn = None
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    wrapped = [Tensor(o, stop_gradient=not record) for o in outs]
+    if record:
+        in_refs = tuple([node.inputs[i] for i in tensor_slots]
+                        + [InputRef(t) for _, t in traced_cots])
+        avals = [(o.shape, o.dtype) for o in outs]
+        gnode = TapeNode(in_refs, vjp_fn, avals,
+                         name=(node.name or "op") + "_grad",
+                         primal_fn=grad_op, in_arrays=in_arrays)
+        for i, w in enumerate(wrapped):
+            w._tape_node = gnode
+            w._tape_out_idx = i
+    full = [None] * len(node.inputs)
+    for i, slot in enumerate(tensor_slots):
+        full[slot] = wrapped[i]
+    return full
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False):
     """Run reverse accumulation from `tensors` (paddle.autograd.backward).
 
     Walks TapeNodes in decreasing id (a reverse topological order),
@@ -142,10 +236,17 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     cotangents, scattering the results into input tensors' `.grad` (leaves)
     or pending cotangent buffers (interior nodes) — the reference's
     ready-queue/GradTensorHolder dance (SURVEY.md §3.2).
+
+    create_graph=True routes each vjp through `_apply_op` (a recorded
+    grad op over the node's original inputs + cotangents), so the produced
+    grads carry a tape and can be differentiated again (double grad).
     """
     import jax.numpy as jnp
 
     from ..tensor import Tensor
+
+    if create_graph:
+        retain_graph = True
 
     if isinstance(tensors, Tensor):
         tensors = [tensors]
@@ -191,7 +292,13 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             heapq.heappush(heap, -node.id)
         slot = pending[node.id]
         if out_idx in slot:
-            slot[out_idx] = slot[out_idx] + cot
+            prev = slot[out_idx]
+            if isinstance(prev, Tensor) or isinstance(cot, Tensor):
+                a = prev if isinstance(prev, Tensor) else Tensor(prev)
+                b = cot if isinstance(cot, Tensor) else Tensor(cot)
+                slot[out_idx] = a + b
+            else:
+                slot[out_idx] = prev + cot
         else:
             slot[out_idx] = cot
 
@@ -213,12 +320,20 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 cotangents.append(slots[i])
             else:
                 cotangents.append(_zeros_like_aval(node.out_avals[i]))
-        cots = tuple(cotangents) if node.n_outputs > 1 else cotangents[0]
-        in_grads = node.vjp_fn(cots)
+        if create_graph:
+            # raises for non-re-derivable (PyLayer) nodes rather than
+            # silently returning graph-less (zero second-order) grads
+            in_grads = _vjp_as_recorded_op(node, cotangents)
+        else:
+            cotangents = [c._data if isinstance(c, Tensor) else c
+                          for c in cotangents]
+            cots = tuple(cotangents) if node.n_outputs > 1 else cotangents[0]
+            in_grads = node.vjp_fn(cots)
         for ref, g in zip(node.inputs, in_grads):
             if ref is None or g is None:
                 continue
-            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+            garr = g._data if isinstance(g, Tensor) else g
+            if isinstance(garr, np.ndarray) and garr.dtype == jax.dtypes.float0:
                 continue
             if ref.stop_gradient:
                 continue
@@ -226,17 +341,22 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             # tensor-level hooks fire as the grad flows through (ref:
             # Tensor.register_hook semantics)
             for hook in inp._grad_hooks:
-                out = hook(_wrap_grad(inp, g))
+                out = hook(g if isinstance(g, Tensor) else _wrap_grad(inp, g))
                 if out is not None:
-                    g = out._data if hasattr(out, "_data") else out
+                    g = out if create_graph else (
+                        out._data if hasattr(out, "_data") else out)
             if ref.node is not None:
                 _accumulate_into_node(ref.node, ref.out_idx, g)
             else:
-                _accumulate_leaf(inp, g)
+                _accumulate_leaf(inp, g, keep_graph=create_graph)
             if inp._retain_grads and ref.node is not None:
-                _accumulate_leaf(inp, g)
+                _accumulate_leaf(inp, g, keep_graph=create_graph)
         if not retain_graph:
             node.vjp_fn = _used_up
+            # release the residuals pinned for create_graph re-derivation
+            # too — a consumed graph cannot be re-walked anyway
+            node.primal_fn = None
+            node.in_arrays = None
 
     return None
 
@@ -254,13 +374,20 @@ def _wrap_grad(like, g):
     return Tensor(g, stop_gradient=True)
 
 
-def _accumulate_leaf(t, g):
+def _accumulate_leaf(t, g, keep_graph=False):
     from ..tensor import Tensor
 
+    if keep_graph:
+        # create_graph: .grad carries its producing tape so it can be
+        # differentiated again (double grad)
+        gt = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        t.grad = gt if t.grad is None else t.grad + gt
+        return
+    garr = g._data if isinstance(g, Tensor) else g
     if t.grad is None:
-        t.grad = Tensor(g, stop_gradient=True)
+        t.grad = Tensor(garr, stop_gradient=True)
     else:
-        t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+        t.grad = Tensor(t.grad._data + garr, stop_gradient=True)
 
 
 def grad(
@@ -276,16 +403,11 @@ def grad(
     """paddle.grad: gradients of outputs w.r.t. inputs, returned (not stored).
 
     Implemented by running the tape walk but collecting into a side dict for
-    `inputs` instead of `.grad`. `create_graph=True` (higher-order eager
-    grads) is not implemented yet — raise rather than silently return a
-    disconnected graph; under jit, higher-order derivatives are available
-    through jax.grad composition.
+    `inputs` instead of `.grad`. With `create_graph=True` the walk routes
+    every vjp through a recorded grad op (see backward), so the returned
+    grads carry a tape and can be differentiated again — the reference's
+    double-grad (`paddle/fluid/eager` higher-order) semantics.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported "
-            "yet; compose jax-level grads via the jit path instead"
-        )
     from ..tensor import Tensor
 
     single_out = isinstance(outputs, Tensor)
@@ -302,7 +424,8 @@ def grad(
         t.grad = None
         t._retain_grads = True
     try:
-        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph)
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=retain_graph, create_graph=create_graph)
         results = []
         for t in inputs:
             if t.grad is None:

@@ -25,7 +25,10 @@ def init_parallel_env():
     if _initialized:
         return
     n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if n_procs > 1 and jax.process_count() == 1:
+    if n_procs > 1 and not _distributed_client_up():
+        # NOTE: nothing before this point may touch the XLA backend —
+        # jax.distributed.initialize() must run before the first
+        # jax.devices()/process_count()/computation in the process
         coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
             "MASTER_ADDR")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
@@ -38,6 +41,18 @@ def init_parallel_env():
                 process_id=rank,
             )
     _initialized = True
+
+
+def _distributed_client_up() -> bool:
+    """Whether jax.distributed is already initialized, WITHOUT touching the
+    XLA backend (jax.process_count() would initialize it and make a later
+    jax.distributed.initialize impossible)."""
+    try:
+        return jax.distributed.is_initialized()
+    except AttributeError:  # older jax
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
 
 
 def _gather_endpoints(rank: int, world: int, timeout: float = None) -> None:

@@ -46,10 +46,12 @@ from .sharding_utils import get_param_spec
 
 
 def _pcast_varying(x, axis_name):
-    """Mark x as varying over the manual axis (scan carry requirement)."""
+    """Mark x as varying over the manual axis (scan carry requirement).
+    Idempotent: already-varying values pass through (pcast rejects
+    varying->varying with a ValueError)."""
     try:
         return jax.lax.pcast(x, (axis_name,), to="varying")
-    except (AttributeError, TypeError):
+    except (AttributeError, TypeError, ValueError):
         return x
 
 
@@ -226,20 +228,37 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
                 jnp.where(fwd_valid, x_, b_[slot_f])), buf, x)
             y = stage_fn(local_params, x)
 
-            # ---- head (+ initial cotangent) at the last stage ----
+            # ---- head (+ initial cotangent), ONLY at the last stage ----
+            # lax.cond with a device-varying predicate: non-last stages
+            # skip the vocab-projection + CE fwd/vjp entirely (a masked
+            # dense computation would waste (S-1)/S of all head FLOPs).
+            # All devices of a tp group share a pp stage index, so the
+            # GSPMD-auto tp collectives inside the branch cannot deadlock.
             tgt = tm(lambda a: a[idx_f], targets)
+            head_valid = is_last & fwd_valid
 
             def head_loss(hp, y_):
                 return head_fn(hp, y_, tgt)
 
-            loss_m, head_vjp = jax.vjp(head_loss, head_params, y)
-            d_hp_m, d_y = head_vjp(_pcast_varying(
-                jnp.asarray(inv_m, loss_m.dtype), axis_name))
-            head_valid = is_last & fwd_valid
-            loss_acc = loss_acc + jnp.where(
-                head_valid, loss_m.astype(jnp.float32), 0.0)
-            d_head = tm(lambda a, g: a + jnp.where(
-                head_valid, g.astype(jnp.float32), 0.0), d_head, d_hp_m)
+            def do_head(y_):
+                loss_m, head_vjp = jax.vjp(head_loss, head_params, y_)
+                d_hp_m, d_y = head_vjp(_pcast_varying(
+                    jnp.asarray(inv_m, loss_m.dtype), axis_name))
+                return loss_m.astype(jnp.float32), d_hp_m, d_y
+
+            def skip_head(y_):
+                zl = _pcast_varying(jnp.zeros((), jnp.float32), axis_name)
+                zh = tm(lambda p: _pcast_varying(
+                    jnp.zeros(p.shape, p.dtype), axis_name), head_params)
+                zy = tm(lambda a: _pcast_varying(
+                    jnp.zeros_like(a), axis_name), y_)
+                return zl, zh, zy
+
+            loss_m, d_hp_m, d_y = jax.lax.cond(
+                head_valid, do_head, skip_head, y)
+            loss_acc = loss_acc + loss_m
+            d_head = tm(lambda a, g: a + g.astype(jnp.float32),
+                        d_head, d_hp_m)
 
             # ---- backward slot (remat from the saved stage input) ----
             m_b = t - (2 * S - 2 - stage)

@@ -116,6 +116,9 @@ def build_pipeline_train_step(model: Layer, optimizer,
         # the 1f1b path does not track buffer (BN-stat) updates inside the
         # schedule; models with buffers keep the autodiff path by default
         schedule = "gpipe" if dict(model.named_buffers()) else "1f1b"
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; use '1f1b' or 'gpipe'")
     # default M: the largest count <= 2*pp dividing the CURRENT batch,
     # re-derived per call (jit retraces per input shape, so a trailing
     # partial batch picks a valid M instead of crashing); the reference

@@ -124,6 +124,9 @@ def _install_tensor_methods():
     Tensor.normal_ = random_ops.normal_
     Tensor.exponential_ = random_ops.exponential_
     Tensor.bernoulli_ = random_ops.bernoulli_
+    Tensor.geometric_ = random_ops.geometric_
+    Tensor.cauchy_ = random_ops.cauchy_
+    Tensor.log_normal_ = random_ops.log_normal_
 
     # a few names that collide with properties/builtins
     Tensor.matmul = lambda s, y, transpose_x=False, transpose_y=False: linalg.matmul(

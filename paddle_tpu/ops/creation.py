@@ -210,3 +210,17 @@ def one_hot(x, num_classes, name=None):
         jnn.one_hot(as_array(x), int(num_classes),
                     dtype=_dtype.to_np_dtype(_config.get_default_dtype()))
     )
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (paddle.vander; reference:
+    python/paddle/tensor/creation.py)."""
+    cols = int(n) if n is not None else as_array(x).shape[0]
+
+    def f(a):
+        powers = jnp.arange(cols, dtype=a.dtype)
+        if not increasing:
+            powers = powers[::-1]
+        return a[:, None] ** powers[None, :]
+
+    return _apply_op(f, x, _name="vander")

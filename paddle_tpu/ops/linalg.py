@@ -340,3 +340,28 @@ def householder_product(x, tau, name=None):
         return q[..., :, :n]
 
     return _apply_op(f, x, tau, _name="householder_product")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of an [N, D] matrix: the strict upper
+    triangle of cdist(x, x) — one distance kernel, shared (paddle.pdist)."""
+    n = as_array(x).shape[0]
+    full = cdist(x, x, p=p)
+
+    def take_triu(d):
+        iu, ju = jnp.triu_indices(n, k=1)
+        return d[iu, ju]
+
+    return _apply_op(take_triu, full, _name="pdist")
+
+
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
+    """Bin edges as numpy.histogram_bin_edges with fixed count (paddle)."""
+    a = as_array(input)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo = float(jnp.min(a))
+        hi = float(jnp.max(a))
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    return Tensor(jnp.linspace(lo, hi, int(bins) + 1, dtype=jnp.float32))

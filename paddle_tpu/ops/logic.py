@@ -97,3 +97,15 @@ def in1d(x, test, name=None):
 
 
 isin = in1d
+
+
+def is_complex(x, name=None):
+    return bool(jnp.issubdtype(as_array(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x, name=None):
+    return bool(jnp.issubdtype(as_array(x).dtype, jnp.floating))
+
+
+def is_integer(x, name=None):
+    return bool(jnp.issubdtype(as_array(x).dtype, jnp.integer))

@@ -606,3 +606,97 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_shard, a - lo, ignore_value)
 
     return Tensor(f(as_array(input)))
+
+
+# --- round-2 op-surface completion (python/paddle/tensor/manipulation.py) ---
+
+
+def hsplit(x, num_or_indices, name=None):
+    """Split horizontally: axis 1 for ndim>=2, axis 0 for 1-D. A list
+    argument gives split INDICES (tensor_split / numpy semantics), not
+    section sizes (paddle.hsplit)."""
+    nd = as_array(x).ndim
+    if nd < 1:
+        raise ValueError("hsplit expects ndim >= 1")
+    return tensor_split(x, num_or_indices, axis=1 if nd > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    if as_array(x).ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    if as_array(x).ndim < 3:
+        raise ValueError("dsplit expects ndim >= 3")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand dim `axis` into `shape` (paddle.unflatten); one -1 inferred."""
+    a_shape = list(as_array(x).shape)
+    axis = int(axis) % len(a_shape)
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s)
+             for s in shape]
+    if shape.count(-1) == 1:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = a_shape[axis] // known
+    new_shape = a_shape[:axis] + shape + a_shape[axis + 1:]
+    return _apply_op(lambda a: jnp.reshape(a, new_shape), x,
+                     _name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows of `size` every `step` along `axis`, appended as a
+    new LAST dim (paddle.unfold / torch.Tensor.unfold semantics)."""
+    a_shape = as_array(x).shape
+    axis = int(axis) % len(a_shape)
+    size, step = int(size), int(step)
+    n = (a_shape[axis] - size) // step + 1
+
+    def f(a):
+        idx = (jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :])
+        win = jnp.take(a, idx.reshape(-1), axis=axis)
+        win = jnp.reshape(
+            win, a.shape[:axis] + (n, size) + a.shape[axis + 1:])
+        # move the window dim to the end
+        return jnp.moveaxis(win, axis + 1, -1)
+
+    return _apply_op(f, x, _name="unfold")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write `values` into x at `index` along `axis` (paddle.select_scatter)."""
+    axis_ = int(axis)
+    idx = int(index.item()) if isinstance(index, Tensor) else int(index)
+
+    def f(a, v):
+        import builtins
+
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis_] = idx
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+
+    return _apply_op(f, x, values, _name="select_scatter")
+
+
+def as_complex(x, name=None):
+    """[..., 2] float -> [...] complex (paddle.as_complex)."""
+    return _apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                     _name="as_complex")
+
+
+def as_real(x, name=None):
+    """[...] complex -> [..., 2] float (paddle.as_real)."""
+    return _apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)],
+                                         axis=-1), x, _name="as_real")
+
+
+def tolist(x, name=None):
+    import numpy as _np
+
+    return _np.asarray(as_array(x)).tolist()

@@ -353,3 +353,98 @@ def igamma(x, a, name=None):
 def igammac(x, a, name=None):
     return _apply_op(lambda xx, aa: jax.scipy.special.gammainc(xx, aa), x, a,
                      _name="igammac")
+
+
+# --- special functions & misc (python/paddle/tensor/math.py parity,
+# round-2 op-surface completion) ---
+
+i0e = _unop(jax.scipy.special.i0e, "i0e")
+i1 = _unop(jax.scipy.special.i1, "i1")
+i1e = _unop(jax.scipy.special.i1e, "i1e")
+sinc = _unop(jnp.sinc, "sinc")
+signbit = _unop(jnp.signbit, "signbit")
+isneginf = _unop(jnp.isneginf, "isneginf")
+isposinf = _unop(jnp.isposinf, "isposinf")
+gammainc = _binop(jax.scipy.special.gammainc, "gammainc")
+gammaincc = _binop(jax.scipy.special.gammaincc, "gammaincc")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return _apply_op(f, x, _name="logit")
+
+
+def multigammaln(x, p, name=None):
+    return _apply_op(lambda a: jax.scipy.special.multigammaln(a, p), x,
+                     _name="multigammaln")
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+
+    return _apply_op(f, x, _name="frexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _apply_op(lambda y_, x_: jnp.trapezoid(y_, x_, axis=axis),
+                         y, x, _name="trapezoid")
+    step = 1.0 if dx is None else dx
+    return _apply_op(lambda y_: jnp.trapezoid(y_, dx=step, axis=axis), y,
+                     _name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def seg(y_, x_=None):
+        y0 = jax.lax.slice_in_dim(y_, 0, y_.shape[axis] - 1, axis=axis)
+        y1 = jax.lax.slice_in_dim(y_, 1, y_.shape[axis], axis=axis)
+        if x_ is not None:
+            x0 = jax.lax.slice_in_dim(x_, 0, x_.shape[axis] - 1, axis=axis)
+            x1 = jax.lax.slice_in_dim(x_, 1, x_.shape[axis], axis=axis)
+            d = x1 - x0
+        else:
+            d = 1.0 if dx is None else dx
+        return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)
+
+    if x is not None:
+        return _apply_op(seg, y, x, _name="cumulative_trapezoid")
+    return _apply_op(seg, y, _name="cumulative_trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize each slice along `axis` to at most max_norm in p-norm
+    (reference: paddle.renorm)."""
+    def f(a):
+        perm_axis = axis % a.ndim
+        red = tuple(i for i in range(a.ndim) if i != perm_axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return _apply_op(f, x, _name="renorm")
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: paddle.add_n)."""
+    if isinstance(inputs, (list, tuple)):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = add(out, t)
+        return out
+    return inputs
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(as_array(x).ndim, dtype=jnp.int64))
+
+
+def inverse(x, name=None):
+    from .linalg import inv
+
+    return inv(x)

@@ -178,3 +178,11 @@ def log_normal_(x, mean=1.0, std=2.0, name=None):
     z = jax.random.normal(key, tuple(x.shape), dtype=x._data.dtype)
     x._rebind(jnp.exp(mean + std * z))
     return x
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (paddle.standard_gamma)."""
+    from ..framework import random as _random
+
+    a = as_array(x)
+    return Tensor(jax.random.gamma(_random.next_key(), a, dtype=a.dtype))

@@ -96,6 +96,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # static-graph capture: append a symbolic update step (the
+        # append_backward + optimizer-op analog) instead of running eagerly
+        from ..static import capture_minimize, in_capture
+
+        if in_capture():
+            capture_minimize(self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,10 +1,26 @@
-"""paddle.static shim (SURVEY.md §2.2 "Static API").
+"""paddle.static — real deferred-graph execution (SURVEY.md §2.2 "Static
+API"; reference: python/paddle/static/ Program/Executor + ProgramDesc,
+SURVEY.md §3.3).
 
-The reference's static graph (ProgramDesc + Executor) is subsumed by jit:
-a Program here is a deferred trace — ops recorded by running the user's
-build function lazily at first Executor.run, compiled by XLA. The surface
-(Program, program_guard, data, Executor.run(feed, fetch_list)) matches the
-reference so static-style scripts run; new code should use @to_static.
+TPU-native design: a Program IS an op-record list captured at the
+`_apply_op` chokepoint while the user's build code runs under
+`program_guard` (the analog of ops being appended to a ProgramDesc block).
+`Executor.run` replays the records as a PURE function of (feeds, external
+state) and compiles it with `jax.jit` — the XLA executable cache plays
+InterpreterCore's program cache, and `jax.grad` over the replayed subgraph
+plays `append_backward`. `Optimizer.minimize` inside a capture appends a
+symbolic update step instead of executing eagerly.
+
+Semantics notes (documented deltas from the reference):
+- build-time placeholder values are zeros; Python control flow on *values*
+  in build code follows the zero branch (the reference has no values at
+  build time at all — same contract, different failure mode);
+- AMP auto-cast decisions made during build are baked into the records
+  (the record-time operand dtypes are re-applied on replay); RNG draws
+  made during build are constants (per-run re-randomization needs
+  eager/@to_static mode);
+- in-place updates on *buffers* made outside `_apply_op` (BN running
+  stats) are not replayed.
 """
 from __future__ import annotations
 
@@ -17,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as _dtype
-from ..tensor import Tensor
+from .. import tensor as _tensor_mod
+from ..tensor import Tensor, as_array
 from .. import nn as _nn
 
 _tls = threading.local()
@@ -38,7 +55,7 @@ class InputSpec:
 
 
 class _DataPlaceholder(Tensor):
-    """Symbolic input: carries spec; gets fed at Executor.run."""
+    """Symbolic input: carries spec; fed at Executor.run."""
 
     def __init__(self, name, shape, dtype):
         shape_concrete = [1 if (s is None or s < 0) else s for s in shape]
@@ -51,13 +68,96 @@ class _DataPlaceholder(Tensor):
 
 
 class Program:
+    """Captured op list + variable registry (the ProgramDesc analog)."""
+
     def __init__(self):
         self.placeholders: Dict[str, _DataPlaceholder] = {}
-        self.build_fns: List[Callable] = []
-        self.fetch_targets: List[Tensor] = []
-        self._build_fn = None
         self.random_seed = None
+        # capture state
+        self.records: List[tuple] = []  # (f, in_refs, out_ids, name)
+        self.minimize_records: List[tuple] = []  # (optimizer, loss_vid)
+        self._var_of_tensor: Dict[int, int] = {}  # id(Tensor) -> var id
+        self._externals: Dict[int, Tensor] = {}  # var id -> live Tensor
+        self._feed_vars: Dict[str, int] = {}  # name -> var id
+        self._keepalive: List[Tensor] = []
+        self._next_var = 0
+        self._opt_states: Dict[int, Any] = {}  # per minimize record
+        self._compiled_cache: Dict[Any, Any] = {}
 
+    # -- variable registry -------------------------------------------------
+    def _new_var(self, tensor: Optional[Tensor]) -> int:
+        vid = self._next_var
+        self._next_var += 1
+        if tensor is not None:
+            self._var_of_tensor[id(tensor)] = vid
+            self._keepalive.append(tensor)
+        return vid
+
+    def _ref_of(self, tensor: Tensor) -> int:
+        """Var id of a build-time tensor; unseen tensors become EXTERNAL
+        inputs (parameters/buffers/eager constants) seeded from the live
+        tensor's current value at each run — so optimizer updates persist
+        and pre-trained weights are picked up."""
+        vid = self._var_of_tensor.get(id(tensor))
+        if vid is None:
+            vid = self._new_var(tensor)
+            self._externals[vid] = tensor
+        return vid
+
+    def _register_placeholder(self, ph: _DataPlaceholder):
+        vid = self._new_var(ph)
+        self._feed_vars[ph.name] = vid
+        self.placeholders[ph.name] = ph
+
+    # -- capture hook (installed while this program is under guard) --------
+    def _record(self, f, inputs, outputs, name, in_dtypes=None):
+        in_refs = []
+        for x in inputs:
+            if isinstance(x, Tensor):
+                in_refs.append(("var", self._ref_of(x)))
+            else:
+                in_refs.append(("const", jnp.asarray(x)))
+        out_ids = [self._new_var(t) for t in outputs]
+        if in_dtypes is not None:
+            # bake the record-time operand dtypes (AMP auto-cast result)
+            # into the replayed callable so replay matches build numerics
+            inner = f
+
+            def f(*args, _inner=inner, _dts=in_dtypes):
+                cast = [a.astype(d) if (d is not None
+                                        and hasattr(a, "astype")
+                                        and a.dtype != d) else a
+                        for a, d in zip(args, _dts)]
+                return _inner(*cast)
+
+        self.records.append((f, in_refs, out_ids, name))
+
+    # -- replay ------------------------------------------------------------
+    def _replay(self, env: Dict[int, Any], records=None) -> Dict[int, Any]:
+        for f, in_refs, out_ids, _name in (self.records if records is None
+                                           else records):
+            args = [env[r] if kind == "var" else r for kind, r in in_refs]
+            outs = f(*args)
+            if not isinstance(outs, (tuple, list)):
+                outs = [outs]
+            for vid, o in zip(out_ids, outs):
+                env[vid] = o
+        return env
+
+    def _prune(self, fetch_vids):
+        """Records + input vars needed to compute fetch_vids (dead-op
+        elimination — the fetch-driven subgraph, as the reference's
+        Executor prunes the program by fetch targets)."""
+        needed = set(fetch_vids)
+        keep = []
+        for rec in reversed(self.records):
+            _f, in_refs, out_ids, _name = rec
+            if any(o in needed for o in out_ids):
+                keep.append(rec)
+                needed.update(r for k, r in in_refs if k == "var")
+        return list(reversed(keep)), needed
+
+    # -- paddle API surface ------------------------------------------------
     def global_block(self):
         return self
 
@@ -65,7 +165,8 @@ class Program:
         return self
 
     def __repr__(self):
-        return f"Program(inputs={list(self.placeholders)})"
+        return (f"Program(inputs={list(self.placeholders)}, "
+                f"ops={len(self.records)})")
 
 
 _default_main = Program()
@@ -80,48 +181,166 @@ def default_startup_program():
     return getattr(_tls, "startup", _default_startup)
 
 
+def _capture_program() -> Optional[Program]:
+    return getattr(_tls, "capture", None)
+
+
+def in_capture() -> bool:
+    return _capture_program() is not None
+
+
+def _capture_hook(f, inputs, outputs, name, in_dtypes=None):
+    prog = _capture_program()
+    if prog is not None:
+        prog._record(f, inputs, outputs, name, in_dtypes)
+
+
+def capture_minimize(optimizer, loss: Tensor):
+    """Called by Optimizer.minimize under a program guard: append a
+    symbolic update step (the append_backward + optimizer-op analog)."""
+    prog = _capture_program()
+    loss_vid = prog._var_of_tensor.get(id(loss))
+    if loss_vid is None:
+        raise ValueError("minimize(loss): loss is not a var of the current "
+                         "static program")
+    prog.minimize_records.append((optimizer, loss_vid))
+
+
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
     prev_m = getattr(_tls, "main", _default_main)
     prev_s = getattr(_tls, "startup", _default_startup)
+    prev_c = getattr(_tls, "capture", None)
     _tls.main = main_program
     _tls.startup = startup_program or _default_startup
+    _tls.capture = main_program
+    _tensor_mod._static_capture_hook = _capture_hook
     try:
         yield
     finally:
         _tls.main = prev_m
         _tls.startup = prev_s
+        _tls.capture = prev_c
+        if prev_c is None:
+            _tensor_mod._static_capture_hook = None
 
 
 def data(name, shape, dtype="float32", lod_level=0):
     ph = _DataPlaceholder(name, shape, dtype)
-    default_main_program().placeholders[name] = ph
+    prog = _capture_program() or default_main_program()
+    prog._register_placeholder(ph)
     return ph
 
 
 class Executor:
-    """Eager-replay executor: `run(program, feed, fetch_list)` re-binds the
-    placeholders and re-executes the captured build closure. The XLA
-    executable cache plays the role of InterpreterCore's program cache."""
+    """Compiles and runs captured Programs (InterpreterCore analog: one
+    jitted pure function per (program, feed-shape) key)."""
 
     def __init__(self, place=None):
         self.place = place
 
-    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        if isinstance(program, _LoadedInferenceProgram):
+            return program._run(feed, fetch_list, return_numpy)
+        if not program.records:
+            return []  # startup program: params already initialized eagerly
+
+        feed_arrays = {}
         for name, value in feed.items():
-            ph = program.placeholders.get(name)
-            if ph is None:
+            if name not in program._feed_vars:
                 continue
-            arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
-            ph._rebind(jnp.asarray(arr))
-        if program._build_fn is not None:
-            fetch_list = program._build_fn() or fetch_list
+            arr = value._data if isinstance(value, Tensor) \
+                else jnp.asarray(value)
+            feed_arrays[name] = arr
+
+        ext_ids = sorted(program._externals)
+        ext_arrays = {vid: as_array(program._externals[vid])
+                      for vid in ext_ids}
+
+        # trainable param vars per minimize record
+        min_specs = []
+        for ridx, (opt, loss_vid) in enumerate(program.minimize_records):
+            pvids = []
+            for p in opt._parameter_list or []:
+                vid = program._var_of_tensor.get(id(p))
+                if vid is not None and vid in program._externals \
+                        and not p.stop_gradient:
+                    pvids.append(vid)
+            if ridx not in program._opt_states:
+                program._opt_states[ridx] = opt.init_state_pytree(
+                    {str(v): ext_arrays[v] for v in pvids})
+            min_specs.append((opt, loss_vid, tuple(pvids)))
+
+        fetch_list = fetch_list or []
+        fetch_vids = []
+        for t in fetch_list:
+            fetch_vids.append(program._var_of_tensor.get(id(t)))
+
+        # key includes the program's op/minimize state: records appended
+        # after a run (more ops, a new minimize) must trigger a rebuild
+        key = (tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(fetch_vids),
+               len(program.records), len(program.minimize_records))
+        compiled = program._compiled_cache.get(key)
+        if compiled is None:
+            compiled = self._build(program, min_specs, fetch_vids)
+            program._compiled_cache[key] = compiled
+
+        lrs = [jnp.asarray(opt.get_lr(), jnp.float32)
+               for opt, _, _ in min_specs]
+        states = [program._opt_states[i] for i in range(len(min_specs))]
+        fetches, new_ext, new_states = compiled(
+            feed_arrays, ext_arrays, states, lrs)
+
+        # persist: write updated externals back into the live tensors
+        for vid, arr in new_ext.items():
+            program._externals[vid]._rebind(arr)
+        for i, st in enumerate(new_states):
+            program._opt_states[i] = st
+        for opt, _, _ in min_specs:
+            opt._step_count += 1
+
         outs = []
-        for t in fetch_list or []:
-            outs.append(t.numpy() if return_numpy else t)
+        for t, vid in zip(fetch_list, fetch_vids):
+            arr = fetches[vid] if vid is not None else as_array(t)
+            outs.append(np.asarray(arr) if return_numpy else Tensor(arr))
         return outs
+
+    def _build(self, program, min_specs, fetch_vids):
+        def pure(feed_arrays, ext_arrays, states, lrs):
+            env = dict(ext_arrays)
+            for n, a in feed_arrays.items():
+                env[program._feed_vars[n]] = a
+            env = program._replay(env)
+
+            new_ext = dict(ext_arrays)
+            new_states = []
+            for (opt, loss_vid, pvids), state, lr in zip(
+                    min_specs, states, lrs):
+                def loss_fn(pdict):
+                    e2 = dict(new_ext)
+                    e2.update({int(k): v for k, v in pdict.items()})
+                    for n, a in feed_arrays.items():
+                        e2[program._feed_vars[n]] = a
+                    e2 = program._replay(e2)
+                    return e2[loss_vid]
+
+                pdict = {str(v): new_ext[v] for v in pvids}
+                grads = jax.grad(lambda pd: loss_fn(pd))(pdict)
+                new_p, new_state = opt.apply_gradients_functional(
+                    pdict, grads, state, lr)
+                new_ext.update({int(k): v for k, v in new_p.items()})
+                new_states.append(new_state)
+
+            fetches = {vid: env[vid] for vid in fetch_vids
+                       if vid is not None}
+            return fetches, new_ext, new_states
+
+        return jax.jit(pure)
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
@@ -149,19 +368,125 @@ def cuda_places(device_ids=None):
     return [TPUPlace(0)]
 
 
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Static-mode backward marker. Under this design gradients are taken
+    with jax.grad over the replayed program inside Executor.run (driven by
+    Optimizer.minimize); append_backward alone is a no-op kept for script
+    compatibility."""
+    return []
+
+
+# ---------------------------------------------------------------------------
+# inference save/load (reference: paddle.static.save/load_inference_model →
+# ProgramDesc + persistables; here: jax.export StableHLO + pickled weights)
+# ---------------------------------------------------------------------------
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None):
-    from .. import jit as _jit
+    """Export the captured forward (feeds -> fetches) as serialized
+    StableHLO with current weights baked in as inputs."""
+    import os
+    import pickle
 
-    raise NotImplementedError(
-        "save_inference_model: use paddle.jit.save (StableHLO export)"
-    )
+    program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    feed_names = [ph.name for ph in feed_vars]
+    fetch_vids = [program._var_of_tensor[id(t)] for t in fetch_vars]
+    records, needed = program._prune(fetch_vids)
+    ext_arrays = {vid: as_array(t)
+                  for vid, t in program._externals.items() if vid in needed}
+
+    def infer_fn(ext, *feeds):
+        # jax.export serialization needs string pytree keys
+        env = {int(k): v for k, v in ext.items()}
+        for name, a in zip(feed_names, feeds):
+            env[program._feed_vars[name]] = a
+        env = program._replay(env, records)
+        return [env[v] for v in fetch_vids]
+
+    from jax import export as jexport
+
+    ext_specs = {str(vid): jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for vid, a in ext_arrays.items()}
+
+    def _feed_specs(symbolic):
+        specs = []
+        scope = jexport.SymbolicScope() if symbolic else None
+        n_sym = 0
+        for ph in feed_vars:
+            dims = []
+            for i, d in enumerate(ph.spec_shape):
+                if symbolic and (d is None or d < 0):
+                    dims.append(f"d{n_sym}")
+                    n_sym += 1
+                else:
+                    dims.append(str(ph._data.shape[i] if (d is None or d < 0)
+                                    else d))
+            if symbolic and scope is not None:
+                shape = jexport.symbolic_shape(",".join(dims), scope=scope)
+            else:
+                shape = tuple(int(d) for d in dims)
+            specs.append(jax.ShapeDtypeStruct(shape, ph._data.dtype))
+        return specs
+
+    try:
+        # None dims export shape-polymorphic (the reference's -1 batch dim)
+        exported = jexport.export(jax.jit(infer_fn))(
+            ext_specs, *_feed_specs(symbolic=True))
+    except Exception:
+        # graph not shape-poly (baked reshapes etc.): concrete fallback
+        exported = jexport.export(jax.jit(infer_fn))(
+            ext_specs, *_feed_specs(symbolic=False))
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"ext": {str(vid): np.asarray(a)
+                             for vid, a in ext_arrays.items()},
+                     "feed_names": feed_names,
+                     "n_fetch": len(fetch_vids)}, f)
+
+
+class _LoadedInferenceProgram:
+    """Deserialized inference program; Executor.run dispatches to it."""
+
+    def __init__(self, exported, ext, feed_names, n_fetch):
+        self._exported = exported
+        self._ext = ext
+        self.feed_names = feed_names
+        self._n_fetch = n_fetch
+
+    def _run(self, feed, fetch_list, return_numpy=True):
+        feeds = []
+        for n in self.feed_names:
+            v = feed[n]
+            feeds.append(v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        outs = self._exported.call(self._ext, *feeds)
+        outs = list(outs)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
 
 
 def load_inference_model(path_prefix, executor):
-    raise NotImplementedError(
-        "load_inference_model: use paddle.jit.load (StableHLO import)"
-    )
+    """Returns [program, feed_target_names, fetch_targets] (paddle API)."""
+    import pickle
+
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    ext = {vid: jnp.asarray(a) for vid, a in meta["ext"].items()}
+    prog = _LoadedInferenceProgram(exported, ext, meta["feed_names"],
+                                   meta["n_fetch"])
+    fetch_targets = list(range(meta["n_fetch"]))
+    return [prog, prog.feed_names, fetch_targets]
 
 
 nn = _nn  # paddle.static.nn compatibility alias (layers work in both modes)

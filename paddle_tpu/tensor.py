@@ -374,6 +374,10 @@ def as_tensor_list(xs):
     return [x if isinstance(x, Tensor) else Tensor(x) for x in xs]
 
 
+# set by paddle_tpu.static when a Program capture is active; None otherwise
+_static_capture_hook = None
+
+
 def _requires_grad(x) -> bool:
     return isinstance(x, Tensor) and not x.stop_gradient
 
@@ -444,12 +448,24 @@ def _apply_op(fn, *inputs, _name: str = "", **static_kwargs):
 
     wrapped = [Tensor(o, stop_gradient=not record) for o in outs]
 
+    # static-graph capture (paddle.static Program deferred trace): when a
+    # Program capture is active, append this op (the closed-over callable +
+    # operand refs) to its record list so Executor.run can replay it as a
+    # pure jitted function of (feeds, params). See static/__init__.py.
+    # The record-time operand dtypes travel with the op so replay
+    # re-applies the same AMP auto-cast decisions (arrays vs inputs).
+    if _static_capture_hook is not None:
+        _static_capture_hook(f, inputs, wrapped, _name or fn.__name__,
+                             tuple(getattr(a, "dtype", None) for a in arrays))
+
     if record:
         in_tensors = tuple(
             _tape.InputRef(x) if isinstance(x, Tensor) else None for x in inputs
         )
         avals = [(o.shape, o.dtype) for o in outs]
-        node = _tape.TapeNode(in_tensors, vjp_fn, avals, name=_name or fn.__name__)
+        node = _tape.TapeNode(in_tensors, vjp_fn, avals,
+                              name=_name or fn.__name__,
+                              primal_fn=f, in_arrays=arrays)
         for i, w in enumerate(wrapped):
             w._tape_node = node
             w._tape_out_idx = i

@@ -138,3 +138,73 @@ class TestInplace:
         expect = np.ones(4, "float32")
         expect[1] = 0.0
         np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+class TestDoubleGrad:
+    """create_graph=True higher-order eager grads (reference: paddle.grad
+    double-grad via the eager engine's recorded grad nodes)."""
+
+    def test_second_derivative_cubic(self):
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.asarray([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = (x ** 3).sum()
+        (g1,) = paddle.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), 3 * np.asarray([4.0, 9.0]),
+                                   rtol=1e-5)
+        (g2,) = paddle.grad(g1.sum(), [x])
+        np.testing.assert_allclose(g2.numpy(), 6 * np.asarray([2.0, 3.0]),
+                                   rtol=1e-5)
+
+    def test_second_derivative_chain(self):
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.asarray([0.5], np.float32),
+                             stop_gradient=False)
+        y = paddle.exp(paddle.sin(x)).sum()
+        (g1,) = paddle.grad(y, [x], create_graph=True)
+        (g2,) = paddle.grad(g1, [x])
+        xv = 0.5
+        # d2/dx2 exp(sin x) = exp(sin x) (cos^2 x - sin x)
+        ref = np.exp(np.sin(xv)) * (np.cos(xv) ** 2 - np.sin(xv))
+        np.testing.assert_allclose(g2.numpy(), [ref], rtol=1e-4)
+
+    def test_gradient_penalty_pattern(self):
+        """WGAN-GP style: grad-norm penalty differentiated through params."""
+        import paddle_tpu as paddle
+
+        paddle.seed(0)
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 3).astype(np.float32),
+            stop_gradient=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3).astype(np.float32),
+            stop_gradient=False)
+        out = paddle.matmul(x, w).sum()
+        (gx,) = paddle.grad(out, [x], create_graph=True)
+        penalty = (gx ** 2).sum()
+        (gw,) = paddle.grad(penalty, [w])
+        # d out/dx = w summed over cols -> penalty = sum_j (sum_k w[j,k])^2
+        # independent per row: d penalty/d w[j,k] = 2 * 2 * rowsum... rows
+        # of x are 2 -> gx shape [2,3]; each row identical = colsum of w^T
+        wv = w.numpy()
+        row = wv.sum(axis=1)  # d out / dx[i,j] = sum_k w[j,k]
+        ref = np.zeros_like(wv)
+        for j in range(3):
+            for k in range(3):
+                ref[j, k] = 2 * row[j] * 2  # two batch rows
+        np.testing.assert_allclose(gw.numpy(), ref, rtol=1e-4)
+
+    def test_backward_create_graph_on_grad_field(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import tape
+
+        x = paddle.to_tensor(np.asarray([1.5], np.float32),
+                             stop_gradient=False)
+        y = (x ** 4).sum()
+        tape.backward(y, create_graph=True)
+        g = x.grad
+        assert g is not None and g._tape_node is not None
+        (g2,) = paddle.grad(g.sum(), [x])
+        np.testing.assert_allclose(g2.numpy(), [12 * 1.5 ** 2], rtol=1e-5)

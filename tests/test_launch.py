@@ -137,3 +137,95 @@ def test_spawn_runs_ranks(tmp_path):
     assert r.returncode == 0, r.stderr
     assert (tmp_path / "r0").read_text() == "2"
     assert (tmp_path / "r1").read_text() == "2"
+
+
+MULTIHOST_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, os.environ.get("PADDLE_REPO_ROOT", "."))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    out = sys.argv[1]
+    dist.init_parallel_env()   # jax.distributed.initialize rendezvous
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+
+    import paddle_tpu.distributed.mesh as mesh_mod
+    # one device per process (pytest's XLA_FLAGS grants 8 per host)
+    byproc = {}
+    for d in jax.devices():
+        byproc.setdefault(d.process_index, d)
+    mesh = mesh_mod.build_mesh(
+        dp=2, devices=np.asarray([byproc[i] for i in sorted(byproc)]))
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    W0 = rng.randn(4, 1).astype(np.float32) * 0.1
+
+    sh = NamedSharding(mesh, P("dp"))
+    xg = jax.make_array_from_process_local_data(sh, X[rank * 4:(rank + 1) * 4])
+    yg = jax.make_array_from_process_local_data(sh, Y[rank * 4:(rank + 1) * 4])
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w_):
+            return jnp.mean((x @ w_ - y) ** 2)
+        l, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, l
+
+    w = jnp.asarray(W0)
+    losses = []
+    for _ in range(4):
+        w, l = step(w, xg, yg)
+        losses.append(float(l))   # cross-process psum under the hood
+
+    with open(os.path.join(out, f"loss.{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    print("rank", rank, "losses", losses)
+""")
+
+
+def test_multihost_rendezvous_dp2_loss_parity(tmp_path):
+    """VERDICT round-1 item 7: two REAL processes through the launch CLI,
+    jax.distributed.initialize rendezvous via the env contract (CPU
+    backend), a dp=2 jitted step, and loss parity with the serial run."""
+    import json
+
+    import numpy as np
+
+    script = _write(tmp_path, "mh_worker.py", MULTIHOST_WORKER)
+    os.environ["PADDLE_REPO_ROOT"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    ctx = JobContext(script=script, script_args=[str(tmp_path)],
+                     nproc_per_node=2, log_dir=str(tmp_path / "log"))
+    rc = CollectiveController(ctx).run(poll_interval=0.2)
+    assert rc == 0, (tmp_path / "log" / "workerlog.0").read_text()
+
+    losses = []
+    for r in (0, 1):
+        with open(tmp_path / f"loss.{r}.json") as f:
+            losses.append(json.load(f))
+    # both ranks observe the SAME global loss (psum across processes)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
+    # serial reference: identical arithmetic, one process
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    w = rng.randn(4, 1).astype(np.float32) * 0.1
+    ref = []
+    for _ in range(4):
+        pred = X @ w
+        ref.append(float(np.mean((pred - Y) ** 2)))
+        g = 2 * X.T @ (pred - Y) / X.shape[0]
+        w = w - 0.1 * g
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+    assert losses[0][-1] < losses[0][0]

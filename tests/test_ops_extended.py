@@ -1,0 +1,207 @@
+"""OpTest coverage for the round-2 op-surface completion (reference:
+python/paddle/tensor/{math,manipulation,creation,linalg}.py — SURVEY.md
+§2.2 "Tensor API", §4.1 numpy-reference pattern)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestSpecialFunctions(OpTest):
+    def test_i0e_i1_i1e(self):
+        x = np.linspace(0.1, 4.0, 13).astype(np.float32)
+        self.check_output(paddle.i0e, lambda a: sps.i0e(a), x)
+        self.check_output(paddle.i1, lambda a: sps.i1(a), x)
+        self.check_output(paddle.i1e, lambda a: sps.i1e(a), x)
+
+    def test_sinc(self):
+        x = np.linspace(-3, 3, 17).astype(np.float32)
+        self.check_output(paddle.sinc, np.sinc, x)
+
+    def test_logit(self):
+        x = np.asarray([0.1, 0.4, 0.6, 0.99], np.float32)
+        self.check_output(paddle.logit, lambda a: np.log(a / (1 - a)), x)
+        self.check_grad(paddle.logit, x)
+
+    def test_logit_eps_clips(self):
+        x = np.asarray([0.0, 1.0], np.float32)
+        out = paddle.logit(paddle.to_tensor(x), eps=1e-6).numpy()
+        assert np.all(np.isfinite(out))
+
+    def test_multigammaln(self):
+        x = np.asarray([3.0, 5.5, 9.0], np.float32)
+        self.check_output(
+            lambda t: paddle.multigammaln(t, 2),
+            lambda a: sps.multigammaln(a, 2).astype(np.float32), x)
+
+    def test_gammainc_gammaincc(self):
+        a = np.asarray([0.5, 1.5, 3.0], np.float32)
+        x = np.asarray([0.5, 2.0, 1.0], np.float32)
+        self.check_output(paddle.gammainc,
+                          lambda a_, x_: sps.gammainc(a_, x_), a, x)
+        self.check_output(paddle.gammaincc,
+                          lambda a_, x_: sps.gammaincc(a_, x_), a, x)
+
+    def test_signbit_isneginf_isposinf(self):
+        x = np.asarray([-2.0, 0.0, 3.0, -np.inf, np.inf], np.float32)
+        assert (paddle.signbit(paddle.to_tensor(x)).numpy()
+                == np.signbit(x)).all()
+        assert (paddle.isneginf(paddle.to_tensor(x)).numpy()
+                == np.isneginf(x)).all()
+        assert (paddle.isposinf(paddle.to_tensor(x)).numpy()
+                == np.isposinf(x)).all()
+
+    def test_frexp(self):
+        x = np.asarray([0.25, 3.0, -6.5, 100.0], np.float32)
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mr, er = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), mr, rtol=1e-6)
+        np.testing.assert_allclose(e.numpy(), er.astype(np.float32))
+
+
+class TestIntegration(OpTest):
+    def test_trapezoid(self):
+        y = np.random.RandomState(0).randn(4, 9).astype(np.float32)
+        x = np.sort(np.random.RandomState(1).rand(9)).astype(np.float32)
+        self.check_output(lambda t: paddle.trapezoid(t, dx=0.5),
+                          lambda a: np.trapezoid(a, dx=0.5, axis=-1), y)
+        self.check_output(paddle.trapezoid,
+                          lambda a, b: np.trapezoid(a, b, axis=-1), y, x)
+
+    def test_cumulative_trapezoid(self):
+        import scipy.integrate as si
+
+        y = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        x = np.sort(np.random.RandomState(1).rand(8)).astype(np.float32)
+        self.check_output(
+            lambda t: paddle.cumulative_trapezoid(t, dx=0.3),
+            lambda a: si.cumulative_trapezoid(a, dx=0.3, axis=-1), y)
+        self.check_output(
+            paddle.cumulative_trapezoid,
+            lambda a, b: si.cumulative_trapezoid(a, b, axis=-1), y, x)
+
+
+class TestManipulationExtras(OpTest):
+    def test_hsplit_vsplit_dsplit(self):
+        x = np.arange(48, dtype=np.float32).reshape(4, 4, 3)
+        for ours, ref in [(paddle.hsplit, np.hsplit),
+                          (paddle.vsplit, np.vsplit)]:
+            outs = ours(paddle.to_tensor(x), 2)
+            refs = ref(x, 2)
+            for o, r in zip(outs, refs):
+                np.testing.assert_array_equal(o.numpy(), r)
+        outs = paddle.dsplit(paddle.to_tensor(x), 3)
+        for o, r in zip(outs, np.dsplit(x, 3)):
+            np.testing.assert_array_equal(o.numpy(), r)
+
+    def test_hsplit_indices_list(self):
+        """List argument = split INDICES (numpy semantics), not sizes."""
+        x = np.arange(8, dtype=np.float32).reshape(1, 8)
+        outs = paddle.hsplit(paddle.to_tensor(x), [2, 5])
+        refs = np.hsplit(x, [2, 5])
+        assert len(outs) == len(refs) == 3
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(o.numpy(), r)
+
+    def test_unflatten(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 12)
+        out = paddle.unflatten(paddle.to_tensor(x), 1, [3, -1])
+        np.testing.assert_array_equal(out.numpy(), x.reshape(2, 3, 4))
+
+    def test_unfold(self):
+        x = np.arange(10, dtype=np.float32)
+        out = paddle.unfold(paddle.to_tensor(x), 0, 4, 2).numpy()
+        ref = np.stack([x[i:i + 4] for i in range(0, 7, 2)])
+        np.testing.assert_array_equal(out, ref)
+        self.check_grad(lambda t: paddle.unfold(t, 0, 4, 2), x)
+
+    def test_select_scatter(self):
+        x = np.zeros((3, 4), np.float32)
+        v = np.arange(4, dtype=np.float32)
+        out = paddle.select_scatter(
+            paddle.to_tensor(x), paddle.to_tensor(v), 0, 1).numpy()
+        ref = x.copy()
+        ref[1] = v
+        np.testing.assert_array_equal(out, ref)
+
+    def test_as_complex_as_real(self):
+        x = np.random.RandomState(0).randn(3, 5, 2).astype(np.float32)
+        c = paddle.as_complex(paddle.to_tensor(x))
+        ref = x[..., 0] + 1j * x[..., 1]
+        np.testing.assert_allclose(c.numpy(), ref, rtol=1e-6)
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    def test_tolist(self):
+        x = np.arange(6).reshape(2, 3)
+        assert paddle.tolist(paddle.to_tensor(x)) == x.tolist()
+
+
+class TestLinalgExtras(OpTest):
+    def test_pdist(self):
+        from scipy.spatial.distance import pdist as sp_pdist
+
+        x = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+        self.check_output(paddle.pdist,
+                          lambda a: sp_pdist(a).astype(np.float32), x)
+        self.check_output(
+            lambda t: paddle.pdist(t, p=1.0),
+            lambda a: sp_pdist(a, metric="minkowski", p=1).astype(
+                np.float32), x)
+
+    def test_histogram_bin_edges(self):
+        x = np.random.RandomState(0).rand(50).astype(np.float32)
+        out = paddle.histogram_bin_edges(paddle.to_tensor(x), bins=8).numpy()
+        ref = np.histogram_bin_edges(x, bins=8)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_vander(self):
+        x = np.asarray([1.0, 2.0, 3.0], np.float32)
+        self.check_output(paddle.vander, lambda a: np.vander(a), x)
+        self.check_output(lambda t: paddle.vander(t, 4, True),
+                          lambda a: np.vander(a, 4, True), x)
+
+    def test_renorm(self):
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32) * 3
+        out = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0).numpy()
+        norms = np.linalg.norm(out, axis=1)
+        assert np.all(norms <= 1.0 + 1e-4)
+        small = np.random.RandomState(1).randn(4, 5).astype(np.float32) * .01
+        np.testing.assert_allclose(
+            paddle.renorm(paddle.to_tensor(small), 2.0, 0, 1.0).numpy(),
+            small, rtol=1e-5)
+
+
+class TestMiscExtras(OpTest):
+    def test_add_n(self):
+        xs = [np.random.RandomState(i).randn(3, 3).astype(np.float32)
+              for i in range(3)]
+        out = paddle.add_n([paddle.to_tensor(a) for a in xs]).numpy()
+        np.testing.assert_allclose(out, xs[0] + xs[1] + xs[2], rtol=1e-6)
+
+    def test_rank_inverse(self):
+        x = np.random.RandomState(0).randn(3, 3).astype(np.float32)
+        assert int(paddle.rank(paddle.to_tensor(x))) == 2
+        np.testing.assert_allclose(
+            paddle.inverse(paddle.to_tensor(x)).numpy(),
+            np.linalg.inv(x), rtol=1e-3, atol=1e-4)
+
+    def test_dtype_predicates(self):
+        assert paddle.is_floating_point(paddle.to_tensor(np.zeros(2,
+                                                                  np.float32)))
+        assert paddle.is_integer(paddle.to_tensor(np.zeros(2, np.int32)))
+        assert not paddle.is_complex(paddle.to_tensor(np.zeros(2,
+                                                               np.float32)))
+        c = paddle.as_complex(paddle.to_tensor(np.zeros((2, 2), np.float32)))
+        assert paddle.is_complex(c)
+
+    def test_standard_gamma_geometric(self):
+        paddle.seed(0)
+        alpha = np.full((20000,), 4.0, np.float32)
+        s = paddle.standard_gamma(paddle.to_tensor(alpha)).numpy()
+        assert abs(s.mean() - 4.0) < 0.1  # Gamma(4,1) mean = 4
+        g = paddle.to_tensor(np.zeros(20000, np.float32))
+        g.geometric_(0.3)
+        assert abs(g.numpy().mean() - 1 / 0.3) < 0.2

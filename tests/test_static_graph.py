@@ -1,0 +1,155 @@
+"""Static-graph mode tests (reference: python/paddle/static Program +
+Executor + save/load_inference_model — SURVEY.md §2.2 "Static API", §3.3).
+The Program captures an op-record trace under program_guard; Executor.run
+replays it as one jitted pure function; minimize appends a symbolic
+update; inference export round-trips through serialized StableHLO."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _build_mlp_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "float32")
+        paddle.seed(0)
+        lin1 = paddle.nn.Linear(8, 16)
+        lin2 = paddle.nn.Linear(16, 1)
+        h = paddle.nn.functional.relu(lin1(x))
+        pred = lin2(h)
+        loss = ((pred - y) ** 2).mean()
+    return main, startup, loss, pred, x
+
+
+def test_static_train_loss_decreases():
+    main, startup, loss, pred, x_ph = _build_mlp_program()
+    with static.program_guard(main, startup):
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt._parameter_list = [p for p in _collect_params(main)]
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    losses = []
+    for _ in range(20):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def _collect_params(program):
+    from paddle_tpu.nn.layer_base import Parameter
+
+    seen = []
+    for t in program._externals.values():
+        if isinstance(t, Parameter) and not t.stop_gradient:
+            seen.append(t)
+    return seen
+
+
+def test_static_matches_eager():
+    """The replayed static program must produce the same forward values as
+    the eager layers it captured."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        paddle.seed(3)
+        lin = paddle.nn.Linear(8, 4)
+        out = paddle.nn.functional.gelu(lin(x))
+
+    exe = static.Executor()
+    xs = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    ref = paddle.nn.functional.gelu(lin(paddle.to_tensor(xs))).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_feed_shape_change_retraces():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        paddle.seed(0)
+        lin = paddle.nn.Linear(6, 2)
+        out = lin(x)
+    exe = static.Executor()
+    for b in (2, 5):
+        xs = np.ones((b, 6), np.float32)
+        (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        assert got.shape == (b, 2)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, loss, pred, x_ph = _build_mlp_program()
+    exe = static.Executor()
+    xs = np.random.RandomState(5).randn(1, 8).astype(np.float32)
+    ys = np.zeros((1, 1), np.float32)
+    (ref,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+
+    prefix = str(tmp_path / "infer" / "model")
+    static.save_inference_model(prefix, [x_ph], [pred], exe, program=main)
+
+    prog, feed_names, fetch_targets = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    (got,) = exe.run(prog, feed={"x": xs}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # None batch dim exports shape-polymorphic: other batch sizes work
+    xs4 = np.tile(xs, (4, 1))
+    (got4,) = exe.run(prog, feed={"x": xs4}, fetch_list=fetch_targets)
+    assert got4.shape == (4, 1)
+    np.testing.assert_allclose(got4, np.tile(ref, (4, 1)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_capture_does_not_leak_outside_guard():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1.0
+    n = len(main.records)
+    # eager op outside the guard must not be captured
+    _ = paddle.to_tensor(np.ones((2, 2), np.float32)) * 3.0
+    assert len(main.records) == n
+
+
+def test_minimize_after_first_run_invalidates_cache():
+    """Appending a minimize record after a cached run must rebuild the
+    compiled function (silent no-op training regression guard)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1)
+        loss = (lin(x) ** 2).mean()
+    exe = static.Executor()
+    xs = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    (l0,) = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=list(lin.parameters()))
+        opt.minimize(loss)
+    (l1,) = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+    (l2,) = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+    assert float(l2) < float(l1), (l0, l1, l2)  # updates actually applied
+
+
+def test_amp_cast_baked_into_records():
+    """Ops captured under amp.auto_cast replay with the build-time dtypes."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        with paddle.amp.auto_cast(enable=True, level="O1"):
+            out = lin(x)
+    exe = static.Executor()
+    xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out],
+                     return_numpy=False)
+    assert "bfloat16" in str(got._data.dtype), got._data.dtype
