@@ -80,19 +80,47 @@ class PredictorTensor:
 
 
 class Predictor:
-    """AnalysisPredictor parity over a jit-saved (StableHLO) program."""
+    """AnalysisPredictor parity over a saved StableHLO program — accepts
+    BOTH paddle_tpu.jit.save artifacts (layer programs) and
+    paddle.static.save_inference_model artifacts (captured static
+    programs with named feeds)."""
 
     def __init__(self, config: Config):
-        from .. import jit as _jit
-
         self._config = config
-        self._layer = _jit.load(config.model_path)
         self._feeds: Dict[str, np.ndarray] = {}
         self._fetches: Dict[str, np.ndarray] = {}
-        n_in = getattr(self._layer, "num_inputs", None)
-        self._input_names = [f"x{i}" for i in range(n_in)] \
-            if n_in else ["x0"]
         self._output_names: List[str] = []
+        self._static_prog = None
+        if self._is_static_artifact(config.model_path):
+            # static.save_inference_model artifact: named feeds + baked
+            # weights (its .pdiparams is a meta pickle with feed_names)
+            from .. import static as _static
+
+            prog, feed_names, _ = _static.load_inference_model(
+                config.model_path, None)
+            self._static_prog = prog
+            self._layer = None
+            self._input_names = list(feed_names)
+        else:
+            from .. import jit as _jit
+
+            self._layer = _jit.load(config.model_path)
+            n_in = getattr(self._layer, "num_inputs", None)
+            self._input_names = [f"x{i}" for i in range(n_in)] \
+                if n_in else ["x0"]
+
+    @staticmethod
+    def _is_static_artifact(path) -> bool:
+        """Dispatch on artifact metadata, not try/except — a corrupted jit
+        artifact must surface its own error, not a misleading one."""
+        import pickle
+
+        try:
+            with open(str(path) + ".pdiparams", "rb") as f:
+                meta = pickle.load(f)
+            return isinstance(meta, dict) and "feed_names" in meta
+        except Exception:
+            return False
 
     def get_input_names(self):
         return list(self._input_names)
@@ -110,13 +138,22 @@ class Predictor:
         if inputs is not None:  # positional list API
             feeds = [np.asarray(x) for x in inputs]
         else:
-            feeds = [self._feeds[n] for n in self._input_names
-                     if n in self._feeds]
-        outs = self._layer(*[Tensor(x) for x in feeds])
-        if isinstance(outs, (list, tuple)):
-            out_list = list(outs)
+            missing = [n for n in self._input_names if n not in self._feeds]
+            if missing:
+                raise ValueError(
+                    f"Predictor.run: missing feeds {missing}; call "
+                    "get_input_handle(name).copy_from_cpu(arr) for every "
+                    f"input ({self._input_names})")
+            feeds = [self._feeds[n] for n in self._input_names]
+        if self._static_prog is not None:
+            out_list = self._static_prog._run(
+                dict(zip(self._input_names, feeds)), None)
         else:
-            out_list = [outs]
+            outs = self._layer(*[Tensor(x) for x in feeds])
+            if isinstance(outs, (list, tuple)):
+                out_list = list(outs)
+            else:
+                out_list = [outs]
         self._output_names = [f"out{i}" for i in range(len(out_list))]
         self._fetches = {
             n: np.asarray(o._data if isinstance(o, Tensor) else o)

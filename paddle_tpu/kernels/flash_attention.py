@@ -43,7 +43,8 @@ def _interpret():
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, block_q, block_k, n_kv, offset):
+                scale, causal, block_q, block_k, n_kv, offset,
+                seg_q_ref=None, seg_k_ref=None):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -65,17 +66,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * np.float32(scale)
+        mask = None
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+            mask = q_pos + offset >= k_pos
+        if seg_q_ref is not None:
+            sq = seg_q_ref[0, 0]
+            sk = seg_k_ref[0, 0]
+            seg_m = sq[:, None] == sk[None, :]
+            mask = seg_m if mask is None else (mask & seg_m)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if mask is not None:
+            # NEG_INF is finite: a fully-masked row has s == m_new == NEG_INF
+            # and exp(0) == 1 everywhere — zero p by the mask itself so l
+            # stays 0 and the epilogue's safe_l emits a zero output row
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         pv = jax.lax.dot_general(
@@ -95,23 +109,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         lse_ref[0] = jnp.broadcast_to(lse_row[None, :], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd_kernel_seg(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
+                    lse_ref, acc, m_scr, l_scr, **params):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref, **params)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
+               seg_k=None, heads=1):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     n_q = s_q // block_q
     n_kv = s_kv // block_k
+    seg = seg_q is not None
+    params = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
+        _fwd_kernel_seg if seg else _fwd_kernel, **params)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if seg:
+        # seg arrays are [batch, 8, s] (NOT replicated per head); the index
+        # map folds the head dim of the [b*h] grid axis away
+        h_ = heads
+        in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h_, 0, i)),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h_, 0, j)),
+        ]
+        args += [seg_q, seg_k]
     with jax.enable_x64(False):
         out, lse = _pc(
         kernel,
         grid=(bh, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
@@ -126,7 +160,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return out, lse[:, 0, :]
 
 
@@ -137,7 +171,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, n_q, offset):
+                    block_q, block_k, n_q, offset,
+                    seg_q_ref=None, seg_k_ref=None):
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -166,8 +201,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+            cmask = q_pos + offset >= k_pos
+            s = jnp.where(cmask, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(cmask, p, 0.0)
+        if seg_q_ref is not None:
+            seg_m = seg_q_ref[0, 0][:, None] == seg_k_ref[0, 0][None, :]
+            # mask p (not just s): fully-masked rows have lse == NEG_INF and
+            # exp(s - lse) == 1, which would leak garbage into dk/dv
+            p = jnp.where(seg_m, p, 0.0)
         # dv += p^T do
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -188,7 +231,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k, n_kv, offset):
+                   dq_acc, *, scale, causal, block_q, block_k, n_kv, offset,
+                   seg_q_ref=None, seg_k_ref=None):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -216,8 +260,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+            cmask = q_pos + offset >= k_pos
+            s = jnp.where(cmask, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(cmask, p, 0.0)
+        if seg_q_ref is not None:
+            seg_m = seg_q_ref[0, 0][:, None] == seg_k_ref[0, 0][None, :]
+            p = jnp.where(seg_m, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -231,7 +281,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_acc,
+                        dv_acc, **params):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref, **params)
+
+
+def _bwd_dq_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       seg_q_ref, seg_k_ref, dq_ref, dq_acc, **params):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref,
+                   **params)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
+               seg_k=None, heads=1):
     q, k, v, out, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -243,21 +309,32 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, s_q))
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
 
+    seg = seg_q is not None
+    dkv_params = dict(scale=scale, causal=causal, block_q=block_q,
+                      block_k=block_k, n_q=n_q, offset=s_kv - s_q)
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_q=n_q, offset=s_kv - s_q)
+        _bwd_dkv_kernel_seg if seg else _bwd_dkv_kernel, **dkv_params)
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+    ]
+    dkv_args = [q, k, v, do, lse8, delta8]
+    h_ = heads
+    if seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b // h_, 0, i)),
+            pl.BlockSpec((1, 8, block_k), lambda b, j, i: (b // h_, 0, j)),
+        ]
+        dkv_args += [seg_q, seg_k]
     with jax.enable_x64(False):
         dk, dv = _pc(
         dkv_kernel,
         grid=(bh, n_kv, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -271,28 +348,37 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse8, delta8)
+    )(*dkv_args)
 
+    dq_params = dict(scale=scale, causal=causal, block_q=block_q,
+                     block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
+        _bwd_dq_kernel_seg if seg else _bwd_dq_kernel, **dq_params)
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_args = [q, k, v, do, lse8, delta8]
+    if seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h_, 0, i)),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h_, 0, j)),
+        ]
+        dq_args += [seg_q, seg_k]
     with jax.enable_x64(False):
         dq = _pc(
         dq_kernel,
         grid=(bh, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse8, delta8)
+    )(*dq_args)
     return dq, dk, dv
 
 
@@ -315,7 +401,7 @@ def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
 _PALLAS_BWD_MIN_SEQ = 4096
 
 
-def _xla_ref_bwd(res, g, scale, causal):
+def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1):
     """XLA-fused backward via recompute: at short sequence the O(s^2)
     score matrix fits comfortably and XLA's fused softmax-grad beats the
     streamed kernels; the Pallas backward takes over for long sequences
@@ -326,11 +412,23 @@ def _xla_ref_bwd(res, g, scale, causal):
         s_ = jax.lax.dot_general(
             q_, k_, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * np.float32(scale)
+        mask = None
         if causal:
             sq, sk = s_.shape[-2], s_.shape[-1]
             mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        if seg_q is not None:
+            # [b, 8, s] -> per-(b*h) rows via repeat on the batch dim
+            sq = jnp.repeat(seg_q[:, 0, :], heads, axis=0)
+            sk = jnp.repeat(seg_k[:, 0, :], heads, axis=0)
+            seg_m = sq[:, :, None] == sk[:, None, :]
+            mask = seg_m if mask is None else (mask & seg_m)
+        if mask is not None:
             s_ = jnp.where(mask, s_, NEG_INF)
         p = jax.nn.softmax(s_, axis=-1).astype(q_.dtype)
+        if mask is not None:
+            # NEG_INF is finite: softmax of a fully-masked row is uniform
+            # (not NaN) — zero it by the mask so those rows emit 0
+            p = jnp.where(mask, p, 0.0).astype(q_.dtype)
         return jax.lax.dot_general(
             p, v_, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32).astype(q_.dtype)
@@ -349,6 +447,42 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
+# segmented (varlen) variant: seg_q8/seg_k8 are [bh, 8, s] int32
+# sublane-replicated segment ids; cross-segment pairs are masked in all
+# four kernels (fwd, dkv, dq, and the short-seq XLA fallback backward)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd_seg(q, k, v, seg_q8, seg_k8, scale, causal, block_q,
+                    block_k, heads):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        seg_q=seg_q8, seg_k=seg_k8, heads=heads)
+    return out
+
+
+def _flash_bhsd_seg_fwd(q, k, v, seg_q8, seg_k8, scale, causal, block_q,
+                        block_k, heads):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          seg_q=seg_q8, seg_k=seg_k8, heads=heads)
+    return out, (q, k, v, out, lse, seg_q8, seg_k8)
+
+
+def _flash_bhsd_seg_bwd(scale, causal, block_q, block_k, heads, res, g):
+    q, k, v, out, lse, seg_q8, seg_k8 = res
+    s_q = q.shape[1]
+    if s_q < _PALLAS_BWD_MIN_SEQ:
+        dq, dk, dv = _xla_ref_bwd((q, k, v, out, lse), g, scale, causal,
+                                  seg_q=seg_q8, seg_k=seg_k8, heads=heads)
+    else:
+        dq, dk, dv = _flash_bwd((q, k, v, out, lse), g, scale, causal,
+                                block_q, block_k, seg_q=seg_q8,
+                                seg_k=seg_k8, heads=heads)
+    return dq, dk, dv, None, None
+
+
+_flash_bhsd_seg.defvjp(_flash_bhsd_seg_fwd, _flash_bhsd_seg_bwd)
+
+
 def supports(seq_q, seq_kv, head_dim, block_q=DEFAULT_BLOCK_Q,
              block_k=DEFAULT_BLOCK_K):
     return (seq_q % block_q == 0 and seq_kv % block_k == 0
@@ -356,9 +490,21 @@ def supports(seq_q, seq_kv, head_dim, block_q=DEFAULT_BLOCK_Q,
             and seq_kv >= block_k)
 
 
+def _seg8(seg, b, s):
+    """[b, s] int32 segment ids -> [b, 8, s] sublane-replicated layout
+    (per-head replication happens in the BlockSpec index map, not HBM)."""
+    seg = jnp.asarray(seg, jnp.int32)
+    return jnp.broadcast_to(seg[:, None, :], (b, 8, s))
+
+
 def flash_attention_bshd(q, k, v, causal=False, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         segment_ids_q=None, segment_ids_k=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout) -> same shape.
+
+    segment_ids_q/k ([batch, seq] int32) activate varlen masking: tokens
+    attend only within equal segment ids (the packed-sequence contract of
+    the reference's flash_attn varlen kernels).
 
     Raises ValueError for unsupported shapes — callers (F.sdpa) catch and
     fall back to the fused XLA path.
@@ -376,6 +522,79 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s_q, d)
     kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s_kv, d)
     vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s_kv, d)
-    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), block_q,
-                      block_k)
+    if segment_ids_q is not None:
+        out = _flash_bhsd_seg(qt, kt, vt,
+                              _seg8(segment_ids_q, b, s_q),
+                              _seg8(segment_ids_k, b, s_kv),
+                              float(scale), bool(causal), block_q, block_k,
+                              h)
+    else:
+        out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), block_q,
+                          block_k)
     return jnp.swapaxes(out.reshape(b, h, s_q, d), 1, 2)
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, block_q=DEFAULT_BLOCK_Q,
+                        block_k=DEFAULT_BLOCK_K):
+    """Varlen flash attention over PACKED sequences (reference:
+    paddle.nn.functional.flash_attention.flash_attn_unpadded /
+    phi flash_attn_varlen kernels — SURVEY.md §2.1 fusion row).
+
+    q/k/v: [total_tokens, heads, head_dim]; cu_seqlens_*: [n_seqs+1] int32
+    prefix sums. Returns ([total_tokens, heads, head_dim], None).
+
+    Implementation: the packed stream runs as ONE batch-1 kernel call with
+    per-token segment ids; cross-sequence attention is masked inside the
+    Pallas kernels. causal=True requires cu_seqlens_q == cu_seqlens_k
+    (self-attention packing — global causal + segment equality is then
+    exactly per-sequence causal).
+    """
+    if dropout:
+        raise NotImplementedError("flash_attn_unpadded: dropout"
+                                  " unsupported on the fused path")
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    cu_q = jnp.asarray(cu_seqlens_q, jnp.int32)
+    cu_k = jnp.asarray(cu_seqlens_k, jnp.int32)
+    total_q, h, d = q.shape
+    total_k = k.shape[0]
+    if causal:
+        if cu_q.shape != cu_k.shape:
+            raise ValueError(
+                "flash_attn_unpadded(causal=True) needs matching q/k packing")
+        try:  # value check when concrete (host arrays — the common case)
+            if bool(np.any(np.asarray(cu_q) != np.asarray(cu_k))):
+                raise ValueError(
+                    "flash_attn_unpadded(causal=True) needs cu_seqlens_q == "
+                    "cu_seqlens_k (global causal positions must align per "
+                    "sequence)")
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced: caller's responsibility
+
+    pad_q = -(-total_q // block_q) * block_q
+    pad_k = -(-total_k // block_k) * block_k
+    qp = jnp.zeros((pad_q, h, d), q.dtype).at[:total_q].set(q)
+    kp = jnp.zeros((pad_k, h, d), k.dtype).at[:total_k].set(k)
+    vp = jnp.zeros((pad_k, h, d), v.dtype).at[:total_k].set(v)
+    # token -> sequence index; q padding -1, k padding -2 (never equal)
+    pos_q = jnp.arange(pad_q, dtype=jnp.int32)
+    pos_k = jnp.arange(pad_k, dtype=jnp.int32)
+    seg_q = jnp.where(pos_q < total_q,
+                      jnp.searchsorted(cu_q[1:], pos_q, side="right")
+                      .astype(jnp.int32), -1)
+    seg_k = jnp.where(pos_k < total_k,
+                      jnp.searchsorted(cu_k[1:], pos_k, side="right")
+                      .astype(jnp.int32), -2)
+    # causal + equal packing: global causal positions already align per
+    # sequence, so the global tril mask composes with segment equality
+    out = flash_attention_bshd(
+        qp[None], kp[None], vp[None], causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+        segment_ids_q=seg_q[None], segment_ids_k=seg_k[None])
+    out = out[0, :total_q]
+    if return_softmax:
+        return out, None
+    return out, None

@@ -100,3 +100,54 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """paddle.nn.functional.flash_attention.flash_attn_unpadded parity:
+    varlen attention over packed [total_tokens, heads, head_dim] tensors
+    via the segment-masked Pallas kernel."""
+    from ...kernels import flash_attention as fa
+
+    d = as_array(query).shape[-1]
+    if d % 128 == 0:
+        def f(q, k, v, cq, ck):
+            out, _ = fa.flash_attn_unpadded(
+                q, k, v, cq, ck, max_seqlen_q, max_seqlen_k, scale=scale,
+                dropout=dropout if training else 0.0, causal=causal)
+            return out
+
+        out = _apply_op(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        _name="flash_attn_unpadded")
+        return out, None
+
+    # head_dim not MXU-tile aligned (e.g. 64): XLA segment-masked dense
+    # fallback — same packed contract, reference numerics
+    def f_ref(q, k, v, cq, ck):
+        import math as _math
+
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        seg_q = jnp.searchsorted(cq[1:], jnp.arange(total_q),
+                                 side="right")
+        seg_k = jnp.searchsorted(ck[1:], jnp.arange(total_k),
+                                 side="right")
+        s_ = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32)
+        s_ = s_ * (scale if scale is not None else 1.0 / _math.sqrt(
+            q.shape[-1]))
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            mask = mask & (jnp.arange(total_q)[:, None]
+                           >= jnp.arange(total_k)[None, :])
+        s_ = jnp.where(mask[None], s_, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s_, axis=-1)
+        p = jnp.where(mask[None], p, 0.0).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", p, v)
+
+    out = _apply_op(f_ref, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                    _name="flash_attn_unpadded_ref")
+    return out, None

@@ -153,3 +153,27 @@ def test_amp_cast_baked_into_records():
     (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out],
                      return_numpy=False)
     assert "bfloat16" in str(got._data.dtype), got._data.dtype
+
+
+def test_predictor_over_static_artifact(tmp_path):
+    """paddle.inference.Predictor consumes save_inference_model output:
+    the full static train -> export -> AnalysisPredictor deploy chain."""
+    from paddle_tpu import inference
+
+    main, startup, loss, pred, x_ph = _build_mlp_program()
+    exe = static.Executor()
+    xs = np.random.RandomState(9).randn(2, 8).astype(np.float32)
+    ys = np.zeros((2, 1), np.float32)
+    (ref,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+
+    prefix = str(tmp_path / "deploy" / "model")
+    static.save_inference_model(prefix, [x_ph], [pred], exe, program=main)
+
+    cfg = inference.Config(prefix)
+    predictor = inference.create_predictor(cfg)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xs)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5, atol=1e-6)
