@@ -91,16 +91,17 @@ class Predictor:
         self._fetches: Dict[str, np.ndarray] = {}
         self._output_names: List[str] = []
         self._static_prog = None
-        if self._is_static_artifact(config.model_path):
+        meta = self._peek_static_meta(config.model_path)
+        if meta is not None:
             # static.save_inference_model artifact: named feeds + baked
-            # weights (its .pdiparams is a meta pickle with feed_names)
+            # weights; reuse the already-parsed meta (weights included) —
+            # no second deserialize of the params payload
             from .. import static as _static
 
-            prog, feed_names, _ = _static.load_inference_model(
-                config.model_path, None)
+            prog = _static.loaded_program_from_meta(config.model_path, meta)
             self._static_prog = prog
             self._layer = None
-            self._input_names = list(feed_names)
+            self._input_names = list(prog.feed_names)
         else:
             from .. import jit as _jit
 
@@ -110,17 +111,20 @@ class Predictor:
                 if n_in else ["x0"]
 
     @staticmethod
-    def _is_static_artifact(path) -> bool:
+    def _peek_static_meta(path):
         """Dispatch on artifact metadata, not try/except — a corrupted jit
-        artifact must surface its own error, not a misleading one."""
+        artifact must surface its own error, not a misleading one. Returns
+        the parsed static meta dict, or None for jit.save artifacts."""
         import pickle
 
         try:
             with open(str(path) + ".pdiparams", "rb") as f:
                 meta = pickle.load(f)
-            return isinstance(meta, dict) and "feed_names" in meta
         except Exception:
-            return False
+            return None
+        if isinstance(meta, dict) and "feed_names" in meta:
+            return meta
+        return None
 
     def get_input_names(self):
         return list(self._input_names)

@@ -172,6 +172,56 @@ class StaticFunction:
         buffers = layer.buffers_pytree() if layer is not None else {}
         seed = jax.random.key_data(_random.next_key())
         leaves, structure = flatten_call(args, kwargs)
+
+        from ..autograd import tape as _tape
+
+        param_tensors = []
+        if layer is not None and _tape.grad_enabled() \
+                and not in_to_static_trace():
+            param_tensors = [p for _, p in layer.named_parameters()
+                             if not p.stop_gradient]
+        if param_tensors:
+            # run_program_op parity (reference:
+            # paddle/fluid/operators/run_program_op — SURVEY.md §2.1 "JIT
+            # runtime"): the WHOLE jitted program is recorded as one op on
+            # the eager tape, so loss.backward() after a @to_static
+            # forward fills param .grad exactly like the dygraph path.
+            from ..tensor import Tensor, _apply_op
+
+            names = [n for n, p in layer.named_parameters()
+                     if not p.stop_gradient]
+            frozen = {n: p._data for n, p in layer.named_parameters()
+                      if p.stop_gradient}
+            n_out_holder = {}
+
+            def prog_fn(*arrs):
+                p = dict(frozen)
+                p.update(dict(zip(names, arrs[:len(names)])))
+                arg_leaves = list(arrs[len(names):])
+                out_leaves, new_buffers = self._compiled(
+                    p, buffers, seed, arg_leaves, structure)
+                n_out_holder["n"] = len(out_leaves)
+                buf_names = sorted(new_buffers)
+                n_out_holder["buf_names"] = buf_names
+                outs = tuple(out_leaves) + tuple(
+                    new_buffers[b] for b in buf_names)
+                # single-output ops take a LEAF cotangent in backward();
+                # a 1-tuple would break the vjp structure
+                return outs[0] if len(outs) == 1 else outs
+
+            results = _apply_op(prog_fn, *param_tensors, *leaves,
+                                _name="run_program")
+            if not isinstance(results, tuple):
+                results = (results,)
+            n_out = n_out_holder["n"]
+            out_ts = results[:n_out]
+            buf_ts = results[n_out:]
+            if buf_ts:
+                layer.load_pytree({b: t._data for b, t in zip(
+                    n_out_holder["buf_names"], buf_ts)})
+            return unflatten_out(list(out_ts), self._out_structure,
+                                 wrap=False)
+
         out_leaves, new_buffers = self._compiled(
             params, buffers, seed, leaves, structure
         )
@@ -189,15 +239,19 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """@paddle.jit.to_static parity."""
 
     def decorate(fn):
+        from .dy2static import convert_to_static
+
         if isinstance(fn, Layer):
-            static = StaticFunction(fn.forward, layer=fn,
-                                    input_spec=input_spec)
+            static = StaticFunction(convert_to_static(fn.forward),
+                                    layer=fn, input_spec=input_spec)
             fn.forward = static
             return fn
         layer = getattr(fn, "__self__", None)
         if isinstance(layer, Layer):
-            return StaticFunction(fn, layer=layer, input_spec=input_spec)
-        static = StaticFunction(fn, layer=None, input_spec=input_spec)
+            return StaticFunction(convert_to_static(fn), layer=layer,
+                                  input_spec=input_spec)
+        static = StaticFunction(convert_to_static(fn), layer=None,
+                                input_spec=input_spec)
         functools.update_wrapper(static, fn)
         return static
 

@@ -1,0 +1,451 @@
+"""dy2static: AST rewrite of Python control flow for @to_static
+(reference: python/paddle/jit/dy2static ProgramTranslator + the
+IfElse/While transformers — SURVEY.md §2.2 "JIT / dy2static").
+
+TPU-native contract: the decorated function's source is rewritten so that
+
+- every `if` becomes `_jst_if(pred, true_fn, false_fn)`: a RUNTIME
+  dispatch — plain Python branching for Python bools, `jax.lax.cond` when
+  the predicate is a traced Tensor (both branches must then produce
+  matching shapes/dtypes, the same contract as the reference's cond op);
+- every `while` becomes `_jst_while(test_fn, body_fn, loop_vars)`:
+  `jax.lax.while_loop` when the test is traced (loop vars must keep
+  shape/dtype), Python iteration otherwise.
+
+Branch/body functions are generated INLINE so they close over the
+enclosing scope lexically; only names ASSIGNED inside a branch/body are
+threaded explicitly (returned and rebound). Constructs the converter
+cannot express functionally (`return`/`break`/`continue` inside a
+converted block, `try`, generators) leave that block unconverted — it
+then behaves exactly as before (trace-time Python), matching the
+reference's partial-conversion fallbacks.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Set
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatch helpers (injected into the rewritten function's globals)
+# ---------------------------------------------------------------------------
+
+
+def _is_traced(x):
+    from ..tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_arrays(tree):
+    from ..tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _like(tree, arrays):
+    """Rewrap arrays in Tensors where `tree` had Tensors."""
+    from ..tensor import Tensor
+
+    flat_t, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda t: isinstance(t, Tensor))
+    flat_a = jax.tree_util.tree_leaves(arrays)
+    out = [Tensor(a) if isinstance(t, Tensor) else a
+           for t, a in zip(flat_t, flat_a)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _jst_if(pred, true_fn, false_fn, operands=(), names=()):
+    """Runtime if-dispatch (reference: convert_ifelse). `operands` are the
+    current values of the names both branches (re)assign — they must be
+    PARAMETERS of the branch functions: a nested def that assigns `h`
+    makes `h` local, so reading the enclosing `h` via closure would be an
+    UnboundLocalError."""
+    from ..tensor import Tensor
+
+    if isinstance(pred, Tensor):
+        pred_arr = pred._data
+    else:
+        pred_arr = pred
+    if not _is_traced(pred):
+        return true_fn(*operands) if bool(pred_arr) else false_fn(*operands)
+
+    # traced predicate: both branches run under lax.cond on arrays.
+    # Operands undefined before the if (assigned fresh by both branches)
+    # ride along as Python sentinels, not cond operands — a branch reading
+    # one before assigning it fails loudly at trace time.
+    defined = [i for i, v in enumerate(operands) if v is not _JST_UNDEF]
+    def_ops = tuple(operands[i] for i in defined)
+    out_t = None
+
+    def _wrap(fn):
+        def inner(arrs):
+            nonlocal out_t
+            vals = list(operands)
+            got = _like(def_ops, arrs)
+            for i, v in zip(defined, got):
+                vals[i] = v
+            out = fn(*vals)
+            out_t = out
+            return _to_arrays(out)
+
+        return inner
+
+    res = jax.lax.cond(jnp.asarray(pred_arr).reshape(()), _wrap(true_fn),
+                       _wrap(false_fn), _to_arrays(def_ops))
+    return _like(out_t, res)
+
+
+class _JstUndef:
+    """Sentinel for loop variables not defined before the loop."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+
+_JST_UNDEF = _JstUndef()
+
+
+def _jst_while(test_fn, body_fn, init, names=()):
+    """Runtime while-dispatch (reference: convert_while_loop)."""
+    first = test_fn(*init)
+    if not _is_traced(first):
+        vars_ = init
+        while bool(first._data if hasattr(first, "_data") else first):
+            vars_ = body_fn(*vars_)
+            first = test_fn(*vars_)
+        return vars_
+
+    undef = [n for n, v in zip(names, init) if v is _JST_UNDEF]
+    if undef:
+        raise NotImplementedError(
+            f"to_static while-loop with a traced condition requires loop "
+            f"variables to be initialized before the loop; undefined: "
+            f"{undef} (the lax.while_loop carry needs their shapes)")
+    proto = init
+
+    def cond(arrs):
+        t = test_fn(*_like(proto, arrs))
+        return jnp.asarray(t._data if hasattr(t, "_data") else t).reshape(())
+
+    def body(arrs):
+        return _to_arrays(body_fn(*_like(proto, arrs)))
+
+    res = jax.lax.while_loop(cond, body, _to_arrays(tuple(init)))
+    return _like(tuple(proto), res)
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names assigned anywhere inside a statement list (no nested defs)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested defs
+        self.names.add(node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.names.add(a.asname or a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            self.names.add(a.asname or a.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts) -> List[str]:
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    # generated helpers from inner conversions are scoped to their block,
+    # never threaded through an outer one
+    return sorted(n for n in c.names if not n.startswith("__jst_"))
+
+
+class _Unsupported(ast.NodeVisitor):
+    """Detects constructs that cannot cross a functionalization boundary."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Try(self, node):
+        if getattr(node, "_jst_generated", False):
+            return  # our own undef-guards are conversion-safe
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):  # nested defs keep their own flow
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_While(self, node):
+        # break/continue belonging to an INNER loop are fine
+        for s in node.body + node.orelse:
+            v = _ReturnOnly()
+            v.visit(s)
+            self.found |= v.found
+
+    def visit_For(self, node):
+        for s in node.body + node.orelse:
+            v = _ReturnOnly()
+            v.visit(s)
+            self.found |= v.found
+
+
+class _ReturnOnly(_Unsupported):
+    def visit_Break(self, node):
+        pass
+
+    def visit_Continue(self, node):
+        pass
+
+
+def _convertible(stmts) -> bool:
+    v = _Unsupported()
+    for s in stmts:
+        v.visit(s)
+    return not v.found
+
+
+# ---------------------------------------------------------------------------
+# transformers
+# ---------------------------------------------------------------------------
+
+
+def _undef_guard(name):
+    """`try: name \n except NameError: name = _JST_UNDEF` — marked so the
+    convertibility analysis doesn't treat it as user try/except."""
+    node = ast.Try(
+        body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Name(id="NameError", ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Name(id="_JST_UNDEF", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+    node._jst_generated = True
+    return node
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # ---- if ----
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if not (_convertible(node.body) and _convertible(node.orelse)):
+            return node
+        outs = _assigned_names(node.body + node.orelse)
+        n = self._uid()
+        tname, fname = f"__jst_true_{n}", f"__jst_false_{n}"
+
+        def branch_fn(name, body):
+            # outs are PARAMETERS: branches that reassign a name would
+            # otherwise shadow it as an unbound local (read-modify-write
+            # like `h = relu(h)`)
+            ret = ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=o, ctx=ast.Load()) for o in outs],
+                ctx=ast.Load()))
+            fn = ast.FunctionDef(
+                name=name, args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=o) for o in outs],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=(list(body) or [ast.Pass()]) + [ret],
+                decorator_list=[])
+            return fn
+
+        call = ast.Call(
+            func=ast.Name(id="_jst_if", ctx=ast.Load()),
+            args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=o, ctx=ast.Load())
+                                  for o in outs], ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=o) for o in outs],
+                            ctx=ast.Load())],
+            keywords=[])
+        if outs:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=o, ctx=ast.Store()) for o in outs],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        guards = [_undef_guard(o) for o in outs]
+        return guards + [branch_fn(tname, node.body),
+                         branch_fn(fname, node.orelse), assign]
+
+    # ---- while ----
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or not _convertible(node.body):
+            return node
+        loop_vars = _assigned_names(node.body)
+        if not loop_vars:
+            return node
+        n = self._uid()
+        tname, bname = f"__jst_test_{n}", f"__jst_body_{n}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=v) for v in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        test_fn = ast.FunctionDef(
+            name=tname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in loop_vars],
+            ctx=ast.Load()))
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [ret], decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="_jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in loop_vars], ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=v)
+                                  for v in loop_vars], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store()) for v in loop_vars],
+                ctx=ast.Store())],
+            value=call)
+        # loop-local temporaries: bind undefined loop vars to the sentinel
+        # so the call site's Load doesn't NameError (python-path loops
+        # assign them in the body; traced loops reject them with guidance)
+        guards = [_undef_guard(v) for v in loop_vars]
+        return guards + [test_fn, body_fn, assign]
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+class _SuperRewriter(ast.NodeTransformer):
+    """zero-arg super() -> super(__class__, <first_param>): the re-exec'd
+    function is no longer lexically inside its class body, so the compiler
+    would not provide the implicit __class__ cell."""
+
+    def __init__(self, first_param):
+        self.first_param = first_param
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "super"
+                and not node.args and not node.keywords
+                and self.first_param):
+            node.args = [ast.Name(id="__class__", ctx=ast.Load()),
+                         ast.Name(id=self.first_param, ctx=ast.Load())]
+        return node
+
+
+@functools.lru_cache(maxsize=256)
+def _convert_cached(fn_code, fn_name, filename, freevars):
+    tree = ast.parse(fn_code)
+    fdef = tree.body[0]
+    fdef.decorator_list = []  # strip @to_static etc.
+    first_param = fdef.args.args[0].arg if fdef.args.args else None
+    if "__class__" in freevars:
+        _SuperRewriter(first_param).visit(fdef)
+    new = _ControlFlowTransformer().visit(tree)
+    # re-create the ORIGINAL closure as real cells: the converted def is
+    # nested in a wrapper taking the freevars as parameters, so lexical
+    # scoping (freevar shadows same-named global) is preserved
+    fdef2 = new.body[0]
+    wrapper = ast.Module(body=[ast.FunctionDef(
+        name="__jst_make",
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=[fdef2,
+              ast.Return(value=ast.Name(id=fn_name, ctx=ast.Load()))],
+        decorator_list=[])], type_ignores=[])
+    ast.fix_missing_locations(wrapper)
+    return compile(wrapper, filename, "exec")
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Rewrite fn's control flow; returns fn unchanged if the source is
+    unavailable (builtins, REPL lambdas) — trace-time behavior is then
+    identical to before."""
+    import types
+
+    if inspect.ismethod(fn):
+        conv = convert_to_static(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    freevars = tuple(fn.__code__.co_freevars)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        code = _convert_cached(src, fn.__name__,
+                               inspect.getfile(fn), freevars)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    glb = dict(fn.__globals__)
+    glb["_jst_if"] = _jst_if
+    glb["_jst_while"] = _jst_while
+    glb["_JST_UNDEF"] = _JST_UNDEF
+    cells = []
+    for name, cell in zip(freevars, fn.__closure__ or ()):
+        try:
+            cells.append(cell.cell_contents)
+        except ValueError:  # unfilled cell (still-executing enclosing fn)
+            cells.append(None)
+    loc: dict = {}
+    exec(code, glb, loc)
+    out = loc["__jst_make"](*cells)
+    functools.update_wrapper(out, fn)
+    return out

@@ -576,6 +576,13 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
 
     pad_q = -(-total_q // block_q) * block_q
     pad_k = -(-total_k // block_k) * block_k
+    if causal:
+        # the kernel's causal offset is s_kv - s_q; unequal padding would
+        # shift the diagonal and leak future tokens
+        common = max(pad_q, pad_k)
+        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        common = -(-common // lcm) * lcm
+        pad_q = pad_k = common
     qp = jnp.zeros((pad_q, h, d), q.dtype).at[:total_q].set(q)
     kp = jnp.zeros((pad_k, h, d), k.dtype).at[:total_k].set(k)
     vp = jnp.zeros((pad_k, h, d), v.dtype).at[:total_k].set(v)

@@ -472,19 +472,26 @@ class _LoadedInferenceProgram:
         return [Tensor(o) for o in outs]
 
 
-def load_inference_model(path_prefix, executor):
-    """Returns [program, feed_target_names, fetch_targets] (paddle API)."""
-    import pickle
-
+def loaded_program_from_meta(path_prefix, meta):
+    """Build the runnable program from an already-parsed .pdiparams meta
+    (avoids deserializing the weights payload twice — inference.Predictor
+    peeks the meta for format dispatch)."""
     from jax import export as jexport
 
     with open(path_prefix + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
+    ext = {vid: jnp.asarray(a) for vid, a in meta["ext"].items()}
+    return _LoadedInferenceProgram(exported, ext, meta["feed_names"],
+                                   meta["n_fetch"])
+
+
+def load_inference_model(path_prefix, executor):
+    """Returns [program, feed_target_names, fetch_targets] (paddle API)."""
+    import pickle
+
     with open(path_prefix + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
-    ext = {vid: jnp.asarray(a) for vid, a in meta["ext"].items()}
-    prog = _LoadedInferenceProgram(exported, ext, meta["feed_names"],
-                                   meta["n_fetch"])
+    prog = loaded_program_from_meta(path_prefix, meta)
     fetch_targets = list(range(meta["n_fetch"]))
     return [prog, prog.feed_names, fetch_targets]
 

@@ -1,0 +1,224 @@
+"""dy2static control-flow conversion tests (reference:
+test/dygraph_to_static pattern — run eagerly and through @to_static,
+assert identical outputs; SURVEY.md §4.4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def test_data_dependent_if_converts():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    g = convert_to_static(f)
+    pos = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.asarray([-5.0, 1.0], np.float32))
+    for t in (pos, neg):
+        np.testing.assert_allclose(g(t).numpy(), f(t).numpy())
+
+    # and under jit: the traced predicate goes through lax.cond
+    st = paddle.jit.to_static(f)
+    for t in (pos, neg):
+        np.testing.assert_allclose(st(t).numpy(), f(t).numpy())
+
+
+def test_elif_chain():
+    def f(x):
+        s = x.sum()
+        if s > 10:
+            out = x * 10
+        elif s > 0:
+            out = x + 100
+        else:
+            out = x * 0
+        return out
+
+    st = paddle.jit.to_static(f)
+    for vals in ([20.0], [1.0], [-3.0]):
+        t = paddle.to_tensor(np.asarray(vals, np.float32))
+        np.testing.assert_allclose(st(t).numpy(), f(t).numpy())
+
+
+def test_if_python_bool_unaffected():
+    def f(x, flag):
+        if flag:  # plain python bool: no lax.cond
+            return x * 2
+        return x + 1
+
+    g = convert_to_static(f)
+    t = paddle.to_tensor(np.asarray([3.0], np.float32))
+    np.testing.assert_allclose(g(t, True).numpy(), [6.0])
+    np.testing.assert_allclose(g(t, False).numpy(), [4.0])
+
+
+def test_while_tensor_condition():
+    def f(x):
+        i = paddle.to_tensor(np.asarray(0, np.int64))
+        while x.sum() > 1.0:
+            x = x / 2
+            i = i + 1
+        return x, i
+
+    g = convert_to_static(f)
+    t = paddle.to_tensor(np.asarray([8.0], np.float32))
+    out, n = g(t)
+    np.testing.assert_allclose(out.numpy(), [1.0])  # 8 -> 4 -> 2 -> 1 stops
+    assert int(n) == 3
+
+    st = paddle.jit.to_static(f)
+    out_j, n_j = st(t)
+    np.testing.assert_allclose(out_j.numpy(), [1.0])
+    assert int(n_j) == 3
+
+
+def test_while_uninitialized_loop_var_guidance():
+    def f(x):
+        while x.sum() > 1.0:
+            tmp = x * 0.5
+            x = tmp
+        return x
+
+    st = paddle.jit.to_static(f)
+    with pytest.raises(Exception, match="initialized before the loop"):
+        st(paddle.to_tensor(np.asarray([8.0], np.float32)))
+
+
+def test_return_inside_if_left_unconverted():
+    """Early return inside a branch: the if is NOT converted (trace-time
+    python), so python-bool flow still works."""
+    def f(x, flag):
+        if flag:
+            return x * 3
+        return x
+
+    g = convert_to_static(f)
+    t = paddle.to_tensor(np.asarray([2.0], np.float32))
+    np.testing.assert_allclose(g(t, True).numpy(), [6.0])
+    np.testing.assert_allclose(g(t, False).numpy(), [2.0])
+
+
+def test_layer_forward_conversion():
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                out = paddle.nn.functional.relu(h)
+            else:
+                out = h * 0.1
+            return out
+
+    paddle.seed(0)
+    net = Gate()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    eager = net(x).numpy()
+    paddle.jit.to_static(net)
+    np.testing.assert_allclose(net(x).numpy(), eager, rtol=1e-6)
+
+
+def test_grad_through_converted_cond():
+    def f(x):
+        if x.sum() > 0:
+            y = (x ** 2).sum()
+        else:
+            y = (x ** 3).sum()
+        return y
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = g(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_backward_through_to_static_forward():
+    """run_program_op parity: loss.backward() after a @to_static forward
+    fills param grads like the dygraph path (the whole jitted program is
+    one op on the eager tape)."""
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.lin(x)
+
+    paddle.seed(1)
+    m_eager = M()
+    m_static = M()
+    m_static.load_pytree(m_eager.parameters_pytree())
+    paddle.jit.to_static(m_static)
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(5, 4)
+                         .astype(np.float32))
+    for m in (m_eager, m_static):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+    for (n, pe), (_, ps) in zip(m_eager.named_parameters(),
+                                m_static.named_parameters()):
+        assert ps.grad is not None, f"no grad for {n} via to_static"
+        np.testing.assert_allclose(ps.grad.numpy(), pe.grad.numpy(),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"grad mismatch {n}")
+
+
+def test_super_call_in_converted_forward():
+    """Zero-arg super() survives conversion (rewritten to
+    super(__class__, self) with the class cell recreated)."""
+    class Base(paddle.nn.Layer):
+        def forward(self, x):
+            return x + 1
+
+    class Child(Base):
+        def forward(self, x):
+            return super().forward(x) * 2
+
+    c = Child()
+    paddle.jit.to_static(c)
+    out = c(paddle.to_tensor(np.asarray([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [4.0])
+
+
+_module_scale = 10.0
+
+
+def test_closure_shadows_same_named_global():
+    def make(_module_scale):
+        def f(x):
+            if x.sum() > 0:
+                y = x * _module_scale
+            else:
+                y = x
+            return y
+
+        return f
+
+    g = convert_to_static(make(2.0))
+    r = g(paddle.to_tensor(np.asarray([3.0], np.float32)))
+    np.testing.assert_allclose(r.numpy(), [6.0])  # closure 2.0, not 10.0
+
+
+def test_import_inside_converted_branch():
+    def f(x, flag=True):
+        if flag:
+            import math as m
+            y = x * 2
+        else:
+            import math as m
+            y = x
+        return y + m.pi
+
+    g = convert_to_static(f)
+    r = g(paddle.to_tensor(np.asarray([1.0], np.float32)))
+    np.testing.assert_allclose(r.numpy(), [2.0 + np.pi], rtol=1e-6)

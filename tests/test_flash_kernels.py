@@ -216,3 +216,23 @@ class TestMaskedRowEdgeCases:
             sl = slice(cu[i], cu[i + 1])
             ref = dense_ref(qn[None, sl], qn[None, sl], qn[None, sl])[0]
             np.testing.assert_allclose(out[sl], ref, atol=2e-3, rtol=2e-3)
+
+
+class TestCausalPadding:
+    def test_unequal_blocks_keep_causal_alignment(self):
+        """block_q != block_k must not shift the causal diagonal via
+        unequal q/k padding."""
+        h, d = 1, 128
+        lens = [80, 48]
+        total = sum(lens)
+        q = _rand((total, h, d), 21)
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        out, _ = fa.flash_attn_unpadded(q, q, q, cu, cu, max(lens),
+                                        max(lens), causal=True,
+                                        block_q=128, block_k=256)
+        out = np.asarray(out)
+        for i, ln in enumerate(lens):
+            sl = slice(cu[i], cu[i + 1])
+            ref = dense_ref(np.asarray(q)[None, sl], np.asarray(q)[None, sl],
+                            np.asarray(q)[None, sl], causal=True)[0]
+            np.testing.assert_allclose(out[sl], ref, atol=2e-3, rtol=2e-3)
