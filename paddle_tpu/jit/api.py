@@ -87,6 +87,30 @@ def flatten_call(args, kwargs):
     return leaves, structure
 
 
+def flatten_call_tensors(args, kwargs):
+    """Like flatten_call but leaves keep their Tensor identity (the
+    run_program tape path needs them differentiable)."""
+    leaves: list = []
+    structure = _encode((tuple(args), dict(kwargs)), leaves)
+    # re-walk: _encode stored obj._data for Tensors; recover the Tensors
+    tensor_leaves: list = []
+
+    def walk(obj):
+        if isinstance(obj, Tensor):
+            tensor_leaves.append(obj)
+        elif isinstance(obj, (jax.Array, np.ndarray)):
+            tensor_leaves.append(jnp.asarray(obj))
+        elif isinstance(obj, (list, tuple)):
+            for o in obj:
+                walk(o)
+        elif isinstance(obj, dict):
+            for k in sorted(obj):
+                walk(obj[k])
+
+    walk((tuple(args), dict(kwargs)))
+    return tensor_leaves, structure
+
+
 def unflatten_call(leaves, structure, wrap=True):
     args, kwargs = _decode(structure, leaves, wrap)
     return args, kwargs
@@ -140,7 +164,10 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
-        self._out_structure = None
+        # out-tree PER input structure: alternating call signatures hit
+        # the jit cache without retracing, so one global field would go
+        # stale and decode with the wrong tree
+        self._out_structures: Dict[Any, Any] = {}
         self._compiled = None
         self._lock = threading.Lock()
 
@@ -158,7 +185,7 @@ class StaticFunction:
             finally:
                 _tls.tracing = False
             out_leaves, out_struct = flatten_out(out)
-            self._out_structure = out_struct
+            self._out_structures[structure] = out_struct
             return out_leaves, new_buffers
 
         self._compiled = jax.jit(pure_fn, static_argnames=("structure",))
@@ -185,8 +212,12 @@ class StaticFunction:
             # paddle/fluid/operators/run_program_op — SURVEY.md §2.1 "JIT
             # runtime"): the WHOLE jitted program is recorded as one op on
             # the eager tape, so loss.backward() after a @to_static
-            # forward fills param .grad exactly like the dygraph path.
+            # forward fills param .grad exactly like the dygraph path —
+            # AND input tensors stay differentiable (leaves keep their
+            # Tensor identity, so grads flow to upstream eager layers).
             from ..tensor import Tensor, _apply_op
+
+            leaves, structure = flatten_call_tensors(args, kwargs)
 
             names = [n for n, p in layer.named_parameters()
                      if not p.stop_gradient]
@@ -219,7 +250,8 @@ class StaticFunction:
             if buf_ts:
                 layer.load_pytree({b: t._data for b, t in zip(
                     n_out_holder["buf_names"], buf_ts)})
-            return unflatten_out(list(out_ts), self._out_structure,
+            return unflatten_out(list(out_ts),
+                                 self._out_structures[structure],
                                  wrap=False)
 
         out_leaves, new_buffers = self._compiled(
@@ -227,7 +259,7 @@ class StaticFunction:
         )
         if layer is not None and new_buffers:
             layer.load_pytree(new_buffers)
-        return unflatten_out(out_leaves, self._out_structure)
+        return unflatten_out(out_leaves, self._out_structures[structure])
 
     @property
     def code(self):

@@ -107,7 +107,9 @@ def _jst_if(pred, true_fn, false_fn, operands=(), names=()):
 
 
 class _JstUndef:
-    """Sentinel for loop variables not defined before the loop."""
+    """Sentinel for variables not defined before a converted block. Any
+    USE fails loudly (the unconverted code would have raised
+    UnboundLocalError); only pass-through is silent."""
 
     _inst = None
 
@@ -115,6 +117,23 @@ class _JstUndef:
         if cls._inst is None:
             cls._inst = super().__new__(cls)
         return cls._inst
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "variable used before assignment in a to_static-converted "
+            "block (it was only assigned on one branch/in the loop body)")
+
+    __bool__ = __getattr__ = __call__ = __iter__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __getitem__ = __len__ = _raise
+
+    def __hash__(self):  # keep usable as dict key internally
+        return id(self)
+
+    def __repr__(self):
+        return "<undefined (to_static converted block)>"
 
 
 _JST_UNDEF = _JstUndef()
@@ -434,10 +453,22 @@ def convert_to_static(fn: Callable) -> Callable:
                                inspect.getfile(fn), freevars)
     except (OSError, TypeError, SyntaxError):
         return fn
-    glb = dict(fn.__globals__)
+    # late-binding globals: lookups fall through to the LIVE module
+    # globals (a helper defined after the decorated function must resolve
+    # at call time, as in the unconverted function)
+    class _GlobalsProxy(dict):
+        def __init__(self, base):
+            super().__init__()
+            self._base = base
+
+        def __missing__(self, key):
+            return self._base[key]
+
+    glb = _GlobalsProxy(fn.__globals__)
     glb["_jst_if"] = _jst_if
     glb["_jst_while"] = _jst_while
     glb["_JST_UNDEF"] = _JST_UNDEF
+    glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
     cells = []
     for name, cell in zip(freevars, fn.__closure__ or ()):
         try:

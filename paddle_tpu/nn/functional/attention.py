@@ -112,24 +112,6 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     via the segment-masked Pallas kernel."""
     from ...kernels import flash_attention as fa
 
-    # shared contract checks — identical on the fused and fallback paths
-    if dropout and training:
-        raise NotImplementedError(
-            "flash_attn_unpadded: dropout unsupported on the fused path")
-    if causal:
-        import numpy as _np
-
-        cq = as_array(cu_seqlens_q)
-        ck = as_array(cu_seqlens_k)
-        try:
-            if cq.shape != ck.shape or bool(
-                    _np.any(_np.asarray(cq) != _np.asarray(ck))):
-                raise ValueError(
-                    "flash_attn_unpadded(causal=True) needs cu_seqlens_q "
-                    "== cu_seqlens_k (per-sequence causal alignment)")
-        except jax.errors.TracerArrayConversionError:
-            pass
-
     d = as_array(query).shape[-1]
     if d % 128 == 0:
         def f(q, k, v, cq, ck):
@@ -143,7 +125,25 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         return out, None
 
     # head_dim not MXU-tile aligned (e.g. 64): XLA segment-masked dense
-    # fallback — same packed contract, reference numerics
+    # fallback — same packed CONTRACT as the fused kernel, whose own
+    # checks don't run on this path
+    if dropout and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: dropout unsupported")
+    if causal:
+        import numpy as _np
+
+        cq_ = as_array(cu_seqlens_q)
+        ck_ = as_array(cu_seqlens_k)
+        try:
+            if cq_.shape != ck_.shape or bool(
+                    _np.any(_np.asarray(cq_) != _np.asarray(ck_))):
+                raise ValueError(
+                    "flash_attn_unpadded(causal=True) needs cu_seqlens_q "
+                    "== cu_seqlens_k (per-sequence causal alignment)")
+        except jax.errors.TracerArrayConversionError:
+            pass
+
     def f_ref(q, k, v, cq, ck):
         import math as _math
 

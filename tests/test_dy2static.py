@@ -222,3 +222,58 @@ def test_import_inside_converted_branch():
     g = convert_to_static(f)
     r = g(paddle.to_tensor(np.asarray([1.0], np.float32)))
     np.testing.assert_allclose(r.numpy(), [2.0 + np.pi], rtol=1e-6)
+
+
+def test_input_grads_flow_through_static_boundary():
+    """Mixed eager/static: grads must flow THROUGH a @to_static module
+    into upstream eager computation (run_program records input tensors)."""
+    class Inner(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.lin(x)
+
+    paddle.seed(2)
+    inner = Inner()
+    paddle.jit.to_static(inner)
+    up = paddle.to_tensor(np.random.RandomState(1).randn(2, 4)
+                          .astype(np.float32), stop_gradient=False)
+    h = up * 2.0  # upstream eager op
+    loss = (inner(h) ** 2).mean()
+    loss.backward()
+    assert up.grad is not None
+    assert float(np.abs(up.grad.numpy()).max()) > 0
+
+
+def test_late_bound_module_helper(tmp_path):
+    """A helper defined AFTER the converted function must resolve at call
+    time (live module globals, not a snapshot)."""
+    import importlib.util
+    import sys as _sys
+
+    mod_src = '''
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_to_static
+
+def f(x):
+    if x.sum() > 0:
+        y = helper(x)
+    else:
+        y = x
+    return y
+
+g = convert_to_static(f)
+
+def helper(x):  # defined AFTER conversion
+    return x * 7
+'''
+    p = tmp_path / "late_mod.py"
+    p.write_text(mod_src)
+    spec = importlib.util.spec_from_file_location("late_mod", p)
+    mod = importlib.util.module_from_spec(spec)
+    _sys.modules["late_mod"] = spec.loader.exec_module(mod) or mod
+    out = mod.g(paddle.to_tensor(np.asarray([2.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [14.0])
