@@ -1,0 +1,75 @@
+"""AMP end-to-end tests (VERDICT round-1 weak #12: bf16 O2 + GradScaler
+interplay with the jit train step was unexercised; reference:
+python/paddle/amp — SURVEY.md §2.2 "AMP")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
+
+
+def test_bf16_o2_jit_train_step_e2e():
+    """bf16 O2 decorate + the jitted train step: loss decreases and the
+    updated params stay bf16 (the bench configuration, CPU-sized)."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=2, seq=32)
+    model = LlamaForCausalLM(cfg)
+    paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    for _, p in model.named_parameters():
+        assert "bfloat16" in str(p._data.dtype)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = build_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 128, (4, 32)))
+    y = paddle.to_tensor(rng.randint(0, 128, (4, 32)))
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))
+    for _, p in model.named_parameters():
+        assert "bfloat16" in str(p._data.dtype)
+
+
+def test_grad_scaler_scaled_matches_unscaled():
+    """Scale cancels exactly through unscale: same updates as no scaler."""
+    def run(with_scaler):
+        paddle.seed(3)
+        net = paddle.nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(
+            enable=with_scaler, init_loss_scaling=1024.0)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (net(x) ** 2).mean()
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            scaler.step(opt)
+            opt.clear_grad()
+        return {n: np.asarray(p._data) for n, p in net.named_parameters()}
+
+    a = run(True)
+    b = run(False)
+    for n in a:
+        np.testing.assert_allclose(a[n], b[n], rtol=1e-5, atol=1e-6)
+
+
+def test_grad_scaler_inf_skips_step_and_decays_scale():
+    paddle.seed(1)
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=256.0,
+                                   decr_every_n_nan_or_inf=1)
+    before = {n: np.asarray(p._data).copy()
+              for n, p in net.named_parameters()}
+    x = paddle.to_tensor(np.full((2, 4), 1e30, np.float32))
+    loss = (net(x) ** 2).mean()  # overflows -> inf grads
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    opt.clear_grad()
+    # step skipped, scale halved
+    for n, p in net.named_parameters():
+        np.testing.assert_array_equal(np.asarray(p._data), before[n])
+    assert scaler.get_loss_scaling() == 128.0
