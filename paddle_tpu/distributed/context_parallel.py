@@ -45,10 +45,84 @@ def _pick_axis(mesh, axis_name: Optional[str]) -> Optional[str]:
     return None
 
 
+def ring_flash_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                               scale: Optional[float] = None):
+    """Per-rank ring attention with the PALLAS flash kernel per KV block
+    (the PaddleNLP ring_flash_attention analog, TPU-native).
+
+    Block r=0 is this rank's diagonal block (causal kernel); blocks r>=1
+    are full-attention blocks valid only when this rank's queries are
+    globally AFTER the block's keys (idx >= r for causal). Block results
+    merge by logsumexp: L = logaddexp(acc, lse_r); the lse cotangent flows
+    through the merge into the kernel's lse-aware backward."""
+    from ..kernels.flash_attention import flash_attention_with_lse_bshd
+
+    cp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    # r = 0: the diagonal block — per-sequence causal (or full) attention
+    acc_o, acc_lse = flash_attention_with_lse_bshd(
+        q, k, v, causal=causal, scale=scale)
+    acc_o = acc_o.astype(jnp.float32)
+    kc = jax.lax.ppermute(k, axis_name, perm)
+    vc = jax.lax.ppermute(v, axis_name, perm)
+
+    def body(carry, r):
+        o, lse, kc, vc = carry
+
+        def attend(kv):
+            kc_, vc_ = kv
+            ob, lseb = flash_attention_with_lse_bshd(
+                q, kc_, vc_, causal=False, scale=scale)
+            return ob.astype(jnp.float32), lseb
+
+        def skip(kv):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.full(acc_lse.shape, _NEG, acc_lse.dtype))
+
+        if causal:
+            # kv block j = (idx - r) % cp is in this rank's past iff
+            # idx >= r; future blocks are SKIPPED (cond, not masked —
+            # a zero-weighted kernel call would still burn the FLOPs)
+            ob, lseb = jax.lax.cond(idx >= r, attend, skip, (kc, vc))
+        else:
+            ob, lseb = attend((kc, vc))
+        new_lse = jnp.logaddexp(lse, lseb)
+        # lse: [b, n, s]; o: [b, s, n, d] -> align weights to bshd
+        w_old = jnp.moveaxis(jnp.exp(lse - new_lse)[..., None], 1, 2)
+        w_new = jnp.moveaxis(jnp.exp(lseb - new_lse)[..., None], 1, 2)
+        o = o * w_old + ob * w_new
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, new_lse, kc, vc), None
+
+    if cp > 1:
+        (acc_o, acc_lse, _, _), _ = jax.lax.scan(
+            body, (acc_o, acc_lse, kc, vc), jnp.arange(1, cp))
+    return acc_o.astype(q.dtype)
+
+
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                          scale: Optional[float] = None):
     """Per-rank ring attention. q/k/v: [b, s_loc, n, d] local seq shards
-    (paddle bshd layout). Must run inside a manual region over axis_name."""
+    (paddle bshd layout). Must run inside a manual region over axis_name.
+
+    Dispatches to the Pallas flash-kernel path when shapes are
+    MXU-tile-aligned (s_loc, d multiples of 128); the dense online-softmax
+    fallback below handles everything else."""
+    from ..kernels.flash_attention import supports as _flash_supports
+
+    b, s_loc_, n_, d_ = q.shape
+    if _flash_supports(s_loc_, s_loc_, d_):
+        return ring_flash_attention_local(q, k, v, axis_name, causal=causal,
+                                          scale=scale)
+    return _ring_dense_local(q, k, v, axis_name, causal=causal, scale=scale)
+
+
+def _ring_dense_local(q, k, v, axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None):
+    """Dense per-block ring attention (any shape; f32 accumulation)."""
     cp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, n, d = q.shape
@@ -110,9 +184,12 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,
 def _cp_call(local_fn, q, k, v, axis_name, mesh, causal, scale):
     spec = P(None, axis_name)
     fn = partial(local_fn, axis_name=axis_name, causal=causal, scale=scale)
+    # check_vma=False: the Pallas flash kernel runs inside this manual
+    # region, and interpret-mode (CPU CI) lowering rejects vma-varying
+    # kernel operands; classic shard_map semantics are sufficient here
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=frozenset({axis_name}),
+        axis_names=frozenset({axis_name}), check_vma=False,
     )(q, k, v)
 
 

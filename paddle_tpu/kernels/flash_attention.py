@@ -42,6 +42,20 @@ def _interpret():
 # ---------------------------------------------------------------------------
 
 
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes (vma) type of
+    `like` — required when the kernel runs inside a shard_map manual
+    region (ring attention), harmless otherwise."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
                 scale, causal, block_q, block_k, n_kv, offset,
                 seg_q_ref=None, seg_k_ref=None):
@@ -151,8 +165,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, s_q), jnp.float32),
+            _sds((bh, s_q, d), q.dtype, q),
+            _sds((bh, 8, s_q), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -297,7 +311,7 @@ def _bwd_dq_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
-               seg_k=None, heads=1):
+               seg_k=None, heads=1, d_lse=None):
     q, k, v, out, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -306,6 +320,10 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
     n_kv = s_kv // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [bh, s_q]
+    if d_lse is not None:
+        # lse cotangent folds into delta: ds = p*(dp - delta) + p*d_lse
+        #                                    = p*(dp - (delta - d_lse))
+        delta = delta - d_lse.astype(jnp.float32)
     lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, s_q))
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
 
@@ -340,8 +358,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+            _sds((bh, s_kv, d), q.dtype, q),
+            _sds((bh, s_kv, d), q.dtype, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -375,7 +393,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
         grid=(bh, n_q, n_kv),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        out_shape=_sds((bh, s_q, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*dq_args)
@@ -401,11 +419,14 @@ def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
 _PALLAS_BWD_MIN_SEQ = 4096
 
 
-def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1):
+def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
+                 d_lse=None):
     """XLA-fused backward via recompute: at short sequence the O(s^2)
     score matrix fits comfortably and XLA's fused softmax-grad beats the
     streamed kernels; the Pallas backward takes over for long sequences
-    where s^2 memory is the binding constraint."""
+    where s^2 memory is the binding constraint. The ONE reference
+    implementation also serves the lse-returning variant (d_lse is the lse
+    cotangent, zeros when the caller only differentiates the output)."""
     q, k, v, _, _ = res
 
     def ref(q_, k_, v_):
@@ -424,17 +445,21 @@ def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1):
             mask = seg_m if mask is None else (mask & seg_m)
         if mask is not None:
             s_ = jnp.where(mask, s_, NEG_INF)
-        p = jax.nn.softmax(s_, axis=-1).astype(q_.dtype)
+        lse_ = jax.scipy.special.logsumexp(s_, axis=-1)
+        p = jnp.exp(s_ - lse_[..., None]).astype(q_.dtype)
         if mask is not None:
-            # NEG_INF is finite: softmax of a fully-masked row is uniform
-            # (not NaN) — zero it by the mask so those rows emit 0
+            # NEG_INF is finite: a fully-masked row's p is uniform (not
+            # NaN) — zero it by the mask so those rows emit 0
             p = jnp.where(mask, p, 0.0).astype(q_.dtype)
-        return jax.lax.dot_general(
+        o_ = jax.lax.dot_general(
             p, v_, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32).astype(q_.dtype)
+        return o_, lse_
 
     _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    if d_lse is None:
+        d_lse = jnp.zeros(g.shape[:2], jnp.float32)
+    return vjp((g, d_lse.astype(jnp.float32)))
 
 
 def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
@@ -481,6 +506,54 @@ def _flash_bhsd_seg_bwd(scale, causal, block_q, block_k, heads, res, g):
 
 
 _flash_bhsd_seg.defvjp(_flash_bhsd_seg_fwd, _flash_bhsd_seg_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd_lse(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_bhsd_lse_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bhsd_lse_bwd(scale, causal, block_q, block_k, res, g):
+    g_out, g_lse = g
+    q, k, v, out, lse = res
+    s_q = q.shape[1]
+    if s_q < _PALLAS_BWD_MIN_SEQ:
+        return _xla_ref_bwd((q, k, v, out, lse), g_out, scale, causal,
+                            d_lse=g_lse)
+    return _flash_bwd((q, k, v, out, lse), g_out, scale, causal, block_q,
+                      block_k, d_lse=g_lse)
+
+
+_flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
+
+
+def flash_attention_with_lse_bshd(q, k, v, causal=False, scale=None,
+                                  block_q=DEFAULT_BLOCK_Q,
+                                  block_k=DEFAULT_BLOCK_K):
+    """Like flash_attention_bshd but also returns the row logsumexp
+    ([b, h, s_q], f32) — the merge statistic ring attention accumulates
+    across KV blocks. Both outputs are differentiable (the lse cotangent
+    folds into the flash backward's delta term)."""
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if not supports(s_q, s_kv, d, block_q, block_k):
+        raise ValueError(
+            f"flash_attention: unsupported shape seq_q={s_q} seq_kv={s_kv} "
+            f"d={d} (need multiples of {block_q}/{block_k}/128)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s_q, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s_kv, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s_kv, d)
+    out, lse = _flash_bhsd_lse(qt, kt, vt, float(scale), bool(causal),
+                               block_q, block_k)
+    return (jnp.swapaxes(out.reshape(b, h, s_q, d), 1, 2),
+            lse.reshape(b, h, s_q))
 
 
 def supports(seq_q, seq_kv, head_dim, block_q=DEFAULT_BLOCK_Q,

@@ -236,3 +236,39 @@ class TestCausalPadding:
             ref = dense_ref(np.asarray(q)[None, sl], np.asarray(q)[None, sl],
                             np.asarray(q)[None, sl], causal=True)[0]
             np.testing.assert_allclose(out[sl], ref, atol=2e-3, rtol=2e-3)
+
+
+class TestLseVariant:
+    @pytest.mark.parametrize("force_pallas_bwd", [False, True])
+    def test_out_and_lse_grads(self, force_pallas_bwd, monkeypatch):
+        """flash_attention_with_lse_bshd: both outputs differentiable; the
+        lse cotangent folds into delta on BOTH backward branches (the
+        Pallas d_lse path is forced via the threshold monkeypatch)."""
+        if force_pallas_bwd:
+            monkeypatch.setattr(fa, "_PALLAS_BWD_MIN_SEQ", 0)
+        b, s, h, d = 1, 256, 2, 128
+        q, k, v = (_rand((b, s, h, d), i + 60) for i in range(3))
+        do = _rand((b, s, h, d), 61)
+        dl = _rand((b, h, s), 62) * 0.1
+
+        def loss_flash(q_, k_, v_):
+            o, lse = fa.flash_attention_with_lse_bshd(q_, k_, v_,
+                                                      causal=True)
+            return jnp.sum(o * do) + jnp.sum(lse * dl)
+
+        def loss_ref(q_, k_, v_):
+            d_ = q_.shape[-1]
+            qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q_, k_, v_))
+            sc = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(d_)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            sc = jnp.where(mask, sc, -1e30)
+            lse = jax.scipy.special.logsumexp(sc, axis=-1)  # [b,h,s]
+            p = jnp.exp(sc - lse[..., None])
+            o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+            return jnp.sum(o * do) + jnp.sum(lse * dl)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-3, rtol=5e-3)
