@@ -230,3 +230,9 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 def load_profiler_result(path):
     raise NotImplementedError("load of XPlane traces: use TensorBoard")
+
+from .perf_meter import (  # noqa: F401,E402
+    PerfMeter,
+    detect_peak_flops,
+    transformer_flops_per_token,
+)

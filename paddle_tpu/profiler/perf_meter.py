@@ -1,0 +1,144 @@
+"""Goodput / MFU counters (SURVEY.md §5 "Metrics / logging": goodput and
+MFU as first-class training metrics — the reference exposes benchmark
+flags + VisualDL scalars; on TPU the canonical health number is
+model-FLOPs-utilization).
+
+Usage (wraps any train loop; host-side only, no device overhead):
+
+    meter = PerfMeter(model_flops_per_token=6 * n_params, peak_flops=...)
+    for batch in loader:
+        loss = step(x, y)
+        meter.step(tokens=x.size)
+        if meter.should_log():
+            print(meter.summary())
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+# bf16 peak FLOPs per chip by generation (public TPU specs)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 394e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak_flops(default: float = PEAK_FLOPS["v5e"]) -> float:
+    """Best-effort peak from the device kind string; `default` otherwise."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        if "v5 lite" in kind or "v5e" in kind:
+            return PEAK_FLOPS["v5e"]
+        if "v5p" in kind or "v5" in kind:
+            return PEAK_FLOPS["v5p"]
+        if "v4" in kind:
+            return PEAK_FLOPS["v4"]
+        if "v6" in kind:
+            return PEAK_FLOPS["v6e"]
+    except Exception:
+        pass
+    return default
+
+
+def transformer_flops_per_token(n_params: int, seq_len: int,
+                                hidden: int, layers: int) -> float:
+    """6*N matmul flops per token (fwd+bwd) + the attention quadratic term
+    (12*s*h per layer) — the standard MFU accounting."""
+    return 6.0 * n_params + 12.0 * seq_len * hidden * layers
+
+
+class PerfMeter:
+    """Running tokens/sec + MFU + goodput over a train loop.
+
+    goodput = productive_time / wall_time, where time spent in recorded
+    non-productive intervals (checkpoint saves, restarts, eval) is
+    excluded via `pause()`/`resume()` — the restart-based recovery
+    accounting of SURVEY.md §5 "Failure detection"."""
+
+    def __init__(self, model_flops_per_token: Optional[float] = None,
+                 peak_flops: Optional[float] = None, n_devices: int = 1,
+                 log_every_steps: int = 50):
+        self.flops_per_token = model_flops_per_token
+        self.peak_flops = peak_flops or detect_peak_flops()
+        self.n_devices = max(n_devices, 1)
+        self.log_every = log_every_steps
+        self._t_start = time.perf_counter()
+        self._t_window = self._t_start
+        self._paused_total = 0.0
+        self._pause_t0: Optional[float] = None
+        self._steps = 0
+        self._tokens = 0
+        self._tokens_window = 0
+
+    # -- non-productive intervals -------------------------------------
+    def pause(self):
+        if self._pause_t0 is None:
+            self._pause_t0 = time.perf_counter()
+
+    def resume(self):
+        if self._pause_t0 is not None:
+            self._paused_total += time.perf_counter() - self._pause_t0
+            self._pause_t0 = None
+
+    # -- accounting ----------------------------------------------------
+    def step(self, tokens: int = 0):
+        self._steps += 1
+        self._tokens += tokens
+        self._tokens_window += tokens
+
+    def should_log(self) -> bool:
+        return self._steps % self.log_every == 0
+
+    @property
+    def wall_time(self) -> float:
+        return time.perf_counter() - self._t_start
+
+    @property
+    def productive_time(self) -> float:
+        paused = self._paused_total
+        if self._pause_t0 is not None:
+            paused += time.perf_counter() - self._pause_t0
+        return self.wall_time - paused
+
+    @property
+    def goodput(self) -> float:
+        w = self.wall_time
+        return self.productive_time / w if w > 0 else 1.0
+
+    def tokens_per_sec(self, window: bool = True) -> float:
+        if window:
+            dt = time.perf_counter() - self._t_window
+            v = self._tokens_window / dt if dt > 0 else 0.0
+            self._t_window = time.perf_counter()
+            self._tokens_window = 0
+            return v
+        t = self.productive_time
+        return self._tokens / t if t > 0 else 0.0
+
+    def mfu(self, tokens_per_sec: Optional[float] = None) -> Optional[float]:
+        if self.flops_per_token is None:
+            return None
+        tps = tokens_per_sec if tokens_per_sec is not None \
+            else self.tokens_per_sec(window=False)
+        return (tps * self.flops_per_token) / (
+            self.peak_flops * self.n_devices)
+
+    def summary(self) -> dict:
+        tps = self.tokens_per_sec(window=False)
+        out = {
+            "steps": self._steps,
+            "tokens": self._tokens,
+            "tokens_per_sec": round(tps, 2),
+            "tokens_per_sec_per_chip": round(tps / self.n_devices, 2),
+            "goodput": round(self.goodput, 4),
+            "wall_time_s": round(self.wall_time, 2),
+        }
+        m = self.mfu(tps)
+        if m is not None:
+            out["mfu"] = round(m, 4)
+        return out

@@ -73,3 +73,26 @@ def test_grad_scaler_inf_skips_step_and_decays_scale():
     for n, p in net.named_parameters():
         np.testing.assert_array_equal(np.asarray(p._data), before[n])
     assert scaler.get_loss_scaling() == 128.0
+
+
+def test_perf_meter_counters():
+    import time as _time
+
+    from paddle_tpu.profiler import PerfMeter, transformer_flops_per_token
+
+    f = transformer_flops_per_token(n_params=1000, seq_len=8, hidden=4,
+                                    layers=2)
+    assert f == 6000 + 12 * 8 * 4 * 2
+    meter = PerfMeter(model_flops_per_token=1e6, peak_flops=1e12,
+                      n_devices=2, log_every_steps=2)
+    meter.step(tokens=100)
+    assert not meter.should_log()
+    meter.step(tokens=100)
+    assert meter.should_log()
+    meter.pause()
+    _time.sleep(0.05)
+    meter.resume()
+    s = meter.summary()
+    assert s["steps"] == 2 and s["tokens"] == 200
+    assert 0 < s["goodput"] < 1.0  # the pause was excluded
+    assert s["mfu"] is not None and s["mfu"] > 0
