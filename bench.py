@@ -132,8 +132,9 @@ def main():
 
     flops_per_tok = model_flops_per_token(cfg, seq)
     achieved_flops = tok_per_sec * flops_per_tok
-    # v5 lite (v5e-class): ~394 TFLOPs bf16 per chip; CPU: no meaningful MFU
-    peak = 394e12 * n_dev if on_tpu else 1e12
+    # v5 lite (v5e-class): 197 TFLOPs bf16 per chip (the headline 394 TOPS
+    # figure is INT8); CPU: no meaningful MFU
+    peak = 197e12 * n_dev if on_tpu else 1e12
     mfu = achieved_flops / peak
 
     result = {

@@ -140,6 +140,39 @@ from .ops.random_ops import (  # noqa: F401
     uniform,
 )
 
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions parity (numpy-backed printing)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """paddle.LazyGuard API parity. The reference defers parameter
+    materialization until first forward (a host-memory optimization for
+    giant CPU-side inits); here parameters are jax arrays initialized
+    directly on the accelerator, so eager init is already cheap and the
+    guard is a documented no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
 # --- subsystems ---
 from . import autograd  # noqa: F401
 from . import amp  # noqa: F401

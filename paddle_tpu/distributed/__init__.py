@@ -87,3 +87,4 @@ class ParallelEnv:
     @property
     def nranks(self):
         return env.get_world_size()
+from .collective import P2POp, batch_isend_irecv, irecv, isend  # noqa: F401,E402

@@ -168,3 +168,48 @@ def ppermute(x, axis_name, perm):
 def all_to_all_jit(x, axis_name, split_axis, concat_axis, tiled=True):
     return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
                               tiled=tiled)
+
+
+class P2POp:
+    """One pending point-to-point op (paddle.distributed.P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send handle API. Same single-controller contract as send():
+    eager host-side p2p does not exist in this build — p2p is expressed
+    inside jitted programs as lax.ppermute (SURVEY.md §5 mapping,
+    send_v2/recv_v2 -> ppermute); calling it eagerly raises with that
+    guidance."""
+    return send(tensor, dst=dst, group=group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src=src, group=group)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """paddle.distributed.batch_isend_irecv API shape.
+
+    Executes each op in order and returns completed task handles. With the
+    built-in send/recv this raises their documented NotImplementedError
+    (eager p2p is jit-only in the single-controller design — use
+    lax.ppermute inside shard_map); custom callables (tests, user shims)
+    run to completion."""
+    class _Done:
+        def wait(self):
+            return None
+
+        def is_completed(self):
+            return True
+
+    tasks = []
+    for op in p2p_op_list:
+        op.op(op.tensor, op.peer, group=op.group)
+        tasks.append(_Done())
+    return tasks

@@ -7,3 +7,4 @@ from .api import (  # noqa: F401
     train_step,
 )
 from .save_load import load, save  # noqa: F401
+from .save_load import TranslatedLayer  # noqa: F401,E402

@@ -95,9 +95,16 @@ from .norm_layers import (  # noqa: F401
     LayerNorm,
     LocalResponseNorm,
     RMSNorm,
+    SpectralNorm,
     SyncBatchNorm,
 )
 from .param_attr import ParamAttr  # noqa: F401
+
+# round-2 additions
+from .activation_layers import Silu as SiLU  # noqa: F401  (paddle alias)
+from .common_layers import Fold  # noqa: F401
+from .loss_layers import CTCLoss  # noqa: F401
+from .rnn_layers import BiRNN  # noqa: F401
 from .pooling_layers import (  # noqa: F401
     AdaptiveAvgPool1D,
     AdaptiveAvgPool2D,

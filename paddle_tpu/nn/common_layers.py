@@ -266,3 +266,18 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class Fold(Layer):
+    """col2im module (paddle.nn.Fold) over functional.fold."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        from .functional.extras import fold
+
+        return fold(x, *self._args)

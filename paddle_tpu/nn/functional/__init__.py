@@ -74,3 +74,14 @@ from .attention import (  # noqa: F401
     scaled_dot_product_attention,
     flash_attention,
 )
+
+from .extras import (  # noqa: F401,E402
+    affine_grid,
+    ctc_loss,
+    fold,
+    gather_tree,
+    grid_sample,
+    sequence_mask,
+    temporal_shift,
+)
+from .extras import fold as col2im  # noqa: F401,E402

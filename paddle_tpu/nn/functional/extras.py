@@ -1,0 +1,208 @@
+"""Round-2 functional completions (reference: python/paddle/nn/functional
+vision.py / loss.py / extension.py — SURVEY.md §2.2 "nn layers"):
+grid_sample/affine_grid, fold (col2im), ctc_loss, sequence_mask,
+gather_tree, temporal_shift.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, _apply_op, as_array
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3] -> sampling grid [N, H, W, 2] (paddle.nn.functional
+    .affine_grid, 4-D case)."""
+    if not isinstance(out_shape, (list, tuple)):
+        out_shape = [int(v) for v in as_array(out_shape)]
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)  # [h, w]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        # [n,2,3] x [h,w,3] -> [n,h,w,2]
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+
+    return _apply_op(f, theta, _name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1] (paddle parity;
+    modes bilinear/nearest, padding zeros/border)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unsupported mode {mode}")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(
+            f"grid_sample: unsupported padding_mode {padding_mode}")
+
+    def f(im, g):
+        n, c, h, w = im.shape
+        gx = g[..., 0].astype(jnp.float32)
+        gy = g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        def sample_at(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            out = jax.vmap(lambda img, jx, jy: img[:, jy, jx])(im, ixc, iyc)
+            if padding_mode == "zeros":
+                valid = ((ix >= 0) & (ix <= w - 1)
+                         & (iy >= 0) & (iy <= h - 1))
+                out = out * valid[:, None].astype(out.dtype)
+            return out  # [n, c, hg, wg]
+
+        if mode == "nearest":
+            return sample_at(jnp.round(fx), jnp.round(fy)).astype(im.dtype)
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wa = ((x1 - fx) * (y1 - fy))[:, None]
+        wb = ((x1 - fx) * (fy - y0))[:, None]
+        wc = ((fx - x0) * (y1 - fy))[:, None]
+        wd = ((fx - x0) * (fy - y0))[:, None]
+        va = sample_at(x0, y0)
+        vb = sample_at(x0, y1)
+        vc = sample_at(x1, y0)
+        vd = sample_at(x1, y1)
+        return (va * wa + vb * wb + vc * wc + vd * wd).astype(im.dtype)
+
+    return _apply_op(f, x, grid, _name="grid_sample")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: x [N, C*kh*kw, L] -> [N, C, H, W] (paddle.nn.functional.fold
+    — the inverse of unfold; overlaps SUM)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    n_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        cols = a.reshape(n, c, kh, kw, n_h, n_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[:, :, ys:ys + sh * n_h:sh,
+                             xs:xs + sw * n_w:sw].add(cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return _apply_op(f, x, _name="fold")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (reference: warpctc kernel; here optax's log-domain DP).
+
+    log_probs: [max_T, B, num_classes] (paddle layout), labels: [B, max_U]
+    int, lengths: [B]."""
+    import optax
+
+    def f(lp, lab, ilen, llen):
+        # optax: logits [B, T, K], paddings 1.0 at padded steps
+        logits = jnp.transpose(lp, (1, 0, 2)).astype(jnp.float32)
+        bsz, t, _ = logits.shape
+        u = lab.shape[1]
+        lp_pad = (jnp.arange(t)[None, :] >= ilen[:, None]).astype(jnp.float32)
+        lab_pad = (jnp.arange(u)[None, :] >= llen[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, lp_pad, lab.astype(jnp.int32),
+                                 lab_pad, blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # paddle: mean over batch of loss/label_len
+            return jnp.mean(per_seq / jnp.maximum(
+                llen.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+
+    return _apply_op(f, log_probs, labels, input_lengths, label_lengths,
+                     _name="ctc_loss")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [..., maxlen] 0/1 mask (paddle.nn.functional
+    .sequence_mask)."""
+    from ...framework import dtype as _dtype
+
+    a = as_array(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(a))
+    out = (jnp.arange(m)[None, :] < jnp.asarray(a).reshape(-1, 1))
+    out = out.reshape(tuple(a.shape) + (m,))
+    return Tensor(out.astype(_dtype.to_np_dtype(dtype)))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace: ids/parents [max_time, batch, beam] ->
+    full sequences (paddle.nn.functional.gather_tree)."""
+    def f(ids_, par_):
+        t, b, k = ids_.shape
+
+        def step(beams, i):
+            # beams: [b, k] current beam indices at time i+1
+            idx = par_[i]
+            prev = jnp.take_along_axis(idx, beams, axis=1)
+            tok = jnp.take_along_axis(ids_[i], prev, axis=1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        last_tok = ids_[t - 1]
+        _, toks = jax.lax.scan(step, init, jnp.arange(t - 2, -1, -1))
+        # toks: [t-1, b, k] in reverse order (times t-2 .. 0)
+        full = jnp.concatenate([toks[::-1], last_tok[None]], axis=0)
+        return full
+
+    return _apply_op(f, ids, parents, _name="gather_tree")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (paddle.nn.functional.temporal_shift)."""
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+             v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return _apply_op(f, x, _name="temporal_shift")

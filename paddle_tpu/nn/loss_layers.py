@@ -143,3 +143,20 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """CTC loss module (paddle.nn.CTCLoss) over functional ctc_loss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from .functional.extras import ctc_loss
+
+        return ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                        blank=self.blank, reduction=self.reduction,
+                        norm_by_times=norm_by_times)

@@ -5,6 +5,8 @@ axis is sharded under jit — SyncBatchNorm aliases BatchNorm + a mesh note).
 """
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..tensor import Tensor
@@ -218,7 +220,56 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
-                 name=None):
+    """Spectral weight normalization via power iteration (reference:
+    paddle.nn.SpectralNorm / spectral_norm op). Returns W / sigma_max,
+    updating the persistent u/v power-iteration vectors each call."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        rng = np.random.RandomState(0)
+        self.weight_u = Tensor(np.asarray(rng.randn(h), np.float32))
+        self.weight_v = Tensor(np.asarray(rng.randn(w), np.float32))
+        self.register_buffer("weight_u", self.weight_u)
+        self.register_buffer("weight_v", self.weight_v)
+
+    def forward(self, weight):
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def f(w_, u, v):
+            perm = (dim,) + tuple(i for i in range(w_.ndim) if i != dim)
+            mat = jnp.transpose(w_, perm).reshape(w_.shape[dim], -1)
+
+            def it(carry, _):
+                u_, v_ = carry
+                v_ = mat.T @ u_
+                v_ = v_ / (jnp.linalg.norm(v_) + eps)
+                u_ = mat @ v_
+                u_ = u_ / (jnp.linalg.norm(u_) + eps)
+                return (u_, v_), None
+
+            (u, v), _ = jax.lax.scan(it, (u.astype(mat.dtype),
+                                          v.astype(mat.dtype)),
+                                     None, length=iters)
+            # reference semantics: u/v are CONSTANTS for the gradient
+            # (d sigma/dW = u v^T only, no power-iteration backprop)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ (mat @ v)
+            return w_ / sigma, u, v
+
+        from ..tensor import _apply_op
+
+        out, new_u, new_v = _apply_op(f, weight, self.weight_u,
+                                      self.weight_v, _name="spectral_norm")
+        self.weight_u._rebind(new_u._data)
+        self.weight_v._rebind(new_v._data)
+        return out

@@ -259,20 +259,71 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
-        from ..ops.manipulation import flip, stack
+        from ..ops.manipulation import stack
 
         a = as_array(inputs)
         t_axis = 0 if self.time_major else 1
         steps = a.shape[t_axis]
-        xs = [inputs[(slice(None),) * t_axis + (t,)] for t in range(steps)]
+        time_ix = list(range(steps))
         if self.is_reverse:
-            xs = xs[::-1]
+            # variable-length reverse: iterate T-1..0 with per-sequence
+            # validity so padding steps are no-ops (the reference masks
+            # right-padding instead of consuming it first)
+            time_ix = time_ix[::-1]
+        lens = None
+        if sequence_length is not None:
+            import jax.numpy as _jnp
+
+            lens = _jnp.asarray(as_array(sequence_length))
         states = initial_states
-        outs = []
-        for x_t in xs:
-            out, states = self.cell(x_t, states)
-            outs.append(out)
-        if self.is_reverse:
-            outs = outs[::-1]
-        out = stack(outs, axis=t_axis)
+        outs = {}
+        for t in time_ix:
+            x_t = inputs[(slice(None),) * t_axis + (t,)]
+            out, new_states = self.cell(x_t, states)
+            if lens is not None:
+                import jax.numpy as _jnp
+
+                from ..tensor import Tensor as _T
+
+                valid = (lens > t)  # [batch]
+                def _sel(new, old):
+                    n_arr = as_array(new)
+                    v = valid.reshape((-1,) + (1,) * (n_arr.ndim - 1))
+                    if old is None:
+                        return _T(_jnp.where(v, n_arr,
+                                             _jnp.zeros_like(n_arr)))
+                    return _T(_jnp.where(v, n_arr, as_array(old)))
+
+                import jax
+
+                if states is None:
+                    states = jax.tree_util.tree_map(
+                        lambda s: None, new_states,
+                        is_leaf=lambda s: isinstance(s, _T))
+                new_states = jax.tree_util.tree_map(
+                    _sel, new_states, states,
+                    is_leaf=lambda s: isinstance(s, _T) or s is None)
+                out = _sel(out, None)  # padded outputs are zero
+            outs[t] = out
+            states = new_states
+        out = stack([outs[t] for t in range(steps)], axis=t_axis)
         return out, states
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (paddle.nn.BiRNN): runs cell_fw forward
+    and cell_bw reverse, concatenating outputs on the feature dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

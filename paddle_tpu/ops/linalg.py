@@ -365,3 +365,31 @@ def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
         if lo == hi:
             lo, hi = lo - 0.5, hi + 0.5
     return Tensor(jnp.linspace(lo, hi, int(bins) + 1, dtype=jnp.float32))
+
+
+def cond(x, p=None, name=None):
+    """Condition number (paddle.linalg.cond): ||A||_p * ||A^-1||_p; p=None
+    means 2-norm via singular values."""
+    def f(a):
+        if p is None or p == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        if p == -2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., -1] / s[..., 0]
+        if p == "fro":
+            na = jnp.sqrt(jnp.sum(a * a, axis=(-2, -1)))
+            ia = jnp.linalg.inv(a)
+            return na * jnp.sqrt(jnp.sum(ia * ia, axis=(-2, -1)))
+        ia = jnp.linalg.inv(a)
+        if p in (1, -1):
+            axis = -2
+        elif p in (np.inf, -np.inf):
+            axis = -1
+        else:
+            raise ValueError(f"cond: unsupported p {p}")
+        red = jnp.max if p in (1, np.inf) else jnp.min
+        return (red(jnp.sum(jnp.abs(a), axis=axis), axis=-1)
+                * red(jnp.sum(jnp.abs(ia), axis=axis), axis=-1))
+
+    return _apply_op(f, x, _name="cond")

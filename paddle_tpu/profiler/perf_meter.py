@@ -17,17 +17,20 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-# bf16 peak FLOPs per chip by generation (public TPU specs)
+# bf16 peak FLOPs per chip by generation (public TPU specs; note v5e's
+# headline 394 TOPS is INT8 — bf16 is half that)
 PEAK_FLOPS = {
     "v4": 275e12,
-    "v5e": 394e12,
+    "v5e": 197e12,
     "v5p": 459e12,
     "v6e": 918e12,
 }
 
 
-def detect_peak_flops(default: float = PEAK_FLOPS["v5e"]) -> float:
-    """Best-effort peak from the device kind string; `default` otherwise."""
+def detect_peak_flops(default=None):
+    """Best-effort bf16 peak from the device kind string. Returns None for
+    unrecognized devices (CPU/GPU dev boxes) so MFU is omitted rather than
+    computed against a meaningless peak."""
     try:
         import jax
 
@@ -121,7 +124,7 @@ class PerfMeter:
         return self._tokens / t if t > 0 else 0.0
 
     def mfu(self, tokens_per_sec: Optional[float] = None) -> Optional[float]:
-        if self.flops_per_token is None:
+        if self.flops_per_token is None or self.peak_flops is None:
             return None
         tps = tokens_per_sec if tokens_per_sec is not None \
             else self.tokens_per_sec(window=False)
