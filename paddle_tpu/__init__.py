@@ -115,6 +115,8 @@ from .ops.linalg import (  # noqa: F401
     histogram,
     histogram_bin_edges,
     histogramdd,
+    lu,
+    lu_unpack,
     matmul,
     matrix_transpose,
     mm,

@@ -65,13 +65,55 @@ irfftn = _wrapn(jnp.fft.irfftn)
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+def _resolve_axes(ndim, s, axes):
+    if axes is None:
+        axes = list(range(ndim - (len(s) if s is not None else ndim), ndim)) \
+            if s is not None else list(range(ndim))
+    return [int(a) for a in axes]
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d FFT of a Hermitian-symmetric signal → real output
+    (python/paddle/fft.py `hfftn` parity): forward c2c over the leading
+    axes, then the Hermitian c2r transform on the last axis (verified
+    against the torch.fft.hfftn/ihfftn convention)."""
     _check_norm(norm)
-    return _apply_op(
-        lambda a: jnp.fft.hfft(
-            jnp.fft.ifft(a, n=None if s is None else s[0], axis=axes[0],
-                         norm=norm),
-            n=None if s is None else s[1], axis=axes[1], norm=norm),
-        x, _name="hfft2")
+
+    def f(a):
+        ax = _resolve_axes(a.ndim, s, axes)
+        out = a
+        for i, axis in enumerate(ax[:-1]):
+            out = jnp.fft.fft(out, n=None if s is None else s[i], axis=axis,
+                              norm=norm)
+        return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=ax[-1],
+                            norm=norm)
+
+    return _apply_op(f, x, _name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of `hfftn` (real input → Hermitian-symmetric half-spectrum):
+    r2c on the last axis, then inverse c2c over the leading axes (the
+    truncated-`ifftn` identity: ihfftn(y) == ifftn(y)[..., :n//2+1])."""
+    _check_norm(norm)
+
+    def f(a):
+        ax = _resolve_axes(a.ndim, s, axes)
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=ax[-1],
+                            norm=norm)
+        for i, axis in enumerate(ax[:-1]):
+            out = jnp.fft.ifft(out, n=None if s is None else s[i], axis=axis,
+                               norm=norm)
+        return out
+
+    return _apply_op(f, x, _name="ihfftn")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm, name=name)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -118,6 +118,16 @@ from .pooling_layers import (  # noqa: F401
     MaxPool1D,
     MaxPool2D,
     MaxPool3D,
+    MaxUnPool1D,
+    MaxUnPool2D,
+    MaxUnPool3D,
+)
+from .loss_layers import (  # noqa: F401
+    HSigmoidLoss,
+    MultiLabelSoftMarginLoss,
+    PoissonNLLLoss,
+    SoftMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .rnn_layers import (  # noqa: F401
     GRU,

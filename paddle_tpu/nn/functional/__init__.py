@@ -33,18 +33,26 @@ from .loss import (  # noqa: F401
     binary_cross_entropy_with_logits,
     cosine_embedding_loss,
     cross_entropy,
+    dice_loss,
     hinge_embedding_loss,
+    hsigmoid_loss,
     kl_div,
     l1_loss,
     log_loss,
+    margin_cross_entropy,
     margin_ranking_loss,
     mse_loss,
+    multi_label_soft_margin_loss,
     nll_loss,
+    npair_loss,
+    poisson_nll_loss,
     sigmoid_focal_loss,
     smooth_l1_loss,
+    soft_margin_loss,
     softmax_with_cross_entropy,
     square_error_cost,
     triplet_margin_loss,
+    triplet_margin_with_distance_loss,
 )
 from .norm import (  # noqa: F401
     batch_norm,
@@ -68,6 +76,9 @@ from .pooling import (  # noqa: F401
     max_pool1d,
     max_pool2d,
     max_pool3d,
+    max_unpool1d,
+    max_unpool2d,
+    max_unpool3d,
 )
 from ...ops.manipulation import pad  # noqa: F401
 from .attention import (  # noqa: F401

@@ -267,3 +267,182 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
     args = [normalizer] if normalizer is not None else []
     return _apply_op(f, logit, label, *args, _name="sigmoid_focal_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)), label in {-1, +1}."""
+    def f(x, y):
+        out = jax.nn.softplus(-y * x)  # stable log(1+exp(z))
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, _name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(x, y, *w):
+        logsig = jax.nn.log_sigmoid
+        out = -(y * logsig(x) + (1 - y) * logsig(-x))
+        if w:
+            out = out * w[0]
+        out = jnp.mean(out, axis=-1)
+        return _reduce(out, reduction)
+
+    args = [weight] if weight is not None else []
+    return _apply_op(f, input, label, *args, _name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation term for label > 1
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, _name="poisson_nll_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2*|X∩Y| / (|X|+|Y|); label is int class ids with trailing dim 1
+    (python/paddle/nn/functional/loss.py `dice_loss` parity)."""
+    def f(x, y):
+        y = y.squeeze(-1).astype(jnp.int32)
+        oh = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * oh, axis=reduce_dims)
+        denom = jnp.sum(x, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        return jnp.mean(1.0 - (2.0 * inse) / (denom + epsilon))
+
+    return _apply_op(f, input, label, _name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (python/paddle/nn/functional/loss.py `npair_loss`):
+    softmax CE over anchor·positiveᵀ similarities with a same-label soft
+    target matrix, plus l2 regularization of the embeddings."""
+    def f(a, p, y):
+        y = y.reshape(-1)
+        l2loss = (jnp.mean(jnp.sum(jnp.square(a), axis=1))
+                  + jnp.mean(jnp.sum(jnp.square(p), axis=1))) * (0.25 * l2_reg)
+        sim = a @ p.T
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = jnp.mean(-jnp.sum(tgt * logp, axis=1))
+        return ce + l2loss
+
+    return _apply_op(f, anchor, positive, labels, _name="npair_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        def distance_function(u, v):
+            return jnp.sqrt(jnp.sum(jnp.square(u - v), axis=-1) + 1e-12)
+
+    def f(a, pos, neg):
+        dp = distance_function(a, pos)
+        dn = distance_function(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, distance_function(pos, neg))
+        out = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, positive, negative,
+                     _name="triplet_margin_with_distance_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace/CosFace-family margin softmax CE over cosine logits
+    (reference `margin_cross_entropy` / `c_margin_cross_entropy`). The
+    target-class logit cos(θ) becomes cos(m1·θ + m2) - m3 before scaling.
+
+    `group=False`/`None` runs the single-shard path; TP vocab-sharded
+    logits should use mpu.ParallelCrossEntropy with pre-margined logits.
+    """
+    if group not in (None, False):
+        raise NotImplementedError(
+            "margin_cross_entropy over a model-parallel group: apply the "
+            "margin locally then use "
+            "distributed.fleet.layers.mpu.ParallelCrossEntropy")
+
+    def f(z, y):
+        y = y.reshape(-1).astype(jnp.int32)
+        # keep strictly inside (-1, 1): d/dx arccos at ±1 is ∓inf, and
+        # normalized features routinely round to exactly 1.0
+        eps = 1e-6 if z.dtype == jnp.float32 else 1e-3
+        cos_t = jnp.clip(jnp.take_along_axis(z, y[:, None], axis=1)[:, 0],
+                         -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        z = scale * jnp.where(
+            jax.nn.one_hot(y, z.shape[1], dtype=bool), target[:, None], z)
+        logp = jax.nn.log_softmax(z, axis=1)
+        ce = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        if return_softmax:
+            return _reduce(ce, reduction), jnp.exp(logp)
+        return _reduce(ce, reduction)
+
+    return _apply_op(f, logits, label, _name="margin_cross_entropy")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference `hsigmoid_loss`). Default tree:
+    the complete binary tree over `num_classes` leaves used by the
+    reference — internal nodes 1..num_classes-1 in heap order, leaf l at
+    heap index l + num_classes; `weight` is [num_classes-1, dim].
+
+    Custom trees pass `path_table`/`path_code` [N, D] padded with -1.
+    `is_sparse` is a storage hint in the reference; dense gather here.
+    """
+    import numpy as _np
+
+    if path_table is None:
+        depth = int(_np.ceil(_np.log2(max(num_classes, 2)))) + 1
+        tbl = _np.full((num_classes, depth), -1, dtype=_np.int64)
+        code = _np.full((num_classes, depth), -1, dtype=_np.int64)
+        for leaf in range(num_classes):
+            node, d = leaf + num_classes, 0
+            path = []
+            while node > 1:
+                path.append((node // 2, node % 2))
+                node //= 2
+            for parent, bit in reversed(path):
+                tbl[leaf, d] = parent - 1  # row into weight
+                code[leaf, d] = bit
+                d += 1
+        table_for = lambda y: jnp.asarray(tbl)[y]
+        code_for = lambda y: jnp.asarray(code)[y]
+    else:
+        pt_, pc_ = as_array(path_table), as_array(path_code)
+        table_for = lambda y: pt_.astype(jnp.int32)
+        code_for = lambda y: pc_.astype(jnp.int32)
+
+    def f(x, y, w, *b):
+        y = y.reshape(-1).astype(jnp.int32)
+        nodes = table_for(y)                       # [N, D]
+        codes = code_for(y).astype(x.dtype)        # [N, D]
+        mask = (nodes >= 0).astype(x.dtype)
+        safe = jnp.maximum(nodes, 0)
+        wp = w[safe]                               # [N, D, dim]
+        z = jnp.einsum("nd,nkd->nk", x, wp)
+        if b:
+            z = z + b[0].reshape(-1)[safe]
+        # P(bit) via sigmoid: bit 0 → sigmoid(z), bit 1 → sigmoid(-z)
+        sign = 1.0 - 2.0 * codes
+        out = jnp.sum(mask * jax.nn.softplus(-sign * z), axis=1)
+        return out[:, None]  # per-sample [N, 1], the reference's shape
+
+    args = [bias] if bias is not None else []
+    return _apply_op(f, input, label, weight, *args, _name="hsigmoid_loss")

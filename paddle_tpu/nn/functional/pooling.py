@@ -37,14 +37,29 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
     pad = _norm_padding(padding, n)
 
     def f(a):
+        eff_pad = pad
+        if ceil_mode and not isinstance(pad, str):
+            # extra right-padding so the window count rounds up; windows
+            # are guaranteed to still touch ≥1 real/base-pad element
+            spatial_off = 1 if channel_last else 2
+            eff_pad = []
+            for d in range(n):
+                size = a.shape[spatial_off + d]
+                p0, p1 = pad[d]
+                span = size + p0 + p1 - ks[d]
+                out_ceil = -(-span // st[d]) + 1
+                if (out_ceil - 1) * st[d] >= size + p0:
+                    out_ceil -= 1  # window may not start inside right pad
+                extra = (out_ceil - 1) * st[d] + ks[d] - (size + p0 + p1)
+                eff_pad.append((p0, p1 + max(extra, 0)))
         if channel_last:
             window = (1,) + ks + (1,)
             strides = (1,) + st + (1,)
-            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+            pads = ([(0, 0)] + list(eff_pad) + [(0, 0)]) if not isinstance(eff_pad, str) else eff_pad
         else:
             window = (1, 1) + ks
             strides = (1, 1) + st
-            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+            pads = ([(0, 0), (0, 0)] + list(eff_pad)) if not isinstance(eff_pad, str) else eff_pad
         if average:
             ones = jnp.ones_like(a)
             s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
@@ -54,7 +69,7 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
                 return s / cnt
             denom = float(np.prod(ks))
             if isinstance(pads, str) or all(p == (0, 0) for p in
-                                            (pad if not isinstance(pad, str) else [])):
+                                            (eff_pad if not isinstance(eff_pad, str) else [])):
                 return s / denom
             if exclusive:
                 cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
@@ -69,6 +84,9 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     fmt = "NWC" if data_format == "NLC" else "NCW"
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 1,
+                              channel_last=fmt == "NWC", ceil_mode=ceil_mode)
     out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, fmt,
                 ceil_mode)
     return out
@@ -76,6 +94,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 2,
+                              channel_last=data_format == "NHWC",
+                              ceil_mode=ceil_mode)
     out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
                 data_format, ceil_mode)
     return out
@@ -83,6 +105,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 3,
+                              channel_last=data_format == "NDHWC",
+                              ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
                  data_format, ceil_mode)
 
@@ -174,3 +200,121 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+def _max_pool_mask(x, kernel_size, stride, padding, n, channel_last,
+                   ceil_mode=False):
+    """Max pool that also returns the argmax flat spatial index per window
+    (the `mask` of the reference's max_pool*d, consumed by max_unpool*d).
+
+    Static unroll over the prod(ks) kernel offsets: each offset is a
+    strided slice of the -inf-padded input; argmax over the offset axis
+    picks the winner, whose global flat index is reconstructed from the
+    window origin. All shapes static → jit/TPU friendly.
+    """
+    import itertools
+
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("string padding not supported with return_mask")
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)  # NC<spatial>
+        spatial = a.shape[2:]
+        def n_out(d):
+            span = spatial[d] + pad[d][0] + pad[d][1] - ks[d]
+            q = -(-span // st[d]) if ceil_mode else span // st[d]
+            out = q + 1
+            if ceil_mode and (out - 1) * st[d] >= spatial[d] + pad[d][0]:
+                out -= 1  # last window may not start inside the right pad
+            return out
+        out_spatial = tuple(n_out(d) for d in range(n))
+        eff_pad = [
+            (pad[d][0],
+             max(pad[d][1],
+                 (out_spatial[d] - 1) * st[d] + ks[d] - spatial[d] - pad[d][0]))
+            for d in range(n)]
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        ap = jnp.pad(a, [(0, 0), (0, 0)] + list(eff_pad), constant_values=neg)
+        vals, idxs = [], []
+        for offs in itertools.product(*[range(k) for k in ks]):
+            sl = [slice(None), slice(None)] + [
+                slice(offs[d], offs[d] + (out_spatial[d] - 1) * st[d] + 1,
+                      st[d]) for d in range(n)]
+            vals.append(ap[tuple(sl)])
+            # global (unpadded) flat index of this offset per output cell
+            flat = jnp.zeros(out_spatial, dtype=jnp.int32)
+            for d in range(n):
+                coord = (jnp.arange(out_spatial[d], dtype=jnp.int32) * st[d]
+                         + offs[d] - pad[d][0])
+                shape = [1] * n
+                shape[d] = out_spatial[d]
+                flat = flat * spatial[d] + coord.reshape(shape)
+            idxs.append(flat)
+        v = jnp.stack(vals, axis=2)              # [N,C,K,*out]
+        i = jnp.stack(idxs, axis=0)              # [K,*out]
+        best = jnp.argmax(v, axis=2)             # [N,C,*out]
+        out = jnp.max(v, axis=2)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(i, v.shape[:2] + i.shape),
+            best[:, :, None], axis=2)[:, :, 0]
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
+        return out, mask
+
+    return _apply_op(f, x, _name="max_pool_mask")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, n, output_size,
+                channel_last):
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n)
+
+    def f(a, idx):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        spatial = a.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(int(s) for s in output_size)[-n:]
+        else:
+            out_spatial = tuple(
+                (spatial[d] - 1) * st[d] - pad[d][0] - pad[d][1] + ks[d]
+                for d in range(n))
+        N, C = a.shape[:2]
+        flat_in = a.reshape(N * C, -1)
+        flat_idx = idx.reshape(N * C, -1).astype(jnp.int32)
+        size = int(np.prod(out_spatial))
+        out = jnp.zeros((N * C, size), dtype=a.dtype)
+        rows = jnp.arange(N * C, dtype=jnp.int32)[:, None]
+        out = out.at[rows, flat_idx].set(flat_in)
+        out = out.reshape((N, C) + out_spatial)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return _apply_op(f, x, indices, _name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, channel_last=data_format == "NLC")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, channel_last=data_format == "NHWC")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, channel_last=data_format == "NDHWC")

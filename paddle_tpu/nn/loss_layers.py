@@ -160,3 +160,81 @@ class CTCLoss(Layer):
         return ctc_loss(log_probs, labels, input_lengths, label_lengths,
                         blank=self.blank, reduction=self.reduction,
                         norm_by_times=norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input = log_input
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, log_input=self.log_input,
+                                  full=self.full, epsilon=self.epsilon,
+                                  reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid with learned internal-node classifiers
+    (python/paddle/nn/layer/loss.py `HSigmoidLoss` parity)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        self.weight = self.create_parameter(
+            shape=[num_classes - 1, feature_size], attr=weight_attr,
+            dtype="float32")
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[num_classes - 1, 1], attr=bias_attr, dtype="float32",
+                is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code, is_sparse=self.is_sparse)

@@ -16,17 +16,20 @@ class _PoolNd(Layer):
 
 class MaxPool1D(_PoolNd):
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                              return_mask=self.kw.get("return_mask", False))
 
 
 class MaxPool2D(_PoolNd):
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                              return_mask=self.kw.get("return_mask", False))
 
 
 class MaxPool3D(_PoolNd):
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                              return_mask=self.kw.get("return_mask", False))
 
 
 class AvgPool1D(_PoolNd):
@@ -90,3 +93,35 @@ class AdaptiveMaxPool2D(AdaptiveMaxPool1D):
 class AdaptiveMaxPool3D(AdaptiveMaxPool1D):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class _UnPoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+
+class MaxUnPool1D(_UnPoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCL",
+                              self.output_size)
+
+
+class MaxUnPool2D(_UnPoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCHW",
+                              self.output_size)
+
+
+class MaxUnPool3D(_UnPoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCDHW",
+                              self.output_size)

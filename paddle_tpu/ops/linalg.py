@@ -257,6 +257,49 @@ def lu(x, pivot=True, get_infos=False, name=None):
     return tuple(outs)
 
 
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack `lu` results into P, L, U (paddle.linalg.lu_unpack parity).
+
+    `y` holds 1-based LAPACK-style sequential row transpositions as
+    returned by :func:`lu`; P satisfies ``P @ L @ U == A``.
+    """
+    lu_ = as_array(x)
+    piv = as_array(y).astype(jnp.int32) - 1  # back to 0-based
+    m, n = lu_.shape[-2], lu_.shape[-1]
+    k = min(m, n)
+
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_[..., :, :k], k=-1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+    if unpack_pivots:
+        def perm_of(pv):
+            def body(i, perm):
+                j = pv[i]
+                pi, pj = perm[i], perm[j]
+                return perm.at[i].set(pj).at[j].set(pi)
+
+            return jax.lax.fori_loop(0, pv.shape[0], body,
+                                     jnp.arange(m, dtype=jnp.int32))
+
+        if piv.ndim == 1:
+            perm = perm_of(piv)
+            P = jnp.eye(m, dtype=lu_.dtype)[:, perm]
+        else:
+            bshape = piv.shape[:-1]
+            perms = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1]))
+            # P[..., i, perm[j]] = eye: one_hot(perm, m) is [B, m, m] with
+            # rows e_perm[j]; P = one_hot(perm)^T per batch (vectorized)
+            P = jnp.swapaxes(jax.nn.one_hot(perms, m, dtype=lu_.dtype), -1, -2)
+            P = P.reshape(*bshape, m, m)
+    wrap = lambda v: Tensor(v) if v is not None else None
+    return wrap(P), wrap(L), wrap(U)
+
+
+def matrix_exp(x, name=None):
+    return _apply_op(jax.scipy.linalg.expm, x, _name="matrix_exp")
+
+
 def eig(x, name=None):
     a = np.asarray(as_array(x))
     w, v = np.linalg.eig(a)

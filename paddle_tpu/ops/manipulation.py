@@ -276,6 +276,16 @@ def flip(x, axis, name=None):
     return _apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x, _name="flip")
 
 
+def fliplr(x, name=None):
+    """Flip along axis 1 (python/paddle/tensor/manipulation.py parity)."""
+    return _apply_op(lambda a: jnp.flip(a, axis=1), x, _name="fliplr")
+
+
+def flipud(x, name=None):
+    """Flip along axis 0 (python/paddle/tensor/manipulation.py parity)."""
+    return _apply_op(lambda a: jnp.flip(a, axis=0), x, _name="flipud")
+
+
 def roll(x, shifts, axis=None, name=None):
     sh = _int_list(shifts)
     ax = _int_list(axis) if axis is not None else None
