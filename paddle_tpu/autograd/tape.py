@@ -275,11 +275,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 _accumulate_leaf(t, g)
             return
         if g is None:
-            if t.size != 1:
-                raise RuntimeError(
-                    "grad can be implicitly created only for scalar outputs; "
-                    f"got shape {tuple(t.shape)}"
-                )
+            # paddle semantics (python/paddle/autograd): grad_tensor=None seeds
+            # ones for ANY shape, not just scalars (unlike torch which raises).
             g = jnp.ones(t._data.shape, dtype=t._data.dtype)
         elif isinstance(g, Tensor):
             g = g._data

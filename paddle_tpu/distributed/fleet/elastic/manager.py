@@ -127,6 +127,14 @@ class ElasticManager:
                           f"{self.node_rank} may be evicted by peers",
                           file=sys.stderr)
             self._stop.wait(self.interval)
+        # the thread may have written a beat AFTER stop() deleted the key
+        # (stop's join is bounded; under load the race resurrects a dead
+        # node until TTL and its peers see a phantom membership change) —
+        # clean up our own key on the way out
+        try:
+            self.store.delete(f"node/{self.node_rank}")
+        except OSError:
+            pass
 
     def start(self):
         self._thread = threading.Thread(target=self._beat, daemon=True)

@@ -4,6 +4,8 @@ CheckpointManager retention, model+optimizer convenience wrappers."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.mesh as mesh_mod
 from paddle_tpu.distributed import checkpoint as ckpt

@@ -4,6 +4,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.mesh as mesh_mod
 

@@ -4,6 +4,8 @@ eager-vs-jit parity assertion (§4.4 dy2static pattern)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 

@@ -7,6 +7,8 @@ import math
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import jax
 import jax.numpy as jnp
 

@@ -9,6 +9,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
 from paddle_tpu.distributed.launch.context import JobContext, rank_env
 from paddle_tpu.distributed.launch.controller import CollectiveController

