@@ -124,7 +124,9 @@ from .ops.linalg import (  # noqa: F401
     norm,
     pdist,
     tensordot,
+    vecdot,
 )
+from .ops.inplace import *  # noqa: F401,F403 — the paddle `op_` family
 from .ops.random_ops import (  # noqa: F401
     bernoulli,
     binomial,

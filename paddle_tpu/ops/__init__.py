@@ -87,39 +87,9 @@ def _install_tensor_methods():
     Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, o)
     Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, o)
 
-    # in-place arithmetic (paddle x.add_(y) style + augmented assignment)
-    def _inplace(fn):
-        def m(self, other):
-            out = fn(self, other)
-            self._rebind(out._data, out._tape_node, out._tape_out_idx)
-            return self
-
-        return m
-
-    Tensor.add_ = _inplace(math.add)
-    Tensor.subtract_ = _inplace(math.subtract)
-    Tensor.multiply_ = _inplace(math.multiply)
-    Tensor.divide_ = _inplace(math.divide)
-    Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None: (
-        self._rebind(math.scale(self, scale, bias, bias_after_scale)._data) or self
-    )
-    Tensor.clip_ = _inplace(lambda s, *a, **k: math.clip(s, *a, **k))
-
-    def _clip_inplace(self, min=None, max=None, name=None):
-        out = math.clip(self, min, max)
-        self._rebind(out._data, out._tape_node, out._tape_out_idx)
-        return self
-
-    Tensor.clip_ = _clip_inplace
-    Tensor.exp_ = lambda self: (self._rebind(math.exp(self)._data) or self)
-    Tensor.sqrt_ = lambda self: (self._rebind(math.sqrt(self)._data) or self)
-    Tensor.reciprocal_ = lambda self: (
-        self._rebind(math.reciprocal(self)._data) or self
-    )
-    Tensor.floor_ = lambda self: (self._rebind(math.floor(self)._data) or self)
-    Tensor.ceil_ = lambda self: (self._rebind(math.ceil(self)._data) or self)
-    Tensor.round_ = lambda self: (self._rebind(math.round(self)._data) or self)
-    Tensor.tanh_ = lambda self: (self._rebind(math.tanh(self)._data) or self)
+    # arithmetic/elementwise `op_` methods come from ops.inplace (generated,
+    # tape-aware) — installed below; only the stateful random fills and
+    # names needing special handling stay handwritten here
     Tensor.uniform_ = random_ops.uniform_
     Tensor.normal_ = random_ops.normal_
     Tensor.exponential_ = random_ops.exponential_
@@ -136,3 +106,9 @@ def _install_tensor_methods():
 
 
 _install_tensor_methods()
+
+# the generated paddle `op_` in-place family (~60 variants) — installed
+# after the handwritten methods above so explicit definitions win
+from . import inplace  # noqa: E402,F401
+
+inplace.install_tensor_inplace_methods()

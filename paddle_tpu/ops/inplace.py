@@ -1,0 +1,102 @@
+"""The paddle in-place ``op_`` family (python/paddle/tensor/ `*_` variants,
+SURVEY.md §2.2 Tensor API).
+
+Paddle exposes ~60 in-place variants (``x.add_(y)``, ``paddle.clip_(x)``…).
+On TPU there is no in-place mutation of device buffers — XLA buffers are
+immutable — so "in-place" is a *binding* operation: compute the functional
+result, then rebind the Python ``Tensor`` to the new buffer/tape node
+(``Tensor._rebind``).  Under jit this donates cleanly; in eager it preserves
+paddle's aliasing semantics (every view of the same ``Tensor`` object sees
+the update, and autograd flows through the rebound tape node exactly like
+the reference's inplace grad nodes).
+
+Each generated ``op_`` is installed (a) as a module-level function here,
+re-exported at ``paddle_tpu.*`` top level, and (b) as a ``Tensor`` method.
+"""
+from __future__ import annotations
+
+import types
+
+from ..tensor import Tensor
+
+# functional source modules, searched in order for each base-op name
+from . import activation, creation, logic, manipulation, math, reduction, search
+
+
+def _make_inplace(name: str, fn):
+    def op_(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        if not isinstance(out, Tensor):  # e.g. ops returning tuples — guard
+            raise TypeError(f"{name}_ source op returned {type(out)}")
+        x._rebind(out._data, out._tape_node, out._tape_out_idx)
+        return x
+
+    op_.__name__ = name + "_"
+    op_.__qualname__ = name + "_"
+    op_.__doc__ = (
+        f"In-place variant of ``{name}`` (paddle ``{name}_`` parity): "
+        f"rebinds ``x`` to the functional result."
+    )
+    return op_
+
+
+# base ops that get a generated `_` variant; mirrors paddle's published
+# inplace surface (python/paddle/tensor/__init__.py tensor_method_func list)
+_UNARY = [
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh",
+    "ceil", "conj", "cos", "cosh", "digamma", "erf", "erfinv", "exp",
+    "expm1", "floor", "frac", "i0", "lgamma", "log", "log10", "log1p",
+    "log2", "logical_not", "logit", "neg", "reciprocal", "round", "rsqrt",
+    "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+    "trunc", "bitwise_not",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "floor_mod",
+    "bitwise_and", "bitwise_or", "bitwise_xor",
+    "logical_and", "logical_or", "logical_xor",
+    "less_than", "less_equal", "greater_than", "greater_equal",
+    "equal", "not_equal",
+    "gcd", "lcm", "fmax", "fmin", "lerp", "hypot", "nextafter",
+    "copysign", "ldexp",
+]
+_OTHER = [  # ops with extra non-tensor args; same generic wrapper works
+    "clip", "scale", "cast", "flatten", "squeeze", "unsqueeze",
+    "nan_to_num", "tril", "triu", "cumsum", "cumprod", "renorm",
+    "index_add", "index_fill", "index_put", "masked_fill", "masked_scatter",
+    "put_along_axis", "fill_diagonal", "lerp", "stanh", "softmax",
+    "hardtanh", "leaky_relu", "relu6", "thresholded_relu",
+    "apply",
+]
+
+_SOURCES = [math, reduction, manipulation, logic, search, activation, creation]
+
+
+def _find(name):
+    for mod in _SOURCES:
+        fn = getattr(mod, name, None)
+        if isinstance(fn, types.FunctionType):
+            return fn
+    return None
+
+
+_generated = []
+for _name in dict.fromkeys(_UNARY + _BINARY + _OTHER):
+    if _name + "_" in globals():
+        continue
+    _fn = _find(_name)
+    if _fn is None:
+        continue
+    _op = _make_inplace(_name, _fn)
+    globals()[_name + "_"] = _op
+    _generated.append(_name + "_")
+
+__all__ = list(_generated)
+
+
+def install_tensor_inplace_methods():
+    """Attach every generated ``op_`` as a Tensor method (idempotent;
+    explicit hand-written methods in ops/__init__ win)."""
+    for nm in _generated:
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, globals()[nm])

@@ -40,6 +40,15 @@ def mv(x, vec, name=None):
     return _apply_op(jnp.matmul, x, vec, _name="mv")
 
 
+def vecdot(x, y, axis=-1, name=None):
+    """paddle.linalg.vecdot parity: batched vector dot along `axis`
+    (conjugates x for complex inputs, matching the Array API)."""
+    ax = int(axis)
+    return _apply_op(
+        lambda a, b: jnp.sum(jnp.conj(a) * b, axis=ax), x, y, _name="vecdot"
+    )
+
+
 def matrix_transpose(x, name=None):
     return _apply_op(lambda a: jnp.swapaxes(a, -1, -2), x, _name="matrix_transpose")
 

@@ -106,6 +106,111 @@ def dstack(x, name=None):
     return _apply_op(lambda *arrs: jnp.dstack(arrs), *list(x), _name="dstack")
 
 
+def column_stack(x, name=None):
+    """paddle.column_stack parity: 1-D inputs become columns."""
+    return _apply_op(
+        lambda *arrs: jnp.column_stack(arrs), *list(x), _name="column_stack"
+    )
+
+
+def row_stack(x, name=None):
+    """paddle.row_stack parity (alias of vstack)."""
+    return _apply_op(lambda *arrs: jnp.vstack(arrs), *list(x), _name="row_stack")
+
+
+def block_diag(inputs, name=None):
+    """paddle.block_diag parity: block-diagonal matrix from 2-D inputs."""
+    import jax.scipy.linalg as jsl
+
+    tensors = [t if t.ndim >= 2 else reshape(t, [1, -1] if t.ndim == 1 else [1, 1])
+               for t in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return _apply_op(
+        lambda *arrs: jsl.block_diag(*arrs), *tensors, _name="block_diag"
+    )
+
+
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    """paddle.slice_scatter parity: write `value` into the slice of `x`
+    described by axes/starts/ends/strides, returning a new tensor."""
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+    strides = _int_list(strides) if strides is not None else [1] * len(axes)
+
+    def impl(a, v):
+        import builtins
+
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return _apply_op(impl, x, value, _name="slice_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """paddle.diagonal_scatter parity: write `y` onto the selected diagonal
+    of `x`.  Built from an index grid (XLA scatter) — no data-dependent
+    shapes, so it stays jittable."""
+    offset, axis1, axis2 = int(offset), int(axis1), int(axis2)
+
+    def impl(a, v):
+        nd = a.ndim
+        ax1, ax2 = axis1 % nd, axis2 % nd
+        n1, n2 = a.shape[ax1], a.shape[ax2]
+        if offset >= 0:
+            dlen = max(0, min(n1, n2 - offset))
+            i1 = jnp.arange(dlen)
+            i2 = jnp.arange(dlen) + offset
+        else:
+            dlen = max(0, min(n1 + offset, n2))
+            i1 = jnp.arange(dlen) - offset
+            i2 = jnp.arange(dlen)
+        # move the two diagonal axes to the front, scatter, move back
+        rest = [d for d in range(nd) if d not in (ax1, ax2)]
+        perm = [ax1, ax2] + rest
+        at = jnp.transpose(a, perm)
+        # v has the diagonal as its LAST axis (paddle/torch convention)
+        vt = jnp.moveaxis(v.astype(a.dtype), -1, 0)
+        updated = at.at[i1, i2, ...].set(vt)
+        inv = [perm.index(d) for d in range(nd)]
+        return jnp.transpose(updated, inv)
+
+    return _apply_op(impl, x, y, _name="diagonal_scatter")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Functional source for paddle's ``fill_diagonal_``: fill the main
+    diagonal (2-D; >2-D fills the [i,i,...,i] hyperdiagonal like numpy)."""
+    offset = int(offset)
+
+    def impl(a):
+        if a.ndim == 2:
+            nr, nc = a.shape
+            if wrap and offset == 0 and nr > nc:
+                # numpy/paddle wrap semantics: flat stride nc+1 continues
+                # past each wrap, skipping one row per block — e.g. (7,3)
+                # writes (0,0),(1,1),(2,2),(4,0),(5,1),(6,2)
+                flat = np.arange(0, nr * nc, nc + 1)
+                return a.at[flat // nc, flat % nc].set(value)
+            n = min(nr, nc - offset) if offset >= 0 else min(nr + offset, nc)
+            n = max(n, 0)
+            i = jnp.arange(n)
+            r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+            return a.at[r, c].set(value)
+        n = min(a.shape)
+        i = jnp.arange(n)
+        return a.at[tuple([i] * a.ndim)].set(value)
+
+    return _apply_op(impl, x, _name="fill_diagonal")
+
+
+def apply(x, func, name=None):
+    """Functional source for paddle's ``Tensor.apply_``: apply a Python
+    callable elementwise-capable function to the whole tensor."""
+    return func(x)
+
+
 def split(x, num_or_sections, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())

@@ -48,6 +48,11 @@ def sort(x, axis=-1, descending=False, stable=False, name=None):
     return _apply_op(f, x, _name="sort")
 
 
+def msort(x, name=None):
+    """paddle.msort parity: sort along the first axis."""
+    return sort(x, axis=0)
+
+
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     if isinstance(k, Tensor):
         k = int(k.item())
