@@ -310,6 +310,416 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
 
 
 # ---------------------------------------------------------------------------
+# Interleaved virtual-pipeline (VPP) schedule
+# ---------------------------------------------------------------------------
+
+
+def _vpp_schedule(S: int, v: int, M: int):
+    """Host-side simulation of the Megatron interleaved 1F1B schedule
+    (reference: fleet/meta_parallel/pipeline_parallel.py interleaved /
+    Megatron-LM forward_backward_pipelining_with_interleaving — SURVEY.md
+    §2.3 "PP").
+
+    Logical stage k = j*S + r lives on rank r = k % S, virtual chunk
+    j = k // S.  Each rank's op order is the Megatron program: W warmup
+    forwards, then 1F1B fwd/bwd pairs, then cooldown backwards, where the
+    n-th forward of a rank is chunk (n//S) % v of microbatch
+    (n//(S*v))*S + n%S (microbatch groups of size S per chunk), and
+    backwards mirror with the chunk order reversed.
+
+    The simulation assigns each op a global tick honoring (a) strict
+    per-rank program order, (b) at most one forward and one backward per
+    rank per tick (our scan tick does one of each), (c) one-tick transfer
+    latency between neighbouring logical stages, (d) the head's cotangent
+    being available the same tick its forward runs (the scan runs the
+    forward phase before the backward phase).
+
+    Returns a dict of numpy [T, S] int32 tables (fwd/bwd exec + receive
+    sides) plus the buffer bound B (max in-flight microbatches per chunk).
+    """
+    total = M * v
+    if M % S:
+        raise ValueError(f"VPP requires microbatches ({M}) % pp ({S}) == 0")
+
+    def fwd_op(n):
+        g, rem = divmod(n, S * v)
+        return (rem // S) % v, g * S + rem % S  # (chunk, microbatch)
+
+    def bwd_op(n):
+        g, rem = divmod(n, S * v)
+        return v - 1 - (rem // S) % v, g * S + rem % S
+
+    warmup = [min(total, (S - r - 1) * 2 + (v - 1) * S) for r in range(S)]
+    progs = []
+    for r in range(S):
+        ops = [("f", n) for n in range(warmup[r])]
+        nf, nb = warmup[r], 0
+        while nf < total or nb < total:
+            if nf < total:
+                ops.append(("f", nf))
+                nf += 1
+            if nb < total:
+                ops.append(("b", nb))
+                nb += 1
+        progs.append(ops)
+
+    f_done = {}  # (r, j, m) -> tick
+    b_done = {}
+    ptr = [0] * S
+    rows = {k: [] for k in ("f_chunk", "f_mb", "f_valid",
+                            "b_chunk", "b_mb", "b_valid")}
+    t, limit = 0, 4 * total + 4 * S * v + 16
+    while any(ptr[r] < len(progs[r]) for r in range(S)):
+        if t > limit:
+            raise RuntimeError("VPP schedule simulation did not converge")
+        row = {k: [0] * S for k in rows}
+        # phase order matters: forwards resolve before backwards so the
+        # head's same-tick d_y hand-off is representable
+        executed = {r: {"f": False, "b": False} for r in range(S)}
+        for kind_pass in ("f", "b"):
+            for r in range(S):
+                while ptr[r] < len(progs[r]):
+                    kind, n = progs[r][ptr[r]]
+                    if executed[r][kind]:
+                        break
+                    if kind == "f":
+                        j, m = fwd_op(n)
+                        if r == 0 and j == 0:
+                            ready = True
+                        elif r > 0:
+                            ready = f_done.get((r - 1, j, m), t) < t
+                        else:  # r == 0, j > 0: from last rank, prev chunk
+                            ready = f_done.get((S - 1, j - 1, m), t) < t
+                        if not ready or kind_pass == "b":
+                            break
+                        f_done[(r, j, m)] = t
+                        row["f_chunk"][r] = j
+                        row["f_mb"][r] = m
+                        row["f_valid"][r] = 1
+                    else:
+                        j, m = bwd_op(n)
+                        if r == S - 1 and j == v - 1:
+                            ready = f_done.get((r, j, m), t + 1) <= t
+                        elif r < S - 1:
+                            ready = b_done.get((r + 1, j, m), t) < t
+                        else:  # r == S-1, j < v-1: from rank 0, next chunk
+                            ready = b_done.get((0, j + 1, m), t) < t
+                        if not ready:
+                            break
+                        b_done[(r, j, m)] = t
+                        row["b_chunk"][r] = j
+                        row["b_mb"][r] = m
+                        row["b_valid"][r] = 1
+                    executed[r][kind] = True
+                    ptr[r] += 1
+        for k in rows:
+            rows[k].append(row[k])
+        t += 1
+    T = t
+
+    tab = {k: np.asarray(rows[k], np.int32) for k in rows}
+
+    # receive-side tables: what the ring delivers at tick t (sent at t-1)
+    fin = {k: np.zeros((T, S), np.int32)
+           for k in ("fin_chunk", "fin_mb", "fin_valid",
+                     "bin_chunk", "bin_mb", "bin_valid")}
+    for t_ in range(1, T):
+        for r in range(S):
+            src = (r - 1) % S
+            if tab["f_valid"][t_ - 1, src]:
+                j = int(tab["f_chunk"][t_ - 1, src])
+                jr = j if r > 0 else j + 1  # last->first hop advances chunk
+                if jr < v and not (src == S - 1 and j == v - 1):
+                    fin["fin_chunk"][t_, r] = jr
+                    fin["fin_mb"][t_, r] = tab["f_mb"][t_ - 1, src]
+                    fin["fin_valid"][t_, r] = 1
+            srcb = (r + 1) % S
+            if tab["b_valid"][t_ - 1, srcb]:
+                j = int(tab["b_chunk"][t_ - 1, srcb])
+                jr = j if r < S - 1 else j - 1  # first->last hop: prev chunk
+                if jr >= 0 and not (srcb == 0 and j == 0):
+                    fin["bin_chunk"][t_, r] = jr
+                    fin["bin_mb"][t_, r] = tab["b_mb"][t_ - 1, srcb]
+                    fin["bin_valid"][t_, r] = 1
+    tab.update(fin)
+
+    # buffer bound: max microbatches of one chunk in flight on one rank
+    # between forward save and backward consume (inclusive)
+    B = 1
+    for r in range(S):
+        for j in range(v):
+            events = []
+            for m in range(M):
+                events.append((f_done[(r, j, m)], 1))
+                events.append((b_done[(r, j, m)] + 1, -1))
+            live = peak = 0
+            for _, delta in sorted(events):
+                live += delta
+                peak = max(peak, live)
+            B = max(B, peak)
+    tab["B"] = B + 1  # +1: recv can land one tick before the fwd consumes
+    tab["T"] = T
+    return tab
+
+
+def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
+                      head_params, targets, *, num_chunks: int, mesh=None,
+                      axis_name: str = "pp"):
+    """Interleaved virtual-pipeline (VPP) 1F1B train schedule, compiled.
+
+    Reference: the interleaved schedule of
+    fleet/meta_parallel/pipeline_parallel.py (SURVEY.md §2.3 "PP"): each
+    rank owns `num_chunks` (v) non-contiguous model chunks (rank r holds
+    logical stages r, S+r, 2S+r, …), shrinking the pipeline bubble by ~v
+    because warm-up/drain steps are chunk-sized (1/v of a stage) instead of
+    stage-sized.
+
+    Args mirror `spmd_pipeline_1f1b`, except `stage_params` leaves carry a
+    leading [S, v] pair of dims (build with `vpp_stack_layer_params`):
+    dim 0 is sharded over `axis_name`, dim 1 indexes the rank's chunks —
+    local chunk j is global logical stage j*S + r.  `stage_fn` receives one
+    chunk's params (the [S, v] dims stripped).
+
+    Returns (loss, d_stage_params, d_head_params, d_inputs) exactly like
+    `spmd_pipeline_1f1b` (d_stage_params in the same [S, v] layout).
+    """
+    mesh = mesh or _mesh.get_mesh()
+    S = int(mesh.shape[axis_name])
+    v = int(num_chunks)
+    tm = jax.tree_util.tree_map
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    inv_m = np.float32(1.0 / M)
+
+    if v == 1:
+        # plain 1F1B with the chunk dim stripped
+        flat = tm(lambda p: p[:, 0] if p.shape[1] == 1 else p, stage_params)
+        loss, d_p, d_h, d_x = spmd_pipeline_1f1b(
+            stage_fn, flat, microbatches, head_fn, head_params, targets,
+            mesh=mesh, axis_name=axis_name)
+        return loss, tm(lambda g: g[:, None], d_p), d_h, d_x
+
+    if S == 1:
+        def chunk_chain(sp, x):
+            for j in range(v):
+                x = stage_fn(tm(lambda p: p[0, j], sp), x)
+            return x
+
+        def one(m):
+            mb = tm(lambda x: x[m], microbatches)
+            tgt = tm(lambda x: x[m], targets)
+
+            def loss_of(sp, hp, x):
+                return head_fn(hp, chunk_chain(sp, x), tgt)
+
+            loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+            d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
+            return loss_m, d_sp, d_hp, d_x
+
+        losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+        return (jnp.mean(losses), tm(lambda a: jnp.sum(a, 0), d_sps),
+                tm(lambda a: jnp.sum(a, 0), d_hps), d_xs)
+
+    sched = _vpp_schedule(S, v, M)
+    T, B = int(sched["T"]), int(sched["B"])
+    tick_rows = {k: jnp.asarray(a) for k, a in sched.items()
+                 if k not in ("T", "B")}
+
+    def inner(local_params, inputs, head_params, targets):
+        stage = jax.lax.axis_index(axis_name)
+        is_last = stage == S - 1
+        local_params = tm(lambda p: p[0], local_params)  # [v, ...]
+        head_params = tm(lambda p: _pcast_varying(p, axis_name), head_params)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+        def zeros_mb():
+            return tm(lambda x: _pcast_varying(
+                jnp.zeros_like(x[0]), axis_name), inputs)
+
+        def zeros_buf():
+            return tm(lambda x: _pcast_varying(
+                jnp.zeros((v, B) + x.shape[1:], x.dtype), axis_name), inputs)
+
+        carry0 = dict(
+            fwd_c=zeros_mb(), bwd_c=zeros_mb(),
+            recv_buf=zeros_buf(), remat_buf=zeros_buf(),
+            cot_buf=zeros_buf(),
+            d_params=tm(lambda p: _pcast_varying(
+                jnp.zeros(p.shape, jnp.float32), axis_name), local_params),
+            d_head=tm(lambda p: _pcast_varying(
+                jnp.zeros(p.shape, jnp.float32), axis_name), head_params),
+            d_inputs=tm(lambda x: _pcast_varying(
+                jnp.zeros_like(x), axis_name), inputs),
+            loss=_pcast_varying(jnp.zeros((), jnp.float32), axis_name),
+        )
+
+        def at_set(buf, j, slot, val, valid):
+            return tm(lambda b_, v_: b_.at[j, slot].set(
+                jnp.where(valid, v_, b_[j, slot])), buf, val)
+
+        def tick(carry, row):
+            c = dict(carry)
+            r = lambda k: row[k][stage]  # noqa: E731 — per-rank table entry
+
+            # ---- receive ring payloads from tick t-1 ----
+            c["recv_buf"] = at_set(c["recv_buf"], r("fin_chunk"),
+                                   r("fin_mb") % B, c["fwd_c"],
+                                   r("fin_valid") == 1)
+            c["cot_buf"] = at_set(c["cot_buf"], r("bin_chunk"),
+                                  r("bin_mb") % B, c["bwd_c"],
+                                  r("bin_valid") == 1)
+
+            # ---- forward phase ----
+            jf, mf = r("f_chunk"), r("f_mb")
+            f_valid = r("f_valid") == 1
+            slot_f = mf % B
+            fresh = tm(lambda x: x[mf], inputs)
+            from_ring = tm(lambda b_: b_[jf, slot_f], c["recv_buf"])
+            x = tm(lambda f_, b_: jnp.where((stage == 0) & (jf == 0), f_, b_),
+                   fresh, from_ring)
+            c["remat_buf"] = at_set(c["remat_buf"], jf, slot_f, x, f_valid)
+            # chunk params selected via lax.switch with STATIC per-branch
+            # slices: a dynamic-slice over the tp/dp-auto-sharded param
+            # leaves sends the GSPMD partitioner into a pathological search
+            # (observed: >10min compiles); static slices partition cleanly
+            y = jax.lax.switch(
+                jf, [(lambda j: lambda x_: stage_fn(
+                    tm(lambda p: p[j], local_params), x_))(j)
+                     for j in range(v)], x)
+
+            # head at the last logical stage (rank S-1, chunk v-1)
+            tgt = tm(lambda a: a[mf], targets)
+            head_valid = is_last & (jf == v - 1) & f_valid
+
+            def do_head(y_):
+                def head_loss(hp, y__):
+                    return head_fn(hp, y__, tgt)
+
+                loss_m, head_vjp = jax.vjp(head_loss, head_params, y_)
+                d_hp_m, d_y = head_vjp(_pcast_varying(
+                    jnp.asarray(inv_m, loss_m.dtype), axis_name))
+                return loss_m.astype(jnp.float32), d_hp_m, d_y
+
+            def skip_head(y_):
+                zl = _pcast_varying(jnp.zeros((), jnp.float32), axis_name)
+                zh = tm(lambda p: _pcast_varying(
+                    jnp.zeros(p.shape, p.dtype), axis_name), head_params)
+                zy = tm(lambda a: _pcast_varying(
+                    jnp.zeros_like(a), axis_name), y_)
+                return zl, zh, zy
+
+            loss_m, d_hp_m, d_y = jax.lax.cond(head_valid, do_head,
+                                               skip_head, y)
+            c["loss"] = c["loss"] + loss_m
+            c["d_head"] = tm(lambda a, g: a + g.astype(jnp.float32),
+                             c["d_head"], d_hp_m)
+            # head cotangent is consumed from cot_buf, same chunk v-1
+            c["cot_buf"] = at_set(c["cot_buf"], jnp.asarray(v - 1), slot_f,
+                                  d_y, head_valid)
+
+            # ---- backward phase (remat from saved chunk input) ----
+            jb, mb_ = r("b_chunk"), r("b_mb")
+            b_valid = r("b_valid") == 1
+            slot_b = mb_ % B
+            x_saved = tm(lambda b_: b_[jb, slot_b], c["remat_buf"])
+            g_in = tm(lambda b_: b_[jb, slot_b], c["cot_buf"])
+
+            def bwd_chunk(j):
+                def f(args):
+                    xs_, gi_ = args
+                    pj_ = tm(lambda p: p[j], local_params)
+                    _, stage_vjp = jax.vjp(stage_fn, pj_, xs_)
+                    d_pj, d_x_ = stage_vjp(gi_)
+                    d_full = tm(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                local_params)
+                    d_full = tm(lambda df, g: df.at[j].set(
+                        g.astype(jnp.float32)), d_full, d_pj)
+                    return d_full, d_x_
+
+                return f
+
+            d_p_full, d_x = jax.lax.switch(
+                jb, [bwd_chunk(j) for j in range(v)], (x_saved, g_in))
+            c["d_params"] = tm(
+                lambda a, g: a + jnp.where(b_valid, g, 0.0),
+                c["d_params"], d_p_full)
+            d_x = tm(lambda g: jnp.where(b_valid, g, jnp.zeros_like(g)), d_x)
+            emit_dx = (stage == 0) & (jb == 0) & b_valid
+            c["d_inputs"] = tm(
+                lambda acc, g: acc.at[mb_].set(
+                    jnp.where(emit_dx, g, acc[mb_])), c["d_inputs"], d_x)
+
+            # ---- ring transfers ----
+            c["fwd_c"] = tm(lambda a: jax.lax.ppermute(a, axis_name,
+                                                       fwd_perm), y)
+            c["bwd_c"] = tm(lambda a: jax.lax.ppermute(a, axis_name,
+                                                       bwd_perm), d_x)
+            return c, None
+
+        carry, _ = jax.lax.scan(tick, carry0, tick_rows)
+        loss = jax.lax.psum(carry["loss"], axis_name) * inv_m
+        d_head = tm(lambda a: jax.lax.psum(a, axis_name), carry["d_head"])
+        d_params = tm(lambda a, p: a.astype(p.dtype)[None],
+                      carry["d_params"], local_params)
+        d_inputs = tm(lambda a: a[None], carry["d_inputs"])
+        return loss, d_params, d_head, d_inputs
+
+    stacked_spec = tm(lambda _: P(axis_name), stage_params)
+    data_spec = tm(lambda _: P(), microbatches)
+    head_spec = tm(lambda _: P(), head_params)
+    tgt_spec = tm(lambda _: P(), targets)
+    loss, d_params, d_head, d_inputs_stacked = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec),
+        out_specs=(P(), stacked_spec, head_spec,
+                   tm(lambda _: P(axis_name), microbatches)),
+        axis_names=frozenset({axis_name}),
+    )(stage_params, microbatches, head_params, targets)
+    d_head = tm(lambda a, p: a.astype(p.dtype), d_head, head_params)
+    d_inputs = tm(lambda a: a[0], d_inputs_stacked)
+    return loss, d_params, d_head, d_inputs
+
+
+def vpp_stack_layer_params(layers: Sequence, S: int, v: int
+                           ) -> Dict[str, jax.Array]:
+    """Stack homogeneous layers for VPP: suffix -> [S, v, Lc, ...] where
+    [r, j] holds global chunk j*S + r (the Megatron interleaved layout:
+    rank r owns logical stages r, S+r, 2S+r, …)."""
+    L = len(layers)
+    if L % (S * v):
+        raise ValueError(f"layers ({L}) must divide pp*chunks ({S * v})")
+    Lc = L // (S * v)
+    trees = [dict(l.named_parameters()) for l in layers]
+    names = list(trees[0].keys())
+    out = {}
+    for n in names:
+        per_chunk = []
+        for r in range(S):
+            chunk_rows = []
+            for j in range(v):
+                c = j * S + r
+                chunk_rows.append(jnp.stack(
+                    [trees[c * Lc + i][n]._data for i in range(Lc)]))
+            per_chunk.append(jnp.stack(chunk_rows))
+        out[n] = jnp.stack(per_chunk)  # [S, v, Lc, ...]
+    return out
+
+
+def vpp_unstack_into_layers(stacked: Dict[str, jax.Array], layers: Sequence,
+                            S: int, v: int):
+    """Inverse of `vpp_stack_layer_params` (post-step write-back)."""
+    L = len(layers)
+    Lc = L // (S * v)
+    for r in range(S):
+        for j in range(v):
+            c = j * S + r
+            for i in range(Lc):
+                layers[c * Lc + i].load_pytree(
+                    {n: a[r, j, i] for n, a in stacked.items()})
+
+
+# ---------------------------------------------------------------------------
 # stacked-parameter utilities (LayerDesc partitioning -> stacked arrays)
 # ---------------------------------------------------------------------------
 

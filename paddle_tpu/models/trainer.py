@@ -74,7 +74,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
                               mesh=None, num_microbatches: Optional[int]
                               = None, donate=True,
                               sharding_stage: int = 1,
-                              schedule: Optional[str] = None):
+                              schedule: Optional[str] = None,
+                              virtual_pp_degree: int = 1):
     """Pipeline-parallel compiled step (SURVEY.md §7 phase 8).
 
     Decoder layers are stacked into [L, ...] arrays pp-sharded on the
@@ -95,6 +96,13 @@ def build_pipeline_train_step(model: Layer, optimizer,
                 stages are not tracked on this path.
       "gpipe" — forward scan + autodiff reverse (all-M residuals live
                 through the backward; higher memory, no remat).
+      "vpp"   — interleaved virtual-pipeline 1F1B
+                (pipeline.spmd_pipeline_vpp): each rank owns
+                `virtual_pp_degree` non-contiguous model chunks (rank r
+                holds logical stages r, pp+r, 2pp+r, …), shrinking the
+                fill/drain bubble ~virtual_pp_degree-fold (the reference's
+                interleaved schedule, paddle `virtual_pp_degree`).
+                Requires num_microbatches % pp == 0.
     num_microbatches defaults to the largest count <= 2*pp dividing the
     batch (the reference guidance is M >> pp to amortize the (pp-1)-tick
     fill/drain bubble; raise it explicitly for big batches)."""
@@ -109,29 +117,68 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
     layers = model.pp_layers()
     S = int(mesh.shape["pp"])
-    if len(layers) % S:
-        raise ValueError(
-            f"{len(layers)} layers not divisible by pp={S}")
+    v = int(virtual_pp_degree)
     if schedule is None:
-        # the 1f1b path does not track buffer (BN-stat) updates inside the
-        # schedule; models with buffers keep the autodiff path by default
-        schedule = "gpipe" if dict(model.named_buffers()) else "1f1b"
-    if schedule not in ("1f1b", "gpipe"):
+        # the 1f1b/vpp paths do not track buffer (BN-stat) updates inside
+        # the schedule; models with buffers keep the autodiff path even
+        # when virtual_pp_degree asks for vpp (explicit schedule="vpp"
+        # overrides, accepting frozen buffer stats)
+        if dict(model.named_buffers()):
+            schedule = "gpipe"
+            if v > 1:
+                import warnings
+
+                warnings.warn(
+                    "virtual_pp_degree>1 ignored: the model has buffers "
+                    "(BN stats) which the vpp schedule does not update; "
+                    "pass pipeline_schedule='vpp' explicitly to accept "
+                    "frozen buffers", UserWarning)
+        else:
+            schedule = "vpp" if v > 1 else "1f1b"
+    if schedule not in ("1f1b", "gpipe", "vpp"):
         raise ValueError(
-            f"unknown pipeline schedule {schedule!r}; use '1f1b' or 'gpipe'")
+            f"unknown pipeline schedule {schedule!r}; "
+            "use '1f1b', 'gpipe' or 'vpp'")
+    if schedule != "vpp":
+        v = 1
+    elif v < 1:
+        raise ValueError(f"virtual_pp_degree must be >= 1, got {v}")
+    if schedule == "vpp" and v > 1:
+        auto_axes = [a for a in mesh.axis_names
+                     if a != "pp" and int(mesh.shape[a]) > 1]
+        if len(auto_axes) >= 2:
+            # XLA's SPMD partitioner CHECK-fails (spmd_partitioner_util.cc
+            # ExpandDeviceGroupsWithIota) partitioning the VPP scan when two
+            # GSPMD-auto axes are live alongside the manual pp axis; pp+tp
+            # and pp+dp both partition fine. Guard until the upstream bug is
+            # fixed rather than crash deep inside XLA.
+            raise NotImplementedError(
+                f"schedule='vpp' currently supports one non-pp mesh axis; "
+                f"got {auto_axes}. Use pp x tp or pp x dp, or "
+                f"schedule='1f1b' for the full hybrid.")
+    if len(layers) % (S * v):
+        raise ValueError(
+            f"{len(layers)} layers not divisible by pp*vpp={S}*{v}")
     # default M: the largest count <= 2*pp dividing the CURRENT batch,
     # re-derived per call (jit retraces per input shape, so a trailing
     # partial batch picks a valid M instead of crashing); the reference
-    # guidance is M >> pp to amortize the fill/drain bubble
+    # guidance is M >> pp to amortize the fill/drain bubble. VPP
+    # additionally requires M % pp == 0 (Megatron microbatch groups).
     mb_holder = {"M": num_microbatches}
 
     def _resolve_m(batch):
         if num_microbatches is None:
-            m = 1
+            m = None
             for cand in range(min(2 * S, batch), 0, -1):
-                if batch % cand == 0:
+                if batch % cand == 0 and (schedule != "vpp" or cand % S == 0):
                     m = cand
                     break
+            if m is None:  # only reachable for vpp (cand=1 matches otherwise)
+                raise ValueError(
+                    f"schedule='vpp' needs num_microbatches to be a "
+                    f"multiple of pp={S} that divides the batch; batch "
+                    f"{batch} has no such divisor <= {2 * S} — pick a "
+                    f"batch size divisible by pp or pass num_microbatches")
             mb_holder["M"] = m
         return mb_holder["M"]
     template = layers[0]
@@ -154,11 +201,20 @@ def build_pipeline_train_step(model: Layer, optimizer,
     # (embed/head/norm) per their GSPMD specs; buffers replicated. The
     # module tree keeps its own arrays (source for sync_to_model shapes);
     # the stacked holder copy is the training source of truth.
-    stacked_specs = _pipe.stacked_param_specs(layers, mesh)
+    if schedule == "vpp":
+        # [S, v, Lc, ...]: dim0 pp-sharded, dim1 = the rank's chunk index
+        stacked_specs = {}
+        for n, p in layers[0].named_parameters():
+            inner = list(_clean_spec(get_param_spec(p), mesh))
+            stacked_specs[n] = P("pp", None, None, *inner)
+        stacked_arrays = _pipe.vpp_stack_layer_params(layers, S, v)
+    else:
+        stacked_specs = _pipe.stacked_param_specs(layers, mesh)
+        stacked_arrays = _pipe.stack_layer_params(layers)
     stacked_names = list(stacked_specs)
     flat_params = {}
     flat_specs = {}
-    for n, a in _pipe.stack_layer_params(layers).items():
+    for n, a in stacked_arrays.items():
         key = _skey(n)
         flat_params[key] = jax.device_put(
             a, NamedSharding(mesh, stacked_specs[n]))
@@ -235,8 +291,13 @@ def build_pipeline_train_step(model: Layer, optimizer,
             h, embed_vjp = jax.vjp(embed_fn, rest)
             mb = _pipe.microbatch(h, mb_holder["M"])
             tgts = _pipe.microbatch(y, mb_holder["M"])
-            loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_1f1b(
-                stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh)
+            if schedule == "vpp":
+                loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_vpp(
+                    stage_fn, stacked, mb, head_fn, rest, tgts,
+                    num_chunks=v, mesh=mesh)
+            else:
+                loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_1f1b(
+                    stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh)
             (d_rest_embed,) = embed_vjp(d_mb.reshape(h.shape))
         grads = {_skey(n): d_stacked[n] for n in stacked_names}
         for n in rest_names:
@@ -245,8 +306,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
     def pure_step(params, buffers, opt_state, lr, seed, x, y):
         stream = _random.KeyStream(jax.random.wrap_key_data(seed))
-        fn = _1f1b_loss_and_grads if schedule == "1f1b" \
-            else _gpipe_loss_and_grads
+        fn = _gpipe_loss_and_grads if schedule == "gpipe" \
+            else _1f1b_loss_and_grads
         loss, new_buffers, grads = fn(params, buffers, stream, x, y)
         if sharding_stage >= 2:
             grads = _constrain(grads, grad_shardings)
@@ -281,8 +342,11 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
     def sync_to_model():
         params = holder["params"]
-        _pipe.unstack_into_layers(
-            {n: params[_skey(n)] for n in stacked_names}, layers)
+        stacked = {n: params[_skey(n)] for n in stacked_names}
+        if schedule == "vpp":
+            _pipe.vpp_unstack_into_layers(stacked, layers, S, v)
+        else:
+            _pipe.unstack_into_layers(stacked, layers)
         model.load_pytree({n: params[n] for n in rest_names})
 
     step.sync_to_model = sync_to_model
@@ -294,7 +358,8 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                      = None, mesh=None, donate=True,
                      num_microbatches: Optional[int] = None,
                      sharding_stage: Optional[int] = None,
-                     pipeline_schedule: Optional[str] = None):
+                     pipeline_schedule: Optional[str] = None,
+                     virtual_pp_degree: int = 1):
     """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
 
     criterion defaults to model.compute_loss (vocab-parallel CE for the
@@ -317,7 +382,8 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         return build_pipeline_train_step(
             model, inner_opt, criterion=criterion, mesh=mesh,
             num_microbatches=num_microbatches, donate=donate,
-            sharding_stage=sharding_stage, schedule=pipeline_schedule)
+            sharding_stage=sharding_stage, schedule=pipeline_schedule,
+            virtual_pp_degree=virtual_pp_degree)
     step = _jit.train_step(model, criterion, inner_opt, donate=donate,
                            sharding_stage=sharding_stage, mesh=mesh)
 

@@ -1,0 +1,221 @@
+"""Interleaved virtual-pipeline (VPP) schedule tests — round-3 verdict
+item 5 (reference: fleet/meta_parallel/pipeline_parallel.py interleaved
+schedule, paddle `virtual_pp_degree`; SURVEY.md §2.3 "PP", §4.3 loss-parity
+discipline)."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import _vpp_schedule, spmd_pipeline_vpp
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
+
+
+class TestSchedule:
+    def test_every_op_scheduled_exactly_once(self):
+        for S, v, M in [(2, 2, 4), (4, 2, 8), (4, 4, 8)]:
+            tab = _vpp_schedule(S, v, M)
+            assert tab["f_valid"].sum() == M * v * S
+            assert tab["b_valid"].sum() == M * v * S
+            # each (rank, chunk, mb) forward exactly once
+            seen = set()
+            T = tab["T"]
+            for t in range(T):
+                for r in range(S):
+                    if tab["f_valid"][t, r]:
+                        key = (r, int(tab["f_chunk"][t, r]),
+                               int(tab["f_mb"][t, r]))
+                        assert key not in seen
+                        seen.add(key)
+
+    def test_bubble_shrinks_vs_plain_1f1b(self):
+        """The interleaved schedule's tick count (1 chunk-fwd + 1 chunk-bwd
+        per tick) beats plain 1F1B's cost expressed in the same chunk-tick
+        units: v * (M + 2(S-1))."""
+        for S, v, M in [(4, 2, 8), (4, 4, 8), (8, 2, 16), (4, 2, 16)]:
+            tab = _vpp_schedule(S, v, M)
+            plain_chunk_ticks = v * (M + 2 * (S - 1))
+            assert tab["T"] < plain_chunk_ticks, (S, v, M, tab["T"])
+
+    def test_rejects_bad_microbatch_count(self):
+        with pytest.raises(ValueError):
+            _vpp_schedule(4, 2, 6)  # M % S != 0
+
+
+class TestVppParity:
+    def test_loss_and_grads_match_serial(self):
+        import jax
+        import jax.numpy as jnp
+
+        S, v, M, d = 4, 2, 8, 16
+        L = S * v
+        rng = np.random.RandomState(0)
+        Ws = rng.randn(L, d, d).astype(np.float32) * 0.3
+        head_W = rng.randn(d, 10).astype(np.float32) * 0.3
+        xs = rng.randn(M, 3, d).astype(np.float32)
+        ys = rng.randint(0, 10, (M, 3))
+
+        def stage_fn(params, x):
+            def body(h, w):
+                return jnp.tanh(h @ w), None
+
+            h, _ = jax.lax.scan(body, x, params["W"])
+            return h
+
+        def head_fn(hp, yact, tgt):
+            lp = jax.nn.log_softmax(yact @ hp["W"])
+            return -jnp.mean(jnp.take_along_axis(lp, tgt[:, None], axis=-1))
+
+        def total_loss(Ws_, hW_):
+            losses = []
+            for m in range(M):
+                h = jnp.asarray(xs[m])
+                for i in range(L):
+                    h = jnp.tanh(h @ Ws_[i])
+                lp = jax.nn.log_softmax(h @ hW_)
+                losses.append(-jnp.mean(jnp.take_along_axis(
+                    lp, jnp.asarray(ys[m])[:, None], axis=-1)))
+            return jnp.mean(jnp.stack(losses))
+
+        ref_loss, (ref_dW, ref_dH) = jax.value_and_grad(
+            total_loss, argnums=(0, 1))(jnp.asarray(Ws), jnp.asarray(head_W))
+
+        stacked = np.zeros((S, v, 1, d, d), np.float32)
+        for r in range(S):
+            for j in range(v):
+                stacked[r, j, 0] = Ws[j * S + r]
+
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices("cpu")[:S]), ("pp",))
+        with mesh:
+            loss, d_sp, d_hp, d_x = spmd_pipeline_vpp(
+                stage_fn, {"W": jnp.asarray(stacked)}, jnp.asarray(xs),
+                head_fn, {"W": jnp.asarray(head_W)}, jnp.asarray(ys),
+                num_chunks=v, mesh=mesh)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        got = np.zeros_like(Ws)
+        for r in range(S):
+            for j in range(v):
+                got[j * S + r] = np.asarray(d_sp["W"])[r, j, 0]
+        np.testing.assert_allclose(got, np.asarray(ref_dW), rtol=2e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_hp["W"]),
+                                   np.asarray(ref_dH), rtol=2e-4, atol=1e-5)
+        # d_inputs: finite-difference-free check vs autodiff of the serial
+        def loss_wrt_x(x0):
+            h = x0
+            for i in range(L):
+                h = jnp.tanh(h @ jnp.asarray(Ws[i]))
+            lp = jax.nn.log_softmax(h @ jnp.asarray(head_W))
+            return -jnp.mean(jnp.take_along_axis(
+                lp, jnp.asarray(ys[0])[:, None], axis=-1)) / M
+
+        ref_dx0 = jax.grad(loss_wrt_x)(jnp.asarray(xs[0]))
+        np.testing.assert_allclose(np.asarray(d_x)[0], np.asarray(ref_dx0),
+                                   rtol=2e-4, atol=1e-6)
+
+
+class TestVppTrainStep:
+    def test_llama_vpp_loss_parity_vs_serial(self):
+        """M=4*pp parity test demanded by the round-2 verdict."""
+        def make(seed=7):
+            paddle.seed(seed)
+            cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=2,
+                                   seq=16)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            return model, opt
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
+        y = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
+
+        model_s, opt_s = _ = make()
+        step_s = build_train_step(model_s, opt_s, mesh=None)
+        serial_losses = [float(step_s(x, y)) for _ in range(3)]
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        # pp4 x tp2 over 8 devices; M = 4*pp = 16 (the verdict's parity
+        # config). The pp x dp flavour is covered in the next test.
+        mesh = mesh_mod.set_mesh(
+            mesh_mod.build_mesh(dp=1, pp=4, tp=2,
+                                devices=np.asarray(jax.devices("cpu"))))
+        try:
+            model_p, opt_p = make()
+            step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                      num_microbatches=16,  # M = 4*pp
+                                      pipeline_schedule="vpp",
+                                      virtual_pp_degree=2)
+            vpp_losses = [float(step_p(x, y)) for _ in range(3)]
+            step_p.sync_to_model()
+        finally:
+            mesh_mod.set_mesh(None)
+
+        np.testing.assert_allclose(serial_losses, vpp_losses, rtol=2e-4,
+                                   atol=2e-5)
+        assert vpp_losses[-1] < vpp_losses[0]
+
+    def test_llama_vpp_pp_dp_parity(self):
+        def make(seed=3):
+            paddle.seed(seed)
+            cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=2,
+                                   seq=16)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            return model, opt
+
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+        y = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+
+        model_s, opt_s = make()
+        step_s = build_train_step(model_s, opt_s, mesh=None)
+        serial = [float(step_s(x, y)) for _ in range(2)]
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(
+            mesh_mod.build_mesh(dp=2, pp=2, tp=1,
+                                devices=np.asarray(jax.devices("cpu")[:4])))
+        try:
+            model_p, opt_p = make()
+            step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                      num_microbatches=8,
+                                      pipeline_schedule="vpp",
+                                      virtual_pp_degree=2)
+            par = [float(step_p(x, y)) for _ in range(2)]
+        finally:
+            mesh_mod.set_mesh(None)
+        np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
+
+    def test_three_auto_axes_guarded(self):
+        """dp x pp x tp + vpp trips an XLA GSPMD bug; we guard with a clear
+        error instead of a partitioner CHECK crash."""
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(
+            mesh_mod.build_mesh(dp=2, pp=2, tp=2,
+                                devices=np.asarray(jax.devices("cpu"))))
+        try:
+            paddle.seed(0)
+            cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=2,
+                                   seq=16)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            with pytest.raises(NotImplementedError, match="vpp"):
+                build_train_step(model, opt, mesh=mesh,
+                                 pipeline_schedule="vpp",
+                                 virtual_pp_degree=2)
+        finally:
+            mesh_mod.set_mesh(None)
