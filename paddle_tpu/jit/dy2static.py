@@ -168,6 +168,98 @@ def _jst_while(test_fn, body_fn, init, names=()):
     return _like(tuple(proto), res)
 
 
+def _jst_for_range(rng_args, body_fn, init, names=()):
+    """Runtime for-range dispatch (reference: convert_for / for_loop
+    transformer). Python ints -> plain loop; a traced bound -> one
+    `lax.fori_loop` compiled into the program, the loop index passed to the
+    body as a traced scalar Tensor.
+
+    `init[0]` is the loop TARGET's pre-loop binding (Python leaks the
+    target past the loop); `body_fn(target, *loop_vars)` returns
+    `(target_after_body, *loop_vars)` so post-loop reads of the target see
+    the last iteration's value. On the traced path the post-loop target is
+    reconstructed as start + (n-1)*step — a body that reassigns the target
+    diverges there (documented trace-path limitation)."""
+    from ..tensor import Tensor
+
+    vals = [a._data if isinstance(a, Tensor) else a for a in rng_args]
+    if len(vals) == 1:
+        start, stop, step = 0, vals[0], 1
+    elif len(vals) == 2:
+        start, stop, step = vals[0], vals[1], 1
+    else:
+        start, stop, step = vals
+
+    tgt, vars_ = init[0], tuple(init[1:])
+    if not any(_is_traced(v) for v in (start, stop, step)):
+        for i in range(int(start), int(stop), int(step)):
+            out = body_fn(i, *vars_)
+            tgt, vars_ = out[0], tuple(out[1:])
+        return (tgt,) + vars_
+
+    undef = [n for n, v in zip(names[1:], vars_) if v is _JST_UNDEF]
+    if undef:
+        raise NotImplementedError(
+            f"to_static for-loop with a traced range requires loop "
+            f"variables to be initialized before the loop; undefined: "
+            f"{undef} (the lax.fori_loop carry needs their shapes)")
+    start = jnp.asarray(start)
+    stop = jnp.asarray(stop)
+    step = jnp.asarray(step)
+    n_iters = jnp.maximum(
+        0, jnp.where(step > 0, (stop - start + step - 1) // step,
+                     (start - stop - step - 1) // (-step)))
+    proto = vars_
+
+    def body(k, arrs):
+        i = start + k * step
+        out = body_fn(Tensor(i), *_like(proto, arrs))
+        return _to_arrays(tuple(out[1:]))
+
+    res = jax.lax.fori_loop(0, n_iters, body, _to_arrays(proto))
+    last_i = start + jnp.maximum(n_iters - 1, 0) * step
+    if tgt is not _JST_UNDEF:
+        # zero-trip loop leaves the pre-loop binding untouched (Python
+        # semantics); only representable when the pre-binding is a value
+        pre = tgt._data if isinstance(tgt, Tensor) else jnp.asarray(tgt)
+        last_i = jnp.where(n_iters > 0, last_i, pre)
+    final_tgt = Tensor(last_i)
+    return (final_tgt,) + tuple(_like(proto, res))
+
+
+def _jst_for_iter(seq, body_fn, init, names=()):
+    """Runtime for-each dispatch: a TRACED Tensor iterates its leading dim
+    via one `lax.scan` (static trip count, compiler-pipelined); anything
+    else (lists, eager Tensors, generators) takes the Python loop.
+    Target threading as in `_jst_for_range`; the traced post-loop target is
+    the last row of the sequence."""
+    from ..tensor import Tensor
+
+    tgt, vars_ = init[0], tuple(init[1:])
+    if isinstance(seq, Tensor) and _is_traced(seq):
+        undef = [n for n, v in zip(names[1:], vars_) if v is _JST_UNDEF]
+        if undef:
+            raise NotImplementedError(
+                f"to_static for-loop over a traced tensor requires loop "
+                f"variables to be initialized before the loop; undefined: "
+                f"{undef} (the lax.scan carry needs their shapes)")
+        proto = vars_
+
+        def body(arrs, x):
+            out = body_fn(Tensor(x), *_like(proto, arrs))
+            return _to_arrays(tuple(out[1:])), None
+
+        res, _ = jax.lax.scan(body, _to_arrays(proto), seq._data)
+        if seq._data.shape[0] > 0:
+            tgt = Tensor(seq._data[-1])
+        return (tgt,) + tuple(_like(proto, res))
+
+    for x in seq:
+        out = body_fn(x, *vars_)
+        tgt, vars_ = out[0], tuple(out[1:])
+    return (tgt,) + vars_
+
+
 # ---------------------------------------------------------------------------
 # AST analysis
 # ---------------------------------------------------------------------------
@@ -386,6 +478,65 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         guards = [_undef_guard(v) for v in loop_vars]
         return guards + [test_fn, body_fn, assign]
 
+    # ---- for ----
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # honest fallbacks (reference partial-conversion contract): else
+        # clause, break/continue/return in the body, or a non-Name target
+        # (tuple unpacking) leave the loop as trace-time Python
+        if node.orelse or not _convertible(node.body):
+            return node
+        if not isinstance(node.target, ast.Name):
+            return node
+        target = node.target.id
+        loop_vars = [v for v in _assigned_names(node.body) if v != target]
+        # the target is threaded FIRST (init[0]/out[0]) so Python's
+        # leak-past-the-loop semantics survive conversion
+        outs = [target] + loop_vars
+        n = self._uid()
+        bname = f"__jst_fbody_{n}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=target)] + [ast.arg(arg=v) for v in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in outs],
+            ctx=ast.Load()))
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=(list(node.body) or [ast.Pass()]) + [ret],
+            decorator_list=[])
+        # `for i in range(...)` -> _jst_for_range((args...), ...);
+        # anything else       -> _jst_for_iter(iterable, ...)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in node.iter.args))
+        if is_range:
+            helper = "_jst_for_range"
+            first_arg = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+        else:
+            helper = "_jst_for_iter"
+            first_arg = node.iter
+        call = ast.Call(
+            func=ast.Name(id=helper, ctx=ast.Load()),
+            args=[first_arg, ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in outs], ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=v)
+                                  for v in outs], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store()) for v in outs],
+                ctx=ast.Store())],
+            value=call)
+        guards = [_undef_guard(v) for v in outs]
+        return guards + [body_fn, assign]
+
 
 # ---------------------------------------------------------------------------
 # entry
@@ -467,6 +618,8 @@ def convert_to_static(fn: Callable) -> Callable:
     glb = _GlobalsProxy(fn.__globals__)
     glb["_jst_if"] = _jst_if
     glb["_jst_while"] = _jst_while
+    glb["_jst_for_range"] = _jst_for_range
+    glb["_jst_for_iter"] = _jst_for_iter
     glb["_JST_UNDEF"] = _JST_UNDEF
     glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
     cells = []
