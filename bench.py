@@ -15,7 +15,7 @@ import time
 import numpy as np
 
 
-def model_flops_per_token(cfg, seq_len):
+def model_flops_per_token(cfg, seq_len, causal=True):
     """6*N (fwd+bwd matmul flops per token per param) + attention term."""
     h = cfg.hidden_size
     l = cfg.num_hidden_layers
@@ -25,42 +25,82 @@ def model_flops_per_token(cfg, seq_len):
     per_layer = 4 * h * h + 3 * h * inter
     n_matmul = l * per_layer + v * h  # + lm_head
     flops = 6 * n_matmul
-    # attention scores/values: 2 matmuls of [s,d]x[d,s]: 12 * s * h per token
-    flops += 12 * seq_len * h * l
+    # attention scores/values: QK^T + AV, fwd 4*s*h, fwd+bwd 12*s*h per
+    # token per layer for full attention; the model is causal so the honest
+    # achieved-flops count is half that (avg context length s/2)
+    attn = 12 * seq_len * h * l
+    flops += attn // 2 if causal else attn
     return flops
 
 
-def _probe_accelerator(timeout=None):
-    """Check in a SUBPROCESS whether the default jax backend initializes.
+PROBE_DIAG = {"attempts": []}
+
+
+def _probe_accelerator(timeout=None, retries=None):
+    """Check in a SUBPROCESS whether the default jax backend initializes
+    AND can run a real computation.
 
     The axon TPU plugin's client creation can hang forever or raise
-    UNAVAILABLE (round-1 BENCH rc=1 / MULTICHIP rc=124); probing in a child
-    process with a hard timeout keeps this process clean either way.
+    UNAVAILABLE (round-1 BENCH rc=1 / MULTICHIP rc=124, round-2 silent CPU
+    fallback); probing in a child process with a hard timeout keeps this
+    process clean either way.  The plugin is known to flake transiently, so
+    we retry with exponential backoff and record every attempt's outcome in
+    PROBE_DIAG (emitted into the bench JSON) so a fallback artifact is
+    diagnosable instead of silently toy.
+
     Returns (backend_name, n_devices) or None if only CPU is usable.
     """
     import os
     import subprocess
 
     if timeout is None:
-        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+    if retries is None:
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "4"))
 
-    code = ("import jax; d = jax.devices(); "
-            "print(jax.default_backend(), len(d))")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return None
-    if r.returncode != 0:
-        return None
-    try:
-        backend, n = r.stdout.strip().split()[-2:]
-        n = int(n)
-    except (ValueError, IndexError):
-        return None
-    if backend == "cpu":
-        return None
-    return backend, n
+    # probe does a tiny matmul, not just client init: a client that
+    # enumerates devices but can't execute is still unusable
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((128,128), dtype=jnp.bfloat16); "
+            "(x @ x).block_until_ready(); "
+            "print('PROBE_OK', jax.default_backend(), len(d))")
+    backoff = 10.0
+    for attempt in range(max(retries, 1)):
+        rec = {"attempt": attempt}
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                               capture_output=True, text=True)
+        except subprocess.TimeoutExpired as e:
+            rec["outcome"] = f"timeout after {timeout:.0f}s"
+            rec["stderr"] = (e.stderr or b"")[-2000:].decode(
+                "utf-8", "replace") if isinstance(e.stderr, bytes) else \
+                str(e.stderr or "")[-2000:]
+        else:
+            rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
+            if r.returncode != 0:
+                rec["outcome"] = f"rc={r.returncode}"
+                rec["stderr"] = (r.stderr or "")[-2000:]
+            else:
+                out = r.stdout.strip().splitlines()
+                ok = [ln for ln in out if ln.startswith("PROBE_OK")]
+                if not ok:
+                    rec["outcome"] = "no PROBE_OK line"
+                    rec["stdout"] = (r.stdout or "")[-500:]
+                else:
+                    _, backend, n = ok[-1].split()
+                    if backend == "cpu":
+                        rec["outcome"] = "cpu-only client"
+                        PROBE_DIAG["attempts"].append(rec)
+                        return None  # no point retrying: no TPU plugin at all
+                    rec["outcome"] = f"ok {backend} x{n}"
+                    PROBE_DIAG["attempts"].append(rec)
+                    return backend, int(n)
+        PROBE_DIAG["attempts"].append(rec)
+        if attempt < retries - 1:
+            time.sleep(backoff)
+            backoff *= 2
+    return None
 
 
 def main():
@@ -92,8 +132,17 @@ def main():
         return bench_serving(paddle, jax, on_tpu, n_dev)
 
     # size the model to the bench platform: big enough to exercise the MXU,
-    # small enough to compile fast on one v5 lite chip
-    if on_tpu:
+    # small enough to compile fast on one v5 lite chip. BENCH_MODEL=1b
+    # selects the largest LLaMA that fits one 16GB chip with AdamW master
+    # weights (~0.74B params ~ 10.4GB of param+opt state in bf16 O2).
+    size = os.environ.get("BENCH_MODEL", "base")
+    if on_tpu and size == "1b":
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=12,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, iters = 4, 2048, 10
+    elif on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=8,
                           num_attention_heads=8, num_key_value_heads=8,
@@ -141,19 +190,27 @@ def main():
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_chip, 2),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
+        # vs_baseline only means something on TPU; a CPU-fallback run is a
+        # liveness artifact, not a perf number (round-2 verdict weak #2)
+        "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
         "extra": {
-            "mfu": round(mfu, 4),
+            "mfu": round(mfu, 4) if on_tpu else None,
+            "mfu_note": "causal model flops vs 197 TFLOPs bf16 v5e peak",
             "devices": n_dev,
             "backend": jax.default_backend(),
             "batch": batch,
             "seq": seq,
             "hidden": cfg.hidden_size,
             "layers": cfg.num_hidden_layers,
+            "params_b": round(
+                sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e9,
+                3),
             "loss_first": round(loss_val, 4),
             "loss_last": round(final, 4),
         },
     }
+    if not on_tpu:
+        result["tpu_probe_error"] = PROBE_DIAG
     print(json.dumps(result))
 
 
@@ -184,7 +241,7 @@ def bench_resnet(paddle, jax, on_tpu, n_dev):
     final = float(loss)  # host sync; steps chain through donated params
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
-    print(json.dumps({
+    result = {
         "metric": "resnet_train_images_per_sec",
         "value": round(ips, 2),
         "unit": "images/s",
@@ -192,7 +249,10 @@ def bench_resnet(paddle, jax, on_tpu, n_dev):
         "extra": {"depth": depth, "batch": batch, "image": size,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "loss_first": round(loss0, 4),
-                  "loss_last": round(final, 4)}}))
+                  "loss_last": round(final, 4)}}
+    if not on_tpu:
+        result["tpu_probe_error"] = PROBE_DIAG
+    print(json.dumps(result))
 
 
 def bench_serving(paddle, jax, on_tpu, n_dev):
@@ -232,7 +292,7 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     finished = engine.run()
     dt = time.perf_counter() - t0
     generated = sum(len(f.output_ids) for f in finished)
-    print(json.dumps({
+    result = {
         "metric": "serving_decode_tokens_per_sec",
         "value": round(generated / dt, 2),
         "unit": "tokens/s",
@@ -241,18 +301,52 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "prompt_len": prompt_len, "new_tokens": new_tokens,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
-                  "layers": cfg.num_hidden_layers}}))
+                  "layers": cfg.num_hidden_layers}}
+    if not on_tpu:
+        result["tpu_probe_error"] = PROBE_DIAG
+    print(json.dumps(result))
+
+
+def _piggyback_kernel_bench():
+    """Round-2 verdict item 3: whenever the probe finds a usable chip, also
+    run the Pallas kernel bench in the same bench session so the driver
+    artifact records on-TPU kernel validation.  Runs AFTER the main JSON
+    line is printed (stdout stays one line; kernel results go to
+    KERNEL_BENCH.json incrementally so a timeout kill keeps partials)."""
+    import os
+    import subprocess
+
+    if os.environ.get("BENCH_KERNELS", "1") != "1":
+        return
+    budget = float(os.environ.get("BENCH_KERNEL_BUDGET", "900"))
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(here, "KERNEL_BENCH.json")
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "tpu_kernel_bench.py"),
+             "--json", out], timeout=budget,
+            stdout=sys.stderr, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("kernel bench hit budget; partial rows in KERNEL_BENCH.json",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"kernel bench failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":
     try:
         main()
+        sys.stdout.flush()
+        if PROBE_DIAG["attempts"] and \
+                PROBE_DIAG["attempts"][-1].get("outcome", "").startswith("ok"):
+            _piggyback_kernel_bench()
     except BaseException as e:  # noqa: BLE001 — always emit a parseable line
         print(json.dumps({
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
+            "tpu_probe_error": PROBE_DIAG,
             "error": f"{type(e).__name__}: {e}"[:500],
         }))
         sys.exit(0)

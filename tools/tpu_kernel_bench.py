@@ -65,6 +65,9 @@ def timeit(fn, q, *rest, iters=20):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None,
+                    help="write rows incrementally to this JSON file "
+                         "(partial results survive a timeout kill)")
     args = ap.parse_args()
 
     from paddle_tpu.kernels import flash_attention as fa
@@ -136,6 +139,11 @@ def main():
                          t_flash_fwd=t_flash_f * 1e3, t_xla_fwd=t_ref_f * 1e3,
                          t_flash_bwd=t_flash_b * 1e3, t_xla_bwd=t_ref_b * 1e3,
                          t_mixed_bwd=t_mixed_b * 1e3))
+        if args.json:
+            import json as _json
+            with open(args.json, "w") as f:
+                _json.dump({"backend": backend, "kernel": "flash_attention",
+                            "rows": rows}, f, indent=1)
         r = rows[-1]
         print(f"seq={s:5d} b={b_eff}  fwd_err={fwd_err:.4f} "
               f"bwd_err={bwd_err:.4f}  "
