@@ -68,6 +68,9 @@ def get_flag(name: str, default=None):
 
 # Core flags mirroring the reference set (SURVEY.md §5).
 define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf.")
+define_flag("FLAGS_static_strict_placeholders", False,
+            "Raise (instead of warn) when a static-graph placeholder is "
+            "coerced to a Python scalar during program capture.")
 define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
 define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")

@@ -7,11 +7,17 @@ import numpy as np
 from ..tensor import Tensor, _apply_op, as_array
 
 
-def _cmp(fn, name):
+def _cmp(fn, opname):
+    # routed through _apply_op (under no_grad: bool outputs carry no vjp) so
+    # static-program capture records comparisons — a comparison invisible to
+    # the Program would replay as a STALE build-time constant
     def op(x, y, name_=None, name=None):
-        return Tensor(fn(as_array(x), as_array(y)))
+        from ..autograd import tape as _tape
 
-    op.__name__ = name
+        with _tape.no_grad():
+            return _apply_op(fn, x, y, _name=opname)
+
+    op.__name__ = opname
     return op
 
 
@@ -23,6 +29,19 @@ less_than = _cmp(jnp.less, "less_than")
 less_equal = _cmp(jnp.less_equal, "less_equal")
 
 
+def _logical(fn, opname):
+    def op(x, y=None, out=None, name=None):
+        from ..autograd import tape as _tape
+
+        with _tape.no_grad():
+            if y is None:
+                return _apply_op(fn, x, _name=opname)
+            return _apply_op(fn, x, y, _name=opname)
+
+    op.__name__ = opname
+    return op
+
+
 def equal_all(x, y, name=None):
     a, b = as_array(x), as_array(y)
     if a.shape != b.shape:
@@ -30,20 +49,16 @@ def equal_all(x, y, name=None):
     return Tensor(jnp.all(a == b))
 
 
-def logical_and(x, y, out=None, name=None):
-    return Tensor(jnp.logical_and(as_array(x), as_array(y)))
-
-
-def logical_or(x, y, out=None, name=None):
-    return Tensor(jnp.logical_or(as_array(x), as_array(y)))
-
-
-def logical_xor(x, y, out=None, name=None):
-    return Tensor(jnp.logical_xor(as_array(x), as_array(y)))
+logical_and = _logical(jnp.logical_and, "logical_and")
+logical_or = _logical(jnp.logical_or, "logical_or")
+logical_xor = _logical(jnp.logical_xor, "logical_xor")
 
 
 def logical_not(x, out=None, name=None):
-    return Tensor(jnp.logical_not(as_array(x)))
+    from ..autograd import tape as _tape
+
+    with _tape.no_grad():
+        return _apply_op(jnp.logical_not, x, _name="logical_not")
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):

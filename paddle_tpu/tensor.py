@@ -313,16 +313,35 @@ class Tensor:
             f"{grad_info},\n       {data_repr})"
         )
 
+    def _static_coercion_guard(self, what):
+        """Under static-program capture, coercing a program var to a Python
+        scalar reads its BUILD-TIME value (placeholders are zeros) and bakes
+        that branch into the program — warn (or raise under
+        FLAGS_static_strict_placeholders). See static/__init__.py."""
+        hook = _static_capture_hook
+        if hook is None:
+            return
+        from . import static as _static
+
+        prog = _static._capture_program()
+        if prog is None or id(self) not in prog._var_of_tensor:
+            return
+        _static._warn_placeholder_coercion(self, what)
+
     def __bool__(self):
+        self._static_coercion_guard("bool")
         return bool(self.numpy())
 
     def __int__(self):
+        self._static_coercion_guard("int")
         return int(self.numpy())
 
     def __float__(self):
+        self._static_coercion_guard("float")
         return float(self.numpy())
 
     def __index__(self):
+        self._static_coercion_guard("index")
         return int(self.numpy())
 
     def __hash__(self):
