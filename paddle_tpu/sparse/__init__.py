@@ -229,3 +229,66 @@ def relu(x, name=None):
 
 def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# unary value-ops (structure-preserving; python/paddle/sparse/unary.py)
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn, opname):
+    def op(x, name=None):
+        x = _coo(x)
+        return SparseCooTensor(jsparse.BCOO(
+            (fn(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+
+    op.__name__ = opname
+    return op
+
+
+sin = _unary(jnp.sin, "sin")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+abs = _unary(jnp.abs, "abs")
+expm1 = _unary(jnp.expm1, "expm1")
+neg = _unary(jnp.negative, "neg")
+sign = _unary(jnp.sign, "sign")
+
+
+def pow(x, factor, name=None):
+    x = _coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.power(x._bcoo.data, factor), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True, name=None):
+    x = _coo(x)
+    d = x._bcoo.data * scale_ + bias if bias_after_scale else \
+        (x._bcoo.data + bias) * scale_
+    return SparseCooTensor(jsparse.BCOO((d, x._bcoo.indices),
+                                        shape=x._bcoo.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework import dtype as _fdtype
+
+    x = _coo(x)
+    data = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(_fdtype.to_np_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(_fdtype.to_np_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+
+
+from . import nn  # noqa: E402,F401 — paddle.sparse.nn (conv/attention/norm)

@@ -1,0 +1,364 @@
+"""paddle.sparse.nn parity: sparse conv / pooling / norm / activation
+layers and the sparse-mask attention functional
+(reference: python/paddle/sparse/nn — SURVEY.md §2.2 "Math domains",
+round-2 verdict missing #6 "sparse nn ops").
+
+TPU-native stance: the reference's GPU path scatters/gathers over rulebook
+tables (spconv-style) — a latency-bound pattern the MXU hates. Here sparse
+conv densifies the active block, runs ONE `lax.conv_general_dilated` (MXU),
+and re-sparsifies with the STRUCTURE mask computed by convolving the 0/1
+occupancy with the kernel support:
+
+- `conv3d`: output active set = binary dilation of the input active set by
+  the kernel (any tap hits an active site);
+- `subm_conv3d`: output active set = input active set (submanifold
+  contract, keeps sparsity from growing layer over layer).
+
+Numerics match the gather/scatter formulation exactly (same sums, same
+sites); for the 5-50% occupancy regimes sparse 3D workloads run at, one
+dense MXU conv beats serialized gathers on TPU. Memory is the dense block —
+documented trade-off, same strategy XLA uses for jax.experimental.sparse.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, as_array
+from . import SparseCooTensor, SparseCsrTensor, _coo, sparse_coo_tensor
+from jax.experimental import sparse as jsparse
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+
+
+def _dense_ndhwc(x):
+    """SparseCooTensor [N, D, H, W, C] -> (dense values, occupancy mask).
+
+    Occupancy comes from the COO INDEX SET, not the values: paddle's
+    sparsity is index-based, so an explicitly-stored all-zero site (e.g.
+    post-ReLU) is still active and must contribute structure (and bias)
+    downstream."""
+    arr = as_array(x.to_dense())
+    idx = x._bcoo.indices
+    occ = jnp.zeros(arr.shape[:-1] + (1,), arr.dtype).at[
+        tuple(idx[:, i] for i in range(idx.shape[1]))].set(1.0)
+    return arr, occ
+
+
+def _conv3d_dense(arr, weight, bias, stride, padding, dilation, groups):
+    """NDHWC x [kd,kh,kw,Cin,Cout] via lax.conv_general_dilated (MXU)."""
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    dilation = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    if isinstance(padding, int):
+        pads = [(padding, padding)] * 3
+    else:
+        pads = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        arr, weight, window_strides=stride, padding=pads,
+        rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + as_array(bias)
+    return out
+
+
+def _resparsify(values, structure):
+    """Dense values + 0/1 structure -> SparseCooTensor at structure sites."""
+    mask = np.asarray(structure[..., 0]) > 0
+    idx = np.argwhere(mask)
+    vals = values[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO(
+        (vals, jnp.asarray(idx)), shape=tuple(values.shape)))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D conv: active output sites = kernel-dilated input sites."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only (paddle parity)")
+    arr, occ = _dense_ndhwc(_coo(x))
+    w = as_array(weight)
+    values = _conv3d_dense(arr, w, bias, stride, padding, dilation, groups)
+    ones_w = jnp.ones(w.shape[:3] + (1, 1), arr.dtype)
+    structure = _conv3d_dense(occ, ones_w, None, stride, padding, dilation, 1)
+    return _resparsify(values, structure)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: output active set == input active set."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only (paddle parity)")
+    x = _coo(x)
+    arr, occ = _dense_ndhwc(x)
+    w = as_array(weight)
+    # submanifold contract requires same-size output: stride 1, SAME pad
+    k = w.shape[:3]
+    pads = [((kk - 1) // 2 * (dilation if isinstance(dilation, int) else 1),
+             kk // 2 * (dilation if isinstance(dilation, int) else 1))
+            for kk in k]
+    values = _conv3d_dense(arr, w, bias, 1, pads, dilation, groups)
+    idx = x._bcoo.indices
+    vals = values[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=tuple(values.shape)))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    x = _coo(x)
+    arr, occ = _dense_ndhwc(x)
+    ks = [kernel_size] * 3 if isinstance(kernel_size, int) else list(kernel_size)
+    st = ks if stride is None else (
+        [stride] * 3 if isinstance(stride, int) else list(stride))
+    pads = [(padding, padding)] * 3 if isinstance(padding, int) else [
+        (p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    neg = jnp.finfo(arr.dtype).min
+    # pool only over active sites: inactive sites must not contribute 0s
+    arr_masked = jnp.where(occ > 0, arr, neg)
+    out = jax.lax.reduce_window(
+        arr_masked, neg, jax.lax.max,
+        (1, *ks, 1), (1, *st, 1), [(0, 0), *pads, (0, 0)])
+    structure = jax.lax.reduce_window(
+        occ, jnp.zeros((), occ.dtype), jax.lax.max, (1, *ks, 1),
+        (1, *st, 1), [(0, 0), *pads, (0, 0)])
+    out = jnp.where(structure > 0, out, 0)
+    return _resparsify(out, structure)
+
+
+def relu(x, name=None):
+    from . import relu as _sparse_relu
+
+    return _sparse_relu(x)
+
+
+def relu6(x, name=None):
+    x = _coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.clip(x._bcoo.data, 0, 6), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = _coo(x)
+    d = x._bcoo.data
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.where(d >= 0, d, negative_slope * d), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the sparse pattern of the last dim (CSR rows): only
+    stored entries participate (paddle.sparse.nn.functional.softmax)."""
+    if isinstance(x, SparseCsrTensor):
+        crows = np.asarray(x._crows)
+        vals = np.asarray(x._values, np.float64)
+        out = np.zeros_like(vals)
+        nrows_total = len(crows) - 1
+        for r in range(nrows_total):
+            lo, hi = crows[r], crows[r + 1]
+            if hi > lo:
+                seg = vals[lo:hi]
+                e = np.exp(seg - seg.max())
+                out[lo:hi] = e / e.sum()
+        return SparseCsrTensor(x._crows, x._cols,
+                               jnp.asarray(out, as_array(x._values).dtype),
+                               x.shape)
+    x = _coo(x)
+    dense = as_array(x.to_dense())
+    occ = jnp.zeros(dense.shape, bool).at[
+        tuple(x._bcoo.indices[:, i] for i in range(x._bcoo.indices.shape[1]))
+    ].set(True)
+    masked = jnp.where(occ, dense, -jnp.inf)
+    sm = jax.nn.softmax(masked, axis=axis)
+    idx = x._bcoo.indices
+    vals = sm[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention (paddle.sparse.nn.functional.attention):
+    softmax(QK^T/sqrt(d) restricted to sparse_mask's CSR pattern) @ V.
+
+    q/k/v: [B, H, S, D] dense; sparse_mask: CSR [B*H, S, S] (or [S, S])
+    giving the allowed attention pattern. TPU design: ONE masked dense
+    QK^T on the MXU with -inf off-pattern (XLA fuses mask+softmax), not a
+    per-row gather — the pattern-restricted numerics are identical.
+    """
+    import math
+
+    q, k, v = as_array(query), as_array(key), as_array(value)
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+
+    # CSR pattern -> dense bool mask
+    if isinstance(sparse_mask, SparseCsrTensor):
+        mask_coo = sparse_mask.to_sparse_coo()
+    else:
+        mask_coo = _coo(sparse_mask)
+    midx = mask_coo._bcoo.indices
+    mshape = mask_coo.shape
+    maskd = jnp.zeros(tuple(mshape), bool).at[
+        tuple(midx[:, i] for i in range(midx.shape[1]))].set(True)
+    if maskd.ndim == 2:
+        maskd = jnp.broadcast_to(maskd, (b, h, s, s))
+    else:
+        maskd = maskd.reshape(b, h, s, s)
+
+    if key_padding_mask is not None:
+        kp = as_array(key_padding_mask).astype(bool)  # [B, S] True=keep
+        maskd = maskd & kp[:, None, None, :]
+    neg = jnp.finfo(logits.dtype).min
+    logits = jnp.where(maskd, logits, neg)
+    if attn_mask is not None:
+        logits = logits + as_array(attn_mask)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(maskd, probs, 0)  # fully-masked rows -> zero output
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    return Tensor(out)
+
+
+class functional:  # namespace shim: sparse.nn.functional.conv3d etc.
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+    relu = staticmethod(relu)
+    relu6 = staticmethod(relu6)
+    leaky_relu = staticmethod(leaky_relu)
+    softmax = staticmethod(softmax)
+    attention = staticmethod(attention)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+from ..nn.layer_base import Layer  # noqa: E402
+from ..tensor import Parameter  # noqa: E402
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = [kernel_size] * 3 if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        from ..framework import random as _random
+
+        k = 1.0 / np.sqrt(in_channels * np.prod(ks))
+        key = _random.next_key()
+        w = jax.random.uniform(key, (*ks, in_channels // groups,
+                                     out_channels), jnp.float32, -k, k)
+        self.weight = Parameter(w)
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+        else:
+            self.bias = None
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._subm = subm
+
+    def forward(self, x):
+        fn = subm_conv3d if self._subm else conv3d
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._dilation, self._groups)
+
+
+class Conv3D(_SparseConvBase):
+    """paddle.sparse.nn.Conv3D parity."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.pop("subm", None)
+        super().__init__(in_channels, out_channels, kernel_size, subm=False,
+                         **kw)
+
+
+class SubmConv3D(_SparseConvBase):
+    """paddle.sparse.nn.SubmConv3D parity (submanifold: sparsity frozen)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.pop("subm", None)
+        super().__init__(in_channels, out_channels, kernel_size, subm=True,
+                         **kw)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return softmax(x, self._axis)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self._ks, self._st, self._pad = kernel_size, stride, padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._ks, self._st, self._pad)
+
+
+class BatchNorm(Layer):
+    """paddle.sparse.nn.BatchNorm: normalizes over the VALUES (active
+    sites) only — inactive sites stay exactly zero, so dense-path BN
+    statistics would be wrong; per-channel stats over nnz entries."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+        self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), jnp.float32)))
+        self._momentum, self._eps = momentum, epsilon
+
+    def forward(self, x):
+        x = _coo(x)
+        vals = x._bcoo.data  # [nnz, C]
+        if self.training:
+            mean = vals.mean(0)
+            var = vals.var(0)
+            m = self._momentum
+            self._mean._rebind(m * as_array(self._mean) + (1 - m) * mean)
+            self._variance._rebind(
+                m * as_array(self._variance) + (1 - m) * var)
+        else:
+            mean = as_array(self._mean)
+            var = as_array(self._variance)
+        normed = (vals - mean) / jnp.sqrt(var + self._eps)
+        out = normed * as_array(self.weight) + as_array(self.bias)
+        return SparseCooTensor(jsparse.BCOO((out, x._bcoo.indices),
+                                            shape=x._bcoo.shape))
