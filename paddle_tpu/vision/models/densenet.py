@@ -1,0 +1,120 @@
+"""DenseNet 121/161/169/201/264
+(reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.norm1(x)))
+        y = self.conv2(self.relu(self.norm2(y)))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(cin)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    """paddle signature: DenseNet(layers=121, bn_size=4, dropout=0.0,
+    num_classes=1000, with_pool=True)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"unsupported DenseNet depth {layers}")
+        init_feat, growth, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_feat, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_feat),
+            nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        ch = init_feat
+        stages = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                stages.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(blocks) - 1:
+                stages.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.dense = nn.Sequential(*stages)
+        self.norm_final = nn.BatchNorm2D(ch)
+        self.relu_final = nn.ReLU()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.dense(x)
+        x = self.relu_final(self.norm_final(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.classifier(x)
+        return x
+
+
+def _make(depth, pretrained, **kw):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not downloadable in this zero-egress "
+            "environment; load a converted state_dict via set_state_dict")
+    return DenseNet(layers=depth, **kw)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _make(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _make(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _make(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _make(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _make(264, pretrained, **kwargs)

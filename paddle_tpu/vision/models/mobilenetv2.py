@@ -1,0 +1,90 @@
+"""MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2D(cin, cout, k, stride=stride, padding=(k - 1) // 2,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(cout),
+            nn.ReLU6(),
+        )
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNReLU(cin, hidden, k=1))
+        layers += [
+            _ConvBNReLU(hidden, hidden, stride=stride, groups=hidden),
+            nn.Conv2D(hidden, cout, 1, bias_attr=False),
+            nn.BatchNorm2D(cout),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.conv(x)
+        return x + y if self.use_res else y
+
+
+class MobileNetV2(nn.Layer):
+    """paddle signature: MobileNetV2(scale=1.0, num_classes=1000,
+    with_pool=True)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        cin = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        feats = [_ConvBNReLU(3, cin, stride=2)]
+        for t, c, n, s in cfg:
+            cout = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(_InvertedResidual(
+                    cin, cout, s if i == 0 else 1, t))
+                cin = cout
+        feats.append(_ConvBNReLU(cin, last, k=1))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not downloadable in this zero-egress "
+            "environment; load a converted state_dict via set_state_dict")
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,127 @@
+"""MobileNetV3 Small/Large
+(reference: python/paddle/vision/models/mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(ch // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        Act = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if exp != cin:
+            layers += [nn.Conv2D(cin, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride,
+                             padding=(k - 1) // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if se:
+            layers.append(_SE(exp))
+        layers += [nn.Conv2D(exp, cout, 1, bias_attr=False),
+                   nn.BatchNorm2D(cout)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.conv(x)
+        return x + y if self.use_res else y
+
+
+_LARGE = [  # k, exp, c, se, act, s
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cin = _make_divisible(16 * scale)
+        feats = [nn.Conv2D(3, cin, 3, stride=2, padding=1, bias_attr=False),
+                 nn.BatchNorm2D(cin), nn.Hardswish()]
+        for k, exp, c, se, act, s in cfg:
+            cout = _make_divisible(c * scale)
+            feats.append(_Block(cin, _make_divisible(exp * scale), cout, k,
+                                s, se, act))
+            cin = cout
+        exp_out = _make_divisible(last_exp * scale)
+        feats += [nn.Conv2D(cin, exp_out, 1, bias_attr=False),
+                  nn.BatchNorm2D(exp_out), nn.Hardswish()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(exp_out, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, 1024, scale, num_classes, with_pool)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not downloadable in this zero-egress "
+            "environment; load a converted state_dict via set_state_dict")
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,132 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, split
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _Unit(nn.Layer):
+    def __init__(self, cin, cout, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(cin, cin, 3, stride=2, padding=1, groups=cin,
+                          bias_attr=False),
+                nn.BatchNorm2D(cin),
+                nn.Conv2D(cin, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act))
+            in2 = cin
+        else:
+            self.branch1 = None
+            in2 = cin // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    """paddle signature: ShuffleNetV2(scale=1.0, act='relu',
+    num_classes=1000, with_pool=True)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c0, c1, c2, c3, clast = _STAGE_OUT[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), _act(act),
+            nn.MaxPool2D(3, 2, padding=1))
+        stages = []
+        cin = c0
+        for cout, rep in zip((c1, c2, c3), _REPEATS):
+            stages.append(_Unit(cin, cout, 2, act))
+            for _ in range(rep - 1):
+                stages.append(_Unit(cout, cout, 1, act))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.last = nn.Sequential(
+            nn.Conv2D(cin, clast, 1, bias_attr=False),
+            nn.BatchNorm2D(clast), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(clast, num_classes)
+
+    def forward(self, x):
+        x = self.last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(x)
+        return x
+
+
+def _make(scale, act, pretrained, **kw):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not downloadable in this zero-egress "
+            "environment; load a converted state_dict via set_state_dict")
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _make(0.25, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _make(0.33, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _make(0.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _make(1.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _make(1.5, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _make(2.0, "relu", pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _make(1.0, "swish", pretrained, **kw)
