@@ -2,6 +2,7 @@
 CHW-based implementations."""
 from __future__ import annotations
 
+import math
 import numbers
 
 import numpy as np
@@ -161,3 +162,253 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# ---------------------------------------------------------------------------
+# round-3 completions: color / geometric / erasing transforms over
+# transforms.functional (reference: python/paddle/vision/transforms)
+# ---------------------------------------------------------------------------
+
+from . import functional as _F
+from .functional import (  # noqa: F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    pad,
+    perspective,
+    rotate,
+    to_grayscale,
+    vflip,
+)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        # paddle accepts a scalar jitter width OR an explicit (min, max)
+        # factor range
+        if isinstance(value, (list, tuple)):
+            self.range = (float(value[0]), float(value[1]))
+            self.value = None
+        else:
+            self.value = float(value)
+            self.range = None
+
+    def _factor(self):
+        if self.range is not None:
+            return float(np.random.uniform(*self.range))
+        if self.value == 0:
+            return 1.0
+        return float(np.random.uniform(max(0.0, 1 - self.value),
+                                       1 + self.value))
+
+    def _apply_image(self, img):
+        return _F.adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return _F.adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return _F.adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if isinstance(value, (list, tuple)):
+            lo, hi = float(value[0]), float(value[1])
+            if not -0.5 <= lo <= hi <= 0.5:
+                raise ValueError("hue range must lie in [-0.5, 0.5]")
+            self.range = (lo, hi)
+        else:
+            if not 0 <= float(value) <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            self.range = (-float(value), float(value))
+
+    def _apply_image(self, img):
+        if self.range == (0.0, 0.0):
+            return _to_chw_float(img)
+        return _F.adjust_hue(img, float(np.random.uniform(*self.range)))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.tfs = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation),
+                    HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.tfs))
+        out = img
+        for k in order:
+            out = self.tfs[k]._apply_image(out)
+        return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return _F.to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return _F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        ang = float(np.random.uniform(*self.degrees))
+        return _F.rotate(img, ang, expand=self.expand, center=self.center,
+                         fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear, self.fill, self.center = shear, fill, center
+
+    def _apply_image(self, img):
+        arr = _to_chw_float(img)
+        h, w = arr.shape[-2:]
+        ang = float(np.random.uniform(*self.degrees))
+        if self.translate is not None:
+            tx = float(np.random.uniform(-self.translate[0],
+                                         self.translate[0]) * w)
+            ty = float(np.random.uniform(-self.translate[1],
+                                         self.translate[1]) * h)
+        else:
+            tx = ty = 0.0
+        sc = float(np.random.uniform(*self.scale_rng)) \
+            if self.scale_rng is not None else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (float(np.random.uniform(-self.shear, self.shear)), 0.0)
+        elif len(self.shear) == 2:
+            sh = (float(np.random.uniform(self.shear[0], self.shear[1])),
+                  0.0)
+        else:
+            sh = (float(np.random.uniform(self.shear[0], self.shear[1])),
+                  float(np.random.uniform(self.shear[2], self.shear[3])))
+        return _F.affine(arr, ang, (tx, ty), sc, sh, fill=self.fill,
+                         center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_chw_float(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[-2:]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        tl = (np.random.randint(0, hw + 1), np.random.randint(0, hh + 1))
+        tr = (w - 1 - np.random.randint(0, hw + 1),
+              np.random.randint(0, hh + 1))
+        br = (w - 1 - np.random.randint(0, hw + 1),
+              h - 1 - np.random.randint(0, hh + 1))
+        bl = (np.random.randint(0, hw + 1),
+              h - 1 - np.random.randint(0, hh + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return _F.perspective(arr, start, [tl, tr, br, bl], fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+
+    def _apply_image(self, img):
+        arr = _to_chw_float(img)
+        h, w = arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = math.exp(np.random.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                arr = arr[..., i:i + ch, j:j + cw]
+                break
+        else:
+            arr = CenterCrop(min(h, w))._apply_image(arr)
+        return Resize(self.size)._apply_image(arr)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        arr = _to_chw_float(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = math.exp(np.random.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    v = np.random.randn(c, eh, ew).astype(np.float32)
+                else:
+                    v = self.value
+                return _F.erase(arr, i, j, eh, ew, v)
+        return arr
+

@@ -1,0 +1,266 @@
+"""Functional image transforms
+(reference: python/paddle/vision/transforms/functional.py).
+
+Pure numpy on CHW float arrays: these run on the HOST in dataloader worker
+processes (the reference's cv2/PIL backends likewise run on CPU), keeping
+the TPU fed without per-image device round-trips. Geometric warps share
+one inverse-mapping bilinear sampler."""
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+
+
+def _chw(img):
+    from . import _to_chw_float
+
+    return _to_chw_float(img)
+
+
+def hflip(img):
+    return _chw(img)[..., ::-1].copy()
+
+
+def vflip(img):
+    return _chw(img)[..., ::-1, :].copy()
+
+
+def crop(img, top, left, height, width):
+    arr = _chw(img)
+    return arr[..., top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    arr = _chw(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = arr.shape[-2:]
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return arr[..., i:i + th, j:j + tw].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _chw(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    widths = [(0, 0), (pt, pb), (pl, pr)]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, widths, mode=mode, constant_values=fill)
+    return np.pad(arr, widths, mode=mode)
+
+
+def _bilinear_sample(arr, sx, sy, fill=0.0):
+    """Sample CHW `arr` at float coords (sx, sy) [H', W']; out-of-bounds
+    pixels get `fill`."""
+    c, h, w = arr.shape
+    x0 = np.floor(sx)
+    y0 = np.floor(sy)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def at(ix, iy):
+        ixc = np.clip(ix, 0, w - 1).astype(np.int64)
+        iyc = np.clip(iy, 0, h - 1).astype(np.int64)
+        return arr[:, iyc, ixc]  # [C, H', W']
+
+    wa = (x1 - sx) * (y1 - sy)
+    wb = (x1 - sx) * (sy - y0)
+    wc = (sx - x0) * (y1 - sy)
+    wd = (sx - x0) * (sy - y0)
+    out = (at(x0, y0) * wa + at(x0, y1) * wb + at(x1, y0) * wc
+           + at(x1, y1) * wd)
+    valid = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) & (sy <= h - 0.5)
+    if np.isscalar(fill) or np.ndim(fill) == 0:
+        fillv = np.full((c, 1, 1), float(fill) if np.isscalar(fill)
+                        else float(np.asarray(fill)), np.float32)
+    else:
+        fillv = np.asarray(fill, np.float32).reshape(c, 1, 1)
+    return np.where(valid[None], out, fillv).astype(np.float32)
+
+
+def _inverse_affine_warp(arr, matrix, fill=0.0):
+    """Warp CHW by the INVERSE of a 2x3 output<-input affine matrix
+    (matrix maps OUTPUT pixel coords to INPUT sample coords)."""
+    h, w = arr.shape[-2:]
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    sx = matrix[0, 0] * xs + matrix[0, 1] * ys + matrix[0, 2]
+    sy = matrix[1, 0] * xs + matrix[1, 1] * ys + matrix[1, 2]
+    return _bilinear_sample(arr, sx, sy, fill)
+
+
+def _affine_inverse_matrix(angle, translate, scale, shear, center):
+    """Inverse of the paddle/torchvision affine: output <- input mapping."""
+    rot = math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: M = T(center) R(rot) Shear S(scale) T(-center) + translate
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    fwd = np.array([[a * scale, b * scale, 0.0],
+                    [c * scale, d * scale, 0.0],
+                    [0.0, 0.0, 1.0]], np.float64)
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], np.float64)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
+    m = pre @ fwd @ post
+    return np.linalg.inv(m)[:2]
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _chw(img)
+    h, w = arr.shape[-2:]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    # PIL/paddle convention: positive angle = counter-clockwise on screen;
+    # in y-down image coords the math-positive rotation looks clockwise,
+    # so negate
+    inv = _affine_inverse_matrix(-angle, translate, scale, shear, center)
+    return _inverse_affine_warp(arr, inv, fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _chw(img)
+    h, w = arr.shape[-2:]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if expand:
+        rad = math.radians(angle)
+        nw = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        nh = int(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5)
+        # map output (expanded) pixels back through rotation about the
+        # expanded center into original coordinates (CCW convention: see
+        # affine)
+        ocx, ocy = (nw - 1) * 0.5, (nh - 1) * 0.5
+        rad_i = math.radians(angle)
+        ys, xs = np.meshgrid(np.arange(nh, dtype=np.float32),
+                             np.arange(nw, dtype=np.float32), indexing="ij")
+        dx, dy = xs - ocx, ys - ocy
+        sx = math.cos(rad_i) * dx - math.sin(rad_i) * dy + center[0]
+        sy = math.sin(rad_i) * dx + math.cos(rad_i) * dy + center[1]
+        return _bilinear_sample(arr, sx, sy, fill)
+    inv = _affine_inverse_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    return _inverse_affine_warp(arr, inv, fill)
+
+
+def _homography(src, dst):
+    """8-DOF homography mapping src (x,y) -> dst (x,y) (4 point pairs)."""
+    A, b = [], []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        b.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.append(v)
+    h = np.linalg.lstsq(np.asarray(A, np.float64),
+                        np.asarray(b, np.float64), rcond=None)[0]
+    return np.append(h, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Warp so that `startpoints` (corners in the input) land on
+    `endpoints` (paddle parity: points are [TL, TR, BR, BL] (x, y))."""
+    arr = _chw(img)
+    h, w = arr.shape[-2:]
+    # inverse map: output pixel -> input sample
+    hom = _homography(endpoints, startpoints)
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    den = hom[2, 0] * xs + hom[2, 1] * ys + hom[2, 2]
+    sx = (hom[0, 0] * xs + hom[0, 1] * ys + hom[0, 2]) / den
+    sy = (hom[1, 0] * xs + hom[1, 1] * ys + hom[1, 2]) / den
+    return _bilinear_sample(arr, sx.astype(np.float32),
+                            sy.astype(np.float32), fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    from ...tensor import Tensor, as_array
+
+    is_tensor = isinstance(img, Tensor)
+    arr = np.asarray(as_array(img)) if is_tensor else _chw(img)
+    out = arr if inplace and not is_tensor else arr.copy()
+    out[..., i:i + h, j:j + w] = v
+    return Tensor(out) if is_tensor else out
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.clip(_chw(img) * float(brightness_factor), 0.0, 1.0)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _chw(img)
+    mean = _rgb_to_gray(arr).mean()
+    return np.clip((arr - mean) * float(contrast_factor) + mean, 0.0, 1.0)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _chw(img)
+    gray = _rgb_to_gray(arr)
+    f = float(saturation_factor)
+    return np.clip(arr * f + gray[None] * (1 - f), 0.0, 1.0)
+
+
+def _rgb_to_gray(arr):
+    if arr.shape[0] == 1:
+        return arr[0]
+    return (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2]).astype(
+        np.float32)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: shift the hue channel in HSV space."""
+    if not -0.5 <= float(hue_factor) <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _chw(img)
+    if arr.shape[0] == 1:
+        return arr
+    r, g, b = arr[0], arr[1], arr[2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.where(delta == 0, 1.0, delta)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + float(hue_factor)) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int64) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r2, g2, b2]).astype(np.float32)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _chw(img)
+    gray = _rgb_to_gray(arr)[None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=0)
+    return gray.astype(np.float32)

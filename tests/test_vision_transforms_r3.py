@@ -1,0 +1,106 @@
+"""Round-3 vision.transforms completions (reference:
+python/paddle/vision/transforms): color jitter family, geometric warps
+(rotate/affine/perspective), erasing, grayscale, functional API."""
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture
+def img():
+    return np.random.RandomState(0).rand(3, 32, 32).astype("float32")
+
+
+class TestFunctional:
+    def test_flips_involutive(self, img):
+        np.testing.assert_allclose(T.hflip(T.hflip(img)), img)
+        np.testing.assert_allclose(T.vflip(T.vflip(img)), img)
+
+    def test_crop_pad(self, img):
+        assert T.crop(img, 2, 3, 10, 12).shape == (3, 10, 12)
+        assert T.center_crop(img, 16).shape == (3, 16, 16)
+        assert T.pad(img, 2).shape == (3, 36, 36)
+        assert T.pad(img, (1, 2)).shape == (3, 36, 34)
+
+    def test_rotate_90_matches_rot90_ccw(self, img):
+        r = T.rotate(img, 90)
+        # interior matches a CCW quarter turn (PIL/paddle convention);
+        # edges differ by sampling
+        np.testing.assert_allclose(
+            r[:, 8:24, 8:24],
+            np.rot90(img, 1, axes=(1, 2))[:, 8:24, 8:24], atol=1e-4)
+
+    def test_rotate_expand_grows(self, img):
+        re = T.rotate(img, 45, expand=True)
+        assert re.shape[1] > 32 and re.shape[2] > 32
+
+    def test_affine_translate(self, img):
+        a = T.affine(img, 0, (2, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_allclose(a[:, :, 5:30], img[:, :, 3:28],
+                                   atol=1e-4)
+
+    def test_perspective_identity(self, img):
+        corners = [(0, 0), (31, 0), (31, 31), (0, 31)]
+        np.testing.assert_allclose(
+            T.perspective(img, corners, corners), img, atol=1e-4)
+
+    def test_color_adjustments(self, img):
+        assert T.adjust_brightness(img, 2.0).max() <= 1.0
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-4)
+        # full hue cycle returns to start
+        h1 = T.adjust_hue(img, 0.5)
+        h2 = T.adjust_hue(h1, 0.5)
+        np.testing.assert_allclose(h2, img, atol=1e-3)
+        s = T.adjust_saturation(img, 0.0)
+        np.testing.assert_allclose(s[0], s[1], atol=1e-5)
+        c = T.adjust_contrast(img, 1.0)
+        np.testing.assert_allclose(c, img, atol=1e-5)
+
+    def test_grayscale(self, img):
+        assert T.to_grayscale(img).shape == (1, 32, 32)
+        g3 = T.to_grayscale(img, 3)
+        np.testing.assert_allclose(g3[0], g3[2])
+
+    def test_erase(self, img):
+        out = T.erase(img, 4, 5, 6, 7, 0.0)
+        assert (out[:, 4:10, 5:12] == 0).all()
+        assert out[0, 0, 0] == img[0, 0, 0]
+
+
+class TestClasses:
+    @pytest.mark.parametrize("ctor", [
+        lambda: T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+        lambda: T.Grayscale(3),
+        lambda: T.Pad(2),
+        lambda: T.RandomRotation(30),
+        lambda: T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                               shear=10),
+        lambda: T.RandomPerspective(1.0),
+        lambda: T.RandomResizedCrop(16),
+        lambda: T.RandomErasing(1.0),
+        lambda: T.BrightnessTransform(0.4),
+        lambda: T.ContrastTransform(0.4),
+        lambda: T.SaturationTransform(0.4),
+        lambda: T.HueTransform(0.2),
+    ])
+    def test_produces_image(self, ctor, img):
+        np.random.seed(1)
+        out = ctor()(img)
+        assert out.ndim == 3
+        assert np.isfinite(out).all()
+
+    def test_random_resized_crop_size(self, img):
+        out = T.RandomResizedCrop((20, 24))(img)
+        assert out.shape == (3, 20, 24)
+
+    def test_random_erasing_erases(self, img):
+        np.random.seed(0)
+        out = T.RandomErasing(prob=1.0, value=0.0)(img)
+        assert (out == 0).sum() > (img == 0).sum()
+
+    def test_compose_chain(self, img):
+        pipeline = T.Compose([T.RandomResizedCrop(16), T.ColorJitter(0.1),
+                              T.Grayscale(1)])
+        out = pipeline(img)
+        assert out.shape == (1, 16, 16)
