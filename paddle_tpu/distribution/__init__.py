@@ -6,14 +6,41 @@ PRNG key stream (framework.random) so `paddle.seed` governs sampling.
 from .distributions import (  # noqa: F401
     Bernoulli,
     Beta,
+    Binomial,
     Categorical,
+    Cauchy,
+    ContinuousBernoulli,
     Dirichlet,
     Distribution,
+    Exponential,
+    ExponentialFamily,
+    Gamma,
+    Geometric,
     Gumbel,
+    Independent,
     Laplace,
     LogNormal,
     Multinomial,
+    MultivariateNormal,
     Normal,
+    Poisson,
+    StudentT,
+    TransformedDistribution,
     Uniform,
 )
 from .kl import kl_divergence, register_kl  # noqa: F401
+from .transforms import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)

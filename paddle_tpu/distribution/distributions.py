@@ -309,3 +309,418 @@ class Gumbel(Distribution):
     def entropy(self):
         e = jnp.log(self.scale) + 1 + np.euler_gamma
         return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions
+    (paddle.distribution.ExponentialFamily): subclasses may expose
+    natural parameters; entropy via the Bregman identity falls back to
+    each subclass's closed form here."""
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        return Tensor(jax.random.exponential(
+            k, _shape(shape, self.rate)) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1.0 - jnp.log(self.rate),
+                                       self.batch_shape))
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.concentration), np.shape(self.rate)))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        g = jax.random.gamma(
+            k, jnp.broadcast_to(self.concentration,
+                                _shape(shape, self.concentration,
+                                       self.rate)))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        e = a - jnp.log(b) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0, 1, ...} (paddle parity)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(np.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor((1.0 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1.0 - self.probs) / jnp.square(self.probs))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        u = jax.random.uniform(k, _shape(shape, self.probs),
+                               minval=1e-12, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, value):
+        return Tensor(jnp.exp(as_array(self.log_prob(value))))
+
+    def entropy(self):
+        p = self.probs
+        e = (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        u = jax.random.uniform(k, _shape(shape, self.loc, self.scale),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(
+            math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + jnp.square(z))))
+
+    def entropy(self):
+        e = jnp.log(4 * math.pi * self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        return Tensor(jax.random.poisson(
+            k, self.rate, _shape(shape, self.rate)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - jsp.gammaln(v + 1))
+
+    def entropy(self):
+        # exact sum over the bulk of the support (paddle uses the same
+        # truncated-series approach)
+        lam = jnp.broadcast_to(self.rate, self.batch_shape or (1,))
+        kmax = int(np.maximum(20, 4 * np.max(np.asarray(lam))) + 20)
+        ks = jnp.arange(kmax, dtype=jnp.float32)
+        lp = (ks[:, None] * jnp.log(lam.reshape(-1)) - lam.reshape(-1)
+              - jsp.gammaln(ks[:, None] + 1))
+        e = -jnp.sum(jnp.exp(lp) * lp, axis=0).reshape(lam.shape)
+        return Tensor(e if self.batch_shape else e.reshape(()))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(np.broadcast_shapes(np.shape(self.total_count),
+                                             np.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        n = jnp.broadcast_to(self.total_count,
+                             _shape(shape, self.total_count, self.probs))
+        p = jnp.broadcast_to(self.probs, n.shape)
+        try:
+            out = jax.random.binomial(k, n, p)
+        except (AttributeError, NotImplementedError):
+            nmax = int(np.max(np.asarray(self.total_count)))
+            u = jax.random.uniform(k, (nmax,) + n.shape)
+            draws = (u < p[None]).astype(jnp.float32)
+            mask = jnp.arange(nmax, dtype=jnp.float32)[
+                (...,) + (None,) * n.ndim] < n[None]
+            out = jnp.sum(draws * mask, axis=0)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.total_count, self.probs
+        logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                - jsp.gammaln(n - v + 1))
+        return Tensor(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lambda): pdf C(l) l^x (1-l)^(1-x) on [0, 1] (paddle parity)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(np.shape(self.probs))
+
+    def _log_norm(self):
+        lam = self.probs
+        near = (lam > self._lims[0]) & (lam < self._lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        # both arctanh(1-2l) and (1-2l) flip sign together at l=0.5, so the
+        # ratio is positive on BOTH sides — no clamp needed (safe is never
+        # near 0.5 by construction; a magnitude clamp here would flip the
+        # sign for l > 0.5 and poison the log with NaN)
+        logc = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        # Taylor about 1/2: log 2 + (4/3)(l-1/2)^2-ish; log 2 suffices at
+        # the boundary width used here
+        return jnp.where(near, math.log(2.0), logc)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        near = (lam > self._lims[0]) & (lam < self._lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor(jnp.where(near, 0.5, m))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        u = jax.random.uniform(k, _shape(shape, self.probs),
+                               minval=1e-7, maxval=1 - 1e-7)
+        lam = self.probs
+        near = (lam > self._lims[0]) & (lam < self._lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        # inverse CDF: x = log1p(u(2l-1)/(1-l)) / log(l/(1-l))
+        num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+        den = jnp.log(safe) - jnp.log1p(-safe)
+        return Tensor(jnp.where(near, u, num / den))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(self._log_norm() + v * jnp.log(self.probs)
+                      + (1 - v) * jnp.log1p(-self.probs))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.df), np.shape(self.loc), np.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, self.loc, jnp.nan), self.batch_shape))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        t = jax.random.t(k, self.df,
+                         _shape(shape, self.df, self.loc, self.scale))
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        df, mu, s = self.df, self.loc, self.scale
+        z = (v - mu) / s
+        return Tensor(
+            jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+            - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+            - (df + 1) / 2 * jnp.log1p(jnp.square(z) / df))
+
+    def entropy(self):
+        df = self.df
+        e = ((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                             - jsp.digamma(df / 2))
+             + 0.5 * jnp.log(df) + jsp.betaln(df / 2, 0.5)
+             + jnp.log(self.scale))
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        d = self.loc.shape[-1]
+        if scale_tril is not None:
+            self._tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        elif precision_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_arr(precision_matrix)))
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix "
+                             "/ scale_tril is required")
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.loc)[:-1], np.shape(self._tril)[:-2]), (d,))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        k = _random.next_key()
+        d = self.loc.shape[-1]
+        z = jax.random.normal(
+            k, tuple(shape) + tuple(self.batch_shape) + (d,))
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._tril, z))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        d = self.loc.shape[-1]
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(
+            self._tril, diff[..., None], lower=True)[..., 0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(jnp.square(sol), -1) - logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1)), -1)
+        e = 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Independent(Distribution):
+    """Reinterpret the last `reinterpreted_batch_rank` batch dims of a
+    base distribution as event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = as_array(self.base.log_prob(value))
+        return Tensor(jnp.sum(
+            lp, axis=tuple(range(lp.ndim - self.rank, lp.ndim))))
+
+    def entropy(self):
+        e = as_array(self.base.entropy())
+        return Tensor(jnp.sum(
+            e, axis=tuple(range(e.ndim - self.rank, e.ndim))))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of Transforms
+    (paddle.distribution.TransformedDistribution parity)."""
+
+    def __init__(self, base, transforms):
+        from .transforms import Transform
+
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        super().__init__(base.batch_shape, shape[len(base.batch_shape):])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = as_array(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._fldj(x)
+            y = x
+        return Tensor(lp + as_array(self.base.log_prob(Tensor(y))))

@@ -1,0 +1,276 @@
+"""Probability transforms
+(reference: python/paddle/distribution/transform.py): invertible maps with
+log-det-Jacobian, composing into TransformedDistribution."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, as_array
+
+
+def _arr(x):
+    a = as_array(x)
+    return a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.integer) \
+        else a
+
+
+class Transform:
+    """Base invertible transform (paddle.distribution.Transform parity):
+    forward / inverse / forward_log_det_jacobian /
+    inverse_log_det_jacobian, each Tensor -> Tensor."""
+
+    _type = "bijection"
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return Tensor(-self._fldj(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # -- implementations over raw arrays -----------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (not injective; inverse returns the positive branch)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2(log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not bijective on R^n; paddle
+    parity: inverse = log)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> open simplex^K via stick-breaking (paddle parity)."""
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zp = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), 1 - z], axis=-1)
+        return jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1) \
+            * jnp.cumprod(zp, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1.0 - jnp.cumsum(y_crop, axis=-1) + y_crop
+        z = y_crop / rem
+        offset = y.shape[-1] - 1 - jnp.arange(y.shape[-1] - 1,
+                                              dtype=y.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        y = self._forward(x)
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z)
+                       + jnp.log(y[..., :-1] / z), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("reshape must preserve the event size")
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead, x.dtype)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply the i-th transform to the i-th slice along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, x, method):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, method)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")
+
+
+class IndependentTransform(Transform):
+    """Treat the last `reinterpreted_batch_rank` dims as event dims: the
+    log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - self.rank, ld.ndim)))

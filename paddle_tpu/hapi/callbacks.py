@@ -138,3 +138,114 @@ class LRScheduler(Callback):
         super().__init__()
         self.by_step = by_step
         self.by_epoch = by_epoch
+
+
+class ReduceLROnPlateau(Callback):
+    """paddle.callbacks.ReduceLROnPlateau parity: shrink the optimizer lr
+    by `factor` after `patience` epochs without monitored improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            # same heuristic as EarlyStopping above: accuracy-like
+            # monitors maximize, everything else minimizes
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        value = float(value[0] if isinstance(value, (list, tuple)) else value)
+        if self.cooldown_counter > 0:
+            # cooldown suppresses both reductions AND patience accrual
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        improved = (
+            self.best is None
+            or (self.mode == "min" and value < self.best - self.min_delta)
+            or (self.mode == "max" and value > self.best + self.min_delta)
+        )
+        if improved:
+            self.best = value
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                try:
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
+                except RuntimeError:
+                    pass  # scheduler-driven lr: scheduler owns the decay
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """paddle.callbacks.VisualDL parity: scalar logging per step/epoch.
+
+    The visualdl package isn't installable here (zero egress); scalars are
+    written as TSV lines under `log_dir` (one file per metric) — readable
+    by the TensorBoard text workflow and trivially parseable. The callback
+    API surface (log_dir ctor, automatic train/eval scalars) matches."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._files = {}
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        f = self._files.get(tag)
+        if f is None:
+            f = self._files[tag] = open(
+                os.path.join(self.log_dir, f"{tag}.tsv"), "a")
+        f.write(f"{step}\t{value}\n")
+        f.flush()
+
+    def _log_all(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            if k in ("batch_size", "num_samples"):
+                continue
+            try:
+                val = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            self._write(f"{prefix}_{k}", val, step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log_all("train", logs, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log_all("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log_all("eval", logs, self._step)
+
+    def __del__(self):
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass

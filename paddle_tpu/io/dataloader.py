@@ -69,12 +69,13 @@ _ERR = "__pdtpu_worker_err__"
 
 
 def _mp_worker_loop(dataset, batch_lists, ring_name, collate, init_fn,
-                    worker_id):
+                    worker_id, num_workers=1):
     """Runs in a forked child: numpy-only; ships pickled batches by shm."""
     from .shm_queue import ShmRing
 
     ring = ShmRing(ring_name, open_existing=True)
     try:
+        _set_worker_info(WorkerInfo(worker_id, num_workers, dataset))
         if init_fn is not None:
             init_fn(worker_id)
         for indices in batch_lists:
@@ -123,7 +124,7 @@ class _MultiProcessIter:
             p = ctx.Process(
                 target=_mp_worker_loop,
                 args=(loader.dataset, per_worker[w], name, collate,
-                      loader.worker_init_fn, w),
+                      loader.worker_init_fn, w, W),
                 daemon=True)
             try:
                 p.start()
@@ -305,3 +306,35 @@ class DataLoader:
         if self.batch_sampler is not None:
             return len(self.batch_sampler)
         raise TypeError("DataLoader over IterableDataset has no len()")
+
+
+# ---------------------------------------------------------------------------
+# worker info (paddle.io.get_worker_info parity)
+# ---------------------------------------------------------------------------
+
+class WorkerInfo:
+    """Identity of the current dataloader worker (None in the main
+    process). Fields mirror the reference: id, num_workers, dataset."""
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker: its WorkerInfo; in the main process: None —
+    IterableDataset shards itself with this (reference contract)."""
+    return _worker_info
+
+
+def _set_worker_info(info):
+    global _worker_info
+    _worker_info = info

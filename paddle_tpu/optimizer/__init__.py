@@ -6,11 +6,16 @@ from .optimizer import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    ASGD,
     L1Decay,
     L2Decay,
     Lamb,
+    LBFGS,
     Momentum,
+    NAdam,
     Optimizer,
+    RAdam,
     RMSProp,
+    Rprop,
     SGD,
 )

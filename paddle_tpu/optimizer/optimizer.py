@@ -425,3 +425,266 @@ class L2Decay:
 class L1Decay:
     def __init__(self, coeff=0.0):
         self._coeff = float(coeff)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (python/paddle/optimizer/radam.py parity): warms up
+    the adaptive term by the variance-rectification factor r_t; falls back
+    to unadapted momentum while rho_t <= 5 (jit-friendly via where)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "moment2": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), dtype=jnp.float32),
+            "beta2_pow": jnp.ones((), dtype=jnp.float32),
+            "t": jnp.zeros((), dtype=jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        work = p.astype(jnp.float32)
+        g = self._decay_grad(work, g.astype(jnp.float32))
+        b1, b2 = self._beta1, self._beta2
+        t = state["t"] + 1
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - b1p)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1 - b2p)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * jnp.maximum(rho_t, 1e-6)
+        r_t = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2p)) + self._epsilon
+        adaptive = lr * r_t * m_hat / v_hat
+        plain = lr * m_hat
+        work = work - jnp.where(rho_t > 5.0, adaptive, plain)
+        return work.astype(p.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+            "t": t}
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (python/paddle/optimizer/nadam.py parity)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "moment2": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "mu_prod": jnp.ones((), dtype=jnp.float32),
+            "beta2_pow": jnp.ones((), dtype=jnp.float32),
+            "t": jnp.zeros((), dtype=jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        work = p.astype(jnp.float32)
+        g = self._decay_grad(work, g.astype(jnp.float32))
+        b1, b2, psi = self._beta1, self._beta2, self._psi
+        t = state["t"] + 1
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_prod"] * mu_t
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - b2p)
+        work = work - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return work.astype(p.dtype), {
+            "moment1": m, "moment2": v, "mu_prod": mu_prod,
+            "beta2_pow": b2p, "t": t}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (python/paddle/optimizer/asgd.py
+    parity): keeps a running sum of the last `batch_num` per-slot grads
+    and steps along their average; batch_num=1 degenerates to SGD."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._n = max(int(batch_num), 1)
+
+    def _init_state(self, p):
+        return {
+            "d": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "grads": jnp.zeros((self._n,) + tuple(p._data.shape),
+                               dtype=jnp.float32),
+            "t": jnp.zeros((), dtype=jnp.int32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        work = p.astype(jnp.float32)
+        g = self._decay_grad(work, g.astype(jnp.float32))
+        slot = state["t"] % self._n
+        old = state["grads"][slot]
+        d = state["d"] - old + g
+        grads = state["grads"].at[slot].set(g)
+        # average over the slots seen so far (first pass: t+1 slots)
+        seen = jnp.minimum(state["t"] + 1, self._n).astype(jnp.float32)
+        work = work - lr * d / seen
+        return work.astype(p.dtype), {
+            "d": d, "grads": grads, "t": state["t"] + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (python/paddle/optimizer/rprop.py
+    parity): per-weight step sizes adapted by gradient-sign agreement;
+    gradient magnitudes are ignored. Full-batch regime only (the
+    reference documents the same caveat)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state(self, p):
+        return {
+            "prev_grad": jnp.zeros(p._data.shape, dtype=jnp.float32),
+            "step_size": jnp.full(p._data.shape, float(self.get_lr()),
+                                  jnp.float32),
+        }
+
+    def _update_param(self, p, g, state, lr, param_name=None):
+        work = p.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        sign = g * state["prev_grad"]
+        step = jnp.where(
+            sign > 0, jnp.minimum(state["step_size"] * self._eta_pos,
+                                  self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(state["step_size"] * self._eta_neg,
+                                  self._lr_min),
+                      state["step_size"]))
+        # iRprop-: on sign change, take no step and forget the gradient
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        work = work - jnp.sign(g_eff) * step
+        return work.astype(p.dtype), {
+            "prev_grad": g_eff, "step_size": step}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (python/paddle/optimizer/lbfgs.py parity): two-loop
+    recursion over a bounded (s, y) history, driven by a closure that
+    re-evaluates loss+grads. HOST-DRIVEN and eager-only by nature (the
+    reference's is too): each inner iteration re-runs the closure."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._data.astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _flat_grads(self):
+        return jnp.concatenate(
+            [(p.grad._data if p.grad is not None
+              else jnp.zeros(p._data.shape)).astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _write_params(self, flat):
+        ofs = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            chunk = flat[ofs:ofs + n].reshape(p._data.shape)
+            p._rebind(chunk.astype(p._data.dtype))
+            ofs += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the loss and calls backward()")
+        loss = closure()
+        flat_g = self._flat_grads()
+        evals = 1
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+                break
+            # two-loop recursion
+            q = flat_g
+            alphas = []
+            for s, y in zip(reversed(self._s_hist),
+                            reversed(self._y_hist)):
+                rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+                a = rho * jnp.dot(s, q)
+                q = q - a * y
+                alphas.append((rho, a, s, y))
+            if self._y_hist:
+                y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+                gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                    jnp.dot(y_last, y_last), 1e-10)
+                r = gamma * q
+            else:
+                r = q
+            for rho, a, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, r)
+                r = r + (a - b) * s
+            direction = -r
+            x0 = self._flat_params()
+            t = float(self.get_lr())
+            if self._line_search_fn == "strong_wolfe":
+                # backtracking Armijo (sufficient-decrease) stand-in
+                f0 = float(loss)
+                gd = float(jnp.dot(flat_g, direction))
+                for _bt in range(20):
+                    self._write_params(x0 + t * direction)
+                    loss = closure()
+                    evals += 1
+                    if float(loss) <= f0 + 1e-4 * t * gd or \
+                            evals >= self._max_eval:
+                        break
+                    t *= 0.5
+            else:
+                self._write_params(x0 + t * direction)
+                loss = closure()
+                evals += 1
+            new_g = self._flat_grads()
+            s_vec = self._flat_params() - x0
+            y_vec = new_g - flat_g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s_hist.append(s_vec)
+                self._y_hist.append(y_vec)
+                if len(self._s_hist) > self._history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) < self._tol_change:
+                flat_g = new_g
+                break
+            flat_g = new_g
+            if evals >= self._max_eval:
+                break
+        self._step_count += 1
+        return loss

@@ -1,0 +1,320 @@
+"""Round-3 tail: distributions (+transforms), optimizers (RAdam/NAdam/
+ASGD/Rprop/LBFGS), LinearLR, callbacks, io.get_worker_info
+(references: python/paddle/distribution, python/paddle/optimizer)."""
+import math
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+class TestDistributions:
+    def test_exponential(self):
+        d = D.Exponential(rate=2.0)
+        paddle.seed(0)
+        s = d.sample([20000]).numpy()
+        np.testing.assert_allclose(s.mean(), 0.5, atol=0.02)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(np.array(0.7, "float32"))).numpy(),
+            st.expon(scale=0.5).logpdf(0.7), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   st.expon(scale=0.5).entropy(), rtol=1e-5)
+
+    def test_gamma(self):
+        d = D.Gamma(concentration=3.0, rate=2.0)
+        paddle.seed(0)
+        s = d.sample([20000]).numpy()
+        np.testing.assert_allclose(s.mean(), 1.5, atol=0.05)
+        x = 1.3
+        np.testing.assert_allclose(
+            float(d.log_prob(paddle.to_tensor(np.float32(x)))),
+            st.gamma(3.0, scale=0.5).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   st.gamma(3.0, scale=0.5).entropy(),
+                                   rtol=1e-4)
+
+    def test_poisson_binomial_geometric(self):
+        p = D.Poisson(rate=4.0)
+        paddle.seed(1)
+        np.testing.assert_allclose(p.sample([20000]).numpy().mean(), 4.0,
+                                   atol=0.1)
+        np.testing.assert_allclose(
+            float(p.log_prob(paddle.to_tensor(np.float32(3)))),
+            st.poisson(4.0).logpmf(3), rtol=1e-5)
+
+        b = D.Binomial(total_count=10.0, probs=0.3)
+        np.testing.assert_allclose(b.sample([20000]).numpy().mean(), 3.0,
+                                   atol=0.1)
+        np.testing.assert_allclose(
+            float(b.log_prob(paddle.to_tensor(np.float32(4)))),
+            st.binom(10, 0.3).logpmf(4), rtol=1e-5)
+
+        g = D.Geometric(probs=0.25)
+        np.testing.assert_allclose(g.sample([40000]).numpy().mean(), 3.0,
+                                   atol=0.15)
+        np.testing.assert_allclose(
+            float(g.log_prob(paddle.to_tensor(np.float32(2)))),
+            st.geom(0.25, loc=-1).logpmf(2), rtol=1e-5)
+
+    def test_cauchy_studentt(self):
+        c = D.Cauchy(loc=1.0, scale=2.0)
+        np.testing.assert_allclose(
+            float(c.log_prob(paddle.to_tensor(np.float32(0.3)))),
+            st.cauchy(1.0, 2.0).logpdf(0.3), rtol=1e-5)
+        np.testing.assert_allclose(float(c.entropy()),
+                                   st.cauchy(1.0, 2.0).entropy(), rtol=1e-5)
+        t = D.StudentT(df=5.0, loc=0.5, scale=1.5)
+        np.testing.assert_allclose(
+            float(t.log_prob(paddle.to_tensor(np.float32(1.1)))),
+            st.t(5.0, 0.5, 1.5).logpdf(1.1), rtol=1e-5)
+        np.testing.assert_allclose(float(t.entropy()),
+                                   st.t(5.0, 0.5, 1.5).entropy(), rtol=1e-4)
+
+    def test_multivariate_normal(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mu = np.array([1.0, -1.0], "float32")
+        d = D.MultivariateNormal(paddle.to_tensor(mu),
+                                 covariance_matrix=paddle.to_tensor(cov))
+        x = np.array([0.3, 0.7], "float32")
+        np.testing.assert_allclose(
+            float(d.log_prob(paddle.to_tensor(x))),
+            st.multivariate_normal(mu, cov).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   st.multivariate_normal(mu, cov).entropy(),
+                                   rtol=1e-5)
+        paddle.seed(2)
+        s = d.sample([30000]).numpy()
+        np.testing.assert_allclose(s.mean(0), mu, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.08)
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(probs=0.3)
+        paddle.seed(3)
+        s = d.sample([30000]).numpy()
+        assert (s >= 0).all() and (s <= 1).all()
+        np.testing.assert_allclose(s.mean(), float(d.mean), atol=0.01)
+        # pdf integrates to ~1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype("float32")
+        pdf = np.exp(d.log_prob(paddle.to_tensor(xs)).numpy())
+        np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, atol=1e-3)
+
+    def test_independent(self):
+        base = D.Normal(paddle.to_tensor(np.zeros((3, 4), "float32")),
+                        paddle.to_tensor(np.ones((3, 4), "float32")))
+        ind = D.Independent(base, 1)
+        assert tuple(ind.batch_shape) == (3,)
+        x = np.random.RandomState(0).randn(3, 4).astype("float32")
+        lp = ind.log_prob(paddle.to_tensor(x)).numpy()
+        ref = st.norm(0, 1).logpdf(x).sum(-1)
+        np.testing.assert_allclose(lp, ref, rtol=1e-5)
+
+
+class TestTransforms:
+    def test_roundtrips(self):
+        x = np.random.RandomState(0).randn(50).astype("float32")
+        for t in [D.AffineTransform(1.5, 2.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform()]:
+            y = t.forward(paddle.to_tensor(x))
+            back = t.inverse(y).numpy()
+            np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_log_det_matches_numeric(self):
+        x = np.linspace(-1.5, 1.5, 11).astype("float32")
+        eps = 1e-3
+        for t in [D.AffineTransform(0.5, 3.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform(),
+                  D.PowerTransform(2.0)]:
+            xs = np.abs(x) + 0.5 if isinstance(t, D.PowerTransform) else x
+            f = lambda a: t.forward(paddle.to_tensor(
+                np.asarray(a, "float32"))).numpy()
+            num = (f(xs + eps) - f(xs - eps)) / (2 * eps)
+            ld = t.forward_log_det_jacobian(
+                paddle.to_tensor(xs)).numpy()
+            np.testing.assert_allclose(ld, np.log(np.abs(num)), atol=1e-3)
+
+    def test_stick_breaking_simplex(self):
+        x = np.random.RandomState(1).randn(5, 3).astype("float32")
+        t = D.StickBreakingTransform()
+        y = t.forward(paddle.to_tensor(x)).numpy()
+        assert y.shape == (5, 4)
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        assert (y > 0).all()
+        back = t.inverse(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_transformed_distribution_lognormal(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        x = np.float32(1.7)
+        np.testing.assert_allclose(
+            float(td.log_prob(paddle.to_tensor(x))),
+            st.lognorm(1.0).logpdf(x), rtol=1e-5)
+        paddle.seed(5)
+        s = td.sample([20000]).numpy()
+        np.testing.assert_allclose(np.log(s).mean(), 0.0, atol=0.03)
+
+    def test_chain_transform(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.array([0.1, 0.5], "float32")
+        y = chain.forward(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y, np.exp(2 * x), rtol=1e-5)
+        ld = chain.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(ld, math.log(2.0) + 2 * x, rtol=1e-5)
+
+
+class TestOptimizersTail:
+    @pytest.mark.parametrize("cls,kw", [
+        ("RAdam", dict(learning_rate=0.05)),
+        ("NAdam", dict(learning_rate=0.05)),
+        ("ASGD", dict(learning_rate=0.02, batch_num=2)),
+        ("Rprop", dict(learning_rate=0.01)),
+    ])
+    def test_converges_on_quadratic(self, cls, kw):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        opt = getattr(paddle.optimizer, cls)(
+            parameters=lin.parameters(), **kw)
+        first = None
+        for _ in range(25):
+            loss = ((lin(x) - y) ** 2).mean()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.8, cls
+
+    def test_lbfgs_closure(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=lin.parameters())
+
+        def closure():
+            opt.clear_grad()
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        l0 = float(((lin(x) - y) ** 2).mean())
+        loss = opt.step(closure)
+        assert float(loss) < l0 * 0.3
+        with pytest.raises(ValueError):
+            opt.step()
+
+    def test_linear_lr(self):
+        from paddle_tpu.optimizer.lr import LinearLR
+
+        sched = LinearLR(0.1, total_steps=4, start_factor=0.5,
+                         end_factor=1.0)
+        vals = [sched()]
+        for _ in range(5):
+            sched.step()
+            vals.append(sched())
+        np.testing.assert_allclose(vals[0], 0.05, rtol=1e-6)
+        np.testing.assert_allclose(vals[4], 0.1, rtol=1e-6)
+        np.testing.assert_allclose(vals[5], 0.1, rtol=1e-6)  # clamped
+
+
+class TestCallbacksAndIO:
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeModel:
+            pass
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        m = FakeModel()
+        m._optimizer = paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=paddle.nn.Linear(2, 2).parameters())
+        cb.model = m
+        for epoch, loss in enumerate([1.0, 1.0, 1.0, 1.0]):
+            cb.on_epoch_end(epoch, {"loss": loss})
+        np.testing.assert_allclose(m._optimizer.get_lr(), 0.05, rtol=1e-6)
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+
+        cb = VisualDL(log_dir=str(tmp_path))
+        cb.on_train_batch_end(0, {"loss": 1.5})
+        cb.on_train_batch_end(1, {"loss": 1.2})
+        cb.on_epoch_end(0, {"loss": 1.2, "acc": [0.7]})
+        content = (tmp_path / "train_loss.tsv").read_text()
+        assert "1.5" in content and "1.2" in content
+        assert (tmp_path / "train_epoch_acc.tsv").exists()
+
+    def test_get_worker_info_main_process(self):
+        assert paddle.io.get_worker_info() is None
+
+    def test_worker_info_fields(self):
+        info = paddle.io.WorkerInfo(1, 4, None)
+        assert info.id == 1 and info.num_workers == 4
+
+
+class TestReviewRegressionsR3b:
+    def test_continuous_bernoulli_high_lambda_no_nan(self):
+        d = D.ContinuousBernoulli(probs=0.7)
+        lp = float(d.log_prob(paddle.to_tensor(np.float32(0.3))))
+        assert np.isfinite(lp)
+        # pdf still integrates to 1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype("float32")
+        pdf = np.exp(d.log_prob(paddle.to_tensor(xs)).numpy())
+        np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, atol=1e-3)
+
+    def test_reduce_lr_cooldown_suppresses(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeModel:
+            pass
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               cooldown=3, verbose=0)
+        m = FakeModel()
+        m._optimizer = paddle.optimizer.SGD(
+            learning_rate=0.8,
+            parameters=paddle.nn.Linear(2, 2).parameters())
+        cb.model = m
+        for epoch in range(6):
+            cb.on_epoch_end(epoch, {"loss": 1.0})
+        # epoch0 sets best; epoch1 reduces (0.4); epochs 2-4 cooldown;
+        # epoch5 accrues wait=1 -> reduces (0.2). NOT 6 reductions.
+        np.testing.assert_allclose(m._optimizer.get_lr(), 0.2, rtol=1e-6)
+
+    def test_reduce_lr_auto_mode_max_for_acc(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeModel:
+            pass
+
+        cb = ReduceLROnPlateau(monitor="acc", patience=2, verbose=0)
+        m = FakeModel()
+        m._optimizer = paddle.optimizer.SGD(
+            learning_rate=0.8,
+            parameters=paddle.nn.Linear(2, 2).parameters())
+        cb.model = m
+        for epoch, acc in enumerate([0.1, 0.3, 0.5, 0.7, 0.9]):
+            cb.on_epoch_end(epoch, {"acc": acc})
+        # steadily improving accuracy must NOT reduce the lr
+        np.testing.assert_allclose(m._optimizer.get_lr(), 0.8, rtol=1e-6)
+
+    def test_color_jitter_accepts_ranges(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = np.random.RandomState(0).rand(3, 8, 8).astype("float32")
+        out = T.ColorJitter(brightness=(0.5, 1.5), contrast=(0.9, 1.1),
+                            saturation=(0.8, 1.2), hue=(-0.1, 0.1))(img)
+        assert out.shape == (3, 8, 8)
+        out2 = T.BrightnessTransform([0.8, 1.2])(img)
+        assert np.isfinite(out2).all()
