@@ -146,3 +146,18 @@ from .transformer import (  # noqa: F401
     TransformerEncoder,
     TransformerEncoderLayer,
 )
+from .common_layers import (  # noqa: F401
+    CircularPad2D,
+    CircularPad3D,
+    ConstantPad1D,
+    ConstantPad2D,
+    ConstantPad3D,
+    Unflatten,
+)
+from .activation_layers import RReLU, Softmax2D  # noqa: F401
+from .rnn_layers import RNNCellBase  # noqa: F401
+from .loss_layers import (  # noqa: F401
+    GaussianNLLLoss,
+    MultiMarginLoss,
+)
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

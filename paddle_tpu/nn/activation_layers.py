@@ -101,3 +101,35 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """paddle.nn.Softmax2D parity: softmax over the channel dim (each
+    spatial position's channel vector sums to 1). Accepts 4-D NCHW or
+    3-D CHW like the reference."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from ..ops import activation as A
+
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3-D (CHW) or 4-D (NCHW) tensor, "
+                f"got ndim={x.ndim}")
+        return A.softmax(x, axis=x.ndim - 3)
+
+
+class RReLU(Layer):
+    """paddle.nn.RReLU parity over functional rrelu (random slope in
+    training, mean slope in eval)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        from ..ops.activation import rrelu
+
+        return rrelu(x, self.lower, self.upper, training=self.training)

@@ -281,3 +281,45 @@ class Fold(Layer):
         from .functional.extras import fold
 
         return fold(x, *self._args)
+
+
+class ConstantPad1D(Pad1D):
+    """paddle.nn.ConstantPad1D parity."""
+
+    def __init__(self, padding, value=0.0, data_format="NCL", name=None):
+        super().__init__(padding, "constant", value, data_format)
+
+
+class ConstantPad2D(Pad2D):
+    def __init__(self, padding, value=0.0, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", value, data_format)
+
+
+class ConstantPad3D(Pad3D):
+    def __init__(self, padding, value=0.0, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", value, data_format)
+
+
+class CircularPad2D(Pad2D):
+    """paddle.nn.CircularPad2D parity (wrap-around padding)."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "circular", 0.0, data_format)
+
+
+class CircularPad3D(Pad3D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "circular", 0.0, data_format)
+
+
+class Unflatten(Layer):
+    """paddle.nn.Unflatten parity over ops.manipulation.unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_arg = axis, shape
+
+    def forward(self, x):
+        from ..ops.manipulation import unflatten
+
+        return unflatten(x, self.axis, self.shape_arg)

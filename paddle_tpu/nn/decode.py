@@ -1,0 +1,143 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode
+(reference: python/paddle/nn/decode.py — SURVEY.md §2.2 "nn layers").
+
+TPU-native notes: the decode loop is host-driven with a bounded
+`max_step_num` (each step's cell/projection is jittable); beam
+reordering is a gather on the beam axis. The backtrace reuses
+`nn.functional.gather_tree` (a compiled scan)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, as_array
+from .layer_base import Layer
+
+
+class BeamSearchDecoder:
+    """paddle.nn.BeamSearchDecoder parity: wraps an RNN cell for beam
+    search over its outputs.
+
+    decoder = BeamSearchDecoder(cell, start_token, end_token, beam_size,
+                                embedding_fn, output_fn)
+    outputs, states = paddle.nn.dynamic_decode(decoder, inits, max_step_num)
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -----------------------------------------------------------
+    def _tile(self, x):
+        """[batch, ...] -> [batch*beam, ...] (repeat per beam)."""
+        a = as_array(x)
+        k = self.beam_size
+        return jnp.repeat(a, k, axis=0)
+
+    def tile_beam_merge_with_batch(self, x):
+        return Tensor(self._tile(x))
+
+    def initialize(self, inits):
+        """Returns (initial token ids [batch*beam], tiled states,
+        log_probs [batch, beam], finished [batch, beam])."""
+        import jax
+
+        tiled = jax.tree_util.tree_map(
+            lambda t: Tensor(self._tile(t)) if isinstance(t, Tensor)
+            else self._tile(t), inits,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaf = jax.tree_util.tree_leaves(tiled)[0]
+        bk = as_array(leaf).shape[0]
+        batch = bk // self.beam_size
+        tokens = jnp.full((bk,), self.start_token, jnp.int64)
+        # beam 0 starts live, others at -inf so step 1 fans out from beam 0
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None, :], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return tokens, tiled, log_probs, finished
+
+    def step(self, tokens, states, log_probs, finished):
+        """One beam step. Returns (chosen token ids [batch, beam],
+        parent beam indices [batch, beam], new states, log_probs,
+        finished)."""
+        import jax
+
+        k = self.beam_size
+        inputs = Tensor(tokens)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        cell_out, new_states = self.cell(inputs, states)
+        logits = cell_out
+        if self.output_fn is not None:
+            logits = self.output_fn(logits)
+        logp = jax.nn.log_softmax(
+            as_array(logits).astype(jnp.float32), axis=-1)  # [b*k, V]
+        bk, vocab = logp.shape
+        batch = bk // k
+        logp = logp.reshape(batch, k, vocab)
+        # finished beams may only emit end_token at zero cost
+        fin_row = jnp.full((vocab,), -1e9, jnp.float32).at[
+            self.end_token].set(0.0)
+        logp = jnp.where(finished[:, :, None], fin_row[None, None, :], logp)
+        total = log_probs[:, :, None] + logp  # [b, k, V]
+        flat = total.reshape(batch, k * vocab)
+        top_val, top_idx = jax.lax.top_k(flat, k)
+        parent = top_idx // vocab  # [b, k]
+        token = (top_idx % vocab).astype(jnp.int64)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | (
+            token == self.end_token)
+        # reorder states by parent beam
+        gidx = (jnp.arange(batch)[:, None] * k + parent).reshape(-1)
+
+        def reorder(t):
+            a = as_array(t)
+            return Tensor(a[gidx]) if isinstance(t, Tensor) else a[gidx]
+
+        new_states = jax.tree_util.tree_map(
+            reorder, new_states, is_leaf=lambda t: isinstance(t, Tensor))
+        return token, parent, new_states, top_val, new_finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """paddle.nn.dynamic_decode parity for BeamSearchDecoder: run the
+    decoder until every beam finishes or `max_step_num`, then backtrace
+    with gather_tree. Returns (predicted_ids [batch, time, beam],
+    final_states), plus sequence lengths when return_length=True."""
+    from .functional.extras import gather_tree
+
+    if max_step_num is None:
+        max_step_num = 100
+    tokens, states, log_probs, finished = decoder.initialize(inits)
+    ids_steps, parent_steps = [], []
+    for _ in range(int(max_step_num)):
+        token, parent, states, log_probs, finished = decoder.step(
+            tokens, states, log_probs, finished)
+        ids_steps.append(token)
+        parent_steps.append(parent)
+        tokens = token.reshape(-1)
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(ids_steps)        # [T, batch, beam]
+    parents = jnp.stack(parent_steps)
+    seqs = gather_tree(Tensor(ids), Tensor(parents))  # [T, batch, beam]
+    out = as_array(seqs)
+    if not output_time_major:
+        out = jnp.transpose(out, (1, 0, 2))  # [batch, T, beam]
+    result = Tensor(out)
+    if return_length:
+        # length = steps until (and including) the first end_token
+        arr = as_array(seqs)  # [T, b, k]
+        is_end = arr == decoder.end_token
+        t = arr.shape[0]
+        first_end = jnp.where(is_end.any(0),
+                              jnp.argmax(is_end, axis=0) + 1, t)
+        return result, states, Tensor(first_end.astype(jnp.int64))
+    return result, states

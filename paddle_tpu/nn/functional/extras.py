@@ -173,7 +173,8 @@ def gather_tree(ids, parents, name=None):
             tok = jnp.take_along_axis(ids_[i], prev, axis=1)
             return prev, tok
 
-        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k)).astype(
+            par_.dtype)
         last_tok = ids_[t - 1]
         _, toks = jax.lax.scan(step, init, jnp.arange(t - 2, -1, -1))
         # toks: [t-1, b, k] in reverse order (times t-2 .. 0)
@@ -206,3 +207,95 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
         return out
 
     return _apply_op(f, x, _name="temporal_shift")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """paddle.nn.functional.class_center_sample parity (PartialFC
+    sampling): keep every positive class in the batch, fill to
+    `num_samples` with random negative centers, and remap labels into the
+    sampled index space (-1 padding semantics follow the reference:
+    positives always survive, so every label remaps).
+
+    Single-controller stance: under a mesh the sampled set is identical on
+    every rank (seeded from the shared key stream), which is the
+    reference's allgathered-positives behavior for the data-parallel case.
+
+    EAGER-ONLY: the sampled set's size depends on the label VALUES
+    (np.unique), which no traced program can express — call it on concrete
+    labels outside jit (the reference's sampler is likewise a host-side
+    step before the heavy compute).
+    """
+    import numpy as np
+
+    from ...framework import random as _random
+
+    if isinstance(as_array(label), jax.core.Tracer):
+        raise RuntimeError(
+            "class_center_sample is eager-only (the sampled-class count "
+            "depends on label values); call it outside jit/to_static and "
+            "feed the remapped labels in")
+    lab = np.asarray(as_array(label)).reshape(-1).astype(np.int64)
+    pos = np.unique(lab)
+    num_samples = int(num_samples)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos)
+        key = _random.next_key()
+        perm = np.asarray(jax.random.permutation(key, len(neg_pool)))
+        extra = neg_pool[perm[:num_samples - len(pos)]]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lab]
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """paddle.nn.functional.sparse_attention parity: attention restricted
+    to a per-(batch, head) CSR pattern.
+
+    q/k/v: [B, H, S, D]; sparse_csr_offset: [B, H, S+1];
+    sparse_csr_columns: [B, H, nnz]. TPU design: the CSR pattern becomes a
+    dense bool mask and the whole thing is ONE masked MXU matmul+softmax
+    (identical numerics to the reference's blocksparse kernel at the
+    stored positions; see sparse/nn.py for the design rationale).
+    """
+    import math
+
+    b, h, s, d = as_array(query).shape
+
+    def f(q_, k_, v_, off, cols):
+        # CSR -> dense bool mask, fully traced (jit-safe): entry j of the
+        # nnz axis belongs to row searchsorted(offset, j, 'right') - 1
+        nnz = cols.shape[-1]
+        j = jnp.arange(nnz)
+        row_of = jax.vmap(jax.vmap(
+            lambda o: jnp.searchsorted(o, j, side="right") - 1))(
+            off.astype(jnp.int32))  # [b, h, nnz]
+        # entries beyond a (b, h) pattern's true nnz (padding) map to the
+        # last row bucket; mark them invalid by j >= off[..., -1]
+        valid = j[None, None, :] < off[..., -1:].astype(jnp.int32)
+        m = jnp.zeros((b, h, s, s), bool)
+        bi = jnp.arange(b)[:, None, None]
+        hi = jnp.arange(h)[None, :, None]
+        m = m.at[bi, hi, jnp.clip(row_of, 0, s - 1),
+                 jnp.clip(cols.astype(jnp.int32), 0, s - 1)].max(valid)
+        scale = 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q_, k_) * scale
+        if key_padding_mask is not None:
+            kp = as_array(key_padding_mask).astype(bool)
+            m = m & kp[:, None, None, :]
+        logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+        if attn_mask is not None:
+            logits = logits + as_array(attn_mask)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(m, p, 0)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v_)
+
+    return _apply_op(f, query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, _name="sparse_attention")

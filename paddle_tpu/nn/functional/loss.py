@@ -446,3 +446,44 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
     args = [bias] if bias is not None else []
     return _apply_op(f, input, label, weight, *args, _name="hsigmoid_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """paddle.nn.functional.gaussian_nll_loss parity:
+    0.5 * (log(max(var, eps)) + (input - label)^2 / max(var, eps))
+    (+ 0.5*log(2*pi) when full=True), reduced per `reduction`."""
+    import math
+
+    def f(x, y, var):
+        var = jnp.clip(var, epsilon, None)
+        out = 0.5 * (jnp.log(var) + jnp.square(x - y) / var)
+        if full:
+            out = out + 0.5 * math.log(2 * math.pi)
+        return _reduce(out, reduction)
+
+    return _apply_op(f, input, label, variance, _name="gaussian_nll_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """paddle.nn.functional.multi_margin_loss parity:
+    mean_j(max(0, margin - x[y] + x[j])^p) over j != y, per sample."""
+    p = int(p)
+
+    def f(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)  # [N, 1]
+        hinge = jnp.maximum(0.0, margin - correct + x)
+        if p != 1:
+            hinge = hinge ** p
+        if w:
+            hinge = hinge * w[0][y][:, None]
+        # zero out the true-class column, average over C (paddle/torch)
+        mask = jnp.ones((n, c), x.dtype).at[
+            jnp.arange(n), y].set(0.0)
+        out = jnp.sum(hinge * mask, axis=1) / c
+        return _reduce(out, reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return _apply_op(f, *args, _name="multi_margin_loss")

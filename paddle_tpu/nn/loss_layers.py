@@ -238,3 +238,29 @@ class HSigmoidLoss(Layer):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                bias=self.bias, path_table=path_table,
                                path_code=path_code, is_sparse=self.is_sparse)
+
+
+class GaussianNLLLoss(Layer):
+    """paddle.nn.GaussianNLLLoss parity."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    """paddle.nn.MultiMarginLoss parity."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)

@@ -188,7 +188,49 @@ class GRU(_RNNBase):
                          time_major, dropout, **kw)
 
 
-class _CellBase(Layer):
+class RNNCellBase(Layer):
+    """paddle.nn.RNNCellBase parity: base class for custom cells. Provides
+    `get_initial_states` (the documented custom-cell hook); subclasses
+    define `forward(inputs, states)` and optionally `state_shape`."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import jax
+
+        ref = as_array(batch_ref)
+        batch = int(ref.shape[batch_dim_idx])
+        if shape is None:
+            shape = getattr(self, "state_shape", None)
+            if shape is None:
+                shape = [self.hidden_size]
+        if dtype is None:
+            dtype = "float32"
+        from ..framework import dtype as _fdtype
+
+        nd = _fdtype.to_np_dtype(dtype)
+
+        def make(s):
+            dims = [batch] + [int(d) for d in
+                              (s if isinstance(s, (list, tuple)) else [s])]
+            return Tensor(jnp.full(dims, init_value, nd))
+
+        # shape may be a flat [..dims..] or a nested structure of them
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return jax.tree_util.tree_map(
+                make, tuple(shape),
+                is_leaf=lambda s: isinstance(s, (list, tuple))
+                and (not s or not isinstance(s[0], (list, tuple))))
+        return make(shape)
+
+
+class _CellBase(RNNCellBase):
+    @property
+    def state_shape(self):
+        if self.mode == "LSTM":
+            return ([self.hidden_size], [self.hidden_size])
+        return [self.hidden_size]
+
     def __init__(self, mode, input_size, hidden_size, **kw):
         super().__init__()
         self.mode = mode

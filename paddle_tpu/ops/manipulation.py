@@ -211,6 +211,27 @@ def apply(x, func, name=None):
     return func(x)
 
 
+def shape(x, name=None):
+    """paddle.shape parity: the runtime shape as an int32 tensor (in the
+    reference this is the dynamic-shape op usable inside static graphs)."""
+    return _apply_op(
+        lambda a: jnp.asarray(a.shape, dtype=jnp.int32), x, _name="shape"
+    )
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """paddle.combinations parity: r-length combinations of a 1-D tensor."""
+    import itertools
+
+    n = as_array(x).shape[0]
+    gen = itertools.combinations_with_replacement(range(n), int(r)) \
+        if with_replacement else itertools.combinations(range(n), int(r))
+    idx = np.asarray(list(gen), dtype=np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, int(r))
+    return _apply_op(lambda a: a[jnp.asarray(idx)], x, _name="combinations")
+
+
 def split(x, num_or_sections, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
@@ -575,6 +596,10 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     pad_list = _int_list(pad)
+    if isinstance(pad_list, int):
+        # paddle semantics: a scalar pads every SPATIAL dim on both sides
+        n_spatial = max(len(data_format) - 2, 1)
+        pad_list = [pad_list] * (2 * n_spatial)
 
     def f(a):
         nd = a.ndim

@@ -1,0 +1,257 @@
+"""Round-3 nn surface closeout (reference: python/paddle/nn):
+pads, Unflatten, Softmax2D, RReLU, GaussianNLLLoss, MultiMarginLoss,
+BeamSearchDecoder/dynamic_decode, class_center_sample, sparse_attention,
+combinations/shape ops."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestNewLayers:
+    def test_constant_pads(self):
+        x = paddle.ones([1, 2, 3])
+        assert paddle.nn.ConstantPad1D(2, value=5.0)(x).shape == [1, 2, 7]
+        x2 = paddle.ones([1, 2, 3, 3])
+        out = paddle.nn.ConstantPad2D(1, value=9.0)(x2)
+        assert out.shape == [1, 2, 5, 5]
+        assert out.numpy()[0, 0, 0, 0] == 9.0
+        x3 = paddle.ones([1, 2, 3, 3, 3])
+        assert paddle.nn.ConstantPad3D(1)(x3).shape == [1, 2, 5, 5, 5]
+
+    def test_circular_pad(self):
+        x = paddle.to_tensor(
+            np.arange(9, dtype="float32").reshape(1, 1, 3, 3))
+        out = paddle.nn.CircularPad2D(1)(x).numpy()[0, 0]
+        # wrap-around: corner picks the opposite corner
+        assert out[0, 0] == 8.0
+        assert out.shape == (5, 5)
+
+    def test_unflatten_softmax2d_rrelu(self):
+        assert paddle.nn.Unflatten(1, [3, 4])(
+            paddle.zeros([2, 12])).shape == [2, 3, 4]
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32"))
+        sm = paddle.nn.Softmax2D()(x).numpy()
+        np.testing.assert_allclose(sm.sum(1), 1.0, rtol=1e-5)
+        net = paddle.nn.RReLU()
+        net.eval()
+        y = net(paddle.to_tensor(np.array([-2.0, 3.0], "float32")))
+        # eval mode: slope = mean(lower, upper)
+        mean_slope = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(y.numpy(), [-2.0 * mean_slope, 3.0],
+                                   rtol=1e-5)
+
+    def test_rnn_cell_base_exported(self):
+        assert issubclass(paddle.nn.GRUCell, paddle.nn.RNNCellBase)
+
+
+class TestNewLosses:
+    def test_gaussian_nll(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 3).astype("float32")
+        y = rng.randn(5, 3).astype("float32")
+        v = np.full((5, 3), 2.0, "float32")
+        out = float(F.gaussian_nll_loss(paddle.to_tensor(x),
+                                        paddle.to_tensor(y),
+                                        paddle.to_tensor(v)))
+        ref = (0.5 * (np.log(v) + (x - y) ** 2 / v)).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        full = float(F.gaussian_nll_loss(paddle.to_tensor(x),
+                                         paddle.to_tensor(y),
+                                         paddle.to_tensor(v), full=True))
+        np.testing.assert_allclose(full, ref + 0.5 * math.log(2 * math.pi),
+                                   rtol=1e-5)
+
+    def test_multi_margin(self):
+        x = np.array([[0.1, 0.2, 0.7], [0.9, 0.05, 0.05]], "float32")
+        y = np.array([2, 0])
+        out = float(F.multi_margin_loss(paddle.to_tensor(x),
+                                        paddle.to_tensor(y)))
+        # per-sample: mean_j!=y max(0, 1 - x[y] + x[j]) / C
+        ref = []
+        for i, yi in enumerate(y):
+            s = sum(max(0.0, 1 - x[i, yi] + x[i, j])
+                    for j in range(3) if j != yi)
+            ref.append(s / 3)
+        np.testing.assert_allclose(out, np.mean(ref), rtol=1e-5)
+        layer = paddle.nn.MultiMarginLoss()
+        np.testing.assert_allclose(
+            float(layer(paddle.to_tensor(x), paddle.to_tensor(y))), out,
+            rtol=1e-6)
+
+
+class TestBeamSearch:
+    def test_beam_decode_shapes_and_greedy_top_beam(self):
+        paddle.seed(0)
+        batch, hidden, vocab, beam = 2, 16, 10, 3
+        cell = paddle.nn.GRUCell(hidden, hidden)
+        emb = paddle.nn.Embedding(vocab, hidden)
+        proj = paddle.nn.Linear(hidden, vocab)
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                          beam_size=beam, embedding_fn=emb,
+                                          output_fn=proj)
+        h0 = paddle.to_tensor(np.random.RandomState(0)
+                              .randn(batch, hidden).astype("float32"))
+        out, states, lens = paddle.nn.dynamic_decode(
+            dec, inits=h0, max_step_num=6, return_length=True)
+        assert out.shape[0] == batch and out.shape[2] == beam
+        assert out.shape[1] <= 6
+        ids = out.numpy()
+        assert (ids >= 0).all() and (ids < vocab).all()
+        assert (lens.numpy() <= out.shape[1]).all()
+
+    def test_beam_one_equals_greedy(self):
+        """beam_size=1 must follow the argmax chain of the cell."""
+        paddle.seed(1)
+        hidden, vocab = 8, 6
+        cell = paddle.nn.GRUCell(hidden, hidden)
+        emb = paddle.nn.Embedding(vocab, hidden)
+        proj = paddle.nn.Linear(hidden, vocab)
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=5,
+                                          beam_size=1, embedding_fn=emb,
+                                          output_fn=proj)
+        h0 = paddle.to_tensor(np.random.RandomState(1)
+                              .randn(1, hidden).astype("float32"))
+        out, _ = paddle.nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+        # manual greedy
+        tok = paddle.to_tensor(np.array([0]))
+        h = h0
+        want = []
+        for _ in range(out.shape[1]):
+            o, h = cell(emb(tok), h)
+            nxt = int(np.argmax(proj(o).numpy()))
+            want.append(nxt)
+            tok = paddle.to_tensor(np.array([nxt]))
+            if nxt == 5:
+                break
+        got = out.numpy()[0, :len(want), 0].tolist()
+        assert got == want
+
+
+class TestMiscOps:
+    def test_combinations(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        np.testing.assert_allclose(
+            paddle.combinations(x).numpy(),
+            [[1, 2], [1, 3], [2, 3]])
+        assert paddle.combinations(x, 2, True).shape == [6, 2]
+
+    def test_shape_op(self):
+        s = paddle.shape(paddle.zeros([2, 7]))
+        assert s.numpy().tolist() == [2, 7]
+
+    def test_class_center_sample(self):
+        paddle.seed(3)
+        lab = paddle.to_tensor(np.array([3, 7, 3, 1]))
+        rl, sampled = F.class_center_sample(lab, 20, 6)
+        s, r = sampled.numpy(), rl.numpy()
+        assert len(s) == 6
+        assert {1, 3, 7}.issubset(set(s.tolist()))
+        assert (s[r] == lab.numpy()).all()
+
+    def test_sparse_attention_matches_causal(self):
+        b, h, sq, d = 1, 1, 4, 8
+        rng = np.random.RandomState(0)
+        q, k, v = (rng.randn(b, h, sq, d).astype("float32")
+                   for _ in range(3))
+        offset = np.array([[[0, 1, 3, 6, 10]]], np.int32)
+        cols = np.array([[[0, 0, 1, 0, 1, 2, 0, 1, 2, 3]]], np.int32)
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v),
+                                 paddle.to_tensor(offset),
+                                 paddle.to_tensor(cols))
+        logits = np.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(d)
+        mask = np.tril(np.ones((sq, sq), bool))
+        logits = np.where(mask, logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_flops_counts_all_output_heads(self):
+        class TwoHead(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = paddle.nn.Linear(64, 64)
+                self.b = paddle.nn.Linear(64, 2048)
+
+            def forward(self, x):
+                return self.a(x), self.b(x)
+
+        class OneHead(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = paddle.nn.Linear(64, 64)
+
+            def forward(self, x):
+                return self.a(x)
+
+        two = paddle.flops(TwoHead(), [1, 64])
+        one = paddle.flops(OneHead(), [1, 64])
+        assert two > one + 2 * 64 * 2048 - 1  # the big head is counted
+
+    def test_softmax2d_3d_input(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4, 5).astype("float32"))
+        out = paddle.nn.Softmax2D()(x).numpy()
+        np.testing.assert_allclose(out.sum(0), 1.0, rtol=1e-5)
+        with pytest.raises(ValueError):
+            paddle.nn.Softmax2D()(paddle.zeros([2, 2]))
+
+    def test_pads_are_pad2d_subclasses(self):
+        assert isinstance(paddle.nn.ConstantPad2D(1), paddle.nn.Pad2D)
+        assert isinstance(paddle.nn.CircularPad3D(1), paddle.nn.Pad3D)
+
+    def test_rnn_cell_base_custom_cell(self):
+        """The documented custom-cell pattern: subclass + no-arg super()
+        + get_initial_states."""
+        class MyCell(paddle.nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 7
+                self.lin = paddle.nn.Linear(7, 7)
+
+            def forward(self, x, states):
+                h = self.lin(x) + states
+                return h, h
+
+        cell = MyCell()
+        x = paddle.to_tensor(np.ones((4, 7), "float32"))
+        h0 = cell.get_initial_states(x)
+        assert h0.shape == [4, 7]
+        assert float(h0.sum()) == 0.0
+        out, h1 = cell(x, h0)
+        assert out.shape == [4, 7]
+        # LSTM-style tuple state shapes
+        lstm = paddle.nn.LSTMCell(5, 6)
+        hc = lstm.get_initial_states(x)
+        assert hc[0].shape == [4, 6] and hc[1].shape == [4, 6]
+
+    def test_sparse_attention_traces_under_jit(self):
+        import jax
+
+        b, h, sq, d = 1, 1, 4, 8
+        rng = np.random.RandomState(0)
+        q, k, v = (rng.randn(b, h, sq, d).astype("float32")
+                   for _ in range(3))
+        offset = np.array([[[0, 1, 3, 6, 10]]], np.int32)
+        cols = np.array([[[0, 0, 1, 0, 1, 2, 0, 1, 2, 3]]], np.int32)
+
+        def run(q_, k_, v_, o_, c_):
+            return F.sparse_attention(
+                paddle.to_tensor(q_), paddle.to_tensor(k_),
+                paddle.to_tensor(v_), paddle.to_tensor(o_),
+                paddle.to_tensor(c_))._data
+
+        jitted = jax.jit(run)
+        got = np.asarray(jitted(q, k, v, offset, cols))
+        eager = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(cols)).numpy()
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
