@@ -299,3 +299,46 @@ def device_count():
 
 def version():
     return __version__
+
+
+def finfo(dtype):
+    """paddle.finfo parity: float type limits (min/max/eps/bits/dtype)."""
+    import numpy as _np
+
+    nd = _dtype_mod.to_np_dtype(dtype)
+    try:
+        info = _np.finfo(nd)
+    except ValueError:  # bfloat16 etc. — numpy defers to ml_dtypes
+        import ml_dtypes
+
+        info = ml_dtypes.finfo(nd)
+
+    class _FInfo:
+        min = float(info.min)
+        max = float(info.max)
+        eps = float(info.eps)
+        tiny = float(getattr(info, "tiny", getattr(info, "smallest_normal",
+                                                   0.0)))
+        smallest_normal = float(getattr(info, "smallest_normal",
+                                        getattr(info, "tiny", 0.0)))
+        resolution = float(getattr(info, "resolution", 0.0))
+        bits = int(info.bits)
+
+    _FInfo.dtype = str(_dtype_mod.from_np_dtype(nd).name)
+    return _FInfo()
+
+
+def iinfo(dtype):
+    """paddle.iinfo parity: integer type limits."""
+    import numpy as _np
+
+    info = _np.iinfo(_dtype_mod.to_np_dtype(dtype))
+
+    class _IInfo:
+        min = int(info.min)
+        max = int(info.max)
+        bits = int(info.bits)
+
+    _IInfo.dtype = str(_dtype_mod.from_np_dtype(
+        _dtype_mod.to_np_dtype(dtype)).name)
+    return _IInfo()

@@ -16,3 +16,31 @@ def __getattr__(name):
 
         return getattr(py_layer, name)
     raise AttributeError(name)
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks parity: context manager whose
+    pack hook runs when a tape op RETAINS operand arrays (TapeNode
+    in_arrays — the double-grad/re-record residuals) and whose unpack
+    hook runs when backward reads them. The vjp closures' internal
+    residuals are compiler-managed (XLA decides activation residency;
+    jax.checkpoint is the remat control) and are not observable here —
+    that part of the reference contract is subsumed, not hooked."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import tape as _tape
+
+        self._prev = _tape._saved_tensor_hooks
+        _tape._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from . import tape as _tape
+
+        _tape._saved_tensor_hooks = self._prev
+        return False

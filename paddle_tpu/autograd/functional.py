@@ -1,0 +1,118 @@
+"""Functional higher-order autograd
+(reference: python/paddle/autograd + paddle.incubate.autograd —
+jacobian/hessian/jvp/vjp): thin paddle-signature shells over jax's
+transforms, which ARE the TPU-native implementation (one traced program,
+no per-element backward loops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _wrap_fn(func):
+    """User func takes/returns Tensors; jax sees arrays."""
+    from ..tensor import Tensor, as_array
+
+    def f(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(as_array(o) for o in out)
+        return as_array(out)
+
+    return f
+
+
+def _unpack(xs):
+    from ..tensor import as_array
+
+    single = not isinstance(xs, (list, tuple))
+    arrs = [as_array(x) for x in ([xs] if single else xs)]
+    return single, arrs
+
+
+def vjp(func, xs, v=None):
+    """paddle.incubate.autograd.vjp parity: (outputs, vjp_result) of
+    `func` at `xs` against cotangent `v` (defaults to ones)."""
+    from ..tensor import Tensor, as_array
+
+    single, arrs = _unpack(xs)
+    out, pullback = jax.vjp(_wrap_fn(func), *arrs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        cot = tuple(as_array(c) for c in vs)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = pullback(cot)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        [Tensor(o) for o in out]
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    """paddle.incubate.autograd.jvp parity: (outputs, jvp_result) of
+    `func` at `xs` along tangent `v` (defaults to ones)."""
+    from ..tensor import Tensor, as_array
+
+    single, arrs = _unpack(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(as_array(t) for t in vs)
+    out, tangent_out = jax.jvp(_wrap_fn(func), tuple(arrs), tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        [Tensor(o) for o in out]
+    touts = Tensor(tangent_out) if not isinstance(tangent_out, tuple) else \
+        [Tensor(t) for t in tangent_out]
+    return outs, touts
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """paddle jacobian parity (functional form): d func(xs) / d xs.
+
+    Single input and output -> one Tensor [*out_shape, *in_shape];
+    multiple inputs -> a list of such Tensors."""
+    from ..tensor import Tensor
+
+    single, arrs = _unpack(xs)
+    wrapped = _wrap_fn(func)
+    # jacrev returns: per OUTPUT leaf (tuple if func returns a tuple), a
+    # tuple over argnums. Probe the output structure without extra flops.
+    out_shape = jax.eval_shape(wrapped, *arrs)
+    multi_out = isinstance(out_shape, tuple)
+    jac = jax.jacrev(wrapped, argnums=tuple(range(len(arrs))))(*arrs)
+    if multi_out:
+        rows = [[Tensor(j) for j in per_out] for per_out in jac]
+        if single:
+            return [r[0] for r in rows]
+        return rows
+    if single:
+        return Tensor(jac[0])
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """paddle hessian parity (functional form, scalar-output func):
+    d^2 func / d xs^2 via forward-over-reverse (the jax idiom — one
+    compiled program)."""
+    from ..tensor import Tensor
+
+    single, arrs = _unpack(xs)
+    wrapped = _wrap_fn(func)
+
+    def scalar(*a):
+        out = wrapped(*a)
+        if isinstance(out, tuple):
+            out = out[0]
+        if jnp.ndim(out) != 0:
+            raise ValueError("hessian() requires a scalar-output func")
+        return out
+
+    hess = jax.hessian(scalar, argnums=tuple(range(len(arrs))))(*arrs)
+    if single:
+        return Tensor(hess[0][0])
+    return [[Tensor(hess[i][j]) for j in range(len(arrs))]
+            for i in range(len(arrs))]

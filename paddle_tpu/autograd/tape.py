@@ -98,6 +98,10 @@ class InputRef:
         self.stop_gradient = tensor.stop_gradient
 
 
+# paddle.autograd.saved_tensors_hooks registry: (pack, unpack) or None
+_saved_tensor_hooks = None
+
+
 class TapeNode:
     """One recorded differentiable op (≡ a GradNode in the reference)."""
 
@@ -109,7 +113,8 @@ class TapeNode:
         "n_outputs",
         "name",
         "primal_fn",
-        "in_arrays",
+        "_in_arrays_raw",
+        "_packed_hooks",
         "__weakref__",
     )
 
@@ -128,7 +133,29 @@ class TapeNode:
         # (original inputs, cotangents) so second-order grads flow through
         # the residuals (reference: double-grad nodes of the eager engine).
         self.primal_fn = primal_fn
-        self.in_arrays = in_arrays
+        # saved_tensors_hooks: pack the explicitly-retained operand arrays
+        # at save time; unpacked lazily via the property below. (The vjp
+        # closure's own residuals are compiler-managed and not hookable.)
+        hooks = _saved_tensor_hooks
+        if hooks is not None and in_arrays is not None:
+            in_arrays = tuple(hooks[0](a) for a in in_arrays)
+            self._packed_hooks = hooks
+        else:
+            self._packed_hooks = None
+        self._in_arrays_raw = in_arrays
+
+    @property
+    def in_arrays(self):
+        raw = self._in_arrays_raw
+        if raw is None or self._packed_hooks is None:
+            return raw
+        return tuple(self._packed_hooks[1](a) for a in raw)
+
+    @in_arrays.setter
+    def in_arrays(self, value):
+        self._in_arrays_raw = value
+        if value is None:
+            self._packed_hooks = None
 
     def __repr__(self):
         return f"TapeNode({self.name}, id={self.id})"

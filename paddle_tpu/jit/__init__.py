@@ -8,3 +8,22 @@ from .api import (  # noqa: F401
 )
 from .save_load import load, save  # noqa: F401
 from .save_load import TranslatedLayer  # noqa: F401,E402
+
+
+def enable_to_static(flag=True):
+    """paddle.jit.enable_to_static parity: globally toggle conversion
+    (False makes @to_static functions run as plain eager Python)."""
+    from . import api as _api
+
+    _api._TO_STATIC_ENABLED = bool(flag)
+
+
+def ignore_module(modules):
+    """paddle.jit.ignore_module parity: module(s) whose functions
+    dy2static must not convert (left as trace-time Python)."""
+    from . import dy2static as _d2s
+
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    _d2s._IGNORED_MODULES.update(getattr(m, "__name__", str(m))
+                                 for m in modules)

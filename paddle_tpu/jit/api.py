@@ -157,6 +157,9 @@ class _LayerScope:
         return False
 
 
+_TO_STATIC_ENABLED = True  # paddle.jit.enable_to_static toggle
+
+
 class StaticFunction:
     """Compiled forward over a Layer or plain function."""
 
@@ -191,6 +194,11 @@ class StaticFunction:
         self._compiled = jax.jit(pure_fn, static_argnames=("structure",))
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            # paddle.jit.enable_to_static(False): plain eager execution.
+            # _fn is already bound when it came from a Layer (dy2static
+            # rebinds via MethodType), so no layer injection here
+            return self._fn(*args, **kwargs)
         with self._lock:
             if self._compiled is None:
                 self._build()

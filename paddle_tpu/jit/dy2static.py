@@ -586,11 +586,19 @@ def _convert_cached(fn_code, fn_name, filename, freevars):
     return compile(wrapper, filename, "exec")
 
 
+_IGNORED_MODULES: set = set()  # paddle.jit.ignore_module registry
+
+
 def convert_to_static(fn: Callable) -> Callable:
     """Rewrite fn's control flow; returns fn unchanged if the source is
     unavailable (builtins, REPL lambdas) — trace-time behavior is then
     identical to before."""
     import types
+
+    mod = getattr(fn, "__module__", None)
+    if mod is not None and any(mod == m or mod.startswith(m + ".")
+                               for m in _IGNORED_MODULES):
+        return fn
 
     if inspect.ismethod(fn):
         conv = convert_to_static(fn.__func__)

@@ -1,2 +1,121 @@
 """paddle.utils (SURVEY.md §2.2): cpp_extension toolchain and helpers."""
 from . import cpp_extension  # noqa: F401
+import functools as _functools
+import importlib as _importlib
+import threading as _threading
+import warnings as _warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """paddle.utils.deprecated parity: decorator emitting a
+    DeprecationWarning on first call."""
+
+    def deco(fn):
+        warned = []
+
+        @_functools.wraps(fn)
+        def wrapper(*a, **k):
+            if not warned:
+                warned.append(True)
+                msg = f"API {fn.__name__} is deprecated since {since}"
+                if update_to:
+                    msg += f"; use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """paddle.utils.try_import parity."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Module {module_name!r} is required but not "
+            "installed (and cannot be downloaded in this zero-egress "
+            "environment)") from None
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version parity against this package."""
+    from .. import __version__
+
+    def key(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    if key(__version__) < key(min_version):
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and key(__version__) > key(max_version):
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the framework computes on the
+    available device and report it."""
+    import jax
+
+    from .. import get_device, to_tensor
+
+    x = to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = (x @ x).numpy()
+    assert y.shape == (2, 2)
+    print(f"paddle_tpu is installed successfully! device={get_device()}, "
+          f"backend={jax.default_backend()}")
+
+
+def download(url, path=None, md5sum=None, method="get"):
+    """paddle.utils.download.get_weights_path_from_url analog: this
+    environment has zero egress — only file:// and existing local paths
+    resolve."""
+    import os
+
+    if os.path.exists(url):
+        return url
+    if url.startswith("file://"):
+        return url[len("file://"):]
+    raise RuntimeError(
+        "network downloads are unavailable in this zero-egress "
+        "environment; place the file locally and pass its path")
+
+
+class _UniqueName:
+    """paddle.utils.unique_name parity: generate / guard / switch."""
+
+    def __init__(self):
+        self._lock = _threading.Lock()
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def switch(self, new_generator=None):
+        old = dict(self._counters)
+        self._counters = {} if new_generator is None else new_generator
+        return old
+
+    class guard:
+        def __init__(self, new_generator=None):
+            self.new = new_generator
+
+        def __enter__(self):
+            self.old = unique_name.switch({} if self.new is None
+                                          else self.new)
+            return self
+
+        def __exit__(self, *exc):
+            unique_name.switch(self.old)
+            return False
+
+
+unique_name = _UniqueName()

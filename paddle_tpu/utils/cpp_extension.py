@@ -102,3 +102,49 @@ def CUDAExtension(*args, **kwargs):  # pragma: no cover
         "CUDAExtension is not supported on TPU: write device compute as "
         "jax/Pallas ops (see paddle_tpu.kernels) and host code as "
         "CppExtension")
+
+
+class BuildExtension:
+    """paddle.utils.cpp_extension.BuildExtension parity: a setuptools
+    build_ext command subclass factory. The heavy lifting (compiler
+    flags, parallel build) is already in `load`; for setup.py flows this
+    wraps setuptools' build_ext unchanged."""
+
+    @staticmethod
+    def with_options(**options):
+        return BuildExtension._make(**options)
+
+    @staticmethod
+    def _make(**options):
+        from setuptools.command.build_ext import build_ext as _build_ext
+
+        class _Cmd(_build_ext):
+            user_options = _build_ext.user_options
+
+        return _Cmd
+
+    def __new__(cls, *args, **kwargs):
+        from setuptools.command.build_ext import build_ext as _build_ext
+
+        return _build_ext(*args, **kwargs)
+
+
+def setup(**attrs):
+    """paddle.utils.cpp_extension.setup parity: setuptools.setup with
+    ext_modules built as C extensions (CppExtension objects converted to
+    setuptools Extensions; CUDAExtension rejected — no CUDA on TPU
+    hosts)."""
+    import setuptools
+
+    exts = []
+    for e in attrs.pop("ext_modules", []):
+        if isinstance(e, CppExtension):
+            exts.append(setuptools.Extension(
+                name=e.name, sources=list(e.sources),
+                extra_compile_args=list(getattr(e, "extra_compile_args",
+                                                []) or [])))
+        else:
+            exts.append(e)
+    attrs.setdefault("cmdclass", {}).setdefault(
+        "build_ext", BuildExtension._make())
+    return setuptools.setup(ext_modules=exts, **attrs)

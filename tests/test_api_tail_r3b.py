@@ -1,0 +1,277 @@
+"""Round-3 batch-b API tail: autograd functional (jacobian/hessian/
+jvp/vjp), jit toggles, paddle.utils helpers, finfo/iinfo, the
+vision.ops detection family (references: python/paddle/autograd,
+python/paddle/utils, python/paddle/vision/ops)."""
+import numpy as np
+import pytest
+import warnings
+
+import paddle_tpu as paddle
+from paddle_tpu import autograd as AG
+from paddle_tpu.vision import ops as V
+
+
+class TestAutogradFunctional:
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        h = AG.hessian(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(h.numpy(), 2 * np.eye(3), atol=1e-5)
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        j = AG.jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0, 6.0]),
+                                   atol=1e-5)
+
+    def test_vjp_jvp(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        _, vj = AG.vjp(lambda t: t * t, x,
+                       paddle.to_tensor(np.array([1.0, 0.0, 1.0],
+                                                 "float32")))
+        np.testing.assert_allclose(vj.numpy(), [2.0, 0.0, 6.0], atol=1e-5)
+        _, tj = AG.jvp(lambda t: t * t, x,
+                       paddle.to_tensor(np.array([1.0, 1.0, 0.0],
+                                                 "float32")))
+        np.testing.assert_allclose(tj.numpy(), [2.0, 4.0, 0.0], atol=1e-5)
+
+    def test_multi_input_jacobian(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        b = paddle.to_tensor(np.array([3.0], "float32"))
+        js = AG.jacobian(lambda x, y: (x * y).sum(), [a, b])
+        np.testing.assert_allclose(js[0].numpy(), [3.0, 3.0], atol=1e-5)
+        np.testing.assert_allclose(js[1].numpy(), [3.0], atol=1e-5)
+
+    def test_saved_tensors_hooks_surface(self):
+        with AG.saved_tensors_hooks(lambda t: t, lambda t: t):
+            x = paddle.to_tensor(np.ones((2,), "float32"),
+                                 stop_gradient=False)
+            (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestJitUtils:
+    def test_enable_to_static_toggle(self):
+        calls = {"n": 0}
+
+        def f(x):
+            calls["n"] += 1
+            return x * 2
+
+        sf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        paddle.jit.enable_to_static(False)
+        try:
+            out = sf(x)
+            np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        finally:
+            paddle.jit.enable_to_static(True)
+
+    def test_ignore_module(self):
+        import types
+
+        from paddle_tpu.jit import dy2static as d2s
+
+        m = types.ModuleType("fake_userlib")
+        paddle.jit.ignore_module(m)
+        assert "fake_userlib" in d2s._IGNORED_MODULES
+
+    def test_utils_helpers(self):
+        assert paddle.utils.try_import("math").sqrt(4) == 2.0
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("not_a_real_module_xyz")
+        assert paddle.utils.require_version("0.0.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+        a = paddle.utils.unique_name.generate("w")
+        b = paddle.utils.unique_name.generate("w")
+        assert a != b
+        with paddle.utils.unique_name.guard():
+            c = paddle.utils.unique_name.generate("w")
+        assert c == "w_0"
+
+    def test_deprecated_decorator(self):
+        @paddle.utils.deprecated(since="2.0", update_to="new_api")
+        def old():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 42
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+    def test_finfo_iinfo(self):
+        fi = paddle.finfo("float32")
+        assert fi.bits == 32 and fi.max > 1e38
+        fb = paddle.finfo(paddle.bfloat16)
+        assert fb.bits == 16
+        ii = paddle.iinfo("int8")
+        assert ii.min == -128 and ii.max == 127
+
+    def test_cpp_extension_setup_surface(self):
+        from paddle_tpu.utils import cpp_extension as cpp
+
+        assert callable(cpp.setup)
+        cmd = cpp.BuildExtension.with_options(no_python_abi_suffix=True)
+        from setuptools.command.build_ext import build_ext
+
+        assert issubclass(cmd, build_ext)
+
+
+class TestDetectionOps:
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[10, 10, 50, 50], [20, 20, 80, 90]], "float32")
+        targets = np.array([[12, 14, 48, 52], [25, 22, 70, 85]], "float32")
+        var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+        enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                          paddle.to_tensor(targets))
+        deltas = enc.numpy()[np.arange(2), np.arange(2)]
+        dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                          paddle.to_tensor(deltas[None]),
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy()[0], targets, rtol=1e-4,
+                                   atol=1e-3)
+
+    def test_prior_box(self):
+        pb, pv = V.prior_box(paddle.zeros([1, 32, 4, 4]),
+                             paddle.zeros([1, 3, 64, 64]),
+                             min_sizes=[16.0], max_sizes=[32.0],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+        assert pb.shape[:2] == [4, 4] and pb.shape[3] == 4
+        assert (pb.numpy() >= 0).all() and (pb.numpy() <= 1).all()
+        assert pv.shape == pb.shape
+
+    def test_yolo_box(self):
+        A, C, H, W = 3, 5, 4, 4
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, A * (5 + C), H, W)
+                             .astype("float32"))
+        imgs = paddle.to_tensor(np.array([[64, 64], [64, 64]], np.int32))
+        boxes, scores = V.yolo_box(x, imgs,
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   class_num=C, conf_thresh=0.01,
+                                   downsample_ratio=16)
+        assert boxes.shape == [2, H * W * A, 4]
+        assert scores.shape == [2, H * W * A, C]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 63).all()  # clipped to image
+
+    def test_psroi_pool_uniform_input(self):
+        # constant per channel-group input -> output equals that constant
+        oh = ow = 2
+        out_c = 3
+        # channel k holds the constant k; paddle layout is out_c-major:
+        # bin (c, i, j) pools input channel (c*oh + i)*ow + j
+        x = np.arange(out_c * oh * ow, dtype="float32")[None, :, None, None] \
+            * np.ones((1, 1, 8, 8), "float32")
+        out = V.psroi_pool(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([[0, 0, 7, 7]], "float32")),
+            paddle.to_tensor(np.array([1], np.int32)), (oh, ow))
+        assert out.shape == [1, out_c, oh, ow]
+        got = out.numpy()[0]
+        for i in range(oh):
+            for j in range(ow):
+                for c in range(out_c):
+                    assert got[c, i, j] == (c * oh + i) * ow + j
+
+    def test_distribute_fpn_and_proposals(self):
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 300, 300]],
+            "float32"))
+        multi, restore, nums = V.distribute_fpn_proposals(rois, 2, 5, 4,
+                                                          224)
+        assert sum(m.shape[0] for m in multi) == 3
+        assert len(multi) == 4
+        # restore index is a permutation
+        assert sorted(restore.numpy().ravel().tolist()) == [0, 1, 2]
+
+    def test_roi_layers(self):
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype("float32"))
+        boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], "float32"))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        assert V.RoIAlign(2)(x, boxes, num).shape == [1, 3, 2, 2]
+        assert V.RoIPool(2)(x, boxes, num).shape == [1, 3, 2, 2]
+
+
+class TestReviewRegressionsR3c:
+    def test_to_static_layer_eager_fallback(self):
+        """enable_to_static(False) on a to_static Layer must run eagerly."""
+        paddle.seed(0)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.lin(x)
+
+        net = paddle.jit.to_static(Net())
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        ref = net(x).numpy()
+        paddle.jit.enable_to_static(False)
+        try:
+            out = net.forward(x).numpy()
+        finally:
+            paddle.jit.enable_to_static(True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_yolo_box_iou_aware(self):
+        A, C, H, W = 3, 4, 2, 2
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(
+            rng.randn(1, A * (5 + C) + A, H, W).astype("float32"))
+        imgs = paddle.to_tensor(np.array([[32, 32]], np.int32))
+        boxes, scores = V.yolo_box(x, imgs, anchors=[8, 8, 16, 16, 24, 24],
+                                   class_num=C, conf_thresh=0.0,
+                                   downsample_ratio=16, iou_aware=True,
+                                   iou_aware_factor=0.5)
+        assert boxes.shape == [1, H * W * A, 4]
+        assert scores.shape == [1, H * W * A, C]
+        assert np.isfinite(scores.numpy()).all()
+
+    def test_distribute_fpn_batched_rois_num(self):
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [0, 0, 300, 300],    # image 0
+             [0, 0, 100, 100], [0, 0, 12, 12]],   # image 1
+            "float32"))
+        multi, restore, nums = V.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224,
+            rois_num=paddle.to_tensor(np.array([2, 2], np.int32)))
+        for n in nums:
+            assert n.shape == [2]  # per-IMAGE counts, not totals
+        total_per_img = np.sum([n.numpy() for n in nums], axis=0)
+        np.testing.assert_array_equal(total_per_img, [2, 2])
+
+    def test_jacobian_multi_output(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        js = AG.jacobian(lambda t: (t * 2, t * t), x)
+        assert isinstance(js, list) and len(js) == 2
+        np.testing.assert_allclose(js[0].numpy(), 2 * np.eye(2), atol=1e-5)
+        np.testing.assert_allclose(js[1].numpy(), np.diag([2.0, 4.0]),
+                                   atol=1e-5)
+
+    def test_text_star_import(self):
+        import paddle_tpu.text as text
+
+        assert set(["Imdb", "WMT16", "Conll05st"]).issubset(
+            set(text.__all__))
+
+    def test_saved_tensors_hooks_fire(self):
+        calls = {"pack": 0, "unpack": 0}
+
+        def pack(t):
+            calls["pack"] += 1
+            return t
+
+        def unpack(t):
+            calls["unpack"] += 1
+            return t
+
+        with AG.saved_tensors_hooks(pack, unpack):
+            x = paddle.to_tensor(np.ones((2,), "float32"),
+                                 stop_gradient=False)
+            g = paddle.grad((x * 3.0).sum(), x, create_graph=True)[0]
+        assert calls["pack"] > 0 and calls["unpack"] > 0
+        np.testing.assert_allclose(g.numpy(), [3.0, 3.0])
