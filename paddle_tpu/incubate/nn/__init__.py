@@ -5,6 +5,7 @@ The serving-grade FusedMultiTransformer (paged KV cache, Pallas decode
 kernels) lives in paddle_tpu.incubate.nn.fused_transformer.
 """
 from .fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
     FusedFeedForward,
     FusedMultiHeadAttention,
     FusedMultiTransformer,
@@ -12,4 +13,5 @@ from .fused_transformer import (  # noqa: F401
     fused_feedforward,
     fused_multi_head_attention,
 )
+from . import functional  # noqa: F401
 from .fused_linear import FusedLinear, fused_linear, fused_matmul_bias  # noqa: F401

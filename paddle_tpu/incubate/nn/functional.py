@@ -1,0 +1,61 @@
+"""incubate.nn.functional namespace
+(reference: python/paddle/incubate/nn/functional): the fused-op
+functional forms. On TPU these are single traced expressions XLA fuses
+into one kernel cluster — the paddle signatures are kept so callers
+switch without edits."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, _apply_op, as_array
+from .fused_linear import fused_linear, fused_matmul_bias  # noqa: F401
+from .fused_transformer import (  # noqa: F401
+    fused_feedforward,
+    fused_multi_head_attention,
+)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """out = LayerNorm(residual + dropout(x + bias)) — one fused
+    expression (reference: fused_bias_dropout_residual_layer_norm)."""
+    from ...framework import random as _random
+
+    def f(x_, res, *rest):
+        i = 0
+        b = None
+        if bias is not None:
+            b = rest[i]
+            i += 1
+        scale = rest[i] if ln_scale is not None else None
+        i += 1 if ln_scale is not None else 0
+        lb = rest[i] if ln_bias is not None else None
+        y = x_ if b is None else x_ + b
+        if training and dropout_rate > 0:
+            k = _random.next_key()
+            keep = jax.random.bernoulli(k, 1.0 - dropout_rate, y.shape)
+            if mode == "upscale_in_train":
+                y = jnp.where(keep, y / (1.0 - dropout_rate), 0.0)
+            else:
+                y = jnp.where(keep, y, 0.0)
+        elif not training and mode == "downscale_in_infer":
+            y = y * (1.0 - dropout_rate)
+        h = res + y
+        mean = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        out = (h - mean) / jnp.sqrt(var + ln_epsilon)
+        if scale is not None:
+            out = out * scale
+        if lb is not None:
+            out = out + lb
+        return out
+
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return _apply_op(f, *args,
+                     _name="fused_bias_dropout_residual_layer_norm")

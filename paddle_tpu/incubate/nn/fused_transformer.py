@@ -370,3 +370,29 @@ class FusedMultiTransformer(Layer):
         if caches is not None:
             return x, new_caches
         return x
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """paddle.incubate.nn.FusedBiasDropoutResidualLayerNorm parity:
+    LayerNorm(residual + dropout(x + bias)) as one fused expression."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            self.dropout_rate, self.epsilon, training=self.training)

@@ -524,4 +524,128 @@ def load_inference_model(path_prefix, executor):
     return [prog, prog.feed_names, fetch_targets]
 
 
-nn = _nn  # paddle.static.nn compatibility alias (layers work in both modes)
+from . import nn  # noqa: E402,F401 — static.nn function builders (+dyn fallback)
+
+
+# ---------------------------------------------------------------------------
+# symbolic gradients + remaining paddle.static surface (round 3)
+# ---------------------------------------------------------------------------
+
+Variable = Tensor  # paddle.static.Variable: program vars ARE Tensors here
+
+
+class CompiledProgram:
+    """paddle.static.CompiledProgram compatibility: the Executor already
+    jit-compiles every program per feed-shape, so this is a transparent
+    wrapper (build_strategy accepted and ignored — XLA owns fusion)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: append records computing
+    d(sum(targets))/d(inputs) to the current program; the returned grad
+    vars can be fetched or consumed by later ops.
+
+    Implementation: the target subgraph is pruned out of the program and
+    replayed under jax.grad INSIDE one appended record — the reference's
+    append_backward op-by-op transposition collapses into one traced
+    jax.grad when Executor.run compiles the program."""
+    import jax
+
+    prog = _capture_program()
+    if prog is None:
+        raise RuntimeError("paddle.static.gradients must run under "
+                           "program_guard (build-time symbolic API)")
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    tvids = []
+    for t in targets:
+        vid = prog._var_of_tensor.get(id(t))
+        if vid is None:
+            raise ValueError("gradients(): target is not a var of the "
+                             "current program")
+        tvids.append(vid)
+    ivids = [prog._ref_of(x) for x in inputs]
+
+    records, needed = prog._prune(tvids)
+    produced = {o for rec in records for o in rec[2]}
+    leaf_vids = sorted((needed | set(ivids)) - produced)
+    vid_to_tensor = {}
+    for t in prog._keepalive:
+        vid_to_tensor.setdefault(prog._var_of_tensor[id(t)], t)
+    vid_to_tensor.update(prog._externals)
+    try:
+        leaf_tensors = [vid_to_tensor[v] for v in leaf_vids]
+    except KeyError as e:
+        raise RuntimeError(f"gradients(): leaf var {e} has no live "
+                           "tensor") from None
+    for x, vid in zip(inputs, ivids):
+        if vid in produced:
+            raise NotImplementedError(
+                "gradients() w.r.t. an intermediate var is not supported; "
+                "take gradients w.r.t. placeholders or parameters")
+
+    tg = None
+    if target_gradients is not None:
+        tg = [as_array(g) if g is not None else None
+              for g in (target_gradients if isinstance(
+                  target_gradients, (list, tuple)) else [target_gradients])]
+
+    def grad_record(*leaf_vals):
+        base_env = dict(zip(leaf_vids, leaf_vals))
+        ivals = tuple(jnp.asarray(base_env[v], jnp.float32)
+                      if not hasattr(base_env[v], "dtype")
+                      else base_env[v] for v in ivids)
+
+        def loss_of(iv):
+            env = dict(base_env)
+            env.update(zip(ivids, iv))
+            out_env = prog._replay(env, records)
+            total = 0.0
+            for j, tv in enumerate(tvids):
+                out = out_env[tv].astype(jnp.float32)
+                cot = tg[j] if tg is not None and tg[j] is not None \
+                    else jnp.ones_like(out)
+                total = total + jnp.sum(out * cot)
+            return total
+
+        gs = jax.grad(loss_of)(ivals)
+        return tuple(g.astype(base_env[v].dtype)
+                     if hasattr(base_env[v], "dtype") else g
+                     for g, v in zip(gs, ivids))
+
+    grad_tensors = [Tensor(jnp.zeros_like(as_array(x))) for x in inputs]
+    prog._record(grad_record, leaf_tensors, grad_tensors, "gradients")
+    return grad_tensors
+
+
+def save(program, model_path, protocol=4):
+    """paddle.static.save parity: persist the program's parameter values
+    (externals) to `model_path + '.pdparams'`."""
+    import pickle
+
+    state = {}
+    for vid, t in program._externals.items():
+        state[f"var_{vid}"] = np.asarray(as_array(t))
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load parity: restore parameter values saved by
+    `save` into the program's externals (shape-matched by var id)."""
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for vid, t in program._externals.items():
+        key = f"var_{vid}"
+        if key in state:
+            t._rebind(jnp.asarray(state[key]))

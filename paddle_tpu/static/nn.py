@@ -1,0 +1,84 @@
+"""paddle.static.nn — the fluid-style functional layer builders
+(reference: python/paddle/static/nn): each call instantiates the layer
+inline at build time, so its parameters become program externals and the
+op records capture the forward. Unknown attributes fall back to the
+dynamic `paddle.nn` namespace (the two APIs share layer classes here)."""
+from __future__ import annotations
+
+from .. import nn as _dyn_nn
+from ..tensor import as_array
+
+
+def _activation(out, act):
+    if act is None:
+        return out
+    from ..ops import activation as A
+
+    fn = getattr(A, act, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {act!r}")
+    return fn(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static.nn.fc: flatten trailing dims, Linear, optional activation."""
+    from ..ops.manipulation import flatten
+
+    shape = as_array(x).shape
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(shape) + num_flatten_dims
+    if num_flatten_dims != len(shape) - 1:
+        x = flatten(x, num_flatten_dims, -1)
+    in_features = int(as_array(x).shape[-1])
+    layer = _dyn_nn.Linear(in_features, size, weight_attr=weight_attr,
+                           bias_attr=bias_attr)
+    return _activation(layer(x), activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    cin = int(as_array(input).shape[1 if data_format == "NCHW" else -1])
+    layer = _dyn_nn.Conv2D(cin, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation,
+                           groups=groups, bias_attr=bias_attr,
+                           data_format=data_format)
+    return _activation(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    if filter_size is None:
+        raise ValueError("static.nn.conv2d_transpose requires filter_size")
+    cin = int(as_array(input).shape[1 if data_format == "NCHW" else -1])
+    layer = _dyn_nn.Conv2DTranspose(cin, num_filters, filter_size,
+                                    stride=stride, padding=padding,
+                                    dilation=dilation, groups=groups,
+                                    bias_attr=bias_attr,
+                                    data_format=data_format)
+    return _activation(layer(input), act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _dyn_nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                              weight_attr=param_attr)
+    return layer(input)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kwargs):
+    c = int(as_array(input).shape[1 if data_layout == "NCHW" else -1])
+    layer = _dyn_nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                                data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return _activation(layer(input), act)
+
+
+def __getattr__(name):  # dynamic-nn fallback (Sequential, Linear, ...)
+    return getattr(_dyn_nn, name)

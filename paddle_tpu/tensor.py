@@ -88,6 +88,19 @@ class Tensor:
     def dim(self):
         return self._data.ndim
 
+    def ndimension(self):
+        return self._data.ndim
+
+    def gradient(self):
+        """paddle Tensor.gradient(): the grad as a numpy array (None if
+        no grad accumulated)."""
+        return None if self.grad is None else np.asarray(self.grad._data)
+
+    def value(self):
+        """paddle Tensor.value() compatibility: the tensor itself (no
+        separate Variable/value split in this design)."""
+        return self
+
     @property
     def rank(self):
         return self._data.ndim

@@ -16,7 +16,8 @@ import numpy as np
 from ..nn.layer_base import Layer
 from ..tensor import Tensor, _apply_op, as_array
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -108,3 +109,115 @@ class UCIHousing:
 
     def __getitem__(self, i):
         return self.x[i], self.y[i]
+
+
+class _SyntheticTextDataset:
+    """Shared base for the paddle.text dataset family. The reference
+    downloads corpora; under zero egress each dataset generates a
+    deterministic synthetic sample set with the REAL schema (token-id
+    sequences / label types match the reference docs), so data pipelines
+    and examples exercise unchanged."""
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification: (token_ids int64[var], label {0,1})."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 256 if mode == "train" else 64
+        self.word_idx = {f"w{i}": i for i in range(5000)}
+        self._samples = [
+            (rng.randint(0, 5000, (rng.randint(8, 64),)).astype(np.int64),
+             np.int64(rng.randint(0, 2)))
+            for _ in range(n)]
+
+
+class Imikolov(_SyntheticTextDataset):
+    """PTB-style n-gram LM: tuples of n token ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        n = 512 if mode == "train" else 128
+        self.word_idx = {f"w{i}": i for i in range(2000)}
+        k = window_size if data_type.upper() == "NGRAM" else 2
+        self._samples = [
+            tuple(np.int64(v) for v in rng.randint(0, 2000, (k,)))
+            for _ in range(n)]
+
+
+class Movielens(_SyntheticTextDataset):
+    """Rating prediction: (user feats, movie feats, rating float)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train"
+                                                 else 1))
+        n = 512 if mode == "train" else 64
+        self._samples = [
+            (np.int64(rng.randint(0, 6040)),      # user id
+             np.int64(rng.randint(0, 2)),          # gender
+             np.int64(rng.randint(0, 7)),          # age bucket
+             np.int64(rng.randint(0, 21)),         # occupation
+             np.int64(rng.randint(0, 3952)),       # movie id
+             rng.randint(0, 19, (3,)).astype(np.int64),  # categories
+             np.float32(rng.randint(1, 6)))        # rating
+            for _ in range(n)]
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL tagging: (pred, mark, word sequences, label sequence)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train"):
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        n = 128 if mode == "train" else 32
+        samples = []
+        for _ in range(n):
+            ln = rng.randint(5, 30)
+            words = rng.randint(0, 4000, (ln,)).astype(np.int64)
+            pred = np.full((ln,), rng.randint(0, 3000), np.int64)
+            mark = (rng.rand(ln) < 0.2).astype(np.int64)
+            labels = rng.randint(0, 59, (ln,)).astype(np.int64)
+            samples.append((words,) + tuple(
+                words.copy() for _ in range(5)) + (pred, mark, labels))
+        self._samples = samples
+
+
+class _WMTBase(_SyntheticTextDataset):
+    def __init__(self, mode, src_vocab, trg_vocab, seed):
+        rng = np.random.RandomState(seed)
+        n = 256 if mode == "train" else 64
+        self._samples = []
+        for _ in range(n):
+            ls = rng.randint(4, 24)
+            lt = rng.randint(4, 24)
+            src = rng.randint(0, src_vocab, (ls,)).astype(np.int64)
+            trg = rng.randint(0, trg_vocab, (lt,)).astype(np.int64)
+            trg_next = np.concatenate(
+                [trg[1:], np.asarray([1], np.int64)])
+            self._samples.append((src, trg, trg_next))
+
+
+class WMT14(_WMTBase):
+    """EN-FR translation triplets (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__(mode, dict_size, dict_size,
+                         6 if mode == "train" else 7)
+
+
+class WMT16(_WMTBase):
+    """EN-DE translation triplets (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(mode, src_dict_size, trg_dict_size,
+                         8 if mode == "train" else 9)

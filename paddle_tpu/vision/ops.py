@@ -302,3 +302,331 @@ class DeformConv2D:
                                      mask=mask, **self._args)
 
         return _DeformConv2D(*a, **k)
+
+
+class RoIAlign(object):
+    """paddle.vision.ops.RoIAlign layer parity."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(object):
+    """paddle.vision.ops.RoIPool layer parity."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """SSD box transform (paddle.vision.ops.box_coder): encode gt boxes
+    against priors, or decode predicted deltas back to boxes."""
+    def f(pb, tb, *maybe_var):
+        var = maybe_var[0] if maybe_var else None
+        pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+        ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if var is None:
+            v = jnp.ones((pb.shape[0], 4), pb.dtype)
+        elif var.ndim == 1:
+            v = jnp.broadcast_to(var[None, :], (pb.shape[0], 4))
+        else:
+            v = var
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+            th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            # [T, P] grid: every target against every prior
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / v[None, :, 0]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / v[None, :, 1]
+            dw = jnp.log(tw[:, None] / pw[None, :]) / v[None, :, 2]
+            dh = jnp.log(th[:, None] / ph[None, :]) / v[None, :, 3]
+            return jnp.stack([dx, dy, dw, dh], axis=-1)
+        # decode_center_size: tb [N, P, 4] deltas (or [P, 4])
+        tb3 = tb if tb.ndim == 3 else tb[None]
+        if axis == 0:
+            cx = pcx[None, :] + tb3[..., 0] * v[None, :, 0] * pw[None, :]
+            cy = pcy[None, :] + tb3[..., 1] * v[None, :, 1] * ph[None, :]
+            w = pw[None, :] * jnp.exp(v[None, :, 2] * tb3[..., 2])
+            h = ph[None, :] * jnp.exp(v[None, :, 3] * tb3[..., 3])
+        else:
+            cx = pcx[:, None] + tb3[..., 0] * v[:, None, 0] * pw[:, None]
+            cy = pcy[:, None] + tb3[..., 1] * v[:, None, 1] * ph[:, None]
+            w = pw[:, None] * jnp.exp(v[:, None, 2] * tb3[..., 2])
+            h = ph[:, None] * jnp.exp(v[:, None, 3] * tb3[..., 3])
+        off = 0.0 if box_normalized else 1.0
+        out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+        return out if tb.ndim == 3 else out[0]
+
+    args = [prior_box, target_box]
+    if prior_box_var is not None and not isinstance(prior_box_var, list):
+        args.append(prior_box_var)
+        return _apply_op(f, *args, _name="box_coder")
+    if isinstance(prior_box_var, list):
+        var = jnp.asarray(prior_box_var, jnp.float32)
+        return _apply_op(lambda pb, tb: f(pb, tb, var), prior_box,
+                         target_box, _name="box_coder")
+    return _apply_op(f, *args, _name="box_coder")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD anchor generation (paddle.vision.ops.prior_box): priors
+    [H, W, A, 4] (normalized xyxy) + variances of the same shape."""
+    fh, fw = as_array(input).shape[2:]
+    ih, iw = as_array(image).shape[2:]
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        if max_sizes:
+            mx = max_sizes[list(min_sizes).index(ms)]
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    A = len(boxes)
+    cx = (np.arange(fw) + offset) * sw
+    cy = (np.arange(fh) + offset) * sh
+    gx, gy = np.meshgrid(cx, cy)  # [fh, fw]
+    out = np.zeros((fh, fw, A, 4), np.float32)
+    for a, (bw, bh) in enumerate(boxes):
+        out[..., a, 0] = (gx - bw / 2) / iw
+        out[..., a, 1] = (gy - bh / 2) / ih
+        out[..., a, 2] = (gx + bw / 2) / iw
+        out[..., a, 3] = (gy + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode one YOLOv3 head (paddle.vision.ops.yolo_box): x
+    [N, A*(5+C), H, W] -> (boxes [N, H*W*A, 4] xyxy, scores
+    [N, H*W*A, C]). Low-confidence boxes are zeroed (static shapes on
+    TPU; the reference prunes — downstream nms treats zero-area boxes as
+    absent)."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def f(xa, imgs):
+        N, _, H, W = xa.shape
+        if iou_aware:
+            # PP-YOLO layout: the FIRST A channels are per-anchor IoU
+            # predictions; objectness blends obj^(1-f) * iou^f
+            ioup = jax.nn.sigmoid(xa[:, :A])  # [N, A, H, W]
+            xa = xa[:, A:]
+        v = xa.reshape(N, A, 5 + class_num, H, W)
+        tx, ty = v[:, :, 0], v[:, :, 1]
+        tw, th = v[:, :, 2], v[:, :, 3]
+        obj = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            obj = (obj ** (1.0 - iou_aware_factor)
+                   * ioup ** iou_aware_factor)
+        cls = jnp.moveaxis(jax.nn.sigmoid(v[:, :, 5:]), 2, -1)  # [N,A,H,W,C]
+        gx = jnp.arange(W, dtype=xa.dtype)[None, None, None, :]
+        gy = jnp.arange(H, dtype=xa.dtype)[None, None, :, None]
+        bx = (jax.nn.sigmoid(tx) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / W
+        by = (jax.nn.sigmoid(ty) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / H
+        aw = anchors[None, :, None, None, 0]
+        ah = anchors[None, :, None, None, 1]
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        bw = jnp.exp(tw) * aw / in_w
+        bh = jnp.exp(th) * ah / in_h
+        imw = imgs[:, 1][:, None, None, None]
+        imh = imgs[:, 0][:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        keep = obj > conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+        scores = cls * (obj * keep)[..., None]
+        # [N, A, H, W, ...] -> [N, H*W*A, ...] (paddle order)
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, -1, 4)
+        scores = scores.transpose(0, 2, 3, 1, 4).reshape(
+            N, -1, class_num)
+        return boxes, scores
+
+    return _apply_op(f, x, img_size, _name="yolo_box")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (paddle.vision.ops.psroi_pool):
+    input channels C = out_c * oh * ow; output bin (i, j) average-pools
+    its OWN channel group — the R-FCN op."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xa, ba):
+        B, C, H, W = xa.shape
+        out_c = C // (oh * ow)
+        R = ba.shape[0]
+        counts = as_array(boxes_num).astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(B), counts, total_repeat_length=R)
+        x1 = ba[:, 0] * spatial_scale
+        y1 = ba[:, 1] * spatial_scale
+        x2 = ba[:, 2] * spatial_scale
+        y2 = ba[:, 3] * spatial_scale
+        bw = jnp.clip(x2 - x1, 0.1) / ow
+        bh = jnp.clip(y2 - y1, 0.1) / oh
+
+        def per_roi(r):
+            # paddle kernel layout is out_c-MAJOR: input channel for
+            # (c, i, j) is (c*oh + i)*ow + j
+            img = xa[img_idx[r]].reshape(out_c, oh, ow, H, W)
+            outs = []
+            for i in range(oh):
+                row = []
+                for j in range(ow):
+                    ys = y1[r] + i * bh[r]
+                    xs = x1[r] + j * bw[r]
+                    # average over the bin via a soft mask (static shapes)
+                    yy = jnp.arange(H, dtype=xa.dtype)
+                    xx = jnp.arange(W, dtype=xa.dtype)
+                    my = ((yy + 1 > ys) & (yy < ys + bh[r])).astype(
+                        xa.dtype)
+                    mx = ((xx + 1 > xs) & (xx < xs + bw[r])).astype(
+                        xa.dtype)
+                    m = my[:, None] * mx[None, :]
+                    denom = jnp.maximum(m.sum(), 1.0)
+                    row.append((img[:, i, j] * m[None]).sum((1, 2))
+                               / denom)
+                outs.append(jnp.stack(row, 0))
+            return jnp.stack(outs, 0).transpose(2, 0, 1)  # [out_c, oh, ow]
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return _apply_op(f, x, boxes, _name="psroi_pool")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (paddle
+    distribute_fpn_proposals). Host-side (ragged outputs by nature):
+    returns (multi_rois list, restore_ind, rois_num_per_level list)."""
+    rois = np.asarray(as_array(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    # per-roi image id from rois_num (the only batch association rois
+    # carry); without it everything is one image
+    if rois_num is not None:
+        counts = np.asarray(as_array(rois_num)).astype(np.int64)
+    else:
+        counts = np.asarray([len(rois)], np.int64)
+    img_of = np.repeat(np.arange(len(counts)), counts)
+    multi, nums, order = [], [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        # keep image-major order inside each level (paddle contract)
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
+        order.append(idx)
+        multi.append(Tensor(jnp.asarray(rois[idx].reshape(-1, 4))))
+        per_img = np.bincount(img_of[idx],
+                              minlength=len(counts)).astype(np.int32)
+        nums.append(Tensor(jnp.asarray(per_img)))
+    concat_order = np.concatenate(order) if order else np.zeros(0, int)
+    restore = np.empty_like(concat_order)
+    restore[concat_order] = np.arange(len(concat_order))
+    return multi, Tensor(jnp.asarray(restore.astype(np.int32)[:, None])), \
+        nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation (paddle generate_proposals, single-image
+    semantics per batch element; host-side ragged outputs by nature)."""
+    sc = np.asarray(as_array(scores))       # [N, A, H, W]
+    bd = np.asarray(as_array(bbox_deltas))  # [N, A*4, H, W]
+    ims = np.asarray(as_array(img_size))    # [N, 2] (h, w)
+    anc = np.asarray(as_array(anchors)).reshape(-1, 4)   # [H*W*A, 4]
+    var = np.asarray(as_array(variances)).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    all_rois, all_nums, all_scores = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, len(s))
+        top = np.argsort(-s)[:k]
+        s_t, d_t, a_t, v_t = s[top], d[top], anc[top], var[top]
+        aw = a_t[:, 2] - a_t[:, 0] + off
+        ah = a_t[:, 3] - a_t[:, 1] + off
+        acx = a_t[:, 0] + aw * 0.5
+        acy = a_t[:, 1] + ah * 0.5
+        cx = acx + d_t[:, 0] * v_t[:, 0] * aw
+        cy = acy + d_t[:, 1] * v_t[:, 1] * ah
+        ww = aw * np.exp(np.clip(d_t[:, 2] * v_t[:, 2], None, 10.0))
+        hh = ah * np.exp(np.clip(d_t[:, 3] * v_t[:, 3], None, 10.0))
+        boxes = np.stack([cx - ww * 0.5, cy - hh * 0.5,
+                          cx + ww * 0.5 - off, cy + hh * 0.5 - off], -1)
+        imh, imw = ims[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s_t = boxes[keep], s_t[keep]
+        if len(boxes):
+            kept = np.asarray(as_array(nms(
+                Tensor(jnp.asarray(boxes.astype(np.float32))),
+                iou_threshold=nms_thresh,
+                scores=Tensor(jnp.asarray(s_t.astype(np.float32))),
+                top_k=post_nms_top_n)))
+            boxes, s_t = boxes[kept], s_t[kept]
+        all_rois.append(boxes.astype(np.float32))
+        all_scores.append(s_t.astype(np.float32))
+        all_nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                              if all_rois else np.zeros((0, 4))))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores)
+                                 if all_scores else np.zeros((0,))))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.asarray(all_nums, np.int32)))
+    return rois, rscores

@@ -1,0 +1,129 @@
+"""Round-3 API tail: text datasets, incubate functional namespace,
+static.nn builders + symbolic gradients + save/load, Tensor method tail
+(references: python/paddle/text, python/paddle/incubate/nn,
+python/paddle/static)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+class TestTextDatasets:
+    @pytest.mark.parametrize("name,mode", [
+        ("Imdb", "train"), ("Imikolov", "test"), ("Movielens", "train"),
+        ("Conll05st", "test"), ("WMT14", "train"), ("WMT16", "test"),
+    ])
+    def test_schema_and_determinism(self, name, mode):
+        import paddle_tpu.text as text
+
+        cls = getattr(text, name)
+        a, b = cls(mode=mode), cls(mode=mode)
+        assert len(a) > 0
+        s0, s1 = a[0], b[0]
+        flat0 = np.concatenate([np.ravel(np.asarray(v)) for v in s0])
+        flat1 = np.concatenate([np.ravel(np.asarray(v)) for v in s1])
+        np.testing.assert_array_equal(flat0, flat1)  # deterministic
+        # loadable by the DataLoader machinery (varlen token sequences
+        # need batch_size=1 with the default collate, same as the
+        # reference — padding is the user's collate_fn job)
+        bs = 1 if name in ("Imdb", "Conll05st", "WMT14", "WMT16") else 4
+        loader = paddle.io.DataLoader(a, batch_size=bs, shuffle=False,
+                                      num_workers=0, drop_last=True)
+        batch = next(iter(loader))
+        assert len(batch) == len(s0)
+
+
+class TestIncubateFunctional:
+    def test_fused_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        res = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, res, dropout_rate=0.0, training=False)
+        h = x.numpy() + res.numpy()
+        ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+            h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_layer_module(self):
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+
+        paddle.seed(0)
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 3, 8).astype("float32"))
+        res = paddle.to_tensor(rng.randn(2, 3, 8).astype("float32"))
+        out = layer(x, res)
+        assert out.shape == [2, 3, 8]
+        np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+
+
+class TestStaticTail:
+    def _build(self):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 1, 8, 8], "float32")
+            paddle.seed(0)
+            h = static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+            h = static.nn.batch_norm(h, is_test=True)
+            h = static.nn.fc(h, 10, num_flatten_dims=1)
+            loss = (h * h).mean()
+            (gx,) = static.gradients(loss, [x])
+        return main, h, gx
+
+    def test_static_nn_builders_and_gradients(self):
+        main, h, gx = self._build()
+        exe = static.Executor()
+        xs = np.random.RandomState(0).randn(2, 1, 8, 8).astype("float32")
+        out, g = exe.run(main, feed={"x": xs}, fetch_list=[h, gx])
+        assert out.shape == (2, 10) and g.shape == xs.shape
+        # numeric check of the symbolic gradient
+        eps = 1e-3
+        xp, xm = xs.copy(), xs.copy()
+        xp[0, 0, 2, 3] += eps
+        xm[0, 0, 2, 3] -= eps
+
+        def lossval(a):
+            (o,) = exe.run(main, feed={"x": a}, fetch_list=[h])
+            return (o * o).mean()
+
+        num = (lossval(xp) - lossval(xm)) / (2 * eps)
+        np.testing.assert_allclose(g[0, 0, 2, 3], num, rtol=2e-2,
+                                   atol=1e-4)
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        main, h, _ = self._build()
+        exe = static.Executor()
+        xs = np.random.RandomState(1).randn(2, 1, 8, 8).astype("float32")
+        (o1,) = exe.run(main, feed={"x": xs}, fetch_list=[h])
+        pth = str(tmp_path / "model")
+        static.save(main, pth)
+        static.load(main, pth)
+        (o2,) = exe.run(main, feed={"x": xs}, fetch_list=[h])
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    def test_variable_and_compiled_program(self):
+        assert static.Variable is paddle.Tensor
+        main, h, _ = self._build()
+        cp = static.CompiledProgram(main)
+        assert cp.global_block() is main
+
+    def test_gradients_outside_guard_raises(self):
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        with pytest.raises(RuntimeError, match="program_guard"):
+            static.gradients(x, [x])
+
+
+class TestTensorMethodTail:
+    def test_gradient_ndimension_value(self):
+        t = paddle.to_tensor(np.ones((2, 3), "float32"),
+                             stop_gradient=False)
+        assert t.ndimension() == 2
+        assert t.value() is t
+        assert t.gradient() is None
+        (t * 2).sum().backward()
+        np.testing.assert_allclose(t.gradient(), 2 * np.ones((2, 3)))
