@@ -88,3 +88,53 @@ class ParallelEnv:
     def nranks(self):
         return env.get_world_size()
 from .collective import P2POp, batch_isend_irecv, irecv, isend  # noqa: F401,E402
+from . import stream  # noqa: F401
+from .collective import (  # noqa: F401
+    all_gather_object,
+    broadcast_object_list,
+    destroy_process_group,
+    get_backend,
+    gloo_barrier,
+    is_available,
+    scatter_object_list,
+)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity: build a model-parallel linear or
+    embedding over the tp axis (the reference's Megatron helper). Returns
+    the layer OUTPUT for input x (constructing the sharded layer inline,
+    as the reference does on first call).
+
+    operation: 'linear' (axis=0: row-parallel / axis=1: column-parallel)
+    or 'embedding' (vocab-parallel)."""
+    from . import mesh as _mesh_mod
+    from .fleet.layers.mpu import mp_layers as _mp
+
+    if axis not in (0, 1):
+        raise ValueError(f"split: axis must be 0 or 1, got {axis}")
+    tp = _mesh_mod.axis_size("tp")
+    if num_partitions not in (1, tp):
+        raise ValueError(
+            f"split: num_partitions ({num_partitions}) must equal the tp "
+            f"mesh size ({tp}) — the reference asserts the same")
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = _mp.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        else:
+            layer = _mp.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, dim = size
+        layer = _mp.VocabParallelEmbedding(vocab, dim,
+                                           weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

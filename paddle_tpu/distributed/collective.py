@@ -213,3 +213,81 @@ def batch_isend_irecv(p2p_op_list):
         op.op(op.tensor, op.peer, group=op.group)
         tasks.append(_Done())
     return tasks
+
+
+# ---------------------------------------------------------------------------
+# object collectives + misc (python/paddle/distributed/communication)
+# ---------------------------------------------------------------------------
+
+
+def _obj_to_tensor(obj):
+    import pickle
+
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    return Tensor(jnp.asarray(data)), len(data)
+
+
+def _tensor_to_obj(t, length):
+    import pickle
+
+    return pickle.loads(np.asarray(as_array(t))[:int(length)].tobytes())
+
+
+def all_gather_object(object_list, obj, group=None):
+    """paddle.distributed.all_gather_object parity under the
+    single-controller stance: every process holds the same Python
+    objects, so the gather of one object is [obj]. Eager multi-rank
+    object exchange has no host p2p channel here (same contract as the
+    tensor collectives: multi-rank = jit path, MIGRATING.md delta #6)."""
+    if _world(_axes_for_group(group)) > 1:
+        raise NotImplementedError(
+            "eager multi-rank all_gather_object has no host channel in "
+            "the single-controller design; Python-side state is already "
+            "identical on every process")
+    object_list.append(obj)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """paddle.distributed.broadcast_object_list parity: in the
+    single-controller design src's list IS every process's list already,
+    so this is a (semantics-preserving) no-op for any world size."""
+    return
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """paddle.distributed.scatter_object_list parity (single-controller:
+    world 1 receives src's first object; the reference's per-rank
+    scattering needs a host channel the eager path doesn't have)."""
+    world = max(_world(_axes_for_group(group)), 1)
+    if world > 1:
+        raise NotImplementedError(
+            "eager multi-rank scatter_object_list has no host channel in "
+            "the single-controller design")
+    src_list = in_object_list or []
+    out_object_list.extend(src_list[:1] or [None])
+
+
+def destroy_process_group(group=None):
+    """paddle.distributed.destroy_process_group parity: drop the mesh/env
+    bindings (the XLA runtime itself has no persistent communicators)."""
+    from . import mesh as _mesh_mod
+
+    if group is None:
+        _mesh_mod.set_mesh(None)
+
+
+def get_backend(group=None):
+    """paddle.distributed.get_backend parity: the comm backend name —
+    'xla' (collectives lower to XLA over ICI/DCN; there is no NCCL)."""
+    return "xla"
+
+
+def is_available():
+    """paddle.distributed.is_available parity."""
+    return True
+
+
+def gloo_barrier():
+    """paddle.distributed.gloo_barrier parity: host-side barrier."""
+    barrier()

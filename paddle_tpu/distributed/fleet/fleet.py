@@ -118,3 +118,145 @@ distributed_optimizer = fleet.distributed_optimizer
 
 def get_hybrid_communicate_group():
     return fleet.get_hybrid_communicate_group()
+
+
+# ---------------------------------------------------------------------------
+# worker/role API (reference fleet.base.fleet_base worker surface). The
+# PS server half is out of scope (SURVEY §2.1 Parameter server) — server
+# entry points raise with that pointer; worker entry points are real.
+# ---------------------------------------------------------------------------
+
+
+def worker_index():
+    """fleet.worker_index parity: this worker's rank."""
+    return _env.get_rank()
+
+
+def worker_num():
+    """fleet.worker_num parity: number of collective workers."""
+    return _env.get_world_size()
+
+
+def is_first_worker():
+    return _env.get_rank() == 0
+
+
+def is_worker():
+    """Collective mode: every process is a worker."""
+    return True
+
+
+def is_server():
+    """Collective mode: there are no parameter servers."""
+    return False
+
+
+def worker_endpoints(to_string=False):
+    import os
+
+    eps = [e for e in os.environ.get(
+        "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+    if not eps:
+        eps = ["127.0.0.1:0"] * _env.get_world_size()
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from .. import collective as _collective
+
+    _collective.barrier()
+
+
+def init_worker(scopes=None):
+    """PS-mode worker bootstrap — a no-op in collective mode (the mesh is
+    ambient after fleet.init), kept for script compatibility."""
+
+
+def stop_worker():
+    """PS-mode worker teardown — collective-mode no-op."""
+
+
+def init_server(*args, **kwargs):
+    raise NotImplementedError(
+        "parameter-server mode is out of the TPU north-star scope "
+        "(SURVEY.md §2.1 'Parameter server'); use collective mode")
+
+
+def run_server():
+    raise NotImplementedError(
+        "parameter-server mode is out of the TPU north-star scope "
+        "(SURVEY.md §2.1 'Parameter server'); use collective mode")
+
+
+class UserDefinedRoleMaker:
+    """Explicit role assignment (reference UserDefinedRoleMaker): the
+    fake-cluster testing hook — pure arithmetic, no processes
+    (SURVEY.md §4.3)."""
+
+    def __init__(self, current_id=0, role=None, worker_num=1,
+                 server_endpoints=None, is_collective=True, **kwargs):
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._current_id == 0
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    """Env-driven role maker (reference PaddleCloudRoleMaker): reads the
+    PADDLE_* env contract the launch CLI writes."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+
+        super().__init__(
+            current_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            worker_num=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            is_collective=is_collective)
+
+
+class UtilBase:
+    """fleet.UtilBase parity: small cross-worker helpers."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ... import to_tensor
+        from .. import collective as _collective
+
+        t = to_tensor(np.asarray(input))
+        op = {"sum": _collective.ReduceOp.SUM,
+              "max": _collective.ReduceOp.MAX,
+              "min": _collective.ReduceOp.MIN}[mode]
+        _collective.all_reduce(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as _collective
+
+        _collective.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference
+        semantics: earlier workers get the remainder)."""
+        n = _env.get_world_size()
+        r = _env.get_rank()
+        per, rem = divmod(len(files), n)
+        start = r * per + min(r, rem)
+        return files[start:start + per + (1 if r < rem else 0)]
+
+
+util = UtilBase()

@@ -275,3 +275,88 @@ class TestReviewRegressionsR3c:
             g = paddle.grad((x * 3.0).sum(), x, create_graph=True)[0]
         assert calls["pack"] > 0 and calls["unpack"] > 0
         np.testing.assert_allclose(g.numpy(), [3.0, 3.0])
+
+
+class TestDistributedTail:
+    def test_object_collectives_single_process(self):
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather_object(out, {"a": 1, "b": [2, 3]})
+        assert out == [{"a": 1, "b": [2, 3]}]
+        objs = [{"x": 5}]
+        dist.broadcast_object_list(objs, src=0)
+        assert objs == [{"x": 5}]
+        got = []
+        dist.scatter_object_list(got, [{"y": 7}], src=0)
+        assert got == [{"y": 7}]
+        assert dist.is_available()
+        assert dist.get_backend() == "xla"
+        dist.gloo_barrier()
+
+    def test_stream_namespace(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        dist.stream.all_reduce(t, sync_op=False, use_calc_stream=True)
+        np.testing.assert_allclose(t.numpy(), [1.0, 2.0])  # world=1
+
+    def test_fleet_worker_api(self):
+        from paddle_tpu.distributed import fleet
+
+        assert fleet.worker_index() == 0
+        assert fleet.worker_num() >= 1
+        assert fleet.is_first_worker() and fleet.is_worker()
+        assert not fleet.is_server()
+        fleet.init_worker()
+        fleet.stop_worker()
+        fleet.barrier_worker()
+        with pytest.raises(NotImplementedError):
+            fleet.init_server()
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.worker_index() == 0 and rm.is_worker()
+        shard = fleet.util.get_file_shard(["a", "b", "c"])
+        assert shard == ["a", "b", "c"]  # world=1: all files
+        np.testing.assert_allclose(
+            fleet.util.all_reduce(np.array([1.0, 2.0], "float32")),
+            [1.0, 2.0])
+
+    def test_distributed_split_helper(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 8).astype("float32"))
+        out = dist.split(x, (8, 4), operation="linear", axis=1)
+        assert out.shape == [3, 4]
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        emb = dist.split(ids, (16, 6), operation="embedding")
+        assert emb.shape == [1, 2, 6]
+
+    def test_split_validates_arguments(self):
+        import paddle_tpu.distributed as dist
+
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        with pytest.raises(ValueError, match="axis"):
+            dist.split(x, (4, 4), operation="linear", axis=2)
+        with pytest.raises(ValueError, match="num_partitions"):
+            dist.split(x, (4, 4), operation="linear", num_partitions=7)
+
+    def test_object_collectives_multirank_honest(self):
+        """world>1 object exchange raises the documented single-controller
+        error instead of crashing or silently no-oping half-way."""
+        import jax
+
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.mesh as mesh_mod
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            dp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        try:
+            with pytest.raises(NotImplementedError):
+                dist.all_gather_object([], {"a": 1})
+            with pytest.raises(NotImplementedError):
+                dist.scatter_object_list([], None, src=0)
+            dist.broadcast_object_list([{"k": 1}])  # no-op, any world
+        finally:
+            mesh_mod.set_mesh(None)
