@@ -1,10 +1,14 @@
 """Flagship model family (BASELINE.md configs 3/4/5)."""
 from .llama import (  # noqa: F401
-    GPTConfig,
-    GPTForCausalLM,
     LlamaConfig,
     LlamaForCausalLM,
     LlamaModel,
+)
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTDecoderLayer,
+    GPTForCausalLM,
+    GPTModel,
 )
 from .generation import generate, sample_logits  # noqa: F401
 from .trainer import build_train_step, place_model  # noqa: F401

@@ -1,0 +1,58 @@
+"""Shared causal-LM head/generation contract for the flagship model
+families (LLaMA, GPT): tied/untied vocab head, vocab-parallel loss,
+dense KV-cache allocation and the generate() entry — one implementation
+so the two models cannot drift."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+
+
+class CausalLMBase(nn.Layer):
+    """Subclass contract: set `self.config`, `self.lm_head` (None for a
+    tied head), `self.loss_fn`, and implement `_backbone_embed_weight()`
+    returning the [vocab, hidden] embedding parameter; expose
+    `forward_cached(input_ids, caches, cur_len)`."""
+
+    def _kv_heads(self):
+        cfg = self.config
+        return getattr(cfg, "num_key_value_heads",
+                       cfg.num_attention_heads)
+
+    def init_kv_caches(self, batch_size, max_length, dtype=None):
+        """Dense per-layer (k, v) caches for incremental decoding."""
+        cfg = self.config
+        dt = dtype or jnp.float32
+        shape = (batch_size, max_length, self._kv_heads(),
+                 cfg.hidden_size // cfg.num_attention_heads)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def generate(self, input_ids, max_length=None, max_new_tokens=None,
+                 decode_strategy="greedy_search", temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0,
+                 seed=None):
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_length=max_length,
+                         max_new_tokens=max_new_tokens,
+                         decode_strategy=decode_strategy,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id,
+                         pad_token_id=pad_token_id, seed=seed)
+
+    def _head(self, h):
+        if self.lm_head is None:
+            # tied head reuses the [vocab, hidden] embedding weight via a
+            # transposed matmul (reference: SharedLayerDesc tied embeddings)
+            from ..ops.linalg import matmul
+
+            return matmul(h, self._backbone_embed_weight(),
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    def compute_loss(self, logits, labels):
+        from ..ops.reduction import mean
+
+        return mean(self.loss_fn(logits, labels))

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from .. import nn
+from .causal_lm import CausalLMBase
 from ..distributed.fleet.layers.mpu import (
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -372,7 +373,7 @@ class LlamaModel(nn.Layer):
         return self.norm(h), new_caches
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(CausalLMBase):
     """Causal LM head; `compute_loss(logits-free)` keeps the vocab-parallel
     CE fused with the lm_head matmul under GSPMD."""
 
@@ -405,43 +406,8 @@ class LlamaForCausalLM(nn.Layer):
             active=active)
         return self._head(h), new_caches
 
-    def init_kv_caches(self, batch_size, max_length, dtype=None):
-        """Dense per-layer (k, v) caches for incremental decoding."""
-        import jax.numpy as _jnp
-
-        cfg = self.config
-        dt = dtype or _jnp.float32
-        shape = (batch_size, max_length, cfg.num_key_value_heads,
-                 cfg.hidden_size // cfg.num_attention_heads)
-        return [(_jnp.zeros(shape, dt), _jnp.zeros(shape, dt))
-                for _ in range(cfg.num_hidden_layers)]
-
-    def generate(self, input_ids, max_length=None, max_new_tokens=None,
-                 decode_strategy="greedy_search", temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0,
-                 seed=None):
-        from .generation import generate as _generate
-
-        return _generate(self, input_ids, max_length=max_length,
-                         max_new_tokens=max_new_tokens,
-                         decode_strategy=decode_strategy,
-                         temperature=temperature, top_k=top_k, top_p=top_p,
-                         eos_token_id=eos_token_id,
-                         pad_token_id=pad_token_id, seed=seed)
-
-    def _head(self, h):
-        if self.lm_head is None:
-            from ..ops.linalg import matmul
-
-            return matmul(h, self.llama.embed_tokens.weight,
-                          transpose_y=True)
-        return self.lm_head(h)
-
-    def compute_loss(self, logits, labels):
-        from ..ops.reduction import mean
-
-        loss = self.loss_fn(logits, labels)
-        return mean(loss)
+    def _backbone_embed_weight(self):
+        return self.llama.embed_tokens.weight
 
     # ------------------------------------------------------------------
     # pipeline decomposition (SURVEY.md §7 phase 8): embed / homogeneous
@@ -459,8 +425,3 @@ class LlamaForCausalLM(nn.Layer):
     def pp_head(self, hidden):
         return self._head(self.llama.norm(hidden))
 
-
-# GPT alias: same decoder architecture family, GPT-3-shaped config
-# (reference: PaddleNLP GPT trainer on the same fused stack)
-GPTConfig = LlamaConfig
-GPTForCausalLM = LlamaForCausalLM

@@ -1,0 +1,122 @@
+"""GPT family (BASELINE.md config 3; reference: PaddleNLP GPT trainer on
+the fused stack): architecture sanity, training convergence, eager-vs-
+cached decode parity, pipeline contract, TP mesh parity."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, build_train_step
+
+
+def _make(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = GPTConfig.tiny(**kw)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    return cfg, model, opt
+
+
+def test_forward_shapes_and_positions_matter():
+    cfg, model, _ = _make()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 8)))
+    model.eval()
+    out = model(x)
+    assert out.shape[0] == 2 and out.shape[1] == 8
+    # learned positions: permuting the sequence changes outputs even for
+    # the SAME token at the same index set (positional signal exists)
+    x2 = paddle.to_tensor(np.roll(x.numpy(), 1, axis=1))
+    out2 = model(x2)
+    assert not np.allclose(out.numpy(), out2.numpy())
+
+
+def test_training_converges():
+    cfg, model, opt = _make()
+    step = build_train_step(model, opt, mesh=None)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+    y = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_cached_decode_matches_full_forward():
+    cfg, model, _ = _make(seed=3)
+    model.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (1, 6))
+    full = model(paddle.to_tensor(ids)).numpy()
+
+    caches = model.init_kv_caches(1, 16)
+    logits, caches = model.forward_cached(
+        paddle.to_tensor(ids[:, :4]), caches, 0)
+    np.testing.assert_allclose(logits.numpy(), full[:, :4], rtol=2e-4,
+                               atol=2e-4)
+    # incremental: feed tokens 4 and 5 one at a time
+    for t in (4, 5):
+        logits, caches = model.forward_cached(
+            paddle.to_tensor(ids[:, t:t + 1]), caches, t)
+        np.testing.assert_allclose(logits.numpy()[:, 0], full[:, t],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy():
+    cfg, model, _ = _make(seed=5)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, cfg.vocab_size, (1, 4)))
+    out, _ = model.generate(ids, max_new_tokens=5,
+                            decode_strategy="greedy_search")
+    assert out.shape[1] == 5
+    assert (out.numpy() < cfg.vocab_size).all()
+
+
+def test_tp_mesh_loss_parity():
+    import jax
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 128, (4, 16)))
+    y = paddle.to_tensor(rng.randint(0, 128, (4, 16)))
+
+    _, model_s, opt_s = _make(seed=7)
+    step_s = build_train_step(model_s, opt_s, mesh=None)
+    serial = [float(step_s(x, y)) for _ in range(2)]
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+        dp=2, tp=2, devices=np.asarray(jax.devices("cpu")[:4])))
+    try:
+        _, model_p, opt_p = _make(seed=7)
+        step_p = build_train_step(model_p, opt_p, mesh=mesh)
+        par = [float(step_p(x, y)) for _ in range(2)]
+    finally:
+        mesh_mod.set_mesh(None)
+    np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
+
+
+def test_pp_pipeline_contract():
+    import jax
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 128, (8, 16)))
+    y = paddle.to_tensor(rng.randint(0, 128, (8, 16)))
+
+    _, model_s, opt_s = _make(seed=9, layers=4)
+    step_s = build_train_step(model_s, opt_s, mesh=None)
+    serial = [float(step_s(x, y)) for _ in range(2)]
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+        pp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+    try:
+        _, model_p, opt_p = _make(seed=9, layers=4)
+        step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                  num_microbatches=4)
+        par = [float(step_p(x, y)) for _ in range(2)]
+    finally:
+        mesh_mod.set_mesh(None)
+    np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
