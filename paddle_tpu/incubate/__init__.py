@@ -2,3 +2,11 @@
 experimental distributed models (MoE)."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from .segment_ops import (  # noqa: F401
+    graph_send_recv,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from . import autograd  # noqa: F401

@@ -1,0 +1,11 @@
+"""paddle.incubate.autograd (reference: python/paddle/incubate/autograd):
+the functional transforms, importable as a real submodule."""
+from ..autograd.functional import (  # noqa: F401
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)
+
+Jacobian = jacobian  # class-style aliases of the reference surface
+Hessian = hessian

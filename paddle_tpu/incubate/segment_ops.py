@@ -1,0 +1,99 @@
+"""Segment reductions + graph message passing
+(reference: python/paddle/incubate — segment_sum/mean/max/min,
+graph_send_recv): jax.ops.segment_* ARE the TPU-native kernels
+(sorted-scatter lowering on XLA)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def _resolve_segments(segment_ids, num_segments, opname):
+    """Paddle's API derives the segment count from the ids' VALUES, which
+    no traced program can do — under jit pass `num_segments` explicitly
+    (kept as an extension kwarg; eager matches paddle exactly)."""
+    ids = as_array(segment_ids)
+    if num_segments is not None:
+        return int(num_segments)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            f"{opname} under jit needs an explicit num_segments= (the "
+            "segment count depends on ids values, unknowable at trace "
+            "time)")
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _masked(reduce, d, s, n):
+    """Segment-reduce with paddle's empty-segment fill of ZERO (jax fills
+    with the monoid identity: +/-inf for min/max)."""
+    out = reduce(d, s, num_segments=n)
+    count = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                num_segments=n)
+    shape = (n,) + (1,) * (d.ndim - 1)
+    return jnp.where(count.reshape(shape) > 0, out, 0)
+
+
+def _segment(reduce, name, mask_empty):
+    def op(data, segment_ids, num_segments=None, name=None):  # noqa: A002
+        n = _resolve_segments(segment_ids, num_segments, op.__name__)
+
+        def f(d, s):
+            s = s.astype(jnp.int32)
+            if mask_empty:
+                return _masked(reduce, d, s, n)
+            return reduce(d, s, num_segments=n)
+
+        return _apply_op(f, data, segment_ids, _name=op.__name__)
+
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment(jax.ops.segment_sum, "segment_sum", False)
+segment_max = _segment(jax.ops.segment_max, "segment_max", True)
+segment_min = _segment(jax.ops.segment_min, "segment_min", True)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    n = _resolve_segments(segment_ids, num_segments, "segment_mean")
+
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        total = jax.ops.segment_sum(d, s, num_segments=n)
+        count = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                    num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return total / jnp.maximum(count.reshape(shape), 1)
+
+    return _apply_op(f, data, segment_ids, _name="segment_mean")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """paddle.incubate.graph_send_recv parity: gather messages from
+    src_index rows, reduce them at dst_index (the GNN scatter-gather)."""
+    di = as_array(dst_index)
+    n = int(out_size) if out_size is not None else (
+        int(as_array(x).shape[0]))
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+    if pool_type not in red:
+        raise ValueError(f"unsupported pool_type {pool_type!r}")
+
+    def f(xa, si, di_):
+        msgs = xa[si.astype(jnp.int32)]
+        d32 = di_.astype(jnp.int32)
+        if pool_type == "mean":
+            total = jax.ops.segment_sum(msgs, d32, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(d32, xa.dtype), d32,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (xa.ndim - 1)
+            return total / jnp.maximum(cnt.reshape(shape), 1)
+        if pool_type in ("max", "min"):
+            # paddle fills no-incoming-edge rows with 0, not +/-inf
+            return _masked(red[pool_type], msgs, d32, n)
+        return red[pool_type](msgs, d32, num_segments=n)
+
+    return _apply_op(f, x, src_index, dst_index, _name="graph_send_recv")

@@ -200,6 +200,19 @@ class GPTModel(nn.Layer):
 
     def _embed(self, input_ids, position_offset=0):
         s = input_ids.shape[1]
+        max_pos = self.config.max_position_embeddings
+        # learned positions end at max_position_embeddings: overflow would
+        # silently clamp to the last row (JAX gather semantics), so fail
+        # loudly wherever the overflow is statically knowable
+        if s > max_pos:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{max_pos}")
+        if isinstance(position_offset, int) and position_offset + s > max_pos:
+            raise ValueError(
+                f"position {position_offset + s} exceeds "
+                f"max_position_embeddings {max_pos} (shorten the prompt "
+                "or max_new_tokens, or raise max_position_embeddings)")
         # static-size arange + (possibly traced) offset: position_offset is
         # a tracer inside the jitted decode loop
         off = as_array(position_offset) if hasattr(position_offset, "_data") \

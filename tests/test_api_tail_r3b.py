@@ -360,3 +360,91 @@ class TestDistributedTail:
             dist.broadcast_object_list([{"k": 1}])  # no-op, any world
         finally:
             mesh_mod.set_mesh(None)
+
+
+class TestIncubateSegmentOps:
+    def test_segment_reductions(self):
+        from paddle_tpu import incubate as inc
+
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(inc.segment_sum(data, ids).numpy(),
+                                   [[4., 6.], [5., 6.]])
+        np.testing.assert_allclose(inc.segment_mean(data, ids).numpy(),
+                                   [[2., 3.], [5., 6.]])
+        np.testing.assert_allclose(inc.segment_max(data, ids).numpy(),
+                                   [[3., 4.], [5., 6.]])
+        np.testing.assert_allclose(inc.segment_min(data, ids).numpy(),
+                                   [[1., 2.], [5., 6.]])
+
+    def test_graph_send_recv_and_grad(self):
+        from paddle_tpu import incubate as inc
+
+        x = paddle.to_tensor(
+            np.array([[1., 1.], [2., 2.], [3., 3.]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2]))
+        dst = paddle.to_tensor(np.array([1, 2, 1]))
+        np.testing.assert_allclose(
+            inc.graph_send_recv(x, src, dst, "sum").numpy(),
+            [[0., 0.], [4., 4.], [2., 2.]])
+        np.testing.assert_allclose(
+            inc.graph_send_recv(x, src, dst, "mean").numpy(),
+            [[0., 0.], [2., 2.], [2., 2.]])
+        d = paddle.to_tensor(np.ones((3, 2), "float32"),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        inc.segment_sum(d, ids).sum().backward()
+        np.testing.assert_allclose(d.grad.numpy(), np.ones((3, 2)))
+
+    def test_incubate_autograd_alias(self):
+        from paddle_tpu import incubate as inc
+
+        j = inc.autograd.jacobian(
+            lambda t: t * t,
+            paddle.to_tensor(np.array([2.0], "float32")))
+        np.testing.assert_allclose(j.numpy(), [[4.0]])
+
+    def test_segment_reviews(self):
+        """Empty segments fill 0 (not inf); jit needs num_segments; name
+        kwarg accepted; incubate.autograd importable."""
+        import jax
+
+        from paddle_tpu import incubate as inc
+
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 2]))  # segment 1 empty
+        mx = inc.segment_max(data, ids, name="m").numpy()
+        np.testing.assert_allclose(mx[1], [0., 0.])  # paddle's zero fill
+        mn = inc.segment_min(data, ids).numpy()
+        np.testing.assert_allclose(mn[1], [0., 0.])
+        assert np.isfinite(mx).all() and np.isfinite(mn).all()
+
+        # under jit: explicit num_segments works; omission raises clearly
+        def f(d, s):
+            return inc.segment_sum(paddle.to_tensor(d),
+                                   paddle.to_tensor(s),
+                                   num_segments=3)._data
+
+        out = jax.jit(f)(data.numpy(), ids.numpy().astype(np.int32))
+        np.testing.assert_allclose(np.asarray(out)[0], [1., 2.])
+
+        def g(d, s):
+            return inc.segment_sum(paddle.to_tensor(d),
+                                   paddle.to_tensor(s))._data
+
+        with pytest.raises(ValueError, match="num_segments"):
+            jax.jit(g)(data.numpy(), ids.numpy().astype(np.int32))
+
+        import paddle_tpu.incubate.autograd as inc_ag
+
+        assert callable(inc_ag.jacobian) and callable(inc_ag.Hessian)
+
+    def test_gpt_position_overflow_raises(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig.tiny(seq=8))
+        model.eval()
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            model(paddle.to_tensor(np.zeros((1, 9), np.int64)))
