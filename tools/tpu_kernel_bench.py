@@ -157,6 +157,74 @@ def main():
               f"  full-grad {r['t_xla_bwd']/r['t_flash_bwd']:.2f}x"
               f"  vs-mixed {r['t_mixed_bwd']/r['t_flash_bwd']:.2f}x")
 
+    # --- paged decode + rms_norm: validate the OTHER two Pallas families
+    # on the real Mosaic compiler (round-2 verdict item 3 names all three)
+    extra = {}
+    try:
+        from paddle_tpu.kernels import paged_attention as pa
+
+        b_dec, kvh, hd, page, ppseq = 8, 8, 128, 16, 64  # 1024-token ctx
+        n_pages = b_dec * ppseq
+        key = jax.random.PRNGKey(1)
+        kq, kk2, kv2 = jax.random.split(key, 3)
+        qd = jax.random.normal(kq, (b_dec, kvh, hd), jnp.bfloat16)
+        kp = jax.random.normal(kk2, (kvh, n_pages, page, hd), jnp.bfloat16)
+        vp = jax.random.normal(kv2, (kvh, n_pages, page, hd), jnp.bfloat16)
+        tables = jnp.arange(n_pages, dtype=jnp.int32).reshape(b_dec, ppseq)
+        lens = jnp.full((b_dec,), page * ppseq - 3, jnp.int32)
+        f_pal = jax.jit(lambda *a: pa.paged_attention(*a))
+        f_xla = jax.jit(lambda *a: pa.paged_attention_xla(*a))
+        o_p = np.asarray(f_pal(qd, kp, vp, tables, lens), np.float32)
+        o_x = np.asarray(f_xla(qd, kp, vp, tables, lens), np.float32)
+        paged_err = float(np.max(np.abs(o_p - o_x)))
+        t_p = timeit(f_pal, qd, kp, vp, tables, lens)
+        t_x = timeit(f_xla, qd, kp, vp, tables, lens)
+        extra["paged_decode"] = dict(
+            err_vs_xla=paged_err, t_pallas_ms=t_p * 1e3,
+            t_xla_ms=t_x * 1e3, ctx=page * ppseq, batch=b_dec)
+        print(f"paged decode: err={paged_err:.4f} pallas {t_p*1e3:.3f}ms "
+              f"xla {t_x*1e3:.3f}ms ({t_x/t_p:.2f}x)")
+    except Exception as e:  # noqa: BLE001 — record, don't kill the sweep
+        extra["paged_decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"paged decode FAILED: {e}", file=sys.stderr)
+
+    try:
+        from paddle_tpu.kernels import rms_norm as rn
+
+        rows_n, cols_n = 8192, 4096
+        key = jax.random.PRNGKey(2)
+        xr = jax.random.normal(key, (rows_n, cols_n), jnp.bfloat16)
+        wr = jnp.ones((cols_n,), jnp.bfloat16)
+        f_pal = jax.jit(lambda x_, w_: rn.rms_norm(x_, w_))
+
+        def ref_rms(x_, w_):
+            xf = x_.astype(jnp.float32)
+            r = jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1,
+                                       keepdims=True) + 1e-6)
+            return (xf * r * w_.astype(jnp.float32)).astype(x_.dtype)
+
+        f_xla = jax.jit(ref_rms)
+        o_p = np.asarray(f_pal(xr, wr), np.float32)
+        o_x = np.asarray(f_xla(xr, wr), np.float32)
+        rms_err = float(np.max(np.abs(o_p - o_x)))
+        t_p = timeit(f_pal, xr, wr)
+        t_x = timeit(f_xla, xr, wr)
+        extra["rms_norm"] = dict(err_vs_xla=rms_err, t_pallas_ms=t_p * 1e3,
+                                 t_xla_ms=t_x * 1e3,
+                                 shape=[rows_n, cols_n])
+        print(f"rms_norm: err={rms_err:.5f} pallas {t_p*1e3:.3f}ms "
+              f"xla {t_x*1e3:.3f}ms ({t_x/t_p:.2f}x)")
+    except Exception as e:  # noqa: BLE001
+        extra["rms_norm"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"rms_norm FAILED: {e}", file=sys.stderr)
+
+    if args.json:
+        import json as _json
+
+        with open(args.json, "w") as f:
+            _json.dump({"backend": backend, "kernel": "flash_attention",
+                        "rows": rows, "extra": extra}, f, indent=1)
+
 
 if __name__ == "__main__":
     main()
