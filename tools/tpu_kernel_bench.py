@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import json
 import math
 import os
 import sys
@@ -74,6 +75,18 @@ def main():
 
     backend = jax.default_backend()
     print(f"backend={backend} devices={jax.devices()}", file=sys.stderr)
+
+    def _dump(path, backend_, rows_, extra_=None):
+        """Incremental JSON write: partial results survive a timeout kill
+        (the --json contract)."""
+        if not path:
+            return
+        payload = {"backend": backend_, "kernel": "flash_attention",
+                   "rows": rows_}
+        if extra_ is not None:
+            payload["extra"] = extra_
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
 
     seqs = [512, 1024, 2048] if args.quick else [512, 1024, 2048, 4096, 8192]
     b, h, d = 4, 8, 128
@@ -139,11 +152,7 @@ def main():
                          t_flash_fwd=t_flash_f * 1e3, t_xla_fwd=t_ref_f * 1e3,
                          t_flash_bwd=t_flash_b * 1e3, t_xla_bwd=t_ref_b * 1e3,
                          t_mixed_bwd=t_mixed_b * 1e3))
-        if args.json:
-            import json as _json
-            with open(args.json, "w") as f:
-                _json.dump({"backend": backend, "kernel": "flash_attention",
-                            "rows": rows}, f, indent=1)
+        _dump(args.json, backend, rows)
         r = rows[-1]
         print(f"seq={s:5d} b={b_eff}  fwd_err={fwd_err:.4f} "
               f"bwd_err={bwd_err:.4f}  "
@@ -172,8 +181,8 @@ def main():
         vp = jax.random.normal(kv2, (kvh, n_pages, page, hd), jnp.bfloat16)
         tables = jnp.arange(n_pages, dtype=jnp.int32).reshape(b_dec, ppseq)
         lens = jnp.full((b_dec,), page * ppseq - 3, jnp.int32)
-        f_pal = jax.jit(lambda *a: pa.paged_attention(*a))
-        f_xla = jax.jit(lambda *a: pa.paged_attention_xla(*a))
+        f_pal = jax.jit(pa.paged_attention)
+        f_xla = jax.jit(pa.paged_attention_xla)
         o_p = np.asarray(f_pal(qd, kp, vp, tables, lens), np.float32)
         o_x = np.asarray(f_xla(qd, kp, vp, tables, lens), np.float32)
         paged_err = float(np.max(np.abs(o_p - o_x)))
@@ -187,6 +196,7 @@ def main():
     except Exception as e:  # noqa: BLE001 — record, don't kill the sweep
         extra["paged_decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         print(f"paged decode FAILED: {e}", file=sys.stderr)
+    _dump(args.json, backend, rows, extra)
 
     try:
         from paddle_tpu.kernels import rms_norm as rn
@@ -195,7 +205,7 @@ def main():
         key = jax.random.PRNGKey(2)
         xr = jax.random.normal(key, (rows_n, cols_n), jnp.bfloat16)
         wr = jnp.ones((cols_n,), jnp.bfloat16)
-        f_pal = jax.jit(lambda x_, w_: rn.rms_norm(x_, w_))
+        f_pal = jax.jit(rn.rms_norm)
 
         def ref_rms(x_, w_):
             xf = x_.astype(jnp.float32)
@@ -217,13 +227,7 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["rms_norm"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         print(f"rms_norm FAILED: {e}", file=sys.stderr)
-
-    if args.json:
-        import json as _json
-
-        with open(args.json, "w") as f:
-            _json.dump({"backend": backend, "kernel": "flash_attention",
-                        "rows": rows, "extra": extra}, f, indent=1)
+    _dump(args.json, backend, rows, extra)
 
 
 if __name__ == "__main__":
