@@ -307,6 +307,61 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     print(json.dumps(result))
 
 
+def _piggyback_extra_configs():
+    """After the main metric line, also measure the ~0.74B model (and the
+    resnet/serving rows) in SUBPROCESSES, writing each result to
+    BENCH_EXTRA.json — so one successful driver session on the flaky
+    tunnel captures every BASELINE row, not just row 0. Budget-bounded;
+    stdout stays one line (children write to the file, logs to stderr)."""
+    import os
+    import subprocess
+
+    if os.environ.get("BENCH_EXTRA", "1") != "1":
+        return
+    import time as _time
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_EXTRA.json")
+    results = {}
+    # ONE shared deadline across all jobs (not per-job): the piggyback
+    # must never multiply the configured budget
+    deadline = _time.monotonic() + float(
+        os.environ.get("BENCH_EXTRA_BUDGET", "900"))
+    jobs = [("llama_1b", {"BENCH_CONFIG": "llama", "BENCH_MODEL": "1b"}),
+            ("resnet", {"BENCH_CONFIG": "resnet"}),
+            ("serving", {"BENCH_CONFIG": "serving"})]
+    for name, env_over in jobs:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 10:
+            results[name] = {"error": "shared BENCH_EXTRA_BUDGET exhausted"}
+        else:
+            env = dict(os.environ, BENCH_KERNELS="0", BENCH_EXTRA="0",
+                       BENCH_PROBE_RETRIES="1", **env_over)
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(here, "bench.py")],
+                    timeout=remaining, capture_output=True, text=True,
+                    env=env)
+                line = r.stdout.strip().splitlines()[-1] \
+                    if r.stdout.strip() else ""
+                results[name] = json.loads(line) if line else {
+                    "error": (r.stderr or "no output")[-400:]}
+            except subprocess.TimeoutExpired:
+                results[name] = {"error": f"timeout after {remaining:.0f}s"}
+            except Exception as e:  # noqa: BLE001
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:400]}
+        try:  # never let reporting kill the process after the metric line
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(results, f, indent=1)
+            os.replace(tmp, out_path)  # atomic: a kill never corrupts
+            print(f"extra config {name}: "
+                  f"{results[name].get('value', results[name].get('error'))}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"extra-config reporting failed: {e}", file=sys.stderr)
+
+
 def _piggyback_kernel_bench():
     """Round-2 verdict item 3: whenever the probe finds a usable chip, also
     run the Pallas kernel bench in the same bench session so the driver
@@ -340,6 +395,7 @@ if __name__ == "__main__":
         if PROBE_DIAG["attempts"] and \
                 PROBE_DIAG["attempts"][-1].get("outcome", "").startswith("ok"):
             _piggyback_kernel_bench()
+            _piggyback_extra_configs()
     except BaseException as e:  # noqa: BLE001 — always emit a parseable line
         print(json.dumps({
             "metric": "llama_train_tokens_per_sec_per_chip",

@@ -85,8 +85,10 @@ def main():
                    "rows": rows_}
         if extra_ is not None:
             payload["extra"] = extra_
-        with open(path, "w") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(payload, f, indent=1)
+        os.replace(tmp, path)  # atomic: a mid-write kill never corrupts
 
     seqs = [512, 1024, 2048] if args.quick else [512, 1024, 2048, 4096, 8192]
     b, h, d = 4, 8, 128
