@@ -146,7 +146,13 @@ class ParallelCrossEntropy(Layer):
             local = lab - lo
             in_range = (local >= 0) & (local < vocab_shard)
             safe = jnp.clip(local, 0, vocab_shard - 1)
-            picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+            # select-reduce, not take_along_axis: a data-dependent gather
+            # over the class axis trips the SPMD partitioner when another
+            # auto axis shards it (see nn/functional/loss.py _pick_class)
+            cls = jax.lax.broadcasted_iota(jnp.int32, shifted.shape,
+                                           shifted.ndim - 1)
+            picked = jnp.sum(jnp.where(cls == safe[..., None], shifted, 0.0),
+                             axis=-1, keepdims=True)
             picked = jnp.where(in_range[..., None], picked, 0.0)
             picked = jax.lax.psum(picked, "tp")
             return logz - picked

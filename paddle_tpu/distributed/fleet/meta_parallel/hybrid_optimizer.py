@@ -41,6 +41,16 @@ class HybridParallelOptimizer:
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer
         self._hcg = hcg
+        # strategy.gradient_merge -> consumed by models.build_train_step
+        # (jit path: accumulate k calls, apply on the k-th — the reference
+        # GradientMergeOptimizer contract)
+        self._gradient_merge_k = 1
+        self._gradient_merge_avg = True
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            cfg = getattr(strategy, "gradient_merge_configs", {})
+            self._gradient_merge_k = int(cfg.get("k_steps", 1))
+            self._gradient_merge_avg = bool(cfg.get("avg", True))
         if optimizer._grad_clip is not None and isinstance(
                 optimizer._grad_clip, ClipGradByGlobalNorm):
             optimizer._grad_clip = HybridParallelClipGrad(

@@ -45,14 +45,49 @@ from .sharding_utils import clean_spec as _clean_spec
 from .sharding_utils import get_param_spec
 
 
-def _pcast_varying(x, axis_name):
-    """Mark x as varying over the manual axis (scan carry requirement).
-    Idempotent: already-varying values pass through (pcast rejects
-    varying->varying with a ValueError)."""
+def _pcast_varying(x, axes):
+    """Mark x as varying over the manual axis/axes (scan carry
+    requirement). Idempotent per axis: only the axes x is not already
+    varying over are cast (pcast rejects varying->varying)."""
+    if isinstance(axes, str):
+        axes = (axes,)
     try:
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        cur = getattr(jax.typeof(x), "vma", frozenset())
+    except Exception:  # noqa: BLE001 — non-tracer values have no aval
+        cur = frozenset()
+    need = tuple(a for a in axes if a not in cur)
+    if not need:
+        return x
+    try:
+        return jax.lax.pcast(x, need, to="varying")
     except (AttributeError, TypeError, ValueError):
         return x
+
+
+def _manual_batch_axes(mesh, axis_name):
+    """Mesh axes folded into the pipeline shard_map's manual set beyond pp.
+
+    With >= 2 GSPMD-auto axes alive alongside the manual pp axis, XLA's
+    SPMD partitioner either CHECK-fails (spmd_partitioner_util.cc:495 —
+    minimal repro: tools/xla_gather_spmd_repro.py) or places tp collectives
+    inside the device-varying head `lax.cond`, where only the last stage's
+    devices execute them (collective-permute rendezvous deadlock, observed
+    on dp2 x pp2 x tp2). Folding the batch-like axes into the manual set
+    leaves at most ONE auto axis (tp/sp) — the regime the partitioner
+    handles — and makes the dp grad sync one explicit psum instead of a
+    per-tick GSPMD choice.
+
+    Returns (data_axes, inert_axes): data_axes shard the microbatch rows
+    manually (explicit psum of grads/loss at the end); inert_axes (the
+    ZeRO 'sharding' axis) carry no in-scan data — every value stays
+    invariant over them, they are folded in only so the partitioner never
+    sees them as a second auto axis.
+    """
+    data_axes = tuple(a for a in ("dp",) if a in mesh.axis_names
+                      and int(mesh.shape[a]) > 1)
+    inert_axes = tuple(a for a in ("sharding",) if a in mesh.axis_names
+                       and int(mesh.shape[a]) > 1)
+    return data_axes, inert_axes
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
@@ -519,6 +554,19 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
         return (jnp.mean(losses), tm(lambda a: jnp.sum(a, 0), d_sps),
                 tm(lambda a: jnp.sum(a, 0), d_hps), d_xs)
 
+    data_axes, inert_axes = _manual_batch_axes(mesh, axis_name)
+    manual_axes = (axis_name,) + data_axes + inert_axes
+    vary = (axis_name,) + data_axes
+    dp_total = int(np.prod([int(mesh.shape[a]) for a in data_axes],
+                           dtype=np.int64)) if data_axes else 1
+    mb_rows = jax.tree_util.tree_leaves(microbatches)[0].shape[1]
+    if mb_rows % dp_total:
+        raise ValueError(
+            f"VPP shards each microbatch's {mb_rows} rows over the dp "
+            f"axes {data_axes} (size {dp_total}) inside the schedule; pick "
+            f"batch/num_microbatches so rows-per-microbatch divides dp")
+    inv_scale = np.float32(1.0 / (M * dp_total))
+
     sched = _vpp_schedule(S, v, M)
     T, B = int(sched["T"]), int(sched["B"])
     tick_rows = {k: jnp.asarray(a) for k, a in sched.items()
@@ -527,30 +575,34 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
     def inner(local_params, inputs, head_params, targets):
         stage = jax.lax.axis_index(axis_name)
         is_last = stage == S - 1
-        local_params = tm(lambda p: p[0], local_params)  # [v, ...]
-        head_params = tm(lambda p: _pcast_varying(p, axis_name), head_params)
+        # params arrive invariant over the manual data axes; cast them
+        # varying so the vjps accumulate per-device partials (ONE psum at
+        # the end) instead of transposing to a psum every tick
+        local_params = tm(lambda p: _pcast_varying(p[0], vary),
+                          local_params)  # [v, ...]
+        head_params = tm(lambda p: _pcast_varying(p, vary), head_params)
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [((i + 1) % S, i) for i in range(S)]
 
         def zeros_mb():
             return tm(lambda x: _pcast_varying(
-                jnp.zeros_like(x[0]), axis_name), inputs)
+                jnp.zeros_like(x[0]), vary), inputs)
 
         def zeros_buf():
             return tm(lambda x: _pcast_varying(
-                jnp.zeros((v, B) + x.shape[1:], x.dtype), axis_name), inputs)
+                jnp.zeros((v, B) + x.shape[1:], x.dtype), vary), inputs)
 
         carry0 = dict(
             fwd_c=zeros_mb(), bwd_c=zeros_mb(),
             recv_buf=zeros_buf(), remat_buf=zeros_buf(),
             cot_buf=zeros_buf(),
             d_params=tm(lambda p: _pcast_varying(
-                jnp.zeros(p.shape, jnp.float32), axis_name), local_params),
+                jnp.zeros(p.shape, jnp.float32), vary), local_params),
             d_head=tm(lambda p: _pcast_varying(
-                jnp.zeros(p.shape, jnp.float32), axis_name), head_params),
+                jnp.zeros(p.shape, jnp.float32), vary), head_params),
             d_inputs=tm(lambda x: _pcast_varying(
-                jnp.zeros_like(x), axis_name), inputs),
-            loss=_pcast_varying(jnp.zeros((), jnp.float32), axis_name),
+                jnp.zeros_like(x), vary), inputs),
+            loss=_pcast_varying(jnp.zeros((), jnp.float32), vary),
         )
 
         def at_set(buf, j, slot, val, valid):
@@ -597,15 +649,15 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
 
                 loss_m, head_vjp = jax.vjp(head_loss, head_params, y_)
                 d_hp_m, d_y = head_vjp(_pcast_varying(
-                    jnp.asarray(inv_m, loss_m.dtype), axis_name))
+                    jnp.asarray(inv_scale, loss_m.dtype), vary))
                 return loss_m.astype(jnp.float32), d_hp_m, d_y
 
             def skip_head(y_):
-                zl = _pcast_varying(jnp.zeros((), jnp.float32), axis_name)
+                zl = _pcast_varying(jnp.zeros((), jnp.float32), vary)
                 zh = tm(lambda p: _pcast_varying(
-                    jnp.zeros(p.shape, p.dtype), axis_name), head_params)
+                    jnp.zeros(p.shape, p.dtype), vary), head_params)
                 zy = tm(lambda a: _pcast_varying(
-                    jnp.zeros_like(a), axis_name), y_)
+                    jnp.zeros_like(a), vary), y_)
                 return zl, zh, zy
 
             loss_m, d_hp_m, d_y = jax.lax.cond(head_valid, do_head,
@@ -657,26 +709,33 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
             return c, None
 
         carry, _ = jax.lax.scan(tick, carry0, tick_rows)
-        loss = jax.lax.psum(carry["loss"], axis_name) * inv_m
-        d_head = tm(lambda a: jax.lax.psum(a, axis_name), carry["d_head"])
+        # one psum over pp + the manual data axes: the pp loss gather and
+        # the dp gradient all-reduce in a single explicit collective each
+        loss = jax.lax.psum(carry["loss"], vary) * inv_scale
+        d_head = tm(lambda a: jax.lax.psum(a, vary), carry["d_head"])
+        d_params = carry["d_params"]
+        if data_axes:
+            d_params = tm(lambda a: jax.lax.psum(a, data_axes), d_params)
         d_params = tm(lambda a, p: a.astype(p.dtype)[None],
-                      carry["d_params"], local_params)
+                      d_params, local_params)
         d_inputs = tm(lambda a: a[None], carry["d_inputs"])
         return loss, d_params, d_head, d_inputs
 
+    dp_spec = data_axes if data_axes else None
     stacked_spec = tm(lambda _: P(axis_name), stage_params)
-    data_spec = tm(lambda _: P(), microbatches)
+    data_spec = tm(lambda _: P(None, dp_spec), microbatches)
     head_spec = tm(lambda _: P(), head_params)
-    tgt_spec = tm(lambda _: P(), targets)
+    tgt_spec = tm(lambda _: P(None, dp_spec), targets)
     loss, d_params, d_head, d_inputs_stacked = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(stacked_spec, data_spec, head_spec, tgt_spec),
         out_specs=(P(), stacked_spec, head_spec,
-                   tm(lambda _: P(axis_name), microbatches)),
-        axis_names=frozenset({axis_name}),
+                   tm(lambda _: P(axis_name, None, dp_spec), microbatches)),
+        axis_names=frozenset(manual_axes),
     )(stage_params, microbatches, head_params, targets)
     d_head = tm(lambda a, p: a.astype(p.dtype), d_head, head_params)
+    # stage 0's shard holds the input cotangents — one-shard gather
     d_inputs = tm(lambda a: a[0], d_inputs_stacked)
     return loss, d_params, d_head, d_inputs
 

@@ -312,7 +312,8 @@ def not_to_static(fn):
 
 def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
                model_call: Optional[Callable] = None, sharding_stage=0,
-               mesh=None):
+               mesh=None, gradient_merge_steps: int = 1,
+               gradient_merge_avg: bool = True):
     """Build a compiled train step: step(inputs, *labels) -> loss.
 
     `model_call(model, inputs)` defaults to `model(inputs)`;
@@ -328,9 +329,18 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
       3   — params are STORED zero-sharded; the forward constrains them
             back to their compute spec (all-gather on use), and updated
             params are constrained to the stored layout again.
+
+    gradient_merge_steps (reference GradientMergeOptimizer /
+    strategy.gradient_merge k_steps, SURVEY.md §2.2 meta-optimizers): when
+    k > 1, each call accumulates grads into a persistent f32 buffer and
+    only every k-th call applies the (avg'd when gradient_merge_avg)
+    merged grad — k successive calls on batch B match one step on batch
+    k*B. The branch is a jit-compiled lax.cond, so the step stays ONE
+    XLA program regardless of k.
     """
     opt_state_holder = {"state": None}
     call = model_call or (lambda m, x: m(x))
+    k_merge = max(int(gradient_merge_steps), 1)
 
     grad_shardings = {}
     stored_shardings = {}
@@ -358,6 +368,19 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
 
     def pure_step(params, buffers, opt_state, lr, seed, arg_leaves, structure):
         stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+        (loss, new_buffers), grads = _loss_and_grads(
+            params, buffers, stream, arg_leaves, structure)
+        if sharding_stage >= 2:
+            grads = _constrain(grads, grad_shardings)
+        new_params, new_opt_state = optimizer.apply_gradients_functional(
+            params, grads, opt_state, lr
+        )
+        if stored_shardings:
+            new_params = _constrain(new_params, stored_shardings)
+        return loss, new_params, new_buffers, new_opt_state
+
+    def _loss_and_grads(params, buffers, stream, arg_leaves, structure):
+        """Shared fwd+bwd closure of both pure steps."""
 
         def compute_loss(p):
             from ..autograd import tape as _tape
@@ -383,23 +406,55 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
                 _tls.tracing = False
             return as_array(loss_t), new_buffers
 
-        (loss, new_buffers), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(params)
-        if sharding_stage >= 2:
-            grads = _constrain(grads, grad_shardings)
-        new_params, new_opt_state = optimizer.apply_gradients_functional(
-            params, grads, opt_state, lr
-        )
-        if stored_shardings:
-            new_params = _constrain(new_params, stored_shardings)
-        return loss, new_params, new_buffers, new_opt_state
+        return jax.value_and_grad(compute_loss, has_aux=True)(params)
 
-    jitted = jax.jit(
-        pure_step,
-        static_argnames=("structure",),
-        donate_argnums=(0, 2) if donate else (),
-    )
+    def pure_step_merge(params, buffers, opt_state, accum, count, lr, seed,
+                        arg_leaves, structure):
+        """gradient_merge variant: accumulate, apply every k_merge-th call."""
+        stream = _random.KeyStream(jax.random.wrap_key_data(seed))
+        (loss, new_buffers), grads = _loss_and_grads(
+            params, buffers, stream, arg_leaves, structure)
+        accum = {n: accum[n] + grads[n].astype(accum[n].dtype)
+                 for n in accum}
+        if sharding_stage >= 2:
+            # keep the carried accumulator in the zero-sharded grad layout
+            # (reduce-scattered once per micro-call, shard-local between)
+            accum = _constrain(accum, grad_shardings)
+        count = count + 1
+
+        def apply(params, opt_state, accum):
+            scale = jnp.float32(1.0 / k_merge if gradient_merge_avg else 1.0)
+            merged = {n: (a * scale).astype(params[n].dtype)
+                      for n, a in accum.items()}
+            if sharding_stage >= 2:
+                merged = _constrain(merged, grad_shardings)
+            new_params, new_opt = optimizer.apply_gradients_functional(
+                params, merged, opt_state, lr)
+            if stored_shardings:
+                new_params = _constrain(new_params, stored_shardings)
+            zeros = {n: jnp.zeros_like(a) for n, a in accum.items()}
+            return new_params, new_opt, zeros, jnp.zeros_like(count)
+
+        def skip(params, opt_state, accum):
+            return params, opt_state, accum, count
+
+        new_params, new_opt, new_accum, new_count = jax.lax.cond(
+            count >= k_merge, apply, skip, params, opt_state, accum)
+        return loss, new_params, new_buffers, new_opt, new_accum, new_count
+
+    if k_merge > 1:
+        jitted = jax.jit(
+            pure_step_merge,
+            static_argnames=("structure",),
+            donate_argnums=(0, 2, 3, 4) if donate else (),
+        )
+    else:
+        jitted = jax.jit(
+            pure_step,
+            static_argnames=("structure",),
+            donate_argnums=(0, 2) if donate else (),
+        )
+    merge_holder = {"accum": None, "count": None}
 
     def step(*args, **kwargs):
         params = model.parameters_pytree()
@@ -409,10 +464,30 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
         lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
         seed = jax.random.key_data(_random.next_key())
         leaves, structure = flatten_call(args, kwargs)
-        loss, new_params, new_buffers, new_opt = jitted(
-            params, buffers, opt_state_holder["state"], lr, seed, leaves,
-            structure,
-        )
+        if k_merge > 1:
+            if merge_holder["accum"] is None:
+                # accumulators live in the grad layout (zero-sharded at
+                # stage>=2, else the param's own sharding) — a replicated
+                # f32 copy of every param would defeat ZeRO's memory story
+                def _accum_zeros(n, p):
+                    z = jnp.zeros(p.shape, jnp.float32)
+                    s = grad_shardings.get(n) if grad_shardings else \
+                        getattr(p, "sharding", None)
+                    return jax.device_put(z, s) if s is not None else z
+
+                merge_holder["accum"] = {
+                    n: _accum_zeros(n, p) for n, p in params.items()}
+                merge_holder["count"] = jnp.zeros((), jnp.int32)
+            (loss, new_params, new_buffers, new_opt, merge_holder["accum"],
+             merge_holder["count"]) = jitted(
+                params, buffers, opt_state_holder["state"],
+                merge_holder["accum"], merge_holder["count"], lr, seed,
+                leaves, structure)
+        else:
+            loss, new_params, new_buffers, new_opt = jitted(
+                params, buffers, opt_state_holder["state"], lr, seed, leaves,
+                structure,
+            )
         opt_state_holder["state"] = new_opt
         model.load_pytree(new_params)
         model.load_pytree(new_buffers)

@@ -147,18 +147,20 @@ def build_pipeline_train_step(model: Layer, optimizer,
     elif v < 1:
         raise ValueError(f"virtual_pp_degree must be >= 1, got {v}")
     if schedule == "vpp" and v > 1 and _VPP_THREE_AXIS_GUARD:
+        # dp + sharding are folded into the VPP shard_map's manual axis set
+        # (pipeline._manual_batch_axes), so the full dp x pp x tp hybrid
+        # compiles; what remains guarded is >= 2 *non-batch* auto axes
+        # (e.g. tp AND sp both >1): XLA's SPMD partitioner CHECK-fails
+        # (spmd_partitioner_util.cc:495, repro tools/xla_gather_spmd_repro
+        # .py) or deadlocks collectives inside the head cond there.
         auto_axes = [a for a in mesh.axis_names
-                     if a != "pp" and int(mesh.shape[a]) > 1]
+                     if a not in ("pp", "dp", "sharding")
+                     and int(mesh.shape[a]) > 1]
         if len(auto_axes) >= 2:
-            # XLA's SPMD partitioner CHECK-fails (spmd_partitioner_util.cc
-            # ExpandDeviceGroupsWithIota) partitioning the VPP scan when two
-            # GSPMD-auto axes are live alongside the manual pp axis; pp+tp
-            # and pp+dp both partition fine. Guard until the upstream bug is
-            # fixed rather than crash deep inside XLA.
             raise NotImplementedError(
-                f"schedule='vpp' currently supports one non-pp mesh axis; "
-                f"got {auto_axes}. Use pp x tp or pp x dp, or "
-                f"schedule='1f1b' for the full hybrid.")
+                f"schedule='vpp' currently supports one non-batch auto "
+                f"mesh axis; got {auto_axes}. Use schedule='1f1b' for "
+                f"this mesh.")
     if len(layers) % (S * v):
         raise ValueError(
             f"{len(layers)} layers not divisible by pp*vpp={S}*{v}")
@@ -171,17 +173,29 @@ def build_pipeline_train_step(model: Layer, optimizer,
 
     def _resolve_m(batch):
         if num_microbatches is None:
+            # vpp additionally needs M % pp == 0 (Megatron microbatch
+            # groups) and rows-per-microbatch divisible by dp (the vpp
+            # schedule shards microbatch rows manually over dp —
+            # pipeline._manual_batch_axes)
+            dp_div = 1
+            if schedule == "vpp":
+                data_axes, _ = _pipe._manual_batch_axes(mesh, "pp")
+                for a in data_axes:
+                    dp_div *= int(mesh.shape[a])
             m = None
             for cand in range(min(2 * S, batch), 0, -1):
-                if batch % cand == 0 and (schedule != "vpp" or cand % S == 0):
+                if batch % cand == 0 and (
+                        schedule != "vpp"
+                        or (cand % S == 0 and (batch // cand) % dp_div == 0)):
                     m = cand
                     break
             if m is None:  # only reachable for vpp (cand=1 matches otherwise)
                 raise ValueError(
                     f"schedule='vpp' needs num_microbatches to be a "
-                    f"multiple of pp={S} that divides the batch; batch "
-                    f"{batch} has no such divisor <= {2 * S} — pick a "
-                    f"batch size divisible by pp or pass num_microbatches")
+                    f"multiple of pp={S} with rows-per-microbatch "
+                    f"divisible by dp={dp_div}; batch {batch} has no such "
+                    f"divisor <= {2 * S} — adjust the batch size or pass "
+                    f"num_microbatches")
             mb_holder["M"] = m
         return mb_holder["M"]
     template = layers[0]
@@ -362,7 +376,8 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                      num_microbatches: Optional[int] = None,
                      sharding_stage: Optional[int] = None,
                      pipeline_schedule: Optional[str] = None,
-                     virtual_pp_degree: int = 1):
+                     virtual_pp_degree: int = 1,
+                     gradient_merge_steps: Optional[int] = None):
     """Compiled hybrid-parallel step(input_ids, labels) -> loss Tensor.
 
     criterion defaults to model.compute_loss (vocab-parallel CE for the
@@ -372,9 +387,20 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
     sharding_stage: ZeRO stage (1/2/3) over the sharding/dp axis; defaults
     to the optimizer wrapper's .stage (DygraphShardingOptimizer /
     group_sharded_parallel) or 1. See jit.train_step for the stage
-    semantics."""
+    semantics.
+
+    gradient_merge_steps (reference GradientMergeOptimizer /
+    strategy.gradient_merge): accumulate k calls' grads, apply on the
+    k-th. Defaults to the fleet optimizer wrapper's strategy setting
+    (HybridParallelOptimizer._gradient_merge_k) or 1. The pipeline path
+    accumulates over microbatches already; combining it with
+    gradient_merge is rejected rather than silently double-scaled."""
     if sharding_stage is None:
         sharding_stage = getattr(optimizer, "stage", 1)
+    if gradient_merge_steps is None:
+        gradient_merge_steps = int(getattr(
+            optimizer, "_gradient_merge_k", 1))
+    merge_avg = bool(getattr(optimizer, "_gradient_merge_avg", True))
     # unwrap the eager sharding facade: under jit the stage IS the layout
     inner_opt = getattr(optimizer, "_inner_opt", optimizer)
     mesh = mesh or _mesh.get_mesh(optional=True)
@@ -382,13 +408,20 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         criterion = model.compute_loss
     if (mesh is not None and "pp" in mesh.axis_names
             and int(mesh.shape["pp"]) > 1 and hasattr(model, "pp_layers")):
+        if gradient_merge_steps > 1:
+            raise NotImplementedError(
+                "gradient_merge with the pipeline schedule: raise "
+                "num_microbatches instead (the pipeline accumulates "
+                "microbatch grads inside the schedule already)")
         return build_pipeline_train_step(
             model, inner_opt, criterion=criterion, mesh=mesh,
             num_microbatches=num_microbatches, donate=donate,
             sharding_stage=sharding_stage, schedule=pipeline_schedule,
             virtual_pp_degree=virtual_pp_degree)
     step = _jit.train_step(model, criterion, inner_opt, donate=donate,
-                           sharding_stage=sharding_stage, mesh=mesh)
+                           sharding_stage=sharding_stage, mesh=mesh,
+                           gradient_merge_steps=gradient_merge_steps,
+                           gradient_merge_avg=merge_avg)
 
     if mesh is None:
         return step

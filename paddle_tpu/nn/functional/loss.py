@@ -21,6 +21,24 @@ def _reduce(out, reduction, weight_sum=None):
     return out
 
 
+def _pick_class(logp, label, axis):
+    """logp[..., label, ...] along `axis` as a select-reduce, not a gather.
+
+    A data-dependent `take_along_axis` over the class axis CHECK-fails
+    XLA's SPMD partitioner (spmd_partitioner_util.cc:495) when the class
+    dim is tp-sharded inside a manual shard_map (repro:
+    tools/xla_gather_spmd_repro.py — the construct that blocked VPP on the
+    full hybrid mesh). The masked reduction partitions cleanly — each
+    vocab shard contributes its local range and the partitioner inserts
+    the psum, which is exactly the reference
+    c_softmax_with_cross_entropy algorithm — and XLA fuses the
+    iota/compare/select into the reduce, so nothing is materialized."""
+    ax = axis % logp.ndim
+    classes = jax.lax.broadcasted_iota(jnp.int32, logp.shape, ax)
+    mask = classes == jnp.expand_dims(label, ax)
+    return jnp.sum(jnp.where(mask, logp, 0), axis=ax)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
                   name=None):
@@ -53,10 +71,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             jnp.maximum(logits, 1e-30))
         valid = lab_i != ignore_index
         safe_lab = jnp.where(valid, lab_i, 0)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe_lab, axis), axis=axis
-        )
-        nll = -jnp.squeeze(picked, axis=axis)
+        nll = -_pick_class(logp, safe_lab, axis)
         if label_smoothing > 0:
             k = logits.shape[axis]
             smooth = -jnp.mean(logp, axis=axis)
@@ -100,9 +115,7 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
         lab_i = lab.astype(jnp.int32)
         valid = lab_i != ignore_index
         safe = jnp.where(valid, lab_i, 0)
-        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lab_i.ndim + 1
-                                     else safe, axis=1 if logp.ndim > 1 else 0)
-        nll = -jnp.squeeze(picked, axis=1) if picked.ndim > lab_i.ndim else -picked
+        nll = -_pick_class(logp, safe, 1 if logp.ndim > 1 else 0)
         if w:
             cw = jnp.take(w[0], safe)
             nll = jnp.where(valid, nll * cw, 0.0)

@@ -172,9 +172,11 @@ class TestVppTrainStep:
                                          parameters=model.parameters())
             return model, opt
 
+        # 16 rows: the vpp schedule shards each microbatch's rows over dp
+        # manually, so batch/num_microbatches must leave rows % dp == 0
         rng = np.random.RandomState(1)
-        x = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
-        y = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+        x = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
+        y = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
 
         model_s, opt_s = make()
         step_s = build_train_step(model_s, opt_s, mesh=None)
@@ -197,14 +199,55 @@ class TestVppTrainStep:
             mesh_mod.set_mesh(None)
         np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
 
-    def test_three_auto_axes_guarded(self):
-        """dp x pp x tp + vpp trips an XLA GSPMD bug; we guard with a clear
-        error instead of a partitioner CHECK crash."""
+    def test_full_hybrid_dp_pp_tp_parity(self):
+        """dp2 x pp2 x tp2 + vpp — the round-3 verdict item 2 config. The
+        batch axes fold into the schedule's manual shard_map axes
+        (pipeline._manual_batch_axes) so XLA's partitioner only sees one
+        auto axis (tp); loss parity vs the serial step proves the manual
+        dp sharding + explicit grad psum are correct."""
+        def make(seed=5):
+            paddle.seed(seed)
+            cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=2,
+                                   seq=16)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            return model, opt
+
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
+        y = paddle.to_tensor(rng.randint(0, 64, (16, 16)))
+
+        model_s, opt_s = make()
+        step_s = build_train_step(model_s, opt_s, mesh=None)
+        serial = [float(step_s(x, y)) for _ in range(3)]
+
         mesh_mod.set_mesh(None)
         import jax
 
         mesh = mesh_mod.set_mesh(
             mesh_mod.build_mesh(dp=2, pp=2, tp=2,
+                                devices=np.asarray(jax.devices("cpu"))))
+        try:
+            model_p, opt_p = make()
+            step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                      num_microbatches=8,
+                                      pipeline_schedule="vpp",
+                                      virtual_pp_degree=2)
+            par = [float(step_p(x, y)) for _ in range(3)]
+        finally:
+            mesh_mod.set_mesh(None)
+        np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
+        assert par[-1] < par[0]
+
+    def test_two_nonbatch_auto_axes_guarded(self):
+        """tp AND sp both >1 under vpp remains guarded (the partitioner
+        bug needs >= 2 non-batch auto axes; batch axes are folded manual)."""
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(
+            mesh_mod.build_mesh(pp=2, tp=2, sp=2,
                                 devices=np.asarray(jax.devices("cpu"))))
         try:
             paddle.seed(0)

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# TPU bench watcher (round-3 verdict item 1): probe the axon tunnel every
+# PROBE_INTERVAL seconds; the first time a real chip answers, run the full
+# bench suite (bench.py piggybacks KERNEL_BENCH.json + BENCH_EXTRA.json on
+# success) and exit. Artifacts land at the repo root so a mid-session tunnel
+# revival is banked even if nobody is watching.
+#
+# Usage: tools/bench_watch.sh [max_seconds]   (default: 10 hours)
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/bench_watch.log
+MAX_SECONDS=${1:-36000}
+PROBE_INTERVAL=${PROBE_INTERVAL:-240}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
+START=$(date +%s)
+
+log() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+log "watcher start: interval=${PROBE_INTERVAL}s probe_timeout=${PROBE_TIMEOUT}s max=${MAX_SECONDS}s"
+ATTEMPT=0
+while :; do
+  NOW=$(date +%s)
+  if [ $((NOW - START)) -ge "$MAX_SECONDS" ]; then
+    log "budget exhausted after $ATTEMPT probes; no TPU this session"
+    exit 1
+  fi
+  ATTEMPT=$((ATTEMPT + 1))
+  OUT=$(timeout "$PROBE_TIMEOUT" python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((128,128), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print('PROBE_OK', jax.default_backend(), len(d))" 2>&1)
+  RC=$?
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -q "PROBE_OK tpu"; then
+    log "probe $ATTEMPT: TPU LIVE — $(echo "$OUT" | grep PROBE_OK)"
+    break
+  fi
+  log "probe $ATTEMPT: down (rc=$RC) $(echo "$OUT" | tail -1 | cut -c1-120)"
+  sleep "$PROBE_INTERVAL"
+done
+
+# Chip is live: bank everything. bench.py's main run (row 0) piggybacks the
+# kernel sweep (KERNEL_BENCH.json) and the 1b/resnet/serving rows
+# (BENCH_EXTRA.json) after its one-line JSON.
+log "running bench.py full capture..."
+BENCH_PROBE_RETRIES=2 BENCH_PROBE_TIMEOUT=150 \
+  BENCH_EXTRA_BUDGET=1500 BENCH_KERNEL_BUDGET=1200 \
+  python bench.py > BENCH_WATCH.json 2>>"$LOG"
+log "bench.py done rc=$?: $(cat BENCH_WATCH.json | cut -c1-200)"
+log "artifacts: BENCH_WATCH.json KERNEL_BENCH.json BENCH_EXTRA.json"
+exit 0
