@@ -91,7 +91,7 @@ def _manual_batch_axes(mesh, axis_name):
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
-                  mesh=None, axis_name: str = "pp"):
+                  mesh=None, axis_name: str = "pp", stage_buffers=None):
     """Run `stage_fn` as an S-stage pipeline over `axis_name`.
 
     Args:
@@ -103,65 +103,91 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
         leading dim is sharded over `axis_name` (each stage sees its block).
       microbatches: [M, ...] array (or pytree of such) of per-microbatch
         inputs to stage 0; replicated over `axis_name`.
+      stage_buffers: optional stacked buffer pytree (stack_layer_buffers,
+        leading dim sharded like stage_params). When given, stage_fn has
+        the (params, buffers, x) -> (y, new_buffers) signature
+        (make_stage_fn_with_buffers) and the schedule carries buffer
+        updates (BN running stats) microbatch to microbatch, returning
+        the updated stack alongside the outputs.
 
-    Returns [M, ...] outputs of the last stage, read out of the schedule as
-    a one-shard gather of the last stage's pp-sharded tick window (a
-    consumer on another device pays one transfer on access; there is no
-    all-reduce of the output volume).
+    Returns [M, ...] outputs of the last stage (a one-shard gather of the
+    last stage's pp-sharded tick window — no all-reduce of the output
+    volume), or (outputs, new_stage_buffers) when stage_buffers is given.
     """
+    tm = jax.tree_util.tree_map
     mesh = mesh or _mesh.get_mesh()
     S = int(mesh.shape[axis_name])
     if S == 1:
-        def run_one(mb):
-            return stage_fn(stage_params, mb)
+        if stage_buffers is None:
+            def run_one(mb):
+                return stage_fn(stage_params, mb)
 
-        return jax.lax.map(run_one, microbatches)
+            return jax.lax.map(run_one, microbatches)
+
+        def one(bufs, mb):
+            y, nb = stage_fn(stage_params, bufs, mb)
+            return nb, y
+
+        new_bufs, ys = jax.lax.scan(one, stage_buffers, microbatches)
+        return ys, new_bufs
 
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     T = M + S - 1
 
-    def inner(local_params, inputs):
+    def inner(local_params, inputs, local_bufs):
         stage = jax.lax.axis_index(axis_name)
-        zero = jax.tree_util.tree_map(
-            lambda x: _pcast_varying(jnp.zeros_like(x[0]), axis_name), inputs)
+        zero = tm(lambda x: _pcast_varying(jnp.zeros_like(x[0]), axis_name),
+                  inputs)
         perm = [(i, (i + 1) % S) for i in range(S)]
+        bufs0 = tm(lambda b: _pcast_varying(b, axis_name), local_bufs) \
+            if stage_buffers is not None else {}
 
-        def tick(state, t):
+        def tick(carry, t):
+            state, bufs = carry
             idx = jnp.clip(t, 0, M - 1)
-            fresh = jax.tree_util.tree_map(lambda x: x[idx], inputs)
-            x = jax.tree_util.tree_map(
-                lambda f, s: jnp.where(stage == 0, f, s), fresh, state)
-            y = stage_fn(local_params, x)
-            nxt = jax.tree_util.tree_map(
-                lambda a: jax.lax.ppermute(a, axis_name, perm), y)
-            return nxt, y
+            fresh = tm(lambda x: x[idx], inputs)
+            x = tm(lambda f, s: jnp.where(stage == 0, f, s), fresh, state)
+            if stage_buffers is None:
+                y = stage_fn(local_params, x)
+            else:
+                y, nb = stage_fn(local_params, bufs, x)
+                # garbage fill/drain ticks must not pollute running stats
+                m = t - stage
+                valid = (m >= 0) & (m < M)
+                bufs = tm(lambda old, new: jnp.where(valid, new, old),
+                          bufs, nb)
+            nxt = tm(lambda a: jax.lax.ppermute(a, axis_name, perm), y)
+            return (nxt, bufs), y
 
-        _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
+        (_, bufs), ys = jax.lax.scan(tick, (zero, bufs0), jnp.arange(T))
         # ticks S-1 .. T-1 on the LAST stage hold the pipeline outputs;
         # emit them pp-stacked ([1, M, ...] per stage) so the caller reads
         # the last stage's shard directly — a one-shard gather, NOT an
         # all-reduce of the full output volume
-        window = jax.tree_util.tree_map(lambda a: a[S - 1:][None], ys)
-        return window
+        window = tm(lambda a: a[S - 1:][None], ys)
+        return window, bufs
 
     # manual over pp only; tp/dp/sp remain GSPMD-auto inside the stage
-    stacked_spec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stage_params)
-    data_spec = jax.tree_util.tree_map(lambda _: P(), microbatches)
-    stacked_out = jax.shard_map(
+    stacked_spec = tm(lambda _: P(axis_name), stage_params)
+    data_spec = tm(lambda _: P(), microbatches)
+    buf_arg = stage_buffers if stage_buffers is not None else {}
+    buf_spec = tm(lambda _: P(axis_name), buf_arg)
+    stacked_out, new_bufs = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(stacked_spec, data_spec),
-        out_specs=jax.tree_util.tree_map(
-            lambda _: P(axis_name), microbatches),
+        in_specs=(stacked_spec, data_spec, buf_spec),
+        out_specs=(tm(lambda _: P(axis_name), microbatches), buf_spec),
         axis_names=frozenset({axis_name}),
-    )(stage_params, microbatches)
-    return jax.tree_util.tree_map(lambda a: a[-1], stacked_out)
+    )(stage_params, microbatches, buf_arg)
+    outs = tm(lambda a: a[-1], stacked_out)
+    if stage_buffers is None:
+        return outs
+    return outs, new_bufs
 
 
 def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
                        head_params, targets, *, mesh=None,
-                       axis_name: str = "pp"):
+                       axis_name: str = "pp", stage_buffers=None):
     """Interleaved 1F1B train schedule in ONE compiled scan.
 
     The reference's host-orchestrated 1F1B (`PipelineParallel.train_batch`,
@@ -200,6 +226,13 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
       d_head_params — grads of head_params (from the last stage);
       d_inputs — [M, ...] cotangents w.r.t. microbatches (from stage 0),
         for the caller to backprop into the embedding.
+    With stage_buffers (stacked BN-stat pytree; stage_fn then has the
+    (params, buffers, x) -> (y, new_buffers) signature), the schedule
+    carries buffer updates microbatch-to-microbatch in forward order and a
+    fifth output — the updated buffer stack — is appended. The backward
+    remat recomputes the stage forward with the CURRENT running stats,
+    which is gradient-exact because train-mode normalization uses batch
+    stats (running stats are pure outputs).
     """
     mesh = mesh or _mesh.get_mesh()
     S = int(mesh.shape[axis_name])
@@ -208,26 +241,46 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
     inv_m = np.float32(1.0 / M)
 
     if S == 1:
-        def one(m):
+        if stage_buffers is None:
+            def one(m):
+                mb = tm(lambda x: x[m], microbatches)
+                tgt = tm(lambda t: t[m], targets)
+
+                def loss_of(sp, hp, x):
+                    return head_fn(hp, stage_fn(sp, x), tgt)
+
+                loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+                d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
+                return loss_m, d_sp, d_hp, d_x
+
+            losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+            d_sp = tm(lambda a: jnp.sum(a, axis=0), d_sps)
+            d_hp = tm(lambda a: jnp.sum(a, axis=0), d_hps)
+            return jnp.mean(losses), d_sp, d_hp, d_xs
+
+        def one_b(bufs, m):
             mb = tm(lambda x: x[m], microbatches)
             tgt = tm(lambda t: t[m], targets)
 
             def loss_of(sp, hp, x):
-                return head_fn(hp, stage_fn(sp, x), tgt)
+                y, nb = stage_fn(sp, bufs, x)
+                return head_fn(hp, y, tgt), nb
 
-            loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+            loss_m, vjp, nb = jax.vjp(loss_of, stage_params, head_params,
+                                      mb, has_aux=True)
             d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
-            return loss_m, d_sp, d_hp, d_x
+            return nb, (loss_m, d_sp, d_hp, d_x)
 
-        losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+        new_bufs, (losses, d_sps, d_hps, d_xs) = jax.lax.scan(
+            one_b, stage_buffers, jnp.arange(M))
         d_sp = tm(lambda a: jnp.sum(a, axis=0), d_sps)
         d_hp = tm(lambda a: jnp.sum(a, axis=0), d_hps)
-        return jnp.mean(losses), d_sp, d_hp, d_xs
+        return jnp.mean(losses), d_sp, d_hp, d_xs, new_bufs
 
     T = M + 2 * (S - 1)
     B = 2 * S - 1  # max in-flight stage inputs (1F1B bound)
 
-    def inner(local_params, inputs, head_params, targets):
+    def inner(local_params, inputs, head_params, targets, local_bufs):
         stage = jax.lax.axis_index(axis_name)
         is_last = stage == S - 1
         # head_params arrive pp-INVARIANT; vjp of an invariant input
@@ -248,9 +301,10 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
         dh0 = tm(lambda p: _pcast_varying(
             jnp.zeros(p.shape, jnp.float32), axis_name), head_params)
         loss0 = _pcast_varying(jnp.zeros((), jnp.float32), axis_name)
+        bufs0 = tm(lambda b: _pcast_varying(b, axis_name), local_bufs)
 
         def tick(carry, t):
-            buf, fwd_c, bwd_c, d_params, d_head, loss_acc = carry
+            buf, fwd_c, bwd_c, d_params, d_head, loss_acc, bn_bufs = carry
 
             # ---- forward slot ----
             m_f = t - stage
@@ -261,7 +315,13 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
             slot_f = idx_f % B
             buf = tm(lambda b_, x_: b_.at[slot_f].set(
                 jnp.where(fwd_valid, x_, b_[slot_f])), buf, x)
-            y = stage_fn(local_params, x)
+            if stage_buffers is None:
+                y = stage_fn(local_params, x)
+            else:
+                y, nb = stage_fn(local_params, bn_bufs, x)
+                # fill/drain ticks run on garbage activations — keep stats
+                bn_bufs = tm(lambda old, new: jnp.where(fwd_valid, new, old),
+                             bn_bufs, nb)
 
             # ---- head (+ initial cotangent), ONLY at the last stage ----
             # lax.cond with a device-varying predicate: non-last stages
@@ -302,7 +362,12 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
             slot_b = idx_b % B
             x_saved = tm(lambda b_: b_[slot_b], buf)
             g_in = tm(lambda dy, c: jnp.where(is_last, dy, c), d_y, bwd_c)
-            _, stage_vjp = jax.vjp(stage_fn, local_params, x_saved)
+            if stage_buffers is None:
+                fwd_for_vjp = stage_fn
+            else:
+                def fwd_for_vjp(p, xx):
+                    return stage_fn(p, jax.lax.stop_gradient(bn_bufs), xx)[0]
+            _, stage_vjp = jax.vjp(fwd_for_vjp, local_params, x_saved)
             d_p_m, d_x = stage_vjp(g_in)
             d_params = tm(lambda a, g: a + jnp.where(
                 bwd_valid, g.astype(jnp.float32), 0.0), d_params, d_p_m)
@@ -313,35 +378,40 @@ def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, head_fn,
             fwd_c = tm(lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
             bwd_c = tm(lambda a: jax.lax.ppermute(a, axis_name, bwd_perm),
                        d_x)
-            return (buf, fwd_c, bwd_c, d_params, d_head, loss_acc), d_x
+            return (buf, fwd_c, bwd_c, d_params, d_head, loss_acc,
+                    bn_bufs), d_x
 
-        init = (buf0, mb_zero, mb_zero, dp0, dh0, loss0)
+        init = (buf0, mb_zero, mb_zero, dp0, dh0, loss0, bufs0)
         carry, dxs = jax.lax.scan(tick, init, jnp.arange(T))
-        _, _, _, d_params, d_head, loss_acc = carry
+        _, _, _, d_params, d_head, loss_acc, bn_bufs = carry
 
         # stage 0 emits d_inputs on ticks 2S-2 .. T-1 (microbatch order)
         d_inputs = tm(lambda a: a[2 * S - 2:][None], dxs)
         loss = jax.lax.psum(loss_acc, axis_name) * inv_m  # mean over M
         d_head = tm(lambda a: jax.lax.psum(a, axis_name), d_head)
         d_params = tm(lambda a, p: a.astype(p.dtype), d_params, local_params)
-        return loss, d_params, d_head, d_inputs
+        return loss, d_params, d_head, d_inputs, bn_bufs
 
     stacked_spec = tm(lambda _: P(axis_name), stage_params)
     data_spec = tm(lambda _: P(), microbatches)
     head_spec = tm(lambda _: P(), head_params)
     tgt_spec = tm(lambda _: P(), targets)
-    loss, d_params, d_head, d_inputs_stacked = jax.shard_map(
+    buf_arg = stage_buffers if stage_buffers is not None else {}
+    buf_spec = tm(lambda _: P(axis_name), buf_arg)
+    loss, d_params, d_head, d_inputs_stacked, new_bufs = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec),
+        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec, buf_spec),
         out_specs=(P(), stacked_spec, head_spec,
-                   tm(lambda _: P(axis_name), microbatches)),
+                   tm(lambda _: P(axis_name), microbatches), buf_spec),
         axis_names=frozenset({axis_name}),
-    )(stage_params, microbatches, head_params, targets)
+    )(stage_params, microbatches, head_params, targets, buf_arg)
     d_head = tm(lambda a, p: a.astype(p.dtype), d_head, head_params)
     # stage 0's shard holds the input cotangents — one-shard gather
     d_inputs = tm(lambda a: a[0], d_inputs_stacked)
-    return loss, d_params, d_head, d_inputs
+    if stage_buffers is None:
+        return loss, d_params, d_head, d_inputs
+    return loss, d_params, d_head, d_inputs, new_bufs
 
 
 # ---------------------------------------------------------------------------
@@ -514,6 +584,14 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
     dim 0 is sharded over `axis_name`, dim 1 indexes the rank's chunks —
     local chunk j is global logical stage j*S + r.  `stage_fn` receives one
     chunk's params (the [S, v] dims stripped).
+
+    dp caveat: with dp folded into the manual axis set
+    (`_manual_batch_axes`), the global loss is the EQUAL-WEIGHT mean of
+    per-dp-shard means. For a plain mean criterion this is exact; for a
+    masked mean (ignore_index / class weights) whose valid counts differ
+    across dp shards it deviates from the global-valid-count mean — the
+    same per-rank-mean semantics as the reference's distributed CE. Use
+    schedule='1f1b' if exact masked-mean semantics across dp are required.
 
     Returns (loss, d_stage_params, d_head_params, d_inputs) exactly like
     `spmd_pipeline_1f1b` (d_stage_params in the same [S, v] layout).
@@ -795,6 +873,26 @@ def stack_layer_params(layers: Sequence) -> Dict[str, jax.Array]:
     }
 
 
+def stack_layer_buffers(layers: Sequence) -> Dict[str, jax.Array]:
+    """Stack the BUFFERS (BN running stats etc.) of homogeneous layers:
+    suffix -> [L, ...]. Empty dict when the layers carry no buffers."""
+    trees = [dict(l.named_buffers()) for l in layers]
+    names = list(trees[0].keys())
+    for t in trees[1:]:
+        if list(t.keys()) != names:
+            raise ValueError("pipeline stages must be homogeneous layers")
+    return {
+        n: jnp.stack([t[n]._data for t in trees]) for n in names
+    }
+
+
+def unstack_buffers_into_layers(stacked: Dict[str, jax.Array],
+                                layers: Sequence):
+    """Inverse of `stack_layer_buffers` (post-step write-back)."""
+    for i, layer in enumerate(layers):
+        layer.load_pytree({n: a[i] for n, a in stacked.items()})
+
+
 def stacked_param_specs(layers: Sequence, mesh, axis_name: str = "pp"
                         ) -> Dict[str, P]:
     """Sharding spec per stacked suffix: ('pp', *layer-param spec)."""
@@ -831,6 +929,42 @@ def make_stage_fn(template_layer, call: Optional[Callable] = None):
 
         h, _ = jax.lax.scan(body, x, local_params)
         return h
+
+    return stage_fn
+
+
+def make_stage_fn_with_buffers(template_layer,
+                               call: Optional[Callable] = None):
+    """Buffer-tracking stage_fn: (local_params, local_buffers, x) ->
+    (y, new_local_buffers).
+
+    The module's buffer updates (BN running stats rebind themselves during
+    forward — nn/functional/norm.py batch_norm) are read back per layer
+    and emitted as the scan's stacked output, so the schedule can carry
+    them microbatch to microbatch — the reference PipelineLayer's
+    sequential-stat semantics. The template's own buffer bindings are
+    restored after the scan so no in-scan tracer leaks into the enclosing
+    trace (the old gpipe failure mode for BN-in-stage models)."""
+    from ..tensor import Tensor, as_array
+
+    call = call or (lambda mod, x: mod(x))
+
+    def stage_fn(local_params, local_buffers, x):
+        saved = {n: b._data for n, b in template_layer.named_buffers()}
+
+        def body(h, pb):
+            layer_params, layer_bufs = pb
+            template_layer.load_pytree(layer_params)
+            template_layer.load_pytree(layer_bufs)
+            out = call(template_layer, Tensor(h))
+            new_bufs = {n: as_array(b)
+                        for n, b in template_layer.named_buffers()}
+            return as_array(out), new_bufs
+
+        h, new_stack = jax.lax.scan(body, x, (local_params, local_buffers))
+        for n, b in template_layer.named_buffers():
+            b._rebind(saved[n])
+        return h, new_stack
 
     return stage_fn
 

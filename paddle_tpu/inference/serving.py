@@ -53,10 +53,19 @@ class ServingEngine:
 
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
                  decode_strategy="greedy_search", temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, seed=0):
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         self.model = model
+        # TP-sharded serving (reference: fused_multi_transformer_op with
+        # mp_degree>1, SURVEY.md §2.1): params lay out per their GSPMD
+        # specs, KV pages shard over tp on the kv-head dim, and the decode
+        # step's paged attention runs in a shard_map manual over tp
+        # (models.llama.forward_paged) — each chip owns its heads' pages.
+        from ..distributed import mesh as _mesh_mod
+
+        self.mesh = mesh if mesh is not None else _mesh_mod.get_mesh(
+            optional=True)
         self.cfg = model.config
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
@@ -84,6 +93,23 @@ class ServingEngine:
                                   kv_dtype) for _ in range(L)]
         self.v_pages = [jnp.zeros((kvh, n_pages, page_size, hd),
                                   kv_dtype) for _ in range(L)]
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..models.trainer import place_model
+
+            place_model(model, self.mesh)
+            tp = int(self.mesh.shape["tp"]) \
+                if "tp" in self.mesh.axis_names else 1
+            if tp > 1 and kvh % tp:
+                raise ValueError(
+                    f"TP serving shards the {kvh} kv heads over tp={tp}; "
+                    f"the kv-head count must be divisible by tp")
+            self._page_sharding = NamedSharding(
+                self.mesh, P("tp") if tp > 1 else P())
+            self._pin_pages()
+        else:
+            self._page_sharding = None
         self.block_tables = np.zeros((max_batch, self.pages_per_seq),
                                      np.int32)
         self.slots = [_Slot() for _ in range(max_batch)]
@@ -99,6 +125,15 @@ class ServingEngine:
         # mutating model weights
         self._params = None
         self._buffers = None
+
+    def _pin_pages(self):
+        """Lay the page pools out in the serving sharding (kv heads over
+        tp); a no-op without a mesh."""
+        if self._page_sharding is not None:
+            self.k_pages = [jax.device_put(p, self._page_sharding)
+                            for p in self.k_pages]
+            self.v_pages = [jax.device_put(p, self._page_sharding)
+                            for p in self.v_pages]
 
     def _cached_params(self):
         if self._params is None:
@@ -257,6 +292,9 @@ class ServingEngine:
             self.k_pages[li], self.v_pages[li] = _pa.prefill_paged_kv_cache(
                 self.k_pages[li], self.v_pages[li],
                 ks[li][:n], vs[li][:n], tables, lens)
+        # re-pin: the eager scatter can drop the kv-head tp sharding, and
+        # the decode jit donates pages in this layout
+        self._pin_pages()
         first_np = np.asarray(first)  # [nb] ints — tiny transfer
         for row, (si, _) in enumerate(new):
             self.slots[si]._first_token = int(first_np[row])
@@ -274,13 +312,15 @@ class ServingEngine:
         strategy = self.decode_strategy
         temp, tk, tp = self.temperature, self.top_k, self.top_p
 
+        serving_mesh = self.mesh
+
         def pure_decode(params, buffers, k_pages, v_pages, tokens, tables,
                         lens, active, seed):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
                 caches = list(zip(k_pages, v_pages))
                 logits, new_caches = model.forward_paged(
                     Tensor(tokens[:, None]), caches, tables, lens,
-                    active=active)
+                    active=active, mesh=serving_mesh)
                 key = jax.random.wrap_key_data(seed)
                 nxt, lp = sample_logits(as_array(logits)[:, 0], key,
                                         strategy, temp, tk, tp)

@@ -184,7 +184,7 @@ class LlamaAttention(nn.Layer):
         return self.o_proj(out)
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None):
+                      context_lens, active=None, mesh=None):
         """Single-token decode over a paged KV cache (serving path,
         SURVEY.md §7 phase 10). hidden_states: [b, 1, hidden];
         paged_cache: (k_pages, v_pages) [kv_heads, n_pages, page_size, d];
@@ -221,10 +221,38 @@ class LlamaAttention(nn.Layer):
             out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
             return out[:, None], kp2, vp2
 
+        import jax as _jax
         import jax.numpy as _jnp
+        from jax.sharding import PartitionSpec as _P
+
+        from ..distributed import mesh as _mesh
+        from ..distributed.sharding_utils import in_manual_region
+
+        # TP-sharded decode (reference: fused_multi_transformer_op's
+        # mp_degree serving config — SURVEY.md §2.1): attention is
+        # embarrassingly parallel over heads, so the step runs inside a
+        # shard_map manual over tp — q/k/v shard on the head dim, the KV
+        # page pools on their kv-head dim, ZERO collectives inside. This
+        # is also what lets the Pallas decode kernel run multi-chip: each
+        # tp rank launches it on its local heads.
+        run = step
+        if mesh is None:  # engine-provided mesh wins over the global one
+            mesh = _mesh.get_mesh(optional=True)
+        tp = int(mesh.shape["tp"]) if mesh is not None \
+            and "tp" in mesh.axis_names else 1
+        if tp > 1 and not in_manual_region() \
+                and self.num_kv_heads % tp == 0:
+            hs = _P(None, None, "tp")      # [b, 1, heads, hd]
+            ps = _P("tp")                  # [kvh, n_pages, page, hd]
+            rs = _P()
+            run = _jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(hs, hs, hs, ps, ps, rs, rs, rs),
+                out_specs=(hs, ps, ps),
+                axis_names=frozenset({"tp"}))
 
         out, new_k, new_v = _apply_op(
-            step, q, k, v, Tensor(as_array(k_pages)),
+            run, q, k, v, Tensor(as_array(k_pages)),
             Tensor(as_array(v_pages)), Tensor(as_array(block_tables)),
             Tensor(as_array(context_lens)),
             Tensor(_jnp.broadcast_to(_jnp.asarray(act, bool), (b,))),
@@ -322,11 +350,12 @@ class LlamaDecoderLayer(nn.Layer):
         return residual + h2, new_cache
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None):
+                      context_lens, active=None, mesh=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         h, new_cache = self.self_attn.forward_paged(
-            h, paged_cache, block_tables, context_lens, active=active)
+            h, paged_cache, block_tables, context_lens, active=active,
+            mesh=mesh)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
@@ -363,12 +392,13 @@ class LlamaModel(nn.Layer):
         return self.norm(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None):
+                      context_lens, active=None, mesh=None):
         h = self.embed_tokens(input_ids)
         new_caches = []
         for layer, cache in zip(self.layers, paged_caches):
             h, nc = layer.forward_paged(h, cache, block_tables,
-                                        context_lens, active=active)
+                                        context_lens, active=active,
+                                        mesh=mesh)
             new_caches.append(nc)
         return self.norm(h), new_caches
 
@@ -400,10 +430,10 @@ class LlamaForCausalLM(CausalLMBase):
         return self._head(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None):
+                      context_lens, active=None, mesh=None):
         h, new_caches = self.llama.forward_paged(
             input_ids, paged_caches, block_tables, context_lens,
-            active=active)
+            active=active, mesh=mesh)
         return self._head(h), new_caches
 
     def _backbone_embed_weight(self):

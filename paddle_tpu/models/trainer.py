@@ -121,23 +121,50 @@ def build_pipeline_train_step(model: Layer, optimizer,
     layers = model.pp_layers()
     S = int(mesh.shape["pp"])
     v = int(virtual_pp_degree)
+    # buffers (BN running stats) in the STAGE layers ride the 1f1b/gpipe
+    # schedules as stacked carried state (pipeline.stack_layer_buffers);
+    # the vpp scan does not thread them yet. Buffers OUTSIDE the stage
+    # layers: embed-region updates are captured on the 1f1b path (vjp
+    # aux), but HEAD-region updates are not (the head runs inside the
+    # schedule's masked cond) — models with non-stage buffers therefore
+    # default to gpipe, whose autodiff path updates all of them.
+    has_layer_buffers = bool(dict(layers[0].named_buffers()))
+    layer_buf_ids = {id(b) for l in layers for _, b in l.named_buffers()}
+    rest_buf_names = [n for n, b in model.named_buffers()
+                      if id(b) not in layer_buf_ids]
     if schedule is None:
-        # the 1f1b/vpp paths do not track buffer (BN-stat) updates inside
-        # the schedule; models with buffers keep the autodiff path even
-        # when virtual_pp_degree asks for vpp (explicit schedule="vpp"
-        # overrides, accepting frozen buffer stats)
-        if dict(model.named_buffers()):
+        if rest_buf_names:
             schedule = "gpipe"
             if v > 1:
                 import warnings
 
                 warnings.warn(
                     "virtual_pp_degree>1 ignored: the model has buffers "
-                    "(BN stats) which the vpp schedule does not update; "
-                    "pass pipeline_schedule='vpp' explicitly to accept "
-                    "frozen buffers", UserWarning)
+                    "outside its pp layers (head/embed BN stats), which "
+                    "only the gpipe schedule fully updates; pass "
+                    "pipeline_schedule explicitly to override",
+                    UserWarning)
+        elif has_layer_buffers and v > 1:
+            import warnings
+
+            warnings.warn(
+                "virtual_pp_degree>1 ignored: the vpp schedule does not "
+                "thread stage buffers (BN stats) yet; using 1f1b, which "
+                "does", UserWarning)
+            schedule = "1f1b"
         else:
             schedule = "vpp" if v > 1 else "1f1b"
+    if schedule == "vpp" and has_layer_buffers:
+        raise NotImplementedError(
+            "schedule='vpp' does not thread stage buffers (BN stats); "
+            "use '1f1b' or 'gpipe' for models with buffered pp layers")
+    if schedule in ("1f1b", "vpp") and rest_buf_names:
+        import warnings
+
+        warnings.warn(
+            f"schedule={schedule!r}: buffer updates in the HEAD region "
+            f"are not tracked (frozen stats for {rest_buf_names[:3]}...); "
+            f"use 'gpipe' if those must update", UserWarning)
     if schedule not in ("1f1b", "gpipe", "vpp"):
         raise ValueError(
             f"unknown pipeline schedule {schedule!r}; "
@@ -203,7 +230,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
         id(p) for l in layers for _, p in l.named_parameters()}
     rest_names = [n for n, p in model.named_parameters()
                   if id(p) not in layer_param_ids]
-    stage_fn = _pipe.make_stage_fn(template)
+    stage_fn = _pipe.make_stage_fn_with_buffers(template) \
+        if has_layer_buffers else _pipe.make_stage_fn(template)
     # stacked keys carry layer-0's FULL name so name-based optimizer rules
     # (decay exclusion by 'norm'/'bias' suffix) keep working; per-layer
     # distinctions necessarily collapse (all layers share one stacked array)
@@ -245,6 +273,15 @@ def build_pipeline_train_step(model: Layer, optimizer,
     repl = NamedSharding(mesh, P())
     for _, b in model.named_buffers():
         b._rebind(jax.device_put(b._data, repl))
+    # stage-layer buffers (BN running stats) are CARRIED STATE of the
+    # schedule: stacked [L, ...] pp-sharded like the params and threaded
+    # through the scan (the reference's PipelineLayer updates BN stats per
+    # microbatch — SURVEY.md §2.2 "PP"; round-3 verdict item 5)
+    stacked_layer_bufs = {}
+    if has_layer_buffers:
+        stacked_layer_bufs = {
+            n: jax.device_put(a, NamedSharding(mesh, P("pp")))
+            for n, a in _pipe.stack_layer_buffers(layers).items()}
 
     # ZeRO layouts over the pipeline step's flat param dict (single source
     # of stage semantics: sharding_optimizer.stage_shardings)
@@ -261,7 +298,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
         return {n: jax.lax.with_sharding_constraint(a, shardings[n])
                 if n in shardings else a for n, a in tree.items()}
 
-    def _gpipe_loss_and_grads(params, buffers, stream, x, y):
+    def _gpipe_loss_and_grads(params, buffers, layer_bufs, stream, x, y):
         def loss_of(params):
             if sharding_stage >= 3:
                 params = _constrain(params, compute_shardings)
@@ -272,28 +309,38 @@ def build_pipeline_train_step(model: Layer, optimizer,
                 h = model.pp_embed(Tensor(x))
                 h_arr = h._data
                 mb = _pipe.microbatch(h_arr, mb_holder["M"])
-                outs = _pipe.spmd_pipeline(
-                    stage_fn, stacked, mb, mesh=mesh)
+                if has_layer_buffers:
+                    outs, new_layer_bufs = _pipe.spmd_pipeline(
+                        stage_fn, stacked, mb, mesh=mesh,
+                        stage_buffers=layer_bufs)
+                else:
+                    outs = _pipe.spmd_pipeline(
+                        stage_fn, stacked, mb, mesh=mesh)
+                    new_layer_bufs = {}
                 full = outs.reshape((h_arr.shape[0],) + h_arr.shape[1:])
                 logits = model.pp_head(Tensor(full))
                 loss = criterion(logits, Tensor(y))
                 new_buffers = scope.new_buffers()
-            return loss._data, new_buffers
+            return loss._data, (new_buffers, new_layer_bufs)
 
-        (loss, new_buffers), grads = jax.value_and_grad(
+        (loss, (new_buffers, new_layer_bufs)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
-        return loss, new_buffers, grads
+        return loss, new_buffers, grads, new_layer_bufs
 
-    def _1f1b_loss_and_grads(params, buffers, stream, x, y):
+    def _1f1b_loss_and_grads(params, buffers, layer_bufs, stream, x, y):
         if sharding_stage >= 3:
             params = _constrain(params, compute_shardings)
         rest = {n: params[n] for n in rest_names}
         stacked = {n: params[_skey(n)] for n in stacked_names}
         with _tape.no_grad(), _random.with_key_stream(stream):
             def embed_fn(rest_p):
-                with _LayerScope(model, rest_p, buffers):
+                # embed-region buffer updates (a conv-BN stem) are captured
+                # as vjp aux; HEAD-region buffer updates stay frozen (the
+                # head runs inside the schedule's masked cond)
+                with _LayerScope(model, rest_p, buffers) as scope:
                     h = model.pp_embed(Tensor(x))
-                return h._data
+                    nb = scope.new_buffers()
+                return h._data, nb
 
             def head_fn(rest_p, y_act, tgt):
                 # runs at the LAST stage inside the pp-manual shard_map;
@@ -305,13 +352,19 @@ def build_pipeline_train_step(model: Layer, optimizer,
                     loss = criterion(logits, Tensor(tgt))
                 return loss._data
 
-            h, embed_vjp = jax.vjp(embed_fn, rest)
+            h, embed_vjp, embed_bufs = jax.vjp(embed_fn, rest, has_aux=True)
             mb = _pipe.microbatch(h, mb_holder["M"])
             tgts = _pipe.microbatch(y, mb_holder["M"])
+            new_layer_bufs = {}
             if schedule == "vpp":
                 loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_vpp(
                     stage_fn, stacked, mb, head_fn, rest, tgts,
                     num_chunks=v, mesh=mesh)
+            elif has_layer_buffers:
+                (loss, d_stacked, d_rest_head, d_mb,
+                 new_layer_bufs) = _pipe.spmd_pipeline_1f1b(
+                    stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh,
+                    stage_buffers=layer_bufs)
             else:
                 loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_1f1b(
                     stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh)
@@ -319,23 +372,30 @@ def build_pipeline_train_step(model: Layer, optimizer,
         grads = {_skey(n): d_stacked[n] for n in stacked_names}
         for n in rest_names:
             grads[n] = d_rest_embed[n] + d_rest_head[n]
-        return loss, {}, grads
+        return loss, embed_bufs, grads, new_layer_bufs
 
-    def pure_step(params, buffers, opt_state, lr, seed, x, y):
+    def pure_step(params, buffers, layer_bufs, opt_state, lr, seed, x, y):
         stream = _random.KeyStream(jax.random.wrap_key_data(seed))
         fn = _gpipe_loss_and_grads if schedule == "gpipe" \
             else _1f1b_loss_and_grads
-        loss, new_buffers, grads = fn(params, buffers, stream, x, y)
+        loss, new_buffers, grads, new_layer_bufs = fn(
+            params, buffers, layer_bufs, stream, x, y)
         if sharding_stage >= 2:
             grads = _constrain(grads, grad_shardings)
         new_params, new_opt = optimizer.apply_gradients_functional(
             params, grads, opt_state, lr)
         new_params = _constrain(new_params, stored_shardings)
-        return loss, new_buffers, new_params, new_opt
+        return loss, new_buffers, new_params, new_opt, new_layer_bufs
 
-    jitted = jax.jit(pure_step, donate_argnums=(0, 2) if donate else ())
-    holder = {"params": flat_params, "opt_state": None}
-    data_sharding = NamedSharding(mesh, _clean_spec(("dp", None), mesh))
+    jitted = jax.jit(pure_step, donate_argnums=(0, 2, 3) if donate else ())
+    holder = {"params": flat_params, "opt_state": None,
+              "layer_bufs": stacked_layer_bufs}
+
+    def _data_put(a):
+        # batch dim over dp, rest replicated — spec sized to the array's
+        # rank (labels may be [B] while inputs are [B, ...])
+        spec = _clean_spec(("dp",) + (None,) * (a.ndim - 1), mesh)
+        return jax.device_put(a, NamedSharding(mesh, spec))
 
     def step(input_ids, labels):
         if holder["opt_state"] is None:
@@ -345,13 +405,14 @@ def build_pipeline_train_step(model: Layer, optimizer,
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         y = labels._data if isinstance(labels, Tensor) else labels
         _resolve_m(int(x.shape[0]))
-        x = jax.device_put(jnp.asarray(x), data_sharding)
-        y = jax.device_put(jnp.asarray(y), data_sharding)
+        x = _data_put(jnp.asarray(x))
+        y = _data_put(jnp.asarray(y))
         lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
         seed = jax.random.key_data(_random.next_key())
-        loss, new_buffers, holder["params"], holder["opt_state"] = jitted(
-            holder["params"], model.buffers_pytree(), holder["opt_state"],
-            lr, seed, x, y)
+        (loss, new_buffers, holder["params"], holder["opt_state"],
+         holder["layer_bufs"]) = jitted(
+            holder["params"], model.buffers_pytree(), holder["layer_bufs"],
+            holder["opt_state"], lr, seed, x, y)
         if new_buffers:
             model.load_pytree(new_buffers)
         optimizer._step_count += 1
@@ -364,6 +425,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
             _pipe.vpp_unstack_into_layers(stacked, layers, S, v)
         else:
             _pipe.unstack_into_layers(stacked, layers)
+        if holder["layer_bufs"]:
+            _pipe.unstack_buffers_into_layers(holder["layer_bufs"], layers)
         model.load_pytree({n: params[n] for n in rest_names})
 
     step.sync_to_model = sync_to_model

@@ -1,0 +1,161 @@
+"""BN running stats through the pipeline schedules (round-3 verdict item 5;
+reference: PipelineLayer supports BN models — SURVEY.md §2.2 "PP"). Stage
+buffers ride the 1f1b/gpipe scans as stacked carried state
+(pipeline.stack_layer_buffers), updating per microbatch in forward order."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu import nn
+from paddle_tpu.models import build_train_step
+from paddle_tpu.tensor import Tensor
+
+
+class ConvBNBlock(nn.Layer):
+    """Homogeneous residual conv-BN block (shape-preserving)."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+        self.bn = nn.BatchNorm2D(ch)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + F.relu(self.bn(self.conv(x)))
+
+
+class TinyConvPipe(nn.Layer):
+    """pp-decomposable conv net: stem linear -> N ConvBN blocks -> pool+fc."""
+
+    def __init__(self, ch=8, blocks=4, classes=10):
+        super().__init__()
+        self.stem = nn.Conv2D(3, ch, 1)
+        self.blocks = nn.LayerList([ConvBNBlock(ch) for _ in range(blocks)])
+        self.fc = nn.Linear(ch, classes)
+        self.ce = nn.CrossEntropyLoss()
+
+    def forward(self, x):
+        h = self.pp_embed(x)
+        for b in self.blocks:
+            h = b(h)
+        return self.pp_head(h)
+
+    def pp_embed(self, x):
+        return self.stem(x)
+
+    def pp_layers(self):
+        return list(self.blocks)
+
+    def pp_head(self, h):
+        import paddle_tpu.nn.functional as F
+
+        pooled = F.adaptive_avg_pool2d(h, 1)
+        from paddle_tpu.ops.manipulation import reshape
+
+        return self.fc(reshape(pooled, [pooled.shape[0], -1]))
+
+    def compute_loss(self, logits, y):
+        return self.ce(logits, y)
+
+
+def _make(seed=21):
+    paddle.seed(seed)
+    model = TinyConvPipe()
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    return model, opt
+
+
+def _bn_stats(model):
+    return {n: np.asarray(b._data).copy()
+            for n, b in model.named_buffers() if "_mean" in n or
+            "_variance" in n}
+
+
+class TestPipelineBN:
+    @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+    def test_single_microbatch_exact_parity(self, schedule):
+        """M=1: pipeline batch stats == serial full-batch stats, so loss
+        AND final running stats must match the serial step exactly."""
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 3, 8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 10, (4,)))
+
+        model_s, opt_s = _make()
+        step_s = build_train_step(model_s, opt_s, mesh=None)
+        serial = [float(step_s(x, y)) for _ in range(3)]
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            pp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        try:
+            model_p, opt_p = _make()
+            step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                      num_microbatches=1,
+                                      pipeline_schedule=schedule)
+            par = [float(step_p(x, y)) for _ in range(3)]
+            step_p.sync_to_model()
+        finally:
+            mesh_mod.set_mesh(None)
+
+        np.testing.assert_allclose(serial, par, rtol=2e-4, atol=2e-5)
+        ref, got = _bn_stats(model_s), _bn_stats(model_p)
+        assert ref and set(ref) == set(got)
+        for n in ref:
+            np.testing.assert_allclose(got[n], ref[n], rtol=2e-4,
+                                       atol=1e-6, err_msg=n)
+
+    def test_multi_microbatch_stats_update(self):
+        """M=4: stats must MOVE (not frozen) and loss must decrease; exact
+        parity with serial is not expected (per-microbatch batch stats —
+        the reference's semantics too)."""
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(8, 3, 8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 10, (8,)))
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            pp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        try:
+            model, opt = _make()
+            before = _bn_stats(model)
+            step = build_train_step(model, opt, mesh=mesh,
+                                    num_microbatches=4,
+                                    pipeline_schedule="1f1b")
+            losses = [float(step(x, y)) for _ in range(4)]
+            step.sync_to_model()
+        finally:
+            mesh_mod.set_mesh(None)
+        after = _bn_stats(model)
+        moved = any(not np.allclose(before[n], after[n]) for n in before)
+        assert moved, "BN running stats frozen through the 1f1b schedule"
+        assert losses[-1] < losses[0]
+
+    def test_default_schedule_for_buffered_model_is_1f1b(self):
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            pp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        try:
+            model, opt = _make()
+            step = build_train_step(model, opt, mesh=mesh,
+                                    num_microbatches=2)
+            rng = np.random.RandomState(2)
+            x = paddle.to_tensor(rng.randn(4, 3, 8, 8).astype("float32"))
+            y = paddle.to_tensor(rng.randint(0, 10, (4,)))
+            before = _bn_stats(model)
+            float(step(x, y))
+            step.sync_to_model()
+            after = _bn_stats(model)
+            assert any(not np.allclose(before[n], after[n]) for n in before)
+        finally:
+            mesh_mod.set_mesh(None)

@@ -1,0 +1,94 @@
+"""TP-sharded serving decode (round-3 verdict item 3; reference:
+fused_multi_transformer_op with mp_degree>1 — SURVEY.md §2.1 "Fused
+transformer ops"): the paged KV pools shard over tp on the kv-head dim,
+the decode step runs in a shard_map manual over tp, and generation must
+match the single-device engine token for token."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _build(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, seq=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _generate(engine, prompts, new_tokens):
+    for p in prompts:
+        engine.add_request(p, max_new_tokens=new_tokens)
+    done = engine.run()
+    return {f.request_id: f.output_ids.tolist() for f in done}
+
+
+class TestServingTP:
+    def test_token_parity_vs_single_device(self):
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 128, (n,)) for n in (9, 17, 5, 12)]
+
+        model = _build()
+        ref = _generate(
+            ServingEngine(model, max_batch=4, max_seq_len=64, page_size=8,
+                          decode_strategy="greedy_search"),
+            prompts, new_tokens=12)
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            tp=4, devices=np.asarray(jax.devices("cpu")[:4])))
+        try:
+            model_tp = _build()  # same seed -> identical weights
+            got = _generate(
+                ServingEngine(model_tp, max_batch=4, max_seq_len=64,
+                              page_size=8, decode_strategy="greedy_search",
+                              mesh=mesh),
+                prompts, new_tokens=12)
+        finally:
+            mesh_mod.set_mesh(None)
+
+        assert set(ref) == set(got)
+        for rid in ref:
+            assert ref[rid] == got[rid], (
+                f"request {rid}: single-device {ref[rid]} vs tp {got[rid]}")
+
+    def test_tp_pages_are_sharded(self):
+        mesh_mod.set_mesh(None)
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            tp=4, devices=np.asarray(jax.devices("cpu")[:4])))
+        try:
+            engine = ServingEngine(_build(), max_batch=2, max_seq_len=32,
+                                   page_size=8, mesh=mesh)
+            spec = engine.k_pages[0].sharding.spec
+            assert tuple(spec)[:1] == ("tp",)
+        finally:
+            mesh_mod.set_mesh(None)
+
+    def test_preemption_still_works_under_tp(self):
+        """Page exhaustion + recompute preemption on the tp engine."""
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            tp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        rng = np.random.RandomState(3)
+        try:
+            engine = ServingEngine(_build(), max_batch=2, max_seq_len=32,
+                                   page_size=8,
+                                   decode_strategy="greedy_search",
+                                   mesh=mesh)
+            prompts = [rng.randint(0, 128, (8,)) for _ in range(3)]
+            done = _generate(engine, prompts, new_tokens=8)
+            assert len(done) == 3
+            assert all(len(v) == 8 for v in done.values())
+        finally:
+            mesh_mod.set_mesh(None)
