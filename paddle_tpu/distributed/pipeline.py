@@ -569,7 +569,7 @@ def _vpp_schedule(S: int, v: int, M: int):
 
 def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
                       head_params, targets, *, num_chunks: int, mesh=None,
-                      axis_name: str = "pp"):
+                      axis_name: str = "pp", stage_buffers=None):
     """Interleaved virtual-pipeline (VPP) 1F1B train schedule, compiled.
 
     Reference: the interleaved schedule of
@@ -594,7 +594,12 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
     schedule='1f1b' if exact masked-mean semantics across dp are required.
 
     Returns (loss, d_stage_params, d_head_params, d_inputs) exactly like
-    `spmd_pipeline_1f1b` (d_stage_params in the same [S, v] layout).
+    `spmd_pipeline_1f1b` (d_stage_params in the same [S, v] layout). With
+    stage_buffers (vpp_stack_layer_buffers, [S, v, Lc, ...]), stage_fn has
+    the buffered signature and the updated stack is a fifth output; under
+    manual dp the final running stats are the pmean over dp shards (each
+    shard normalizes by its local microbatch rows — the DDP-style
+    cross-replica buffer averaging).
     """
     mesh = mesh or _mesh.get_mesh()
     S = int(mesh.shape[axis_name])
@@ -606,31 +611,63 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
     if v == 1:
         # plain 1F1B with the chunk dim stripped
         flat = tm(lambda p: p[:, 0] if p.shape[1] == 1 else p, stage_params)
+        if stage_buffers is not None:
+            flat_b = tm(lambda b: b[:, 0], stage_buffers)
+            loss, d_p, d_h, d_x, nb = spmd_pipeline_1f1b(
+                stage_fn, flat, microbatches, head_fn, head_params,
+                targets, mesh=mesh, axis_name=axis_name,
+                stage_buffers=flat_b)
+            return (loss, tm(lambda g: g[:, None], d_p), d_h, d_x,
+                    tm(lambda b: b[:, None], nb))
         loss, d_p, d_h, d_x = spmd_pipeline_1f1b(
             stage_fn, flat, microbatches, head_fn, head_params, targets,
             mesh=mesh, axis_name=axis_name)
         return loss, tm(lambda g: g[:, None], d_p), d_h, d_x
 
     if S == 1:
-        def chunk_chain(sp, x):
-            for j in range(v):
-                x = stage_fn(tm(lambda p: p[0, j], sp), x)
-            return x
+        if stage_buffers is None:
+            def chunk_chain(sp, x):
+                for j in range(v):
+                    x = stage_fn(tm(lambda p: p[0, j], sp), x)
+                return x
 
-        def one(m):
+            def one(m):
+                mb = tm(lambda x: x[m], microbatches)
+                tgt = tm(lambda x: x[m], targets)
+
+                def loss_of(sp, hp, x):
+                    return head_fn(hp, chunk_chain(sp, x), tgt)
+
+                loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+                d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
+                return loss_m, d_sp, d_hp, d_x
+
+            losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+            return (jnp.mean(losses), tm(lambda a: jnp.sum(a, 0), d_sps),
+                    tm(lambda a: jnp.sum(a, 0), d_hps), d_xs)
+
+        def one_b(bufs, m):
             mb = tm(lambda x: x[m], microbatches)
             tgt = tm(lambda x: x[m], targets)
 
             def loss_of(sp, hp, x):
-                return head_fn(hp, chunk_chain(sp, x), tgt)
+                nb = bufs
+                for j in range(v):
+                    x, nb_j = stage_fn(tm(lambda p: p[0, j], sp),
+                                       tm(lambda b: b[0, j], nb), x)
+                    nb = tm(lambda full, upd: full.at[0, j].set(upd),
+                            nb, nb_j)
+                return head_fn(hp, x, tgt), nb
 
-            loss_m, vjp = jax.vjp(loss_of, stage_params, head_params, mb)
+            loss_m, vjp, nb = jax.vjp(loss_of, stage_params, head_params,
+                                      mb, has_aux=True)
             d_sp, d_hp, d_x = vjp(jnp.asarray(inv_m, loss_m.dtype))
-            return loss_m, d_sp, d_hp, d_x
+            return nb, (loss_m, d_sp, d_hp, d_x)
 
-        losses, d_sps, d_hps, d_xs = jax.lax.map(one, jnp.arange(M))
+        new_bufs, (losses, d_sps, d_hps, d_xs) = jax.lax.scan(
+            one_b, stage_buffers, jnp.arange(M))
         return (jnp.mean(losses), tm(lambda a: jnp.sum(a, 0), d_sps),
-                tm(lambda a: jnp.sum(a, 0), d_hps), d_xs)
+                tm(lambda a: jnp.sum(a, 0), d_hps), d_xs, new_bufs)
 
     data_axes, inert_axes = _manual_batch_axes(mesh, axis_name)
     manual_axes = (axis_name,) + data_axes + inert_axes
@@ -650,7 +687,7 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
     tick_rows = {k: jnp.asarray(a) for k, a in sched.items()
                  if k not in ("T", "B")}
 
-    def inner(local_params, inputs, head_params, targets):
+    def inner(local_params, inputs, head_params, targets, local_bufs):
         stage = jax.lax.axis_index(axis_name)
         is_last = stage == S - 1
         # params arrive invariant over the manual data axes; cast them
@@ -658,6 +695,7 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
         # the end) instead of transposing to a psum every tick
         local_params = tm(lambda p: _pcast_varying(p[0], vary),
                           local_params)  # [v, ...]
+        local_bufs = tm(lambda b: _pcast_varying(b[0], vary), local_bufs)
         head_params = tm(lambda p: _pcast_varying(p, vary), head_params)
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [((i + 1) % S, i) for i in range(S)]
@@ -681,6 +719,7 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
             d_inputs=tm(lambda x: _pcast_varying(
                 jnp.zeros_like(x), vary), inputs),
             loss=_pcast_varying(jnp.zeros((), jnp.float32), vary),
+            bn_bufs=local_bufs,
         )
 
         def at_set(buf, j, slot, val, valid):
@@ -712,10 +751,30 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
             # slices: a dynamic-slice over the tp/dp-auto-sharded param
             # leaves sends the GSPMD partitioner into a pathological search
             # (observed: >10min compiles); static slices partition cleanly
-            y = jax.lax.switch(
-                jf, [(lambda j: lambda x_: stage_fn(
-                    tm(lambda p: p[j], local_params), x_))(j)
-                     for j in range(v)], x)
+            if stage_buffers is None:
+                y = jax.lax.switch(
+                    jf, [(lambda j: lambda x_: stage_fn(
+                        tm(lambda p: p[j], local_params), x_))(j)
+                         for j in range(v)], x)
+            else:
+                def fwd_chunk(j):
+                    def f(args):
+                        x_, bufs_ = args
+                        y_, nb_j = stage_fn(
+                            tm(lambda p: p[j], local_params),
+                            tm(lambda b: b[j], bufs_), x_)
+                        nb_full = tm(lambda full, upd: full.at[j].set(upd),
+                                     bufs_, nb_j)
+                        return y_, nb_full
+
+                    return f
+
+                y, nb = jax.lax.switch(
+                    jf, [fwd_chunk(j) for j in range(v)],
+                    (x, c["bn_bufs"]))
+                c["bn_bufs"] = tm(
+                    lambda old, new: jnp.where(f_valid, new, old),
+                    c["bn_bufs"], nb)
 
             # head at the last logical stage (rank S-1, chunk v-1)
             tgt = tm(lambda a: a[mf], targets)
@@ -758,7 +817,15 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
                 def f(args):
                     xs_, gi_ = args
                     pj_ = tm(lambda p: p[j], local_params)
-                    _, stage_vjp = jax.vjp(stage_fn, pj_, xs_)
+                    if stage_buffers is None:
+                        fwd_j = stage_fn
+                    else:
+                        bufs_j = jax.lax.stop_gradient(
+                            tm(lambda b: b[j], c["bn_bufs"]))
+
+                        def fwd_j(pp_, xx_):
+                            return stage_fn(pp_, bufs_j, xx_)[0]
+                    _, stage_vjp = jax.vjp(fwd_j, pj_, xs_)
                     d_pj, d_x_ = stage_vjp(gi_)
                     d_full = tm(lambda p: jnp.zeros(p.shape, jnp.float32),
                                 local_params)
@@ -797,25 +864,36 @@ def spmd_pipeline_vpp(stage_fn, stage_params, microbatches, head_fn,
         d_params = tm(lambda a, p: a.astype(p.dtype)[None],
                       d_params, local_params)
         d_inputs = tm(lambda a: a[None], carry["d_inputs"])
-        return loss, d_params, d_head, d_inputs
+        bn_bufs = carry["bn_bufs"]
+        if data_axes:
+            # each dp shard updated stats from its local rows: emit the
+            # cross-replica average (DDP-style buffer averaging)
+            bn_bufs = tm(lambda b: jax.lax.pmean(b, data_axes), bn_bufs)
+        bn_bufs = tm(lambda b: b[None], bn_bufs)
+        return loss, d_params, d_head, d_inputs, bn_bufs
 
     dp_spec = data_axes if data_axes else None
     stacked_spec = tm(lambda _: P(axis_name), stage_params)
     data_spec = tm(lambda _: P(None, dp_spec), microbatches)
     head_spec = tm(lambda _: P(), head_params)
     tgt_spec = tm(lambda _: P(None, dp_spec), targets)
-    loss, d_params, d_head, d_inputs_stacked = jax.shard_map(
+    buf_arg = stage_buffers if stage_buffers is not None else {}
+    buf_spec = tm(lambda _: P(axis_name), buf_arg)
+    loss, d_params, d_head, d_inputs_stacked, new_bufs = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec),
+        in_specs=(stacked_spec, data_spec, head_spec, tgt_spec, buf_spec),
         out_specs=(P(), stacked_spec, head_spec,
-                   tm(lambda _: P(axis_name, None, dp_spec), microbatches)),
+                   tm(lambda _: P(axis_name, None, dp_spec), microbatches),
+                   buf_spec),
         axis_names=frozenset(manual_axes),
-    )(stage_params, microbatches, head_params, targets)
+    )(stage_params, microbatches, head_params, targets, buf_arg)
     d_head = tm(lambda a, p: a.astype(p.dtype), d_head, head_params)
     # stage 0's shard holds the input cotangents — one-shard gather
     d_inputs = tm(lambda a: a[0], d_inputs_stacked)
-    return loss, d_params, d_head, d_inputs
+    if stage_buffers is None:
+        return loss, d_params, d_head, d_inputs
+    return loss, d_params, d_head, d_inputs, new_bufs
 
 
 def vpp_stack_layer_params(layers: Sequence, S: int, v: int
@@ -856,6 +934,28 @@ def vpp_unstack_into_layers(stacked: Dict[str, jax.Array], layers: Sequence,
                     {n: a[r, j, i] for n, a in stacked.items()})
 
 
+def vpp_stack_layer_buffers(layers: Sequence, S: int, v: int
+                            ) -> Dict[str, jax.Array]:
+    """Stack layer BUFFERS in the VPP chunk layout: suffix ->
+    [S, v, Lc, ...] (same indexing as `vpp_stack_layer_params`)."""
+    L = len(layers)
+    Lc = L // (S * v)
+    trees = [dict(l.named_buffers()) for l in layers]
+    names = list(trees[0].keys())
+    out = {}
+    for n in names:
+        per_chunk = []
+        for r in range(S):
+            rows = []
+            for j in range(v):
+                c = j * S + r
+                rows.append(jnp.stack(
+                    [trees[c * Lc + i][n]._data for i in range(Lc)]))
+            per_chunk.append(jnp.stack(rows))
+        out[n] = jnp.stack(per_chunk)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # stacked-parameter utilities (LayerDesc partitioning -> stacked arrays)
 # ---------------------------------------------------------------------------
@@ -886,11 +986,7 @@ def stack_layer_buffers(layers: Sequence) -> Dict[str, jax.Array]:
     }
 
 
-def unstack_buffers_into_layers(stacked: Dict[str, jax.Array],
-                                layers: Sequence):
-    """Inverse of `stack_layer_buffers` (post-step write-back)."""
-    for i, layer in enumerate(layers):
-        layer.load_pytree({n: a[i] for n, a in stacked.items()})
+
 
 
 def stacked_param_specs(layers: Sequence, mesh, axis_name: str = "pp"
@@ -904,9 +1000,13 @@ def stacked_param_specs(layers: Sequence, mesh, axis_name: str = "pp"
 
 
 def unstack_into_layers(stacked: Dict[str, jax.Array], layers: Sequence):
-    """Write stacked arrays back into the per-layer modules (post-step)."""
+    """Write stacked arrays back into the per-layer modules (post-step).
+    Works for params AND buffers alike (load_pytree keys by name)."""
     for i, layer in enumerate(layers):
         layer.load_pytree({n: a[i] for n, a in stacked.items()})
+
+
+unstack_buffers_into_layers = unstack_into_layers
 
 
 def make_stage_fn(template_layer, call: Optional[Callable] = None):

@@ -40,15 +40,17 @@ class BaseGate(Layer):
             num_tokens, self.num_experts, self.top_k, factor)
 
     def forward(self, x):
-        """x: [n, d_model] Tensor -> (dispatch, combine, aux_loss) Tensors."""
+        """x: [n, d_model] Tensor -> (dispatch, combine, aux_loss,
+        dropped) Tensors; `dropped` counts capacity-overflow routing
+        slots (drop-rate observable)."""
         n = int(x.shape[0])
         cap = self.capacity(n)
 
         def f(xa, wa):
             logits = xa @ wa.astype(xa.dtype)
-            d, c, aux, _ = routing.topk_dispatch(
+            d, c, aux, _, dropped = routing.topk_dispatch(
                 logits, self.top_k, cap, normalize=self.normalize)
-            return d.astype(xa.dtype), c.astype(xa.dtype), aux
+            return d.astype(xa.dtype), c.astype(xa.dtype), aux, dropped
 
         return _apply_op(f, x, self.weight, _name="moe_gate")
 

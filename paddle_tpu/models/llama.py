@@ -54,7 +54,8 @@ class LlamaConfig:
     @staticmethod
     def llama2_13b():
         return LlamaConfig(hidden_size=5120, intermediate_size=13824,
-                           num_hidden_layers=40, num_attention_heads=40)
+                           num_hidden_layers=40, num_attention_heads=40,
+                           num_key_value_heads=40)
 
     @staticmethod
     def gpt3_1p3b():

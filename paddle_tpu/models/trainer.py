@@ -121,13 +121,14 @@ def build_pipeline_train_step(model: Layer, optimizer,
     layers = model.pp_layers()
     S = int(mesh.shape["pp"])
     v = int(virtual_pp_degree)
-    # buffers (BN running stats) in the STAGE layers ride the 1f1b/gpipe
-    # schedules as stacked carried state (pipeline.stack_layer_buffers);
-    # the vpp scan does not thread them yet. Buffers OUTSIDE the stage
-    # layers: embed-region updates are captured on the 1f1b path (vjp
-    # aux), but HEAD-region updates are not (the head runs inside the
-    # schedule's masked cond) — models with non-stage buffers therefore
-    # default to gpipe, whose autodiff path updates all of them.
+    # buffers (BN running stats) in the STAGE layers ride the
+    # 1f1b/gpipe/vpp schedules as stacked carried state
+    # (pipeline.stack_layer_buffers / vpp_stack_layer_buffers). Buffers
+    # OUTSIDE the stage layers: embed-region updates are captured on the
+    # 1f1b/vpp path (vjp aux), but HEAD-region updates are not (the head
+    # runs inside the schedule's masked cond) — models with non-stage
+    # buffers therefore default to gpipe, whose autodiff path updates all
+    # of them.
     has_layer_buffers = bool(dict(layers[0].named_buffers()))
     layer_buf_ids = {id(b) for l in layers for _, b in l.named_buffers()}
     rest_buf_names = [n for n, b in model.named_buffers()
@@ -144,20 +145,8 @@ def build_pipeline_train_step(model: Layer, optimizer,
                     "only the gpipe schedule fully updates; pass "
                     "pipeline_schedule explicitly to override",
                     UserWarning)
-        elif has_layer_buffers and v > 1:
-            import warnings
-
-            warnings.warn(
-                "virtual_pp_degree>1 ignored: the vpp schedule does not "
-                "thread stage buffers (BN stats) yet; using 1f1b, which "
-                "does", UserWarning)
-            schedule = "1f1b"
         else:
             schedule = "vpp" if v > 1 else "1f1b"
-    if schedule == "vpp" and has_layer_buffers:
-        raise NotImplementedError(
-            "schedule='vpp' does not thread stage buffers (BN stats); "
-            "use '1f1b' or 'gpipe' for models with buffered pp layers")
     if schedule in ("1f1b", "vpp") and rest_buf_names:
         import warnings
 
@@ -253,9 +242,13 @@ def build_pipeline_train_step(model: Layer, optimizer,
             inner = list(_clean_spec(get_param_spec(p), mesh))
             stacked_specs[n] = P("pp", None, None, *inner)
         stacked_arrays = _pipe.vpp_stack_layer_params(layers, S, v)
+        raw_layer_bufs = _pipe.vpp_stack_layer_buffers(layers, S, v) \
+            if has_layer_buffers else {}
     else:
         stacked_specs = _pipe.stacked_param_specs(layers, mesh)
         stacked_arrays = _pipe.stack_layer_params(layers)
+        raw_layer_bufs = _pipe.stack_layer_buffers(layers) \
+            if has_layer_buffers else {}
     stacked_names = list(stacked_specs)
     flat_params = {}
     flat_specs = {}
@@ -277,11 +270,9 @@ def build_pipeline_train_step(model: Layer, optimizer,
     # schedule: stacked [L, ...] pp-sharded like the params and threaded
     # through the scan (the reference's PipelineLayer updates BN stats per
     # microbatch — SURVEY.md §2.2 "PP"; round-3 verdict item 5)
-    stacked_layer_bufs = {}
-    if has_layer_buffers:
-        stacked_layer_bufs = {
-            n: jax.device_put(a, NamedSharding(mesh, P("pp")))
-            for n, a in _pipe.stack_layer_buffers(layers).items()}
+    stacked_layer_bufs = {
+        n: jax.device_put(a, NamedSharding(mesh, P("pp")))
+        for n, a in raw_layer_bufs.items()}
 
     # ZeRO layouts over the pipeline step's flat param dict (single source
     # of stage semantics: sharding_optimizer.stage_shardings)
@@ -355,19 +346,21 @@ def build_pipeline_train_step(model: Layer, optimizer,
             h, embed_vjp, embed_bufs = jax.vjp(embed_fn, rest, has_aux=True)
             mb = _pipe.microbatch(h, mb_holder["M"])
             tgts = _pipe.microbatch(y, mb_holder["M"])
-            new_layer_bufs = {}
+            pipe_kw = dict(mesh=mesh)
+            if has_layer_buffers:
+                pipe_kw["stage_buffers"] = layer_bufs
             if schedule == "vpp":
-                loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_vpp(
+                out = _pipe.spmd_pipeline_vpp(
                     stage_fn, stacked, mb, head_fn, rest, tgts,
-                    num_chunks=v, mesh=mesh)
-            elif has_layer_buffers:
-                (loss, d_stacked, d_rest_head, d_mb,
-                 new_layer_bufs) = _pipe.spmd_pipeline_1f1b(
-                    stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh,
-                    stage_buffers=layer_bufs)
+                    num_chunks=v, **pipe_kw)
             else:
-                loss, d_stacked, d_rest_head, d_mb = _pipe.spmd_pipeline_1f1b(
-                    stage_fn, stacked, mb, head_fn, rest, tgts, mesh=mesh)
+                out = _pipe.spmd_pipeline_1f1b(
+                    stage_fn, stacked, mb, head_fn, rest, tgts, **pipe_kw)
+            if has_layer_buffers:
+                loss, d_stacked, d_rest_head, d_mb, new_layer_bufs = out
+            else:
+                loss, d_stacked, d_rest_head, d_mb = out
+                new_layer_bufs = {}
             (d_rest_embed,) = embed_vjp(d_mb.reshape(h.shape))
         grads = {_skey(n): d_stacked[n] for n in stacked_names}
         for n in rest_names:
@@ -426,11 +419,19 @@ def build_pipeline_train_step(model: Layer, optimizer,
         else:
             _pipe.unstack_into_layers(stacked, layers)
         if holder["layer_bufs"]:
-            _pipe.unstack_buffers_into_layers(holder["layer_bufs"], layers)
+            if schedule == "vpp":
+                _pipe.vpp_unstack_into_layers(
+                    holder["layer_bufs"], layers, S, v)
+            else:
+                _pipe.unstack_buffers_into_layers(
+                    holder["layer_bufs"], layers)
         model.load_pytree({n: params[n] for n in rest_names})
 
     step.sync_to_model = sync_to_model
     step._holder = holder
+    step._jitted = jitted          # AOT lowering (tools/scale_rehearsal.py)
+    step._flat_specs = flat_specs
+    step._data_put = _data_put
     return step
 
 
@@ -498,7 +499,12 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         b._rebind(jax.device_put(b._data, repl))
 
     holder = step._opt_state_holder
-    data_sharding = NamedSharding(mesh, _clean_spec(("dp", None), mesh))
+
+    def _data_put(a):
+        # batch dim over dp, rest replicated — spec sized to the array's
+        # rank (labels may be [B] while inputs are [B, ...])
+        spec = _clean_spec(("dp",) + (None,) * (a.ndim - 1), mesh)
+        return jax.device_put(a, NamedSharding(mesh, spec))
 
     def sharded_step(input_ids, labels):
         if holder["state"] is None:
@@ -509,9 +515,7 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                 inner_opt.init_state_pytree(params), specs, mesh)
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         y = labels._data if isinstance(labels, Tensor) else labels
-        x = jax.device_put(x, data_sharding)
-        y = jax.device_put(y, data_sharding)
-        return step(Tensor(x), Tensor(y))
+        return step(Tensor(_data_put(x)), Tensor(_data_put(y)))
 
     sharded_step._inner = step
     return sharded_step
