@@ -173,9 +173,17 @@ class LlamaAttention(nn.Layer):
             from ..distributed.sharding_utils import in_manual_region
 
             if _cp.context_parallel_enabled() and not in_manual_region():
-                # long-context path: ring attention over the cp/sep axis
+                # long-context path: ring attention over the cp/sep axis.
+                # FLAGS_cp_ring_balance='zigzag' opts into the
+                # load-balanced layout (context_parallel.py) — opt-in
+                # until the per-layer relayout cost is chip-measured
+                from ..framework import config as _config
+
+                bal = _config.get_flag("FLAGS_cp_ring_balance", None)
+
                 def ring_fn(qq, kk, vv):
-                    return _cp.ring_attention(qq, kk, vv, causal=True)
+                    return _cp.ring_attention(qq, kk, vv, causal=True,
+                                              balance=bal)
 
                 out = _apply_op(ring_fn, q, k, v, _name="ring_attention")
             else:

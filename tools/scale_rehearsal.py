@@ -23,7 +23,21 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# parse the device budget BEFORE jax initializes its backend
+try:
+    N_DEV = int(sys.argv[sys.argv.index("--devices") + 1]) \
+        if "--devices" in sys.argv else 8
+except (IndexError, ValueError):
+    raise SystemExit("--devices takes an integer: 8, 16 or 32")
+MESH_KW = {8: dict(pp=2, dp=2, tp=2),
+           16: dict(pp=2, dp=2, tp=4),   # v5p-16-class factoring
+           32: dict(pp=4, dp=2, tp=4)}.get(N_DEV)
+if MESH_KW is None:
+    raise SystemExit("--devices must be 8, 16 or 32")
+# append (not overwrite): user flags like --xla_dump_to must survive
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_DEV}").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
@@ -39,6 +53,7 @@ def main():
     geometry = "13b"
     if "--geometry" in sys.argv:
         geometry = sys.argv[sys.argv.index("--geometry") + 1]
+    n_dev, mesh_kw = N_DEV, MESH_KW
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed.mesh as mesh_mod
@@ -81,7 +96,7 @@ def main():
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
-        pp=2, dp=2, tp=2, devices=np.asarray(jax.devices("cpu")[:8])))
+        devices=np.asarray(jax.devices("cpu")[:n_dev]), **mesh_kw))
     step = build_train_step(model, opt, mesh=mesh, sharding_stage=2,
                             num_microbatches=microbatches)
     t_build = time.perf_counter() - t_build0
@@ -138,7 +153,8 @@ def main():
         "model": {"hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                   "vocab": cfg.vocab_size, "params_b": round(n_params / 1e9, 3),
                   "dtype": cfg.dtype},
-        "mesh": "pp2xdp2xtp2 (8 virtual CPU devices)",
+        "mesh": "x".join(f"{k}{v}" for k, v in mesh_kw.items())
+                + f" ({n_dev} virtual CPU devices)",
         "schedule": "1f1b", "sharding_stage": 2,
         "batch": batch, "seq": seq, "microbatches": microbatches,
         "build_s": round(t_build, 1),
@@ -168,7 +184,8 @@ def main():
                     "_remat" if old.get("remat") else ""): old}
         except (OSError, json.JSONDecodeError):
             all_results = {}
-    key = geometry + ("_remat" if cfg.use_recompute else "")
+    key = geometry + ("_remat" if cfg.use_recompute else "") \
+        + (f"_{n_dev}dev" if n_dev != 8 else "")
     all_results[key] = result
     with open(path, "w") as f:
         json.dump(all_results, f, indent=1)
