@@ -32,6 +32,8 @@ from .mesh import (  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention,
     ulysses_attention,
+    zigzag_reorder,
+    zigzag_stream_attention,
 )
 from .parallel import DataParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -202,6 +202,9 @@ def _zigzag_permutation(s: int, cp: int):
     [chunk i | chunk 2cp-1-i] of 2cp equal chunks. Returns (perm, inv)."""
     import numpy as np
 
+    if s % (2 * cp):
+        raise ValueError(
+            f"zigzag layout needs seq ({s}) divisible by 2*cp ({2 * cp})")
     half = s // (2 * cp)
     order = []
     for i in range(cp):
@@ -212,6 +215,80 @@ def _zigzag_permutation(s: int, cp: int):
     inv = np.empty_like(perm)
     inv[perm] = np.arange(s, dtype=np.int32)
     return perm, inv
+
+
+def zigzag_reorder(*arrays, mesh=None, axis_name: Optional[str] = None,
+                   axis: int = 1):
+    """Permute the seq `axis` of each array into the zigzag layout — the
+    ONCE-per-batch relayout of the token stream (inputs AND labels; the
+    per-position LM loss is permutation-invariant, so nothing needs
+    un-permuting). Models with `cp_zigzag_stream` then run zigzag ring
+    attention with zero per-layer gathers. No cp axis live -> identity."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    ax = _pick_axis(mesh, axis_name)
+    if ax is None or int(mesh.shape[ax]) == 1:
+        return arrays if len(arrays) > 1 else arrays[0]
+    cp = int(mesh.shape[ax])
+    from ..tensor import Tensor, as_array
+
+    out = []
+    for a in arrays:
+        arr = as_array(a)
+        perm, _ = _zigzag_permutation(arr.shape[axis], cp)
+        taken = jnp.take(arr, jnp.asarray(perm), axis=axis)
+        out.append(Tensor(taken) if isinstance(a, Tensor) else taken)
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def zigzag_positions(s: int, mesh=None, axis_name: Optional[str] = None):
+    """Global token position of each slot in the zigzag-ordered stream
+    ([s] int32 numpy) — feeds RoPE so rotary phases follow the ORIGINAL
+    positions after `zigzag_reorder`. Identity when no cp axis is live."""
+    import numpy as np
+
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    ax = _pick_axis(mesh, axis_name)
+    if ax is None or int(mesh.shape[ax]) == 1:
+        return np.arange(s, dtype=np.int32)
+    perm, _ = _zigzag_permutation(s, int(mesh.shape[ax]))
+    return perm
+
+
+def _zigzag_dense_local(q, k, v, axis_name: str, positions,
+                        scale: Optional[float] = None):
+    """Dense fallback for the zigzag STREAM layout (any shape): the
+    shared online-softmax ring with position-based causal masks."""
+    return _ring_dense_local(q, k, v, axis_name, causal=True, scale=scale,
+                             positions=positions)
+
+
+def zigzag_stream_attention(q, k, v, axis_name: Optional[str] = None,
+                            scale: Optional[float] = None, mesh=None):
+    """Causal ring attention for a token stream ALREADY in the zigzag
+    layout (`zigzag_reorder` applied once at the data boundary): no
+    entry/exit permutation gathers. Flash-aligned shapes use the
+    balanced zigzag flash ring; others use the position-masked dense
+    ring. Output stays in the zigzag layout."""
+    mesh = mesh or _mesh.get_mesh(optional=True)
+    axis = _pick_axis(mesh, axis_name)
+    s = q.shape[1]
+    if axis is None or int(mesh.shape[axis]) == 1:
+        from ..nn.functional.attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, causal=True, scale=scale)
+    cp = int(mesh.shape[axis])
+    if s % (2 * cp):
+        raise ValueError(
+            f"zigzag stream needs seq ({s}) divisible by 2*cp ({2 * cp})")
+    from ..kernels.flash_attention import supports as _flash_supports
+
+    half = s // (2 * cp)
+    if _flash_supports(half, half, q.shape[3]):
+        return _cp_call(zigzag_ring_flash_local, q, k, v, axis, mesh,
+                        scale=scale)
+    positions, _ = _zigzag_permutation(s, cp)
+    return _cp_call(_zigzag_dense_local, q, k, v, axis, mesh,
+                    positions=positions, scale=scale)
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
@@ -232,8 +309,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
 
 def _ring_dense_local(q, k, v, axis_name: str, causal: bool = True,
-                      scale: Optional[float] = None):
-    """Dense per-block ring attention (any shape; f32 accumulation)."""
+                      scale: Optional[float] = None, positions=None):
+    """Dense per-block ring attention (any shape; f32 accumulation).
+
+    positions: optional [s_global] static array giving each slot's token
+    position (the zigzag-stream layout); default = contiguous order."""
     cp = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, n, d = q.shape
@@ -245,7 +325,11 @@ def _ring_dense_local(q, k, v, axis_name: str, causal: bool = True,
 
     from .pipeline import _pcast_varying
 
-    qpos = idx * s_loc + jnp.arange(s_loc)
+    pos = jnp.asarray(positions) if positions is not None else None
+    if pos is not None:
+        qpos = jax.lax.dynamic_slice_in_dim(pos, idx * s_loc, s_loc)
+    else:
+        qpos = idx * s_loc + jnp.arange(s_loc)
     m0 = _pcast_varying(jnp.full((b, n, s_loc), _NEG, jnp.float32), axis_name)
     l0 = _pcast_varying(jnp.zeros((b, n, s_loc), jnp.float32), axis_name)
     o0 = _pcast_varying(jnp.zeros((b, n, s_loc, d), jnp.float32), axis_name)
@@ -254,7 +338,10 @@ def _ring_dense_local(q, k, v, axis_name: str, causal: bool = True,
     def body(carry, r):
         o, m, l, kc, vc = carry
         j = (idx - r) % cp                      # kv block currently held
-        kpos = j * s_loc + jnp.arange(s_loc)
+        if pos is not None:
+            kpos = jax.lax.dynamic_slice_in_dim(pos, j * s_loc, s_loc)
+        else:
+            kpos = j * s_loc + jnp.arange(s_loc)
         s = jnp.einsum("bnqd,bnkd->bnqk", qt, kc) * sc
         if causal:
             s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG)

@@ -44,6 +44,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_recompute: bool = False
+    # run the token stream in the zigzag context-parallel layout: the
+    # caller permutes inputs+labels ONCE (distributed.zigzag_reorder) and
+    # attention uses the balanced zigzag ring with zero per-layer
+    # relayout gathers; RoPE follows the original token positions
+    cp_zigzag_stream: bool = False
     dtype: str = "float32"
 
     @staticmethod
@@ -106,6 +111,7 @@ class LlamaAttention(nn.Layer):
         self.head_dim = config.hidden_size // config.num_attention_heads
         self.hidden_size = config.hidden_size
         self.rope_theta = config.rope_theta
+        self.cp_zigzag_stream = getattr(config, "cp_zigzag_stream", False)
         self.q_proj = ColumnParallelLinear(
             config.hidden_size, self.num_heads * self.head_dim,
             has_bias=False, gather_output=False)
@@ -138,6 +144,25 @@ class LlamaAttention(nn.Layer):
         cos, sin = rope_tables(s, self.head_dim, base=self.rope_theta,
                                dtype=as_array(q).dtype,
                                position_offset=position_offset)
+        if self.cp_zigzag_stream and kv_cache is None:
+            # zigzag stream: rotary phases follow the ORIGINAL token
+            # positions of the permuted slots (static gather, fuses).
+            # The layout is only legal on the pure-cp attention path: the
+            # dense fallbacks (padding masks; attention inside the
+            # pipeline's manual region) apply contiguous-order causal
+            # masks that would silently corrupt a permuted stream.
+            from ..distributed import context_parallel as _cp
+            from ..distributed.sharding_utils import in_manual_region
+
+            if _cp.context_parallel_enabled():
+                if attn_mask is not None or in_manual_region():
+                    raise NotImplementedError(
+                        "cp_zigzag_stream supports only the pure cp "
+                        "attention path (no padding attn_mask, no pp "
+                        "pipeline stage); use the contiguous layout "
+                        "(cp_zigzag_stream=False) for this config")
+            zpos = _cp.zigzag_positions(s)
+            cos, sin = cos[jnp.asarray(zpos)], sin[jnp.asarray(zpos)]
 
         def rope_fn(qq, kk):
             return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
@@ -173,17 +198,22 @@ class LlamaAttention(nn.Layer):
             from ..distributed.sharding_utils import in_manual_region
 
             if _cp.context_parallel_enabled() and not in_manual_region():
-                # long-context path: ring attention over the cp/sep axis.
-                # FLAGS_cp_ring_balance='zigzag' opts into the
-                # load-balanced layout (context_parallel.py) — opt-in
-                # until the per-layer relayout cost is chip-measured
-                from ..framework import config as _config
+                if self.cp_zigzag_stream:
+                    # stream already in zigzag layout: balanced ring, no
+                    # per-layer relayout gathers
+                    def ring_fn(qq, kk, vv):
+                        return _cp.zigzag_stream_attention(qq, kk, vv)
+                else:
+                    # contiguous stream; FLAGS_cp_ring_balance='zigzag'
+                    # opts into per-call relayout balancing (opt-in
+                    # until the gather cost is chip-measured)
+                    from ..framework import config as _config
 
-                bal = _config.get_flag("FLAGS_cp_ring_balance", None)
+                    bal = _config.get_flag("FLAGS_cp_ring_balance", None)
 
-                def ring_fn(qq, kk, vv):
-                    return _cp.ring_attention(qq, kk, vv, causal=True,
-                                              balance=bal)
+                    def ring_fn(qq, kk, vv):
+                        return _cp.ring_attention(qq, kk, vv, causal=True,
+                                                  balance=bal)
 
                 out = _apply_op(ring_fn, q, k, v, _name="ring_attention")
             else:
