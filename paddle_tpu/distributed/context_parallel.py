@@ -254,14 +254,6 @@ def zigzag_positions(s: int, mesh=None, axis_name: Optional[str] = None):
     return perm
 
 
-def _zigzag_dense_local(q, k, v, axis_name: str, positions,
-                        scale: Optional[float] = None):
-    """Dense fallback for the zigzag STREAM layout (any shape): the
-    shared online-softmax ring with position-based causal masks."""
-    return _ring_dense_local(q, k, v, axis_name, causal=True, scale=scale,
-                             positions=positions)
-
-
 def zigzag_stream_attention(q, k, v, axis_name: Optional[str] = None,
                             scale: Optional[float] = None, mesh=None):
     """Causal ring attention for a token stream ALREADY in the zigzag
@@ -287,7 +279,7 @@ def zigzag_stream_attention(q, k, v, axis_name: Optional[str] = None,
         return _cp_call(zigzag_ring_flash_local, q, k, v, axis, mesh,
                         scale=scale)
     positions, _ = _zigzag_permutation(s, cp)
-    return _cp_call(_zigzag_dense_local, q, k, v, axis, mesh,
+    return _cp_call(_ring_dense_local, q, k, v, axis, mesh, causal=True,
                     positions=positions, scale=scale)
 
 

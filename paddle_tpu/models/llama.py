@@ -144,25 +144,30 @@ class LlamaAttention(nn.Layer):
         cos, sin = rope_tables(s, self.head_dim, base=self.rope_theta,
                                dtype=as_array(q).dtype,
                                position_offset=position_offset)
-        if self.cp_zigzag_stream and kv_cache is None:
-            # zigzag stream: rotary phases follow the ORIGINAL token
-            # positions of the permuted slots (static gather, fuses).
-            # The layout is only legal on the pure-cp attention path: the
-            # dense fallbacks (padding masks; attention inside the
-            # pipeline's manual region) apply contiguous-order causal
-            # masks that would silently corrupt a permuted stream.
+        zigzag_live = False
+        if self.cp_zigzag_stream:
+            # zigzag stream legality, checked ONCE up front: the layout
+            # is only expressible on the pure-cp training attention path.
+            # Every other path (padding masks, attention inside a pp
+            # pipeline stage, dense/paged kv-cache decode) applies
+            # contiguous-order RoPE/causal masks that would silently
+            # corrupt a permuted stream — raise instead.
             from ..distributed import context_parallel as _cp
             from ..distributed.sharding_utils import in_manual_region
 
-            if _cp.context_parallel_enabled():
-                if attn_mask is not None or in_manual_region():
-                    raise NotImplementedError(
-                        "cp_zigzag_stream supports only the pure cp "
-                        "attention path (no padding attn_mask, no pp "
-                        "pipeline stage); use the contiguous layout "
-                        "(cp_zigzag_stream=False) for this config")
-            zpos = _cp.zigzag_positions(s)
-            cos, sin = cos[jnp.asarray(zpos)], sin[jnp.asarray(zpos)]
+            zigzag_live = _cp.context_parallel_enabled()
+            if zigzag_live and (attn_mask is not None or kv_cache is not None
+                                or in_manual_region()):
+                raise NotImplementedError(
+                    "cp_zigzag_stream supports only the pure cp "
+                    "attention path (no padding attn_mask, no kv_cache "
+                    "decode, no pp pipeline stage); use the contiguous "
+                    "layout (cp_zigzag_stream=False) for this config")
+            if zigzag_live:
+                # rotary phases follow the ORIGINAL token positions of
+                # the permuted slots (static gather, fuses)
+                zpos = _cp.zigzag_positions(s)
+                cos, sin = cos[jnp.asarray(zpos)], sin[jnp.asarray(zpos)]
 
         def rope_fn(qq, kk):
             return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
@@ -198,7 +203,7 @@ class LlamaAttention(nn.Layer):
             from ..distributed.sharding_utils import in_manual_region
 
             if _cp.context_parallel_enabled() and not in_manual_region():
-                if self.cp_zigzag_stream:
+                if zigzag_live:
                     # stream already in zigzag layout: balanced ring, no
                     # per-layer relayout gathers
                     def ring_fn(qq, kk, vv):

@@ -1,0 +1,121 @@
+"""Grouped-query attention (num_key_value_heads < num_attention_heads —
+LLaMA-2-70B/Mistral-style GQA): the repeat_interleave training path, the
+dense-cache generation path, the paged decode kernel's group>1 path, and
+kv-head-sharded TP serving."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
+
+
+def _gqa_cfg(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2, seq=32):
+    return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                       intermediate_size=hidden * 4,
+                       num_hidden_layers=layers,
+                       num_attention_heads=heads,
+                       num_key_value_heads=kv_heads,
+                       max_position_embeddings=seq)
+
+
+class TestGQA:
+    def test_training_matches_mha_with_tied_kv(self):
+        """A GQA model whose kv projections are replicated groupwise into
+        an MHA model must produce identical logits — checks the
+        repeat_interleave grouping math, not just 'it runs'."""
+        import jax.numpy as jnp
+
+        paddle.seed(3)
+        gqa = LlamaForCausalLM(_gqa_cfg(heads=4, kv_heads=2))
+        paddle.seed(3)
+        mha = LlamaForCausalLM(_gqa_cfg(heads=4, kv_heads=4))
+        # copy shared weights; expand GQA's kv projections into MHA's by
+        # repeating each kv head for its group (head_dim=8, groups of 2)
+        gp = dict(gqa.named_parameters())
+        hd = 32 // 4
+        for n, p in mha.named_parameters():
+            src = gp.get(n)
+            if src is None:
+                continue
+            a = np.asarray(src._data)
+            if a.shape != tuple(p.shape):
+                # [hidden, kvh*hd] -> [hidden, h*hd] by group repetition
+                a = a.reshape(a.shape[0], -1, hd)
+                a = np.repeat(a, 2, axis=1).reshape(p.shape)
+            p._rebind(jnp.asarray(a))
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randint(0, 64, (2, 16)))
+        lg = np.asarray(gqa(x)._data, np.float32)
+        lm = np.asarray(mha(x)._data, np.float32)
+        np.testing.assert_allclose(lg, lm, rtol=1e-4, atol=1e-5)
+
+    def test_gqa_trains(self):
+        paddle.seed(1)
+        model = LlamaForCausalLM(_gqa_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = build_train_step(model, opt, mesh=None)
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randint(0, 64, (4, 16)))
+        y = paddle.to_tensor(rng.randint(0, 64, (4, 16)))
+        losses = [float(step(x, y)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_gqa_serving_matches_dense_generation(self):
+        """Paged decode with group>1 must produce the same tokens as the
+        dense-cache greedy generation path."""
+        paddle.seed(5)
+        cfg = _gqa_cfg(vocab=128, hidden=64, heads=4, kv_heads=2, seq=64)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 128, (n,)) for n in (7, 12)]
+
+        engine = ServingEngine(model, max_batch=2, max_seq_len=64,
+                               page_size=8, decode_strategy="greedy_search")
+        for p in prompts:
+            engine.add_request(p, max_new_tokens=8)
+        done = {f.request_id: f.output_ids.tolist() for f in engine.run()}
+
+        from paddle_tpu.models.generation import generate
+
+        for rid, p in enumerate(prompts):
+            new_tokens, _ = generate(model, paddle.to_tensor(p[None]),
+                                     max_new_tokens=8,
+                                     decode_strategy="greedy_search")
+            ref_ids = np.asarray(new_tokens._data)[0].tolist()
+            assert done[rid] == ref_ids, (rid, done[rid], ref_ids)
+
+    def test_gqa_tp_serving_parity(self):
+        """TP serving shards the kv heads; GQA (kvh=2, tp=2: one kv head
+        per chip serving two q heads) must match single-device decode."""
+        import jax
+
+        paddle.seed(7)
+        cfg = _gqa_cfg(vocab=128, hidden=64, heads=4, kv_heads=2, seq=64)
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 128, (n,)) for n in (9, 5)]
+
+        def gen(mesh):
+            paddle.seed(7)
+            model = LlamaForCausalLM(cfg)
+            eng = ServingEngine(model, max_batch=2, max_seq_len=64,
+                                page_size=8,
+                                decode_strategy="greedy_search", mesh=mesh)
+            for p in prompts:
+                eng.add_request(p, max_new_tokens=8)
+            return {f.request_id: f.output_ids.tolist() for f in eng.run()}
+
+        mesh_mod.set_mesh(None)
+        ref = gen(None)
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            tp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+        try:
+            got = gen(mesh)
+        finally:
+            mesh_mod.set_mesh(None)
+        assert ref == got
