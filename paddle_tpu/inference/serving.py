@@ -34,6 +34,9 @@ class _Slot:
     admit_seq: int = 0    # admission order (preemption picks the youngest)
     needs_first_sample: bool = False  # consume prefill-time sample next step
     _first_token: int = -1
+    # per-request sampling: only the greedy flag lives on the slot (the
+    # all-greedy fast path reads it every step); numeric params stay in
+    # ServingEngine._req_params — ONE source of truth across preemption
 
 
 @dataclass
@@ -115,11 +118,12 @@ class ServingEngine:
         self.slots = [_Slot() for _ in range(max_batch)]
         self._pending: List = []  # queued (rid, ids, max_new, prior_tokens)
         self._prompts: Dict[int, np.ndarray] = {}
+        self._req_params: Dict[int, dict] = {}  # per-request sampling
         self._next_rid = 0
         self._admit_seq = 0
         self._key = jax.random.PRNGKey(seed)
-        self._decode_fn = None
-        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fns: Dict[bool, object] = {}
+        self._prefill_fns: Dict[tuple, object] = {}
         # params pytree cached across steps (round-2 verdict weak #5:
         # rebuilding it every decode step); call refresh_params() after
         # mutating model weights
@@ -150,7 +154,13 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # admission
     # ------------------------------------------------------------------
-    def add_request(self, prompt_ids, max_new_tokens=32) -> int:
+    def add_request(self, prompt_ids, max_new_tokens=32,
+                    decode_strategy=None, temperature=None, top_k=None,
+                    top_p=None) -> int:
+        """Queue a request. Sampling params default to the engine-level
+        settings; per-request overrides ride the request through
+        preemption/re-admission (one compiled decode step serves mixed
+        greedy/sampling batches — params are runtime [b] arrays)."""
         ids = np.asarray(as_array(prompt_ids)).reshape(-1).astype(np.int64)
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -161,6 +171,14 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._prompts[rid] = ids
+        strategy = decode_strategy if decode_strategy is not None \
+            else self.decode_strategy
+        self._req_params[rid] = dict(
+            greedy=strategy == "greedy_search",
+            temperature=float(temperature if temperature is not None
+                              else self.temperature),
+            top_k=int(top_k if top_k is not None else self.top_k),
+            top_p=float(top_p if top_p is not None else self.top_p))
         # queue only — admission happens at the next step() so requests
         # arriving together prefill together in one batched compiled call
         self._pending.append((rid, ids, int(max_new_tokens), []))
@@ -196,6 +214,7 @@ class ServingEngine:
             s.context_len = len(ctx)
             s.max_new_tokens = max_new
             s.n_pages = need
+            s.greedy = self._req_params[rid]["greedy"]
             s.admit_seq = self._admit_seq
             self._admit_seq += 1
             s.needs_first_sample = True
@@ -233,21 +252,21 @@ class ServingEngine:
     # prefill: batched dense-cache forward on the admitted prompts, then
     # one scatter of all their K/V into the pages
     # ------------------------------------------------------------------
-    def _get_prefill_fn(self, nb, bucket):
-        """One compiled prefill per (batch-bucket, token-bucket): prompts
-        pad to a page multiple, batch pads to a power of two — compiles
-        bounded by log2(max_batch) * max_seq_len/page_size."""
-        fn = self._prefill_fns.get((nb, bucket))
+    def _get_prefill_fn(self, nb, bucket, all_greedy):
+        """One compiled prefill per (batch-bucket, token-bucket,
+        all-greedy?): prompts pad to a page multiple, batch pads to a
+        power of two. The all-greedy specialization skips the per-row
+        sampler's vocab sort entirely (argmax only)."""
+        fn = self._prefill_fns.get((nb, bucket, all_greedy))
         if fn is not None:
             return fn
         model = self.model
         from ..jit.api import _LayerScope
-        from ..models.generation import sample_logits
+        from ..models.generation import (sample_logits,
+                                         sample_logits_per_row)
 
-        strategy = self.decode_strategy
-        temp, tk, tp = self.temperature, self.top_k, self.top_p
-
-        def pure_prefill(params, buffers, ids, true_lens, seed):
+        def pure_prefill(params, buffers, ids, true_lens, seed,
+                         greedy, temp, tk, tp):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
                 caches = model.init_kv_caches(nb, bucket)
                 logits, caches = model.forward_cached(
@@ -255,14 +274,20 @@ class ServingEngine:
                 # causal mask => position true_len-1 ignores the padding
                 last = as_array(logits)[jnp.arange(nb), true_lens - 1, :]
                 # first token sampled ON DEVICE (round-2 verdict weak #5:
-                # the host-side sample paid a [nb, vocab] transfer)
+                # the host-side sample paid a [nb, vocab] transfer),
+                # per-request params as runtime [nb] arrays
                 key = jax.random.wrap_key_data(seed)
-                first, _ = sample_logits(last, key, strategy, temp, tk, tp)
+                if all_greedy:
+                    first, _ = sample_logits(last, key, "greedy_search")
+                else:
+                    first, _ = sample_logits_per_row(last, key, greedy,
+                                                     temp, tk, tp)
                 ks = jnp.stack([as_array(k) for k, v in caches])
                 vs = jnp.stack([as_array(v) for k, v in caches])
             return first, ks, vs  # ks: [L, nb, bucket, kvh, hd]
 
-        fn = self._prefill_fns[(nb, bucket)] = jax.jit(pure_prefill)
+        fn = self._prefill_fns[(nb, bucket, all_greedy)] = \
+            jax.jit(pure_prefill)
         return fn
 
     def _prefill_batch(self, new):
@@ -275,16 +300,28 @@ class ServingEngine:
         nb = min(nb, self.max_batch)
         longest = max(len(ids) for _, ids in new)
         bucket = -(-longest // self.page_size) * self.page_size
-        fn = self._get_prefill_fn(nb, bucket)
+        all_greedy = all(self.slots[si].greedy for si, _ in new)
+        fn = self._get_prefill_fn(nb, bucket, all_greedy)
         params, buffers = self._cached_params()
         padded = np.zeros((nb, bucket), np.int64)
         true_lens = np.ones((nb,), np.int32)
-        for row, (_, ids) in enumerate(new):
+        greedy = np.ones((nb,), bool)
+        temp = np.ones((nb,), np.float32)
+        tk = np.zeros((nb,), np.int32)
+        tp_arr = np.ones((nb,), np.float32)
+        for row, (si, ids) in enumerate(new):
             padded[row, :len(ids)] = ids
             true_lens[row] = len(ids)
+            rp = self._req_params[self.slots[si].request_id]
+            greedy[row] = rp["greedy"]
+            temp[row] = rp["temperature"]
+            tk[row] = rp["top_k"]
+            tp_arr[row] = rp["top_p"]
         self._key, sk = jax.random.split(self._key)
         first, ks, vs = fn(params, buffers, jnp.asarray(padded),
-                           jnp.asarray(true_lens), jax.random.key_data(sk))
+                           jnp.asarray(true_lens), jax.random.key_data(sk),
+                           jnp.asarray(greedy), jnp.asarray(temp),
+                           jnp.asarray(tk), jnp.asarray(tp_arr))
         tables = jnp.asarray(np.stack(
             [self.block_tables[si] for si, _ in new]))
         lens = jnp.asarray(true_lens[:n], jnp.int32)
@@ -302,34 +339,39 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # decode step: one jitted forward for all slots
     # ------------------------------------------------------------------
-    def _get_decode_fn(self):
-        if self._decode_fn is not None:
-            return self._decode_fn
+    def _get_decode_fn(self, all_greedy):
+        fn = self._decode_fns.get(all_greedy)
+        if fn is not None:
+            return fn
         model = self.model
         from ..jit.api import _LayerScope
-        from ..models.generation import sample_logits
-
-        strategy = self.decode_strategy
-        temp, tk, tp = self.temperature, self.top_k, self.top_p
+        from ..models.generation import (sample_logits,
+                                         sample_logits_per_row)
 
         serving_mesh = self.mesh
 
         def pure_decode(params, buffers, k_pages, v_pages, tokens, tables,
-                        lens, active, seed):
+                        lens, active, seed, greedy, temp, tk, tp):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
                 caches = list(zip(k_pages, v_pages))
                 logits, new_caches = model.forward_paged(
                     Tensor(tokens[:, None]), caches, tables, lens,
                     active=active, mesh=serving_mesh)
                 key = jax.random.wrap_key_data(seed)
-                nxt, lp = sample_logits(as_array(logits)[:, 0], key,
-                                        strategy, temp, tk, tp)
+                if all_greedy:
+                    # static specialization: no vocab sort, argmax only
+                    nxt, lp = sample_logits(as_array(logits)[:, 0], key,
+                                            "greedy_search")
+                else:
+                    nxt, lp = sample_logits_per_row(
+                        as_array(logits)[:, 0], key, greedy, temp, tk, tp)
                 nk = tuple(as_array(k) for k, v in new_caches)
                 nv = tuple(as_array(v) for k, v in new_caches)
             return nxt, nk, nv
 
-        self._decode_fn = jax.jit(pure_decode, donate_argnums=(2, 3))
-        return self._decode_fn
+        fn = self._decode_fns[all_greedy] = jax.jit(
+            pure_decode, donate_argnums=(2, 3))
+        return fn
 
     def step(self) -> List[FinishedRequest]:
         """Run one decode step for all active slots; returns requests that
@@ -377,14 +419,28 @@ class ServingEngine:
                            for s in self.slots], np.int32)
         act_mask = np.asarray([s.active and i in active
                                for i, s in enumerate(self.slots)], bool)
-        fn = self._get_decode_fn()
+        fn = self._get_decode_fn(all(self.slots[i].greedy for i in active))
         self._key, sk = jax.random.split(self._key)
         params, buffers = self._cached_params()
+        defaults = dict(greedy=True, temperature=1.0, top_k=0, top_p=1.0)
+
+        def _rp(s):
+            return self._req_params.get(s.request_id, defaults) \
+                if s.active else defaults
+
+        greedy = np.asarray([_rp(s)["greedy"] for s in self.slots], bool)
+        temp = np.asarray([_rp(s)["temperature"] for s in self.slots],
+                          np.float32)
+        tk = np.asarray([_rp(s)["top_k"] for s in self.slots], np.int32)
+        tp_arr = np.asarray([_rp(s)["top_p"] for s in self.slots],
+                            np.float32)
         nxt, nk, nv = fn(params, buffers, tuple(self.k_pages),
                          tuple(self.v_pages), jnp.asarray(tokens),
                          jnp.asarray(self.block_tables),
                          jnp.asarray(lens), jnp.asarray(act_mask),
-                         jax.random.key_data(sk))
+                         jax.random.key_data(sk), jnp.asarray(greedy),
+                         jnp.asarray(temp), jnp.asarray(tk),
+                         jnp.asarray(tp_arr))
         self.k_pages, self.v_pages = list(nk), list(nv)
         nxt = np.asarray(nxt)
         finished = finished_early
@@ -408,6 +464,7 @@ class ServingEngine:
             self.block_tables[slot_idx, :s.n_pages].tolist())
         s.n_pages = 0
         s.active = False
+        self._req_params.pop(s.request_id, None)
         return FinishedRequest(
             request_id=s.request_id,
             prompt_ids=self._prompts.pop(s.request_id),

@@ -42,9 +42,11 @@ def sample_logits(logits, key, decode_strategy="sampling", temperature=1.0,
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens whose prefix (exclusive) mass is < top_p; always keep
-        # the argmax
-        keep_sorted = (cum - probs) < jnp.float32(top_p)
+        # keep tokens whose prefix (exclusive) mass is < top_p; the argmax
+        # is ALWAYS kept (top_p <= 0 would otherwise mask everything and
+        # degrade to uniform sampling)
+        keep_sorted = ((cum - probs) < jnp.float32(top_p)) | (
+            jax.lax.broadcasted_iota(jnp.int32, cum.shape, 1) == 0)
         # threshold = smallest kept logit
         thresh = jnp.min(
             jnp.where(keep_sorted, sorted_logits, jnp.float32(np.inf)),
@@ -53,6 +55,50 @@ def sample_logits(logits, key, decode_strategy="sampling", temperature=1.0,
     tok = jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
     lp = jax.nn.log_softmax(logits, axis=-1)
     return tok, jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
+
+
+def sample_logits_per_row(logits, key, greedy, temperature, top_k, top_p):
+    """Vectorized per-ROW sampling from [b, vocab] logits — each request
+    carries its own decode params (the serving engine's per-request
+    sampling; reference: PaddleNLP generate kwargs per call).
+
+    greedy: [b] bool — argmax rows; temperature/top_k/top_p: [b] arrays
+    (top_k == 0 disables the k filter for that row; top_p == 1.0 disables
+    the nucleus filter). Returns (tokens [b] i32, logprobs [b] f32)."""
+    logits = logits.astype(jnp.float32)
+    lp_plain = jax.nn.log_softmax(logits, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    f = logits / temp
+    sorted_desc = jnp.sort(f, axis=-1)[:, ::-1]
+    # per-row top-k threshold: the (k-1)-th largest; k==0 -> keep all
+    kk = jnp.clip(top_k.astype(jnp.int32), 0, f.shape[-1])
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.maximum(kk - 1, 0)[:, None], axis=-1)
+    f = jnp.where((kk[:, None] > 0) & (f < kth), jnp.float32(-1e30), f)
+    # per-row nucleus on the top-k-FILTERED distribution (the scalar
+    # sampler applies its filters sequentially — same semantics here);
+    # the argmax is ALWAYS kept so top_p <= 0 means argmax-only
+    sorted_f = jnp.sort(f, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = ((cum - probs) < top_p.astype(jnp.float32)[:, None]) | (
+        jax.lax.broadcasted_iota(jnp.int32, cum.shape, 1) == 0)
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_f, jnp.float32(np.inf)),
+        axis=-1, keepdims=True)
+    f = jnp.where((top_p[:, None] < 1.0) & (f < thresh),
+                  jnp.float32(-1e30), f)
+    sampled_tok = jax.random.categorical(key, f, axis=-1).astype(jnp.int32)
+
+    tok = jnp.where(greedy, greedy_tok, sampled_tok)
+    lp_f = jax.nn.log_softmax(f, axis=-1)
+    lp = jnp.where(
+        greedy,
+        jnp.take_along_axis(lp_plain, greedy_tok[:, None], axis=-1)[:, 0],
+        jnp.take_along_axis(lp_f, sampled_tok[:, None], axis=-1)[:, 0])
+    return tok, lp
 
 
 def _build_generate_fn(model, batch, prompt_len, total_len, decode_strategy,
