@@ -156,11 +156,19 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
                     decode_strategy=None, temperature=None, top_k=None,
-                    top_p=None) -> int:
+                    top_p=None, eos_token_id=None, on_token=None) -> int:
         """Queue a request. Sampling params default to the engine-level
         settings; per-request overrides ride the request through
         preemption/re-admission (one compiled decode step serves mixed
-        greedy/sampling batches — params are runtime [b] arrays)."""
+        greedy/sampling batches — params are runtime [b] arrays).
+
+        eos_token_id: per-request stop token (falls back to the engine's).
+        on_token: optional callable(rid, token_id) streamed each time a
+        token is COMMITTED for this request (host-side, after the decode
+        step). On preemption the already-streamed tokens are preserved
+        with the request and NOT re-streamed — streaming resumes from the
+        next new token after re-admission. Calling engine.abort() from
+        inside the callback is supported."""
         ids = np.asarray(as_array(prompt_ids)).reshape(-1).astype(np.int64)
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -178,7 +186,10 @@ class ServingEngine:
             temperature=float(temperature if temperature is not None
                               else self.temperature),
             top_k=int(top_k if top_k is not None else self.top_k),
-            top_p=float(top_p if top_p is not None else self.top_p))
+            top_p=float(top_p if top_p is not None else self.top_p),
+            eos=eos_token_id if eos_token_id is not None
+            else self.eos_token_id,
+            on_token=on_token)
         # queue only — admission happens at the next step() so requests
         # arriving together prefill together in one batched compiled call
         self._pending.append((rid, ids, int(max_new_tokens), []))
@@ -223,6 +234,44 @@ class ServingEngine:
         if new:
             self._prefill_batch(new)
 
+    def _req_eos(self, rid):
+        rp = self._req_params.get(rid)
+        return rp["eos"] if rp is not None else self.eos_token_id
+
+    def _stream(self, rid, token):
+        rp = self._req_params.get(rid)
+        cb = rp.get("on_token") if rp is not None else None
+        if cb is not None:
+            cb(rid, int(token))
+
+    def _release_slot(self, slot_idx):
+        """Return a slot's pages to the pool and deactivate it (shared by
+        finish / preempt / abort)."""
+        s = self.slots[slot_idx]
+        self._free_pages.extend(
+            self.block_tables[slot_idx, :s.n_pages].tolist())
+        s.n_pages = 0
+        s.active = False
+
+    def abort(self, request_id: int) -> bool:
+        """Drop a request: dequeue it if still pending, or free its slot
+        and pages if running (safe to call from an on_token callback).
+        Returns True if it was found. Nothing is emitted for an aborted
+        request (vLLM abort semantics)."""
+        for i, (rid, *_rest) in enumerate(self._pending):
+            if rid == request_id:
+                self._pending.pop(i)
+                self._prompts.pop(request_id, None)
+                self._req_params.pop(request_id, None)
+                return True
+        for idx, s in enumerate(self.slots):
+            if s.active and s.request_id == request_id:
+                self._release_slot(idx)
+                self._prompts.pop(request_id, None)
+                self._req_params.pop(request_id, None)
+                return True
+        return False
+
     def _ensure_page(self, slot_idx) -> bool:
         """Grow the slot's allocation to cover writing position context_len.
         Returns False if the pool is exhausted (caller preempts)."""
@@ -240,10 +289,7 @@ class ServingEngine:
         the FRONT of pending with its context so far; it re-prefills when
         pages free up — the reference/vLLM recompute-preemption policy."""
         s = self.slots[slot_idx]
-        self._free_pages.extend(
-            self.block_tables[slot_idx, :s.n_pages].tolist())
-        s.n_pages = 0
-        s.active = False
+        self._release_slot(slot_idx)
         self._pending.insert(
             0, (s.request_id, self._prompts[s.request_id],
                 s.max_new_tokens, list(s.tokens)))
@@ -390,8 +436,9 @@ class ServingEngine:
             if s.needs_first_sample:
                 s.needs_first_sample = False
                 s.tokens.append(s._first_token)
-                if (self.eos_token_id is not None
-                        and s.tokens[-1] == self.eos_token_id) or \
+                self._stream(s.request_id, s._first_token)
+                eos = self._req_eos(s.request_id)
+                if (eos is not None and s.tokens[-1] == eos) or \
                         len(s.tokens) >= s.max_new_tokens:
                     first_done.append(i)
             tokens[i] = s.tokens[-1]
@@ -446,13 +493,18 @@ class ServingEngine:
         finished = finished_early
         for i in active:
             s = self.slots[i]
+            if not s.active:
+                continue  # abort()ed from an on_token callback this step
             s.context_len += 1  # the token we just fed is now cached
             s.tokens.append(int(nxt[i]))
+            self._stream(s.request_id, s.tokens[-1])
+            if not s.active:
+                continue  # the callback above aborted THIS request
             # finish at append time (slots at max_new never re-enter decode;
             # add_request guarantees context_len stays <= max_seq_len)
+            eos = self._req_eos(s.request_id)
             if len(s.tokens) >= s.max_new_tokens or (
-                    self.eos_token_id is not None
-                    and s.tokens[-1] == self.eos_token_id):
+                    eos is not None and s.tokens[-1] == eos):
                 finished.append(self._finish(i))
         if finished:
             self._admit()
@@ -460,14 +512,15 @@ class ServingEngine:
 
     def _finish(self, slot_idx) -> FinishedRequest:
         s = self.slots[slot_idx]
-        self._free_pages.extend(
-            self.block_tables[slot_idx, :s.n_pages].tolist())
-        s.n_pages = 0
-        s.active = False
+        self._release_slot(slot_idx)
         self._req_params.pop(s.request_id, None)
+        # pop with default: an on_token callback may have abort()ed the
+        # request between the decode step and this finish
+        prompt = self._prompts.pop(s.request_id, None)
         return FinishedRequest(
             request_id=s.request_id,
-            prompt_ids=self._prompts.pop(s.request_id),
+            prompt_ids=prompt if prompt is not None
+            else np.zeros((0,), np.int64),
             output_ids=np.asarray(s.tokens, np.int64))
 
     def has_work(self) -> bool:

@@ -168,3 +168,85 @@ class TestPerRequestSampling:
             eng2.add_request(p, max_new_tokens=8)
         ref = {f.request_id: f.output_ids.tolist() for f in eng2.run()}
         assert done == ref
+
+
+class TestServingRequestAPI:
+    """Per-request eos, streaming callbacks, abort (vLLM-style request
+    lifecycle on the reference serving surface)."""
+
+    def test_per_request_eos_stops_early(self):
+        model = _build(seed=9)
+        # find what greedy emits, then use its second token as this
+        # request's eos: generation must stop right there
+        probe = ServingEngine(model, max_batch=2, max_seq_len=64,
+                              page_size=8, decode_strategy="greedy_search")
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(0, 128, (9,))
+        probe.add_request(prompt, max_new_tokens=6)
+        toks = probe.run()[0].output_ids.tolist()
+        # pick an eos position whose token has not occurred before it, so
+        # the stop is attributable to exactly that position
+        stop = next(j for j in range(1, len(toks))
+                    if toks[j] not in toks[:j])
+
+        model2 = _build(seed=9)
+        eng = ServingEngine(model2, max_batch=2, max_seq_len=64,
+                            page_size=8, decode_strategy="greedy_search")
+        eng.add_request(prompt, max_new_tokens=6, eos_token_id=toks[stop])
+        out = eng.run()[0].output_ids.tolist()
+        assert out == toks[:stop + 1]
+
+    def test_streaming_callback_sees_every_token_in_order(self):
+        model = _build(seed=10)
+        eng = ServingEngine(model, max_batch=2, max_seq_len=64,
+                            page_size=8, decode_strategy="greedy_search")
+        rng = np.random.RandomState(5)
+        streamed = []
+        rid = eng.add_request(rng.randint(0, 128, (7,)), max_new_tokens=6,
+                              on_token=lambda r, t: streamed.append((r, t)))
+        out = eng.run()[0].output_ids.tolist()
+        assert [t for r, t in streamed] == out
+        assert all(r == rid for r, _ in streamed)
+
+    def test_abort_pending_and_running(self):
+        model = _build(seed=11)
+        eng = ServingEngine(model, max_batch=1, max_seq_len=64,
+                            page_size=8, decode_strategy="greedy_search")
+        rng = np.random.RandomState(6)
+        r0 = eng.add_request(rng.randint(0, 128, (6,)), max_new_tokens=6)
+        r1 = eng.add_request(rng.randint(0, 128, (6,)), max_new_tokens=6)
+        # r1 still pending (max_batch=1): abort it before it runs
+        assert eng.abort(r1)
+        eng.step()  # admits + prefills r0
+        assert eng.abort(r0)          # abort mid-flight
+        assert not eng.abort(12345)   # unknown id
+        done = eng.run()
+        assert done == []             # nothing emitted for aborted requests
+        assert not eng.has_work()
+        # engine still serves new work afterwards (pages were freed)
+        r2 = eng.add_request(rng.randint(0, 128, (6,)), max_new_tokens=4)
+        done = eng.run()
+        assert len(done) == 1 and done[0].request_id == r2
+
+    def test_abort_from_streaming_callback(self):
+        """Client-disconnect pattern: on_token aborts its own request
+        mid-decode; the step must survive and emit nothing for it."""
+        model = _build(seed=12)
+        eng = ServingEngine(model, max_batch=2, max_seq_len=64,
+                            page_size=8, decode_strategy="greedy_search")
+        rng = np.random.RandomState(7)
+        seen = []
+
+        def cb(rid, tok):
+            seen.append(tok)
+            if len(seen) == 3:
+                eng.abort(rid)
+
+        rid = eng.add_request(rng.randint(0, 128, (6,)), max_new_tokens=8,
+                              on_token=cb)
+        other = eng.add_request(rng.randint(0, 128, (6,)), max_new_tokens=8)
+        done = {f.request_id: f.output_ids.tolist() for f in eng.run()}
+        assert rid not in done          # aborted: nothing emitted
+        assert len(seen) == 3           # streaming stopped at the abort
+        assert len(done[other]) == 8    # the other request unaffected
+        assert not eng.has_work()
