@@ -234,6 +234,33 @@ class ServingEngine:
         if new:
             self._prefill_batch(new)
 
+    def warmup(self, prompt_len=None, sampling=False):
+        """Pre-compile the serving programs BEFORE traffic: runs one
+        throwaway greedy request end to end (prefill bucket + the
+        all-greedy decode specialization); sampling=True runs a second
+        throwaway sampling request so the per-row-sampler variants
+        compile too. Must be called on an idle engine (queued work would
+        be drained and its outputs discarded). Returns wall seconds."""
+        import time as _time
+
+        if self.has_work():
+            raise RuntimeError(
+                "warmup() must run on an idle engine: queued/active "
+                "requests would be decoded and their outputs discarded")
+        t0 = _time.perf_counter()
+        plen = int(prompt_len) if prompt_len is not None else min(
+            self.page_size, self.max_seq_len - 2)
+        strategies = ["greedy_search"] + (["sampling"] if sampling else [])
+        for strategy in strategies:
+            # eos -1 can never match a token id: the throwaway request is
+            # guaranteed to reach the decode step (an engine-level eos
+            # matching the first sampled token would otherwise finish at
+            # prefill and skip the decode compile entirely)
+            self.add_request(np.zeros((plen,), np.int64), max_new_tokens=2,
+                             decode_strategy=strategy, eos_token_id=-1)
+            self.run()
+        return _time.perf_counter() - t0
+
     def _req_eos(self, rid):
         rp = self._req_params.get(rid)
         return rp["eos"] if rp is not None else self.eos_token_id

@@ -250,3 +250,26 @@ class TestServingRequestAPI:
         assert len(seen) == 3           # streaming stopped at the abort
         assert len(done[other]) == 8    # the other request unaffected
         assert not eng.has_work()
+
+    def test_warmup_precompiles(self):
+        """warmup() runs throwaway requests; sampling=True compiles BOTH
+        decode specializations, and a busy engine is rejected."""
+        model = _build(seed=13)
+        eng = ServingEngine(model, max_batch=2, max_seq_len=64,
+                            page_size=8, decode_strategy="greedy_search")
+        dt = eng.warmup(sampling=True)
+        assert dt > 0
+        assert True in eng._decode_fns and False in eng._decode_fns
+        assert any(k[2] is True for k in eng._prefill_fns)
+        assert any(k[2] is False for k in eng._prefill_fns)
+        rng = np.random.RandomState(8)
+        eng.add_request(rng.randint(0, 128, (8,)), max_new_tokens=4)
+        done = eng.run()
+        assert len(done) == 1 and len(done[0].output_ids) == 4
+        # busy engine: warmup refuses instead of draining real work
+        eng.add_request(rng.randint(0, 128, (8,)), max_new_tokens=4)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="idle"):
+            eng.warmup()
+        assert len(eng.run()) == 1  # the real request is intact
