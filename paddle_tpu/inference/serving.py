@@ -234,19 +234,22 @@ class ServingEngine:
         if new:
             self._prefill_batch(new)
 
-    def warmup(self, prompt_len=None, sampling=False):
+    def warmup(self, prompt_len=None, sampling=None):
         """Pre-compile the serving programs BEFORE traffic: runs one
         throwaway greedy request end to end (prefill bucket + the
-        all-greedy decode specialization); sampling=True runs a second
-        throwaway sampling request so the per-row-sampler variants
-        compile too. Must be called on an idle engine (queued work would
-        be drained and its outputs discarded). Returns wall seconds."""
+        all-greedy decode specialization), plus a sampling request for
+        the per-row-sampler variants when sampling=True — or by default
+        whenever the ENGINE's decode_strategy is a sampling one. Must be
+        called on an idle engine (queued work would be drained and its
+        outputs discarded). Returns wall seconds."""
         import time as _time
 
         if self.has_work():
             raise RuntimeError(
                 "warmup() must run on an idle engine: queued/active "
                 "requests would be decoded and their outputs discarded")
+        if sampling is None:
+            sampling = self.decode_strategy != "greedy_search"
         t0 = _time.perf_counter()
         plen = int(prompt_len) if prompt_len is not None else min(
             self.page_size, self.max_seq_len - 2)

@@ -268,8 +268,6 @@ class TestServingRequestAPI:
         assert len(done) == 1 and len(done[0].output_ids) == 4
         # busy engine: warmup refuses instead of draining real work
         eng.add_request(rng.randint(0, 128, (8,)), max_new_tokens=4)
-        import pytest as _pytest
-
-        with _pytest.raises(RuntimeError, match="idle"):
+        with pytest.raises(RuntimeError, match="idle"):
             eng.warmup()
         assert len(eng.run()) == 1  # the real request is intact
