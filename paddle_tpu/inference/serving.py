@@ -56,7 +56,8 @@ class ServingEngine:
 
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
                  decode_strategy="greedy_search", temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None):
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
+                 decode_burst=1):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         self.model = model
@@ -123,7 +124,18 @@ class ServingEngine:
         self._admit_seq = 0
         self._key = jax.random.PRNGKey(seed)
         self._decode_fns: Dict[bool, object] = {}
+        self._burst_fns: Dict[tuple, object] = {}
         self._prefill_fns: Dict[tuple, object] = {}
+        # multi-step scheduling (vLLM-style): run `decode_burst` decode
+        # steps inside ONE compiled lax.scan — on-device sampling feeds
+        # the next step, per-slot budget/eos masks deactivate finished
+        # rows — and sync with the host once per burst. On a tunneled
+        # chip the per-step host round-trip dominates single-token decode
+        # (round-4 measurement: ~300 ms/step vs ~ms of compute), so burst
+        # K amortizes it K-fold. Token callbacks still fire per token (in
+        # order, after the burst), so streaming semantics are unchanged;
+        # abort() from a callback takes effect at burst granularity.
+        self.decode_burst = max(1, int(decode_burst))
         # params pytree cached across steps (round-2 verdict weak #5:
         # rebuilding it every decode step); call refresh_params() after
         # mutating model weights
@@ -202,7 +214,7 @@ class ServingEngine:
         # Pages are allocated ON DEMAND (round-2 verdict weak #5: reserving
         # the full pages_per_seq up front voided paging's memory
         # elasticity): admission takes only the prompt's pages; decode
-        # grows the allocation page by page (_ensure_page), and exhaustion
+        # grows the allocation page by page (_ensure_pages), and exhaustion
         # preempts the youngest slot (vLLM's recompute policy).
         new: List[tuple] = []  # (slot_idx, context_ids)
         while self._pending:
@@ -251,17 +263,29 @@ class ServingEngine:
         if sampling is None:
             sampling = self.decode_strategy != "greedy_search"
         t0 = _time.perf_counter()
-        plen = int(prompt_len) if prompt_len is not None else min(
-            self.page_size, self.max_seq_len - 2)
+        # a burst engine's first decode call sizes its scan at the full
+        # decode_burst: ask for decode_burst + 1 new tokens (first one
+        # comes from the prefill-time sample) so warmup compiles the SAME
+        # burst program traffic will use. step() still falls back to the
+        # single-step program when every active row is on its last token,
+        # so a second 2-token request warms that program too.
+        max_new = self.decode_burst + 1
+        plen = int(prompt_len) if prompt_len is not None else max(
+            1, min(self.page_size, self.max_seq_len - max_new))
+        max_new = max(2, min(max_new, self.max_seq_len - plen))
+        budgets = [max_new] + ([2] if self.decode_burst > 1 and
+                               max_new > 2 else [])
         strategies = ["greedy_search"] + (["sampling"] if sampling else [])
         for strategy in strategies:
-            # eos -1 can never match a token id: the throwaway request is
-            # guaranteed to reach the decode step (an engine-level eos
-            # matching the first sampled token would otherwise finish at
-            # prefill and skip the decode compile entirely)
-            self.add_request(np.zeros((plen,), np.int64), max_new_tokens=2,
-                             decode_strategy=strategy, eos_token_id=-1)
-            self.run()
+            for mx in budgets:
+                # eos -1 can never match a token id: the throwaway request
+                # is guaranteed to reach the decode step (an engine-level
+                # eos matching the first sampled token would otherwise
+                # finish at prefill and skip the decode compile entirely)
+                self.add_request(np.zeros((plen,), np.int64),
+                                 max_new_tokens=mx,
+                                 decode_strategy=strategy, eos_token_id=-1)
+                self.run()
         return _time.perf_counter() - t0
 
     def _req_eos(self, rid):
@@ -302,11 +326,13 @@ class ServingEngine:
                 return True
         return False
 
-    def _ensure_page(self, slot_idx) -> bool:
-        """Grow the slot's allocation to cover writing position context_len.
-        Returns False if the pool is exhausted (caller preempts)."""
+    def _ensure_pages(self, slot_idx, steps) -> bool:
+        """Grow the slot's allocation to cover `steps` successive decode
+        writes starting at context_len (1 for a single step, up to the
+        burst length for multi-step decode). Returns False if the pool is
+        exhausted (caller preempts)."""
         s = self.slots[slot_idx]
-        need = -(-(s.context_len + 1) // self.page_size)
+        need = -(-(s.context_len + steps) // self.page_size)
         while s.n_pages < need:
             if not self._free_pages:
                 return False
@@ -415,38 +441,97 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # decode step: one jitted forward for all slots
     # ------------------------------------------------------------------
+    def _decode_step_core(self, all_greedy):
+        """ONE single-token decode step (forward_paged + sampling + cache
+        repack) shared by the one-step program and the burst scan body —
+        the single place the decode semantics live, so the two programs
+        cannot drift apart."""
+        model = self.model
+        from ..models.generation import (sample_logits,
+                                         sample_logits_per_row)
+
+        serving_mesh = self.mesh
+
+        def core(tok, kps, vps, tables, lens, act, key, greedy, temp, tk,
+                 tp):
+            caches = list(zip(kps, vps))
+            logits, new_caches = model.forward_paged(
+                Tensor(tok[:, None]), caches, tables, lens,
+                active=act, mesh=serving_mesh)
+            if all_greedy:
+                # static specialization: no vocab sort, argmax only
+                nxt, _ = sample_logits(as_array(logits)[:, 0], key,
+                                       "greedy_search")
+            else:
+                nxt, _ = sample_logits_per_row(
+                    as_array(logits)[:, 0], key, greedy, temp, tk, tp)
+            nk = tuple(as_array(k) for k, v in new_caches)
+            nv = tuple(as_array(v) for k, v in new_caches)
+            return nxt, nk, nv
+
+        return core
+
     def _get_decode_fn(self, all_greedy):
         fn = self._decode_fns.get(all_greedy)
         if fn is not None:
             return fn
         model = self.model
         from ..jit.api import _LayerScope
-        from ..models.generation import (sample_logits,
-                                         sample_logits_per_row)
 
-        serving_mesh = self.mesh
+        core = self._decode_step_core(all_greedy)
 
         def pure_decode(params, buffers, k_pages, v_pages, tokens, tables,
                         lens, active, seed, greedy, temp, tk, tp):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
-                caches = list(zip(k_pages, v_pages))
-                logits, new_caches = model.forward_paged(
-                    Tensor(tokens[:, None]), caches, tables, lens,
-                    active=active, mesh=serving_mesh)
                 key = jax.random.wrap_key_data(seed)
-                if all_greedy:
-                    # static specialization: no vocab sort, argmax only
-                    nxt, lp = sample_logits(as_array(logits)[:, 0], key,
-                                            "greedy_search")
-                else:
-                    nxt, lp = sample_logits_per_row(
-                        as_array(logits)[:, 0], key, greedy, temp, tk, tp)
-                nk = tuple(as_array(k) for k, v in new_caches)
-                nv = tuple(as_array(v) for k, v in new_caches)
+                nxt, nk, nv = core(tokens, k_pages, v_pages, tables, lens,
+                                   active, key, greedy, temp, tk, tp)
             return nxt, nk, nv
 
         fn = self._decode_fns[all_greedy] = jax.jit(
             pure_decode, donate_argnums=(2, 3))
+        return fn
+
+    def _get_burst_fn(self, all_greedy, n_steps):
+        """Compiled K-step decode: lax.scan over the single-token step with
+        on-device sampling feeding the next iteration. Per-row masks mirror
+        the host's finish rules exactly — a row stays active while its
+        remaining-token budget is positive and it has not emitted its eos —
+        so the host replay of (tokens, emitted) flags reconstructs the same
+        streams single-stepping would have produced."""
+        fn = self._burst_fns.get((all_greedy, n_steps))
+        if fn is not None:
+            return fn
+        model = self.model
+        from ..jit.api import _LayerScope
+
+        core = self._decode_step_core(all_greedy)
+
+        def pure_burst(params, buffers, k_pages, v_pages, tokens, tables,
+                       lens, active, rem, eos, seed, greedy, temp, tk, tp):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                def one(carry, _):
+                    tok, kps, vps, ln, act, rm, key = carry
+                    key, sk = jax.random.split(key)
+                    nxt, nk, nv = core(tok, kps, vps, tables, ln, act, sk,
+                                       greedy, temp, tk, tp)
+                    nxt = nxt.astype(tok.dtype)
+                    emitted = act
+                    ln2 = ln + act.astype(ln.dtype)
+                    rm2 = rm - act.astype(rm.dtype)
+                    act2 = act & (rm2 > 0) & (nxt != eos)
+                    tok2 = jnp.where(act, nxt, tok)
+                    return (tok2, nk, nv, ln2, act2, rm2, key), (nxt, emitted)
+
+                key = jax.random.wrap_key_data(seed)
+                carry, (toks, emits) = jax.lax.scan(
+                    one, (tokens, k_pages, v_pages, lens, active, rem, key),
+                    None, length=n_steps)
+                _, nk, nv, _, _, _, _ = carry
+            return toks, emits, nk, nv
+
+        fn = self._burst_fns[(all_greedy, n_steps)] = jax.jit(
+            pure_burst, donate_argnums=(2, 3))
         return fn
 
     def step(self) -> List[FinishedRequest]:
@@ -480,11 +565,24 @@ class ServingEngine:
             if finished_early:
                 self._admit()
             return finished_early
-        # on-demand page growth for the position this step writes; pool
+        # burst sizing buckets to {1, decode_burst} — ONE compiled scan
+        # length (a per-tail-length K would compile a new program for every
+        # distinct remaining budget). Rows that exhaust their budget or hit
+        # eos mid-burst deactivate on device, so a partially-useful final
+        # burst is correct, just not free; it only occurs while the queue
+        # drains. max rem == 1 (every row on its last token) drops to the
+        # single-step program.
+        rem_of = {i: self.slots[i].max_new_tokens - len(self.slots[i].tokens)
+                  for i in active}
+        k_burst = self.decode_burst if (
+            self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
+        # on-demand page growth for the positions this step writes (one per
+        # single step, up to min(burst, remaining) for a burst); pool
         # exhaustion preempts the youngest slot (recompute policy) and
         # retries, so the oldest slots always make progress
         while True:
-            stalled = [i for i in active if not self._ensure_page(i)]
+            stalled = [i for i in active
+                       if not self._ensure_pages(i, min(k_burst, rem_of[i]))]
             if not stalled:
                 break
             victim = max(stalled, key=lambda i: self.slots[i].admit_seq)
@@ -496,7 +594,7 @@ class ServingEngine:
                            for s in self.slots], np.int32)
         act_mask = np.asarray([s.active and i in active
                                for i, s in enumerate(self.slots)], bool)
-        fn = self._get_decode_fn(all(self.slots[i].greedy for i in active))
+        all_greedy = all(self.slots[i].greedy for i in active)
         self._key, sk = jax.random.split(self._key)
         params, buffers = self._cached_params()
         defaults = dict(greedy=True, temperature=1.0, top_k=0, top_p=1.0)
@@ -511,6 +609,46 @@ class ServingEngine:
         tk = np.asarray([_rp(s)["top_k"] for s in self.slots], np.int32)
         tp_arr = np.asarray([_rp(s)["top_p"] for s in self.slots],
                             np.float32)
+        if k_burst > 1:
+            rem = np.asarray([max(rem_of.get(i, 0), 0) if act_mask[i] else 0
+                              for i in range(self.max_batch)], np.int32)
+            eos_arr = np.asarray(
+                [e if s.active and
+                 (e := self._req_eos(s.request_id)) is not None else -1
+                 for s in self.slots], np.int32)
+            fn = self._get_burst_fn(all_greedy, k_burst)
+            toks, emits, nk, nv = fn(
+                params, buffers, tuple(self.k_pages), tuple(self.v_pages),
+                jnp.asarray(tokens), jnp.asarray(self.block_tables),
+                jnp.asarray(lens), jnp.asarray(act_mask), jnp.asarray(rem),
+                jnp.asarray(eos_arr), jax.random.key_data(sk),
+                jnp.asarray(greedy), jnp.asarray(temp), jnp.asarray(tk),
+                jnp.asarray(tp_arr))
+            self.k_pages, self.v_pages = list(nk), list(nv)
+            toks = np.asarray(toks)     # [K, B]
+            emits = np.asarray(emits)   # [K, B] bool
+            finished = finished_early
+            # replay the burst token-by-token: identical host semantics to
+            # K single steps (stream order, finish rules, abort from a
+            # callback skips the rest of that request's burst)
+            for j in range(k_burst):
+                for i in active:
+                    s = self.slots[i]
+                    if not s.active or not emits[j, i]:
+                        continue
+                    s.context_len += 1
+                    s.tokens.append(int(toks[j, i]))
+                    self._stream(s.request_id, s.tokens[-1])
+                    if not s.active:
+                        continue  # the callback above aborted THIS request
+                    eos = self._req_eos(s.request_id)
+                    if len(s.tokens) >= s.max_new_tokens or (
+                            eos is not None and s.tokens[-1] == eos):
+                        finished.append(self._finish(i))
+            if finished:
+                self._admit()
+            return finished
+        fn = self._get_decode_fn(all_greedy)
         nxt, nk, nv = fn(params, buffers, tuple(self.k_pages),
                          tuple(self.v_pages), jnp.asarray(tokens),
                          jnp.asarray(self.block_tables),

@@ -58,6 +58,38 @@ class TestServingTP:
             assert ref[rid] == got[rid], (
                 f"request {rid}: single-device {ref[rid]} vs tp {got[rid]}")
 
+    def test_burst_token_parity_vs_single_device(self):
+        # burst decode under a tp mesh: the K-step scan runs the shard_map
+        # decode inside it; tokens must still match the single-device,
+        # single-step engine exactly (greedy)
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(0, 128, (n,)) for n in (9, 5, 12)]
+
+        model = _build()
+        ref = _generate(
+            ServingEngine(model, max_batch=3, max_seq_len=64, page_size=8,
+                          decode_strategy="greedy_search"),
+            prompts, new_tokens=10)
+
+        mesh_mod.set_mesh(None)
+        import jax
+
+        mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+            tp=4, devices=np.asarray(jax.devices("cpu")[:4])))
+        try:
+            model_tp = _build()
+            got = _generate(
+                ServingEngine(model_tp, max_batch=3, max_seq_len=64,
+                              page_size=8, decode_strategy="greedy_search",
+                              mesh=mesh, decode_burst=4),
+                prompts, new_tokens=10)
+        finally:
+            mesh_mod.set_mesh(None)
+
+        assert set(ref) == set(got)
+        for rid in ref:
+            assert ref[rid] == got[rid]
+
     def test_tp_pages_are_sharded(self):
         mesh_mod.set_mesh(None)
         import jax

@@ -51,8 +51,29 @@ def timeit(fn, q, *rest, iters=20):
     output (prevents skipping/overlap) and the loop ends with a host
     transfer (forces completion). See .claude/skills/verify/SKILL.md.
     """
-    out = fn(q, *rest)  # compile
-    float(jnp.sum(_first_leaf(out).astype(jnp.float32)))
+    # Warm up with ADAPTIVE synced executions, not one: on the axon tunnel
+    # the first ~6-7 EXECUTIONS of a freshly-compiled program (especially
+    # big Mosaic custom-call binaries) carry a ~2.4 s cumulative cost
+    # beyond the compile itself (remote executor upload / cache fill),
+    # re-paid if interleaved programs evict it. A single warmup call
+    # folded that into the timed loop and made the flash fwd read as a
+    # seq-independent ~110 ms/iter plateau (round-4 first capture). Warm
+    # until the last exec is within 2x of the fastest seen (min 4, max 16
+    # iterations) so the timed loop measures steady state only.
+    best = float("inf")
+    for widx in range(16):
+        w0 = time.perf_counter()
+        out = fn(q, *rest)
+        float(jnp.sum(_first_leaf(out).astype(jnp.float32)))
+        wdt = time.perf_counter() - w0
+        # plateau = this exec no longer improves on the best seen so far
+        # (>= 0.9*best, compared BEFORE folding wdt into best — a monotone
+        # decay would otherwise satisfy itself and stop at the minimum
+        # count) — but a single slow outlier (tunnel hiccup, > 2x best) is
+        # not a plateau: keep warming through it
+        if widx >= 4 and 0.9 * best <= wdt <= 2 * best:
+            break
+        best = min(best, wdt)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(q, *rest)
@@ -95,10 +116,13 @@ def main():
     causal = True
     rows = []
     for s in seqs:
-        if b * s * h * d * 2 > 2**31:
-            b_eff = max(1, b // (s // 2048))
-        else:
-            b_eff = b
+        # the binding memory constraint is the XLA REFERENCE's f32 score
+        # matrix (b*h*s^2*4 bytes, twice live in its backward), not the
+        # inputs: cap it at ~2 GB so the comparison fits a 16 GB chip
+        # (seq 8192 at b=4 OOMed with an 8 GB scores temp, round 4)
+        b_eff = b
+        while b_eff > 1 and b_eff * h * s * s * 4 > 2 * 2**30:
+            b_eff //= 2
         key = jax.random.PRNGKey(0)
         kq, kk, kv, kg = jax.random.split(key, 4)
         shape = (b_eff, s, h, d)
