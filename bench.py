@@ -141,6 +141,10 @@ def main():
                           intermediate_size=5504, num_hidden_layers=12,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048, dtype="bfloat16")
+        # one scanned layer body instead of 12 unrolled: ~12x smaller
+        # program for the axon remote-compile helper, which 500'd on the
+        # unrolled 0.74B step (BENCH_EXTRA.json round-4 diagnostics)
+        cfg.scan_layers = os.environ.get("BENCH_SCAN_LAYERS", "1") == "1"
         batch, seq, iters = 4, 2048, 10
     elif on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,

@@ -1022,12 +1022,21 @@ def make_stage_fn(template_layer, call: Optional[Callable] = None):
     call = call or (lambda mod, x: mod(x))
 
     def stage_fn(local_params, x):
+        # save/restore the template's own bindings (try/finally: a trace
+        # error mid-scan must not leave the layer bound to dead scan
+        # tracers, poisoning every later use of the model)
+        saved = {n: p._data for n, p in template_layer.named_parameters()}
+
         def body(h, layer_params):
             template_layer.load_pytree(layer_params)
             out = call(template_layer, Tensor(h))
             return as_array(out), None
 
-        h, _ = jax.lax.scan(body, x, local_params)
+        try:
+            h, _ = jax.lax.scan(body, x, local_params)
+        finally:
+            for n, p in template_layer.named_parameters():
+                p._rebind(saved[n])
         return h
 
     return stage_fn
@@ -1050,7 +1059,10 @@ def make_stage_fn_with_buffers(template_layer,
     call = call or (lambda mod, x: mod(x))
 
     def stage_fn(local_params, local_buffers, x):
+        # save/restore params AND buffers (try/finally: a trace error must
+        # not leave the template bound to dead scan tracers)
         saved = {n: b._data for n, b in template_layer.named_buffers()}
+        saved_p = {n: p._data for n, p in template_layer.named_parameters()}
 
         def body(h, pb):
             layer_params, layer_bufs = pb
@@ -1061,9 +1073,14 @@ def make_stage_fn_with_buffers(template_layer,
                         for n, b in template_layer.named_buffers()}
             return as_array(out), new_bufs
 
-        h, new_stack = jax.lax.scan(body, x, (local_params, local_buffers))
-        for n, b in template_layer.named_buffers():
-            b._rebind(saved[n])
+        try:
+            h, new_stack = jax.lax.scan(body, x,
+                                        (local_params, local_buffers))
+        finally:
+            for n, b in template_layer.named_buffers():
+                b._rebind(saved[n])
+            for n, p in template_layer.named_parameters():
+                p._rebind(saved_p[n])
         return h, new_stack
 
     return stage_fn

@@ -272,6 +272,15 @@ class ServingEngine:
         max_new = self.decode_burst + 1
         plen = int(prompt_len) if prompt_len is not None else max(
             1, min(self.page_size, self.max_seq_len - max_new))
+        if prompt_len is not None and self.decode_burst > 1 and \
+                plen + max_new > self.max_seq_len:
+            raise ValueError(
+                f"warmup(prompt_len={plen}) leaves no room for a "
+                f"decode_burst={self.decode_burst} budget within "
+                f"max_seq_len={self.max_seq_len}: the burst program would "
+                f"NOT be compiled and the first real request would pay "
+                f"the compile in-traffic. Use a shorter prompt_len (<= "
+                f"{self.max_seq_len - max_new}) or a smaller decode_burst.")
         max_new = max(2, min(max_new, self.max_seq_len - plen))
         budgets = [max_new] + ([2] if self.decode_burst > 1 and
                                max_new > 2 else [])

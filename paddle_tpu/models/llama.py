@@ -49,6 +49,14 @@ class LlamaConfig:
     # attention uses the balanced zigzag ring with zero per-layer
     # relayout gathers; RoPE follows the original token positions
     cp_zigzag_stream: bool = False
+    # compile the decoder stack as ONE lax.scan over weight-stacked layers
+    # instead of L unrolled copies: the jitted program shrinks ~L-fold
+    # (MaxText-style compile-time scaling; XLA re-traces one homogeneous
+    # body). Opt-in: the unrolled form lets XLA specialize per layer and
+    # is fine at small L. Ignored by the pipeline path (pp stages stack
+    # their layer blocks already) and by pure-eager execution (the
+    # autograd tape needs per-op dispatch).
+    scan_layers: bool = False
     dtype: str = "float32"
 
     @staticmethod
@@ -421,9 +429,46 @@ class LlamaModel(nn.Layer):
     def forward(self, input_ids, attn_mask=None):
         h = self.embed_tokens(input_ids)
         h = shard_tensor(h, "dp", ("sp", "sep"), None)
-        for layer in self.layers:
-            h = layer(h, attn_mask)
+        if self._use_scan_layers():
+            h = self._forward_scan(h, attn_mask)
+        else:
+            for layer in self.layers:
+                h = layer(h, attn_mask)
         return self.norm(h)
+
+    def _use_scan_layers(self):
+        """scan_layers applies only under a jax trace (jit / grad): pure
+        eager execution records autograd on the tape per op, which a
+        traced-once scan body would sidestep — fall back to the unrolled
+        loop there (the compile-size problem scan solves doesn't exist in
+        eager anyway)."""
+        if not getattr(self.config, "scan_layers", False) \
+                or len(self.layers) < 2:
+            return False
+        import jax as _jax
+
+        # the precise signal is whether the layer WEIGHTS are traced: the
+        # jitted train/eval step binds params to tracers (_LayerScope), and
+        # that is exactly when stacking+scanning them is both legal and
+        # worth it; concrete weights mean pure-eager tape execution
+        for _, p in self.layers[0].named_parameters():
+            return isinstance(p._data, _jax.core.Tracer)
+        return False
+
+    def _forward_scan(self, h, attn_mask=None):
+        """ONE lax.scan over the weight-stacked decoder layers (reference
+        compiles L separate ops per layer; SURVEY.md §2.1 'CINN' stance —
+        let the compiler see one homogeneous body). Reuses the pipeline's
+        template-layer scan (distributed.pipeline.make_stage_fn): layer 0
+        is re-bound to each traced [L, ...] slice, so the same module code
+        runs for every layer; grads flow to every layer's own parameters
+        through the jnp.stack."""
+        from ..distributed import pipeline as _pipe
+
+        stacked = _pipe.stack_layer_params(self.layers)
+        stage_fn = _pipe.make_stage_fn(
+            self.layers[0], call=lambda mod, x: mod(x, attn_mask))
+        return Tensor(stage_fn(stacked, as_array(h)))
 
     def forward_cached(self, input_ids, caches, cur_len):
         """caches: list of per-layer (k_cache, v_cache). Returns
