@@ -39,6 +39,9 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
     use_recompute: bool = False
+    # one lax.scan over weight-stacked layers instead of L unrolled copies
+    # (models.scan_stack; same contract as LlamaConfig.scan_layers)
+    scan_layers: bool = False
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -222,9 +225,15 @@ class GPTModel(nn.Layer):
         return shard_tensor(h, "dp", ("sp", "sep"), None)
 
     def forward(self, input_ids, attn_mask=None):
+        from .scan_stack import forward_scan, use_scan_layers
+
         h = self._embed(input_ids)
-        for layer in self.layers:
-            h = layer(h, attn_mask)
+        if use_scan_layers(self.config, self.layers):
+            h = forward_scan(self.layers, h,
+                             call=lambda mod, x: mod(x, attn_mask))
+        else:
+            for layer in self.layers:
+                h = layer(h, attn_mask)
         return self.ln_f(h)
 
     def forward_cached(self, input_ids, caches, cur_len):

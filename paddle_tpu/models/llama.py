@@ -437,38 +437,15 @@ class LlamaModel(nn.Layer):
         return self.norm(h)
 
     def _use_scan_layers(self):
-        """scan_layers applies only under a jax trace (jit / grad): pure
-        eager execution records autograd on the tape per op, which a
-        traced-once scan body would sidestep — fall back to the unrolled
-        loop there (the compile-size problem scan solves doesn't exist in
-        eager anyway)."""
-        if not getattr(self.config, "scan_layers", False) \
-                or len(self.layers) < 2:
-            return False
-        import jax as _jax
-
-        # the precise signal is whether the layer WEIGHTS are traced: the
-        # jitted train/eval step binds params to tracers (_LayerScope), and
-        # that is exactly when stacking+scanning them is both legal and
-        # worth it; concrete weights mean pure-eager tape execution
-        for _, p in self.layers[0].named_parameters():
-            return isinstance(p._data, _jax.core.Tracer)
-        return False
+        from .scan_stack import use_scan_layers
+        return use_scan_layers(self.config, self.layers)
 
     def _forward_scan(self, h, attn_mask=None):
-        """ONE lax.scan over the weight-stacked decoder layers (reference
-        compiles L separate ops per layer; SURVEY.md §2.1 'CINN' stance —
-        let the compiler see one homogeneous body). Reuses the pipeline's
-        template-layer scan (distributed.pipeline.make_stage_fn): layer 0
-        is re-bound to each traced [L, ...] slice, so the same module code
-        runs for every layer; grads flow to every layer's own parameters
-        through the jnp.stack."""
-        from ..distributed import pipeline as _pipe
-
-        stacked = _pipe.stack_layer_params(self.layers)
-        stage_fn = _pipe.make_stage_fn(
-            self.layers[0], call=lambda mod, x: mod(x, attn_mask))
-        return Tensor(stage_fn(stacked, as_array(h)))
+        """ONE lax.scan over the weight-stacked decoder layers — see
+        models.scan_stack (shared with the GPT family)."""
+        from .scan_stack import forward_scan
+        return forward_scan(self.layers, h,
+                            call=lambda mod, x: mod(x, attn_mask))
 
     def forward_cached(self, input_ids, caches, cur_len):
         """caches: list of per-layer (k_cache, v_cache). Returns

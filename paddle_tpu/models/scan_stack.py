@@ -1,0 +1,43 @@
+"""Weight-stacked decoder scanning shared by the model families.
+
+`scan_layers` compiles a homogeneous decoder stack as ONE lax.scan over
+[L, ...]-stacked parameters instead of L unrolled copies — the jitted
+program shrinks ~L-fold (MaxText-style compile-time scaling; the
+reference's graph grows per layer, SURVEY.md §2.1 'CINN' stance). The
+scan body re-binds a template layer to each traced slice via the
+pipeline's make_stage_fn, so the exact same module code runs either way
+and grads flow to every layer's own parameters through the jnp.stack.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..tensor import Tensor, as_array
+
+
+def use_scan_layers(config, layers) -> bool:
+    """scan_layers applies only when the layer WEIGHTS are traced: the
+    jitted train/eval step binds params to tracers (_LayerScope), and that
+    is exactly when stacking+scanning them is both legal and worth it.
+    Concrete weights mean pure-eager tape execution, which needs per-op
+    dispatch — fall back to the unrolled loop there (the compile-size
+    problem scan solves doesn't exist in eager anyway)."""
+    if not getattr(config, "scan_layers", False) or len(layers) < 2:
+        return False
+    for _, p in layers[0].named_parameters():
+        return isinstance(p._data, jax.core.Tracer)
+    return False
+
+
+def forward_scan(layers, h, call=None) -> Tensor:
+    """Run `h` through the homogeneous `layers` as one lax.scan.
+
+    call: (module, Tensor) -> Tensor — how to invoke one layer (closes
+    over attention masks etc.). Template bindings are saved/restored by
+    make_stage_fn (try/finally), so a trace error cannot leak scan
+    tracers into layer 0."""
+    from ..distributed import pipeline as _pipe
+
+    stacked = _pipe.stack_layer_params(layers)
+    stage_fn = _pipe.make_stage_fn(layers[0], call=call)
+    return Tensor(stage_fn(stacked, as_array(h)))

@@ -108,3 +108,25 @@ class TestScanLayers:
         finally:
             mesh_mod.set_mesh(None)
         np.testing.assert_allclose(serial, sharded, rtol=0, atol=1e-4)
+
+
+class TestScanLayersGPT:
+    def test_gpt_train_parity_with_unrolled(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        def train(scan):
+            paddle.seed(0)
+            cfg = GPTConfig.tiny(vocab=97, hidden=64, layers=3, heads=4,
+                                 seq=32)
+            cfg.scan_layers = scan
+            m = GPTForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            step = build_train_step(m, opt)
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randint(0, 97, (2, 32)))
+            y = paddle.to_tensor(rng.randint(0, 97, (2, 32)))
+            return [float(step(x, y)) for _ in range(3)]
+
+        np.testing.assert_allclose(train(False), train(True),
+                                   rtol=0, atol=1e-6)
