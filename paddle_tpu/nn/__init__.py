@@ -161,3 +161,5 @@ from .loss_layers import (  # noqa: F401
     MultiMarginLoss,
 )
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+
+from . import quant  # noqa: F401

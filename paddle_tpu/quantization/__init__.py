@@ -1,0 +1,186 @@
+"""paddle.quantization parity: QuantConfig / observers / quanters / QAT / PTQ.
+
+Reference surface: `python/paddle/quantization/` (config.py, qat.py,
+ptq.py, observers/abs_max.py, quanters/abs_max.py) — the 2.x-era
+quantization-aware-training and post-training-quantization framework that
+PaddleSlim drives. The reference inserts FakeQuant C++ ops around
+conv/linear kernels; here fake quantization is an ordinary traced
+computation (round + clip with a straight-through estimator written as
+`x + stop_gradient(q(x) - x)`), so it works identically under eager, jit,
+and every parallel transform — no special ops, no pass rewriting.
+
+Flow parity:
+    q_config = QuantConfig(activation=quanter, weight=quanter)
+    qat = QAT(q_config);  model = qat.quantize(model)      # train
+    ptq = PTQ(q_config);  model = ptq.quantize(model)      # calibrate
+    ... run calibration batches ...
+    infer_model = ptq.convert(model)
+
+TPU-native endpoint: `PTQ.convert` / `QAT.convert` produce
+`nn.quant.WeightOnlyLinear` layers (int8 HBM storage) instead of the
+reference's fake-quant deployment graph, so a converted model drops
+straight into the serving engine with halved weight bandwidth.
+
+Observer statistics (abs-max, moving average) live in layer buffers
+updated via the same `_rebind` mechanism as BatchNorm running stats
+(`nn/functional/norm.py`), so calibration works inside jitted steps too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, _apply_op, as_array
+from . import observers, quanters
+from .observers import AbsmaxObserver
+from .quanters import FakeQuanterWithAbsMaxObserver
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+    "FakeQuanterWithAbsMaxObserver", "observers", "quanters",
+    "QuantedLinear",
+]
+
+
+class QuantConfig:
+    """Which layers get which activation/weight quanters (reference:
+    `python/paddle/quantization/config.py`).
+
+    Resolution order per layer: instance config (`add_layer_config`) >
+    type config (`add_type_config`) > global default (constructor args).
+    A `None` quanter means "leave that tensor in float".
+    """
+
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._by_instance = []  # [(layer_ids, act, wt)]
+        self._by_type = []      # [(types, act, wt)]
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        self._by_instance.append(
+            ({id(l) for l in layers}, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (tuple(layer_type) if isinstance(layer_type, (list, tuple))
+                 else (layer_type,))
+        self._by_type.append((types, activation, weight))
+
+    def _resolve(self, layer):
+        for ids, act, wt in self._by_instance:
+            if id(layer) in ids:
+                return act, wt
+        for types, act, wt in self._by_type:
+            if isinstance(layer, types):
+                return act, wt
+        return self._global
+
+
+class QuantedLinear(Layer):
+    """Linear wrapped with fake-quant of activation and/or weight
+    (reference: `nn/quant/qat/linear.py` QuantedLinear). Holds the SOURCE
+    layer as a sublayer so its parameters keep training; the quanters'
+    observer state rides in buffers."""
+
+    def __init__(self, source, activation_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.source = source
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.source.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        out = F.linear(x, w, self.source.bias)
+        post = getattr(self.source, "gather_output", None)
+        if post is not None:  # replay ColumnParallelLinear's contract
+            from ..distributed.sharding_utils import shard_tensor
+            out = shard_tensor(out, None, None, None if post else "tp")
+        return out
+
+
+def _swap_linears(model, make_replacement):
+    """Walk `model` in place, replacing linear-family sublayers with
+    whatever `make_replacement(layer)` returns (None keeps the layer).
+    Shares the walker (and its linear-family predicate) with
+    `nn.quant.quantize_for_inference`."""
+    from ..nn.quant import _walk_linear_family
+
+    return _walk_linear_family(model, lambda name, full, child:
+                               make_replacement(child))
+
+
+class _Quantization:
+    """Shared QAT/PTQ mechanics (reference mirrors this split in
+    `quantization/quantize.py`'s base class): wrap configured linears in
+    `QuantedLinear`, convert to int8 weight-only storage at the end."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            act, wt = self._config._resolve(layer)
+            if act is None and wt is None:
+                return None
+            return QuantedLinear(
+                layer,
+                act._instance(layer) if act is not None else None,
+                wt._instance(layer) if wt is not None else None)
+
+        return _swap_linears(model, make)
+
+    def convert(self, model, inplace=True):
+        return _convert_to_weight_only(model, inplace)
+
+
+class QAT(_Quantization):
+    """Quantization-aware training (reference: `quantization/qat.py`).
+
+    `quantize` wraps each configured linear in `QuantedLinear`; training
+    then sees quantization noise while gradients flow via the
+    straight-through estimator. `convert` freezes the trained weights
+    into `WeightOnlyLinear` int8 storage for inference.
+    """
+
+
+class PTQ(_Quantization):
+    """Post-training quantization (reference: `quantization/ptq.py`).
+
+    `quantize` inserts observers/quanters (AbsmaxObserver's forward is
+    the identity plus absmax bookkeeping); run calibration batches, then
+    `convert` freezes int8 weight storage. Activation observers inform
+    `llm.int8`-style thresholds but weight-only conversion is the TPU
+    deployment target (decode is weight-bandwidth-bound, activations
+    stay bf16).
+    """
+
+
+def _convert_to_weight_only(model, inplace=True):
+    """Shared QAT/PTQ endpoint: QuantedLinear → WeightOnlyLinear (int8)."""
+    from ..nn.quant import WeightOnlyLinear
+
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+
+    def _walk(parent):
+        for name, child in list(parent._sub_layers.items()):
+            if isinstance(child, QuantedLinear):
+                setattr(parent, name,
+                        WeightOnlyLinear.from_source(child.source,
+                                                     "weight_only_int8"))
+            else:
+                _walk(child)
+
+    _walk(model)
+    model.eval()
+    return model

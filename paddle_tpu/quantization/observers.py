@@ -1,0 +1,51 @@
+"""Observers (reference: `python/paddle/quantization/observers/abs_max.py`).
+
+An observer is a FACTORY the user places in `QuantConfig`; `_instance`
+builds the per-layer `Layer` that actually watches tensors. Observer
+forward is the identity — it only records statistics into buffers (via
+`_rebind`, the same mechanism as BatchNorm running stats, so calibration
+works inside jitted steps)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, _apply_op, as_array
+
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+
+
+class AbsmaxObserverLayer(Layer):
+    """Tracks the running max of |x| over every observed batch."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer("abs_max", Tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        new = jnp.maximum(as_array(self.abs_max),
+                          jnp.max(jnp.abs(as_array(x))).astype(jnp.float32))
+        self.abs_max._rebind(new)
+        return x
+
+    def scales(self):
+        qmax = (1 << (self._quant_bits - 1)) - 1
+        return float(as_array(self.abs_max)) / qmax
+
+    def quant_axis(self):
+        return -1  # per-tensor
+
+    def extra_repr(self):
+        return f"quant_bits={self._quant_bits}"
+
+
+class AbsmaxObserver:
+    """Factory placed in QuantConfig (reference: AbsmaxObserver)."""
+
+    def __init__(self, quant_bits=8):
+        self._quant_bits = quant_bits
+
+    def _instance(self, layer):
+        return AbsmaxObserverLayer(quant_bits=self._quant_bits)

@@ -1,0 +1,71 @@
+"""Quanters (reference: `python/paddle/quantization/quanters/abs_max.py`,
+FakeQuanterWithAbsMaxObserver — the moving-average abs-max fake quanter
+the reference's QAT pass wires around conv/linear inputs).
+
+The fake-quant computation is a plain traced op with a straight-through
+estimator: `x + stop_gradient(quant(x) - x)` — value is the quantized
+lattice point, gradient is identity. The reference implements the same
+STE inside `fake_quantize_dequantize_moving_average_abs_max`'s C++ grad
+kernel; writing it as stop_gradient algebra makes it free under jit and
+composable with every transform (vjp tape, pjit, scan) with no custom
+kernels.
+
+The moving average only updates in training mode (buffer `_rebind`, like
+BatchNorm stats); eval mode quantizes against the frozen state."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, _apply_op, as_array
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer"]
+
+
+class FakeQuanterWithAbsMaxObserverLayer(Layer):
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(np.ones((), np.float32)))
+
+    def forward(self, x):
+        qmax = float((1 << (self._quant_bits - 1)) - 1)
+        if self.training:
+            batch_max = jnp.max(jnp.abs(as_array(x))).astype(jnp.float32)
+            r = self._moving_rate
+            state = as_array(self.scale)
+            self.scale._rebind(r * state + (1.0 - r) * batch_max)
+            absmax = batch_max  # quantize THIS batch against its own range
+        else:
+            absmax = as_array(self.scale)
+
+        def f(a):
+            s = jnp.maximum(absmax.astype(a.dtype) / qmax,
+                            jnp.finfo(jnp.float32).tiny.astype(a.dtype)
+                            if a.dtype != jnp.int32 else 1)
+            q = jnp.clip(jnp.rint(a / s), -qmax, qmax) * s
+            return a + jax.lax.stop_gradient(q - a)  # STE
+
+        return _apply_op(f, x, _name="fake_quant_dequant_abs_max")
+
+    def scales(self):
+        qmax = (1 << (self._quant_bits - 1)) - 1
+        return float(as_array(self.scale)) / qmax
+
+    def extra_repr(self):
+        return (f"moving_rate={self._moving_rate}, "
+                f"quant_bits={self._quant_bits}")
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """Factory placed in QuantConfig (reference class of the same name)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        self._kw = dict(moving_rate=moving_rate, quant_bits=quant_bits)
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserverLayer(**self._kw)

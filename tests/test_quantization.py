@@ -1,0 +1,357 @@
+"""Quantization suite: paddle.nn.quant weight-only family +
+paddle.quantization QAT/PTQ flows (reference:
+`python/paddle/nn/quant/quantized_linear.py`, `python/paddle/quantization/`;
+test models: `test/quantization/test_weight_only_linear.py`,
+`test_quant_aware.py` — same assertions, numpy references instead of
+CUDA kernel outputs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (
+    WeightOnlyLinear,
+    llm_int8_linear,
+    quantize_for_inference,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+from paddle_tpu.quantization import (
+    QAT,
+    PTQ,
+    AbsmaxObserver,
+    FakeQuanterWithAbsMaxObserver,
+    QuantConfig,
+    QuantedLinear,
+)
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype=np.float32)
+
+
+class TestWeightQuantize:
+    @pytest.mark.parametrize("algo,bits", [("weight_only_int8", 8),
+                                           ("weight_only_int4", 4)])
+    @pytest.mark.parametrize("group_size", [-1, 64])
+    def test_roundtrip_bound(self, algo, bits, group_size):
+        """Symmetric absmax quant: |dequant - w| <= scale/2 elementwise
+        (the lattice half-step), scale = group absmax / (2^(b-1)-1)."""
+        rng = np.random.RandomState(0)
+        w = rng.randn(128, 48).astype(np.float32)
+        qw, scale = weight_quantize(paddle.to_tensor(w), algo=algo,
+                                    group_size=group_size)
+        wd = _np(weight_dequantize(qw, scale, algo=algo,
+                                   group_size=group_size))
+        s = _np(scale)
+        s2 = s if s.ndim == 2 else s[None, :]
+        groups = s2.shape[0]
+        bound = np.repeat(s2, 128 // groups, axis=0) * 0.5 + 1e-7
+        assert wd.shape == w.shape
+        assert (np.abs(wd - w) <= bound).all()
+
+    def test_int8_storage_and_shapes(self):
+        w = paddle.to_tensor(np.random.RandomState(1).randn(64, 32)
+                             .astype(np.float32))
+        qw, scale = weight_quantize(w)
+        assert qw.numpy().dtype == np.int8 and qw.shape == [64, 32]
+        assert scale.shape == [32]
+        qw4, scale4 = weight_quantize(w, algo="weight_only_int4")
+        assert qw4.shape == [32, 32]  # two nibbles per byte along in-dim
+
+    def test_rejects_bad_args(self):
+        w = paddle.to_tensor(np.ones((8, 4), np.float32))
+        with pytest.raises(ValueError):
+            weight_quantize(w, algo="weight_only_int2")
+        with pytest.raises(ValueError):
+            weight_quantize(w, group_size=32)
+
+
+class TestWeightOnlyLinear:
+    def test_matches_dequant_matmul_exactly(self):
+        rng = np.random.RandomState(2)
+        w = paddle.to_tensor(rng.randn(96, 40).astype(np.float32) * 0.05)
+        x = paddle.to_tensor(rng.randn(5, 96).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(40).astype(np.float32))
+        qw, s = weight_quantize(w)
+        y = _np(weight_only_linear(x, qw, b, s, "int8"))
+        ref = _np(x) @ _np(weight_dequantize(qw, s)) + _np(b)
+        np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("algo,rtol", [("weight_only_int8", 0.02),
+                                           ("weight_only_int4", 0.30)])
+    def test_accuracy_vs_float(self, algo, rtol):
+        rng = np.random.RandomState(3)
+        w = paddle.to_tensor(rng.randn(256, 64).astype(np.float32) * 0.02)
+        x = paddle.to_tensor(rng.randn(4, 256).astype(np.float32))
+        qw, s = weight_quantize(w, algo=algo)
+        dt = "int4" if "int4" in algo else "int8"
+        y = _np(weight_only_linear(x, qw, None, s, dt))
+        ref = _np(paddle.matmul(x, w))
+        rel = np.abs(y - ref).max() / np.abs(ref).max()
+        assert rel < rtol, rel
+
+    def test_group_size_beats_per_channel_on_spiky_weights(self):
+        """Per-group scales localize a magnitude spike; per-channel scales
+        smear it over the whole column — groupwise must win."""
+        rng = np.random.RandomState(4)
+        w = rng.randn(128, 16).astype(np.float32) * 0.02
+        w[:4] *= 50.0  # spike in the first group only
+        wt = paddle.to_tensor(w)
+        x = paddle.to_tensor(rng.randn(3, 128).astype(np.float32))
+        ref = _np(paddle.matmul(x, wt))
+        errs = {}
+        for gs in (-1, 64):
+            qw, s = weight_quantize(wt, algo="weight_only_int4",
+                                    group_size=gs)
+            y = _np(weight_only_linear(x, qw, None, s, "int4",
+                                       group_size=gs))
+            errs[gs] = np.abs(y - ref).max()
+        assert errs[64] < errs[-1]
+
+    def test_llm_int8_outlier_decomposition(self):
+        """An activation column at 50x normal scale would wreck naive
+        per-row int8 quant; llm.int8 routes it through the float path."""
+        rng = np.random.RandomState(5)
+        w = paddle.to_tensor(rng.randn(64, 32).astype(np.float32) * 0.05)
+        x_np = rng.randn(4, 64).astype(np.float32)
+        x_np[:, 7] *= 50.0  # outlier feature column
+        x = paddle.to_tensor(x_np)
+        qw, s = weight_quantize(w)
+        y = _np(llm_int8_linear(x, qw, None, s, threshold=6.0))
+        ref = _np(paddle.matmul(x, w))
+        rel = np.abs(y - ref).max() / np.abs(ref).max()
+        assert rel < 0.03, rel
+
+    def test_jit_and_grad_through_weight_only(self):
+        """The quantized weight is inference storage: jit compiles it,
+        and grads still flow to the ACTIVATION input (weight is int8,
+        non-differentiable by construction)."""
+        rng = np.random.RandomState(6)
+        w = paddle.to_tensor(rng.randn(32, 16).astype(np.float32) * 0.1)
+        qw, s = weight_quantize(w)
+        x = paddle.to_tensor(rng.randn(2, 32).astype(np.float32),
+                             stop_gradient=False)
+        y = weight_only_linear(x, qw, None, s, "int8")
+        y.sum().backward()
+        wd = _np(weight_dequantize(qw, s))
+        np.testing.assert_allclose(_np(x.grad), np.tile(wd.sum(1), (2, 1)),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestModelSwap:
+    def test_sequential_swap_and_exclude(self):
+        rng = np.random.RandomState(7)
+        m = paddle.nn.Sequential(
+            paddle.nn.Linear(32, 64), paddle.nn.ReLU(),
+            paddle.nn.Linear(64, 8))
+        x = paddle.to_tensor(rng.randn(4, 32).astype(np.float32))
+        ref = _np(m(x))
+        quantize_for_inference(m, exclude=("2",))
+        assert isinstance(m[0], WeightOnlyLinear)
+        assert type(m[2]).__name__ == "Linear"  # excluded
+        out = _np(m(x))
+        assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
+
+    def test_bias_survives_swap(self):
+        """Regression: __init__'s `self.bias = None` instance-dict entry
+        must not shadow the Parameter from_source assigns — a quantized
+        Linear with a large bias must include it in forward."""
+        rng = np.random.RandomState(20)
+        lin = paddle.nn.Linear(8, 4)
+        big = rng.randn(4).astype(np.float32) * 10.0
+        lin.bias.set_value(paddle.to_tensor(big))
+        wol = WeightOnlyLinear.from_source(lin)
+        assert wol.bias is not None
+        x = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        np.testing.assert_allclose(_np(wol(x)), np.tile(big, (2, 1)),
+                                   rtol=1e-6)
+
+    def test_llm_int8_rejects_grouped_scales(self):
+        with pytest.raises(ValueError):
+            WeightOnlyLinear(64, 8, algo="llm.int8", group_size=64)
+        w = paddle.to_tensor(np.random.RandomState(21)
+                             .randn(128, 8).astype(np.float32))
+        qw, s = weight_quantize(w, group_size=64)  # 2-D grouped scale
+        x = paddle.to_tensor(np.ones((2, 128), np.float32))
+        with pytest.raises(ValueError):
+            llm_int8_linear(x, qw, None, s)
+
+    def test_state_dict_round_trips_quant_buffers(self):
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+        quantize_for_inference(m)
+        sd = m.state_dict()
+        assert any("quant_weight" in k for k in sd)
+        m2 = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+        quantize_for_inference(m2)
+        m2.set_state_dict(sd)
+        x = paddle.to_tensor(np.random.RandomState(8)
+                             .randn(2, 16).astype(np.float32))
+        np.testing.assert_allclose(_np(m(x)), _np(m2(x)), rtol=1e-6)
+
+    def test_llama_logits_close_after_quant(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=2,
+                               seq=32)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.random.RandomState(9)
+                               .randint(0, 128, (2, 16)))
+        ref = _np(m(ids)[0] if isinstance(m(ids), tuple) else m(ids))
+        quantize_for_inference(m, exclude=("lm_head",))
+        out = m(ids)
+        out = _np(out[0] if isinstance(out, tuple) else out)
+        denom = np.abs(ref).max() + 1e-9
+        assert np.abs(out - ref).max() / denom < 0.05
+
+    def test_quantized_serving_engine_decodes(self):
+        """End-to-end: weight-only model through the paged-KV serving
+        engine — the int8 buffers ride buffers_pytree() into the compiled
+        decode step with no engine changes."""
+        from paddle_tpu.inference import ServingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2,
+                               seq=32)
+        paddle.seed(1)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        quantize_for_inference(m, exclude=("lm_head",))
+        engine = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                               decode_strategy="greedy_search")
+        p = np.random.RandomState(10).randint(0, 64, (5,))
+        engine.add_request(p, max_new_tokens=6)
+        done = engine.run()
+        assert len(done) == 1 and len(done[0].output_ids) == 6
+
+
+class TestQATPTQ:
+    def test_fake_quanter_ste_and_lattice(self):
+        q = FakeQuanterWithAbsMaxObserver(quant_bits=8)._instance(None)
+        x = paddle.to_tensor(np.linspace(-1, 1, 64).astype(np.float32),
+                             stop_gradient=False)
+        y = q(x)
+        # value lies on the quant lattice of THIS batch's absmax
+        step = 1.0 / 127.0
+        np.testing.assert_allclose(_np(y) / step,
+                                   np.round(_np(y) / step), atol=1e-4)
+        y.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones(64), rtol=1e-6)
+
+    def test_moving_average_state(self):
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)._instance(None)
+        q(paddle.to_tensor(np.array([2.0], np.float32)))
+        q(paddle.to_tensor(np.array([4.0], np.float32)))
+        # 0.5*(0.5*1 + 0.5*2) + 0.5*4  (buffer starts at 1.0)
+        assert abs(float(q.scale.numpy()) - (0.5 * 1.5 + 0.5 * 4.0)) < 1e-5
+        q.eval()
+        before = float(q.scale.numpy())
+        q(paddle.to_tensor(np.array([100.0], np.float32)))
+        assert float(q.scale.numpy()) == before  # frozen in eval
+
+    def test_qat_quantize_train_convert(self):
+        rng = np.random.RandomState(11)
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(32, 4))
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        qat = QAT(cfg)
+        m = qat.quantize(m)
+        assert isinstance(m[0], QuantedLinear)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        losses = []
+        for _ in range(12):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]  # trains THROUGH the fake quant
+        m.eval()
+        # convert contract: int8 weight-only storage of the TRAINED
+        # weights — compare against the float function of those weights
+        # (QAT-eval output differs by design: per-tensor moving scales +
+        # activation fake-quant, neither of which deploys)
+        import paddle_tpu.nn.functional as F
+        h = F.relu(F.linear(x, m[0].source.weight, m[0].source.bias))
+        ref = _np(F.linear(h, m[2].source.weight, m[2].source.bias))
+        infer = qat.convert(m)
+        from paddle_tpu.nn.quant import WeightOnlyLinear as WOL
+        assert isinstance(infer[0], WOL)
+        out = _np(infer(x))
+        # two stacked int8 layers at fan-in 16: per-layer lattice noise
+        # does not average out over so few terms — 10% is the honest bound
+        assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.10
+
+    def test_ptq_observer_records_and_converts(self):
+        rng = np.random.RandomState(12)
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+        cfg = QuantConfig(activation=AbsmaxObserver(), weight=None)
+        ptq = PTQ(cfg)
+        m = ptq.quantize(m)
+        xs = [rng.randn(4, 16).astype(np.float32) * s for s in (1.0, 3.0)]
+        for x in xs:
+            m(paddle.to_tensor(x))
+        obs = m[0].activation_quanter
+        expect = max(np.abs(x).max() for x in xs)
+        assert abs(float(obs.abs_max.numpy()) - expect) < 1e-5
+        assert abs(obs.scales() - expect / 127.0) < 1e-7
+        infer = ptq.convert(m)
+        x = paddle.to_tensor(xs[0])
+        out = _np(infer(x))
+        assert out.shape == (4, 8)
+
+    def test_quant_config_resolution_order(self):
+        l1, l2 = paddle.nn.Linear(4, 4), paddle.nn.Linear(4, 4)
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(paddle.nn.Linear,
+                            weight=FakeQuanterWithAbsMaxObserver())
+        cfg.add_layer_config(l1, activation=FakeQuanterWithAbsMaxObserver())
+        a1, w1 = cfg._resolve(l1)
+        a2, w2 = cfg._resolve(l2)
+        assert a1 is not None and w1 is None  # instance wins outright
+        assert a2 is None and w2 is not None  # type config
+
+
+class TestQuantTP:
+    def test_tp_parity_with_single_device(self):
+        """Quantized ColumnParallel/RowParallel forward under a tp-2 mesh
+        equals the single-device quantized forward bit-for-bit (same int8
+        lattice, GSPMD only changes the layout)."""
+        import paddle_tpu.distributed.mesh as mesh_mod
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        rng = np.random.RandomState(13)
+        # rank-3 [b, s, h] activations: the mp-layer shard contract
+        # (None, None, 'tp') is written for sequence activations
+        x = paddle.to_tensor(rng.randn(2, 4, 32).astype(np.float32))
+
+        def build_and_run():
+            paddle.seed(3)
+            col = ColumnParallelLinear(32, 16, has_bias=True,
+                                       gather_output=False)
+            row = RowParallelLinear(16, 8, has_bias=True,
+                                    input_is_parallel=True)
+            m = paddle.nn.Sequential(col, row)
+            quantize_for_inference(m)
+            return _np(m(x))
+
+        ref = build_and_run()
+        mesh_mod.set_mesh(None)
+        try:
+            import jax
+
+            mesh_mod.set_mesh(mesh_mod.build_mesh(
+                tp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+            out = build_and_run()
+        finally:
+            mesh_mod.set_mesh(None)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)

@@ -44,44 +44,62 @@ def _first_leaf(out):
 
 
 def timeit(fn, q, *rest, iters=20):
-    """Chained timing with a real host sync.
+    """Device-time measurement: iterate INSIDE one program via lax.scan.
 
-    On the axon TPU tunnel block_until_ready() does NOT sync (it reports
-    dispatch time only), so each iteration's input depends on the previous
-    output (prevents skipping/overlap) and the loop ends with a host
-    transfer (forces completion). See .claude/skills/verify/SKILL.md.
+    The axon tunnel charges per-program, per-dispatch overheads that dwarf
+    kernel time and are paid unpredictably: block_until_ready() does not
+    sync (dispatch time only), a freshly-uploaded program's first
+    executions carry a multi-second cumulative tax, and big Mosaic
+    custom-call binaries can stay slow for EVERY host-dispatched exec in a
+    process juggling several programs (round-4 second capture: flash fwd
+    read a seq-independent ~110-126 ms/exec while the GRAD program
+    containing the same fwd kernel ran in 5 ms). Host-side call loops
+    therefore measure the tunnel, not the kernel.
+
+    Fix: run `iters` kernel executions inside ONE jitted lax.scan — one
+    dispatch, one program, serialized iterations (the carry folds each
+    output back into the next input so iterations can neither be elided
+    nor overlapped), ending in a host transfer that forces completion.
+    The per-iteration quotient is device time with all per-dispatch tax
+    amortized iters-fold; identical machinery times the Pallas and XLA
+    variants so the comparison stays fair.
     """
-    # Warm up with ADAPTIVE synced executions, not one: on the axon tunnel
-    # the first ~6-7 EXECUTIONS of a freshly-compiled program (especially
-    # big Mosaic custom-call binaries) carry a ~2.4 s cumulative cost
-    # beyond the compile itself (remote executor upload / cache fill),
-    # re-paid if interleaved programs evict it. A single warmup call
-    # folded that into the timed loop and made the flash fwd read as a
-    # seq-independent ~110 ms/iter plateau (round-4 first capture). Warm
-    # until the last exec is within 2x of the fastest seen (min 4, max 16
-    # iterations) so the timed loop measures steady state only.
+
+    @jax.jit
+    def many(q0, *rest_):
+        def body(carry, _):
+            out = fn(carry, *rest_)
+            # serialize: next input depends on EVERY output leaf — fn is
+            # inlined here, so a leaf the carry ignores is dead code XLA
+            # will eliminate (e.g. dk/dv of a grad tuple, biasing the
+            # backward comparison toward whichever variant can be
+            # partially DCE'd). Scale by a runtime-tiny factor (not
+            # literal 0.0, which the algebraic simplifier may fold) so
+            # the carry stays q0-valued with realistic data.
+            total = sum(jnp.sum(leaf).astype(jnp.float32)
+                        for leaf in jax.tree_util.tree_leaves(out))
+            dep = total * jnp.float32(1e-30)
+            return carry + dep.astype(carry.dtype), None
+
+        return jax.lax.scan(body, q0, None, length=iters)[0]
+
+    # warm the scanned program itself through compile + the tunnel's
+    # first-executions tax, adaptively (min 2, max 8 execs) until an exec
+    # stops improving on the best seen
     best = float("inf")
-    for widx in range(16):
+    for widx in range(8):
         w0 = time.perf_counter()
-        out = fn(q, *rest)
-        float(jnp.sum(_first_leaf(out).astype(jnp.float32)))
+        float(jnp.sum(many(q, *rest).astype(jnp.float32)))
         wdt = time.perf_counter() - w0
-        # plateau = this exec no longer improves on the best seen so far
-        # (>= 0.9*best, compared BEFORE folding wdt into best — a monotone
-        # decay would otherwise satisfy itself and stop at the minimum
-        # count) — but a single slow outlier (tunnel hiccup, > 2x best) is
-        # not a plateau: keep warming through it
-        if widx >= 4 and 0.9 * best <= wdt <= 2 * best:
+        if widx >= 1 and 0.9 * best <= wdt <= 2 * best:
             break
         best = min(best, wdt)
+    reps = 3
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(q, *rest)
-        # chain: next q depends on this out (same value, new token)
-        lead = _first_leaf(out)
-        q = q + jnp.zeros_like(q) * jnp.sum(lead).astype(q.dtype)
-    float(jnp.sum(_first_leaf(out).astype(jnp.float32)))  # host sync
-    return (time.perf_counter() - t0) / iters
+    for _ in range(reps):
+        out = many(q, *rest)
+    float(jnp.sum(out.astype(jnp.float32)))  # host sync
+    return (time.perf_counter() - t0) / (reps * iters)
 
 
 def main():
