@@ -169,6 +169,14 @@ def main():
         cfg.use_recompute = os.environ["BENCH_RECOMPUTE"] == "1"
     if size != "1b" and "BENCH_SCAN_LAYERS" in os.environ:
         cfg.scan_layers = os.environ["BENCH_SCAN_LAYERS"] == "1"
+    # geometry overrides for bisecting tunnel compile-helper failures
+    # (the 0.74B program 500s in the helper; these find the boundary)
+    for env, attr in (("BENCH_HIDDEN", "hidden_size"),
+                      ("BENCH_LAYERS", "num_hidden_layers"),
+                      ("BENCH_INTER", "intermediate_size"),
+                      ("BENCH_VOCAB", "vocab_size")):
+        if env in os.environ:
+            setattr(cfg, attr, int(os.environ[env]))
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
@@ -296,6 +304,14 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     model = LlamaForCausalLM(cfg)
     if on_tpu:
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    # BENCH_SERVING_QUANT=weight_only_int8|weight_only_int4 swaps the
+    # projection weights to quantized HBM storage — decode is
+    # weight-bandwidth-bound, so this measures the nn.quant lever
+    quant = os.environ.get("BENCH_SERVING_QUANT", "")
+    if quant:
+        from paddle_tpu.nn.quant import quantize_for_inference
+
+        quantize_for_inference(model, algo=quant, exclude=("lm_head",))
     # multi-step scheduling: K decode iterations per compiled call (one
     # host sync per burst) — the engine's answer to per-step dispatch
     # latency dominating single-token decode on a tunneled chip
@@ -329,7 +345,7 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         "vs_baseline": 0.0,
         "extra": {"requests": len(finished), "batch": max_batch,
                   "prompt_len": prompt_len, "new_tokens": new_tokens,
-                  "decode_burst": burst,
+                  "decode_burst": burst, "quant": quant or None,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers}}

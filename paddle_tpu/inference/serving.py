@@ -57,7 +57,7 @@ class ServingEngine:
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
                  decode_strategy="greedy_search", temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
-                 decode_burst=1):
+                 decode_burst=1, kv_cache_quant=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         self.model = model
@@ -92,6 +92,19 @@ class ServingEngine:
             kv_dtype = next(iter(model.parameters()))._data.dtype
         except StopIteration:
             kv_dtype = jnp.float32
+        # kv_cache_quant="int8": pages hold int8 + per-(head, page, slot)
+        # f32 scales written at token time — ~2x KV capacity/bandwidth vs
+        # bf16 (reference: fused_multi_transformer int8 cachekv variants)
+        if kv_cache_quant not in (None, "int8"):
+            raise ValueError("kv_cache_quant must be None or 'int8'")
+        self.kv_cache_quant = kv_cache_quant
+        if kv_cache_quant == "int8":
+            kv_dtype = jnp.int8
+            self.k_scales, self.v_scales = map(list, zip(*[
+                _pa.alloc_page_scales(n_pages, page_size, kvh)
+                for _ in range(L)]))
+        else:
+            self.k_scales = self.v_scales = None
         self.kv_dtype = kv_dtype
         self.k_pages = [jnp.zeros((kvh, n_pages, page_size, hd),
                                   kv_dtype) for _ in range(L)]
@@ -150,6 +163,11 @@ class ServingEngine:
                             for p in self.k_pages]
             self.v_pages = [jax.device_put(p, self._page_sharding)
                             for p in self.v_pages]
+            if self.k_scales is not None:
+                self.k_scales = [jax.device_put(p, self._page_sharding)
+                                 for p in self.k_scales]
+                self.v_scales = [jax.device_put(p, self._page_sharding)
+                                 for p in self.v_scales]
 
     def _cached_params(self):
         if self._params is None:
@@ -437,9 +455,16 @@ class ServingEngine:
             [self.block_tables[si] for si, _ in new]))
         lens = jnp.asarray(true_lens[:n], jnp.int32)
         for li in range(len(self.k_pages)):
-            self.k_pages[li], self.v_pages[li] = _pa.prefill_paged_kv_cache(
-                self.k_pages[li], self.v_pages[li],
-                ks[li][:n], vs[li][:n], tables, lens)
+            if self.k_scales is not None:
+                (self.k_pages[li], self.k_scales[li], self.v_pages[li],
+                 self.v_scales[li]) = _pa.prefill_paged_kv_cache_q8(
+                    self.k_pages[li], self.k_scales[li], self.v_pages[li],
+                    self.v_scales[li], ks[li][:n], vs[li][:n], tables, lens)
+            else:
+                self.k_pages[li], self.v_pages[li] = \
+                    _pa.prefill_paged_kv_cache(
+                        self.k_pages[li], self.v_pages[li],
+                        ks[li][:n], vs[li][:n], tables, lens)
         # re-pin: the eager scatter can drop the kv-head tp sharding, and
         # the decode jit donates pages in this layout
         self._pin_pages()
@@ -461,9 +486,12 @@ class ServingEngine:
 
         serving_mesh = self.mesh
 
-        def core(tok, kps, vps, tables, lens, act, key, greedy, temp, tk,
-                 tp):
-            caches = list(zip(kps, vps))
+        def core(tok, kps, vps, kss, vss, tables, lens, act, key, greedy,
+                 temp, tk, tp):
+            # kss/vss non-empty iff kv_cache_quant: per-layer cache entry
+            # is then (k_pages, v_pages, k_scales, v_scales)
+            caches = list(zip(kps, vps, kss, vss)) if kss \
+                else list(zip(kps, vps))
             logits, new_caches = model.forward_paged(
                 Tensor(tok[:, None]), caches, tables, lens,
                 active=act, mesh=serving_mesh)
@@ -474,9 +502,11 @@ class ServingEngine:
             else:
                 nxt, _ = sample_logits_per_row(
                     as_array(logits)[:, 0], key, greedy, temp, tk, tp)
-            nk = tuple(as_array(k) for k, v in new_caches)
-            nv = tuple(as_array(v) for k, v in new_caches)
-            return nxt, nk, nv
+            nk = tuple(as_array(c[0]) for c in new_caches)
+            nv = tuple(as_array(c[1]) for c in new_caches)
+            nks = tuple(as_array(c[2]) for c in new_caches) if kss else ()
+            nvs = tuple(as_array(c[3]) for c in new_caches) if kss else ()
+            return nxt, nk, nv, nks, nvs
 
         return core
 
@@ -489,16 +519,18 @@ class ServingEngine:
 
         core = self._decode_step_core(all_greedy)
 
-        def pure_decode(params, buffers, k_pages, v_pages, tokens, tables,
-                        lens, active, seed, greedy, temp, tk, tp):
+        def pure_decode(params, buffers, k_pages, v_pages, k_scales,
+                        v_scales, tokens, tables, lens, active, seed,
+                        greedy, temp, tk, tp):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
                 key = jax.random.wrap_key_data(seed)
-                nxt, nk, nv = core(tokens, k_pages, v_pages, tables, lens,
-                                   active, key, greedy, temp, tk, tp)
-            return nxt, nk, nv
+                nxt, nk, nv, nks, nvs = core(
+                    tokens, k_pages, v_pages, k_scales, v_scales, tables,
+                    lens, active, key, greedy, temp, tk, tp)
+            return nxt, nk, nv, nks, nvs
 
         fn = self._decode_fns[all_greedy] = jax.jit(
-            pure_decode, donate_argnums=(2, 3))
+            pure_decode, donate_argnums=(2, 3, 4, 5))
         return fn
 
     def _get_burst_fn(self, all_greedy, n_steps):
@@ -516,31 +548,35 @@ class ServingEngine:
 
         core = self._decode_step_core(all_greedy)
 
-        def pure_burst(params, buffers, k_pages, v_pages, tokens, tables,
-                       lens, active, rem, eos, seed, greedy, temp, tk, tp):
+        def pure_burst(params, buffers, k_pages, v_pages, k_scales,
+                       v_scales, tokens, tables, lens, active, rem, eos,
+                       seed, greedy, temp, tk, tp):
             with _tape.no_grad(), _LayerScope(model, params, buffers):
                 def one(carry, _):
-                    tok, kps, vps, ln, act, rm, key = carry
+                    tok, kps, vps, kss, vss, ln, act, rm, key = carry
                     key, sk = jax.random.split(key)
-                    nxt, nk, nv = core(tok, kps, vps, tables, ln, act, sk,
-                                       greedy, temp, tk, tp)
+                    nxt, nk, nv, nks, nvs = core(
+                        tok, kps, vps, kss, vss, tables, ln, act, sk,
+                        greedy, temp, tk, tp)
                     nxt = nxt.astype(tok.dtype)
                     emitted = act
                     ln2 = ln + act.astype(ln.dtype)
                     rm2 = rm - act.astype(rm.dtype)
                     act2 = act & (rm2 > 0) & (nxt != eos)
                     tok2 = jnp.where(act, nxt, tok)
-                    return (tok2, nk, nv, ln2, act2, rm2, key), (nxt, emitted)
+                    return (tok2, nk, nv, nks, nvs, ln2, act2, rm2, key), \
+                        (nxt, emitted)
 
                 key = jax.random.wrap_key_data(seed)
                 carry, (toks, emits) = jax.lax.scan(
-                    one, (tokens, k_pages, v_pages, lens, active, rem, key),
+                    one, (tokens, k_pages, v_pages, k_scales, v_scales,
+                          lens, active, rem, key),
                     None, length=n_steps)
-                _, nk, nv, _, _, _, _ = carry
-            return toks, emits, nk, nv
+                _, nk, nv, nks, nvs, _, _, _, _ = carry
+            return toks, emits, nk, nv, nks, nvs
 
         fn = self._burst_fns[(all_greedy, n_steps)] = jax.jit(
-            pure_burst, donate_argnums=(2, 3))
+            pure_burst, donate_argnums=(2, 3, 4, 5))
         return fn
 
     def step(self) -> List[FinishedRequest]:
@@ -626,14 +662,17 @@ class ServingEngine:
                  (e := self._req_eos(s.request_id)) is not None else -1
                  for s in self.slots], np.int32)
             fn = self._get_burst_fn(all_greedy, k_burst)
-            toks, emits, nk, nv = fn(
+            toks, emits, nk, nv, nks, nvs = fn(
                 params, buffers, tuple(self.k_pages), tuple(self.v_pages),
+                tuple(self.k_scales or ()), tuple(self.v_scales or ()),
                 jnp.asarray(tokens), jnp.asarray(self.block_tables),
                 jnp.asarray(lens), jnp.asarray(act_mask), jnp.asarray(rem),
                 jnp.asarray(eos_arr), jax.random.key_data(sk),
                 jnp.asarray(greedy), jnp.asarray(temp), jnp.asarray(tk),
                 jnp.asarray(tp_arr))
             self.k_pages, self.v_pages = list(nk), list(nv)
+            if self.k_scales is not None:
+                self.k_scales, self.v_scales = list(nks), list(nvs)
             toks = np.asarray(toks)     # [K, B]
             emits = np.asarray(emits)   # [K, B] bool
             finished = finished_early
@@ -658,14 +697,16 @@ class ServingEngine:
                 self._admit()
             return finished
         fn = self._get_decode_fn(all_greedy)
-        nxt, nk, nv = fn(params, buffers, tuple(self.k_pages),
-                         tuple(self.v_pages), jnp.asarray(tokens),
-                         jnp.asarray(self.block_tables),
-                         jnp.asarray(lens), jnp.asarray(act_mask),
-                         jax.random.key_data(sk), jnp.asarray(greedy),
-                         jnp.asarray(temp), jnp.asarray(tk),
-                         jnp.asarray(tp_arr))
+        nxt, nk, nv, nks, nvs = fn(
+            params, buffers, tuple(self.k_pages), tuple(self.v_pages),
+            tuple(self.k_scales or ()), tuple(self.v_scales or ()),
+            jnp.asarray(tokens), jnp.asarray(self.block_tables),
+            jnp.asarray(lens), jnp.asarray(act_mask),
+            jax.random.key_data(sk), jnp.asarray(greedy),
+            jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp_arr))
         self.k_pages, self.v_pages = list(nk), list(nv)
+        if self.k_scales is not None:
+            self.k_scales, self.v_scales = list(nks), list(nvs)
         nxt = np.asarray(nxt)
         finished = finished_early
         for i in active:

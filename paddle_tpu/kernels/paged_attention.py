@@ -47,6 +47,37 @@ def alloc_pages(n_pages, page_size, num_kv_heads, head_dim,
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
 
+# int8 KV cache (reference: fused_multi_transformer's int8 cachekv
+# variants — SURVEY.md §2.1): pages store int8, plus one f32 scale per
+# (kv_head, page, slot) written at token-write time (dynamic symmetric
+# absmax over head_dim). Decode applies K scales to the score COLUMNS
+# after q·k_int8 and V scales to the softmax weights before p·v_int8 —
+# algebraically exact dequantization without ever materializing float
+# pages, so KV HBM traffic and capacity improve ~2x vs bf16.
+
+_SCALE_LANES = 128  # scale pools pad page_size up to the TPU lane width
+
+
+def alloc_page_scales(n_pages, page_size, num_kv_heads):
+    """Scale pools for int8 pages: [kv_heads, n_pages, 128] f32 (slots
+    beyond page_size unused — lane-aligned so the Pallas BlockSpec tiles
+    cleanly; the overhead is 512 B/page against 4 KB of int8 payload at
+    page_size=16, head_dim=128)."""
+    if page_size > _SCALE_LANES:
+        raise ValueError(f"page_size must be <= {_SCALE_LANES} for int8 KV")
+    shape = (num_kv_heads, n_pages, _SCALE_LANES)
+    return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+
+def _quant_kv_token(x):
+    """Per-(row, head) symmetric int8 quant of [..., head_dim] values."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, np.float32(1e-12))
+    q = jnp.clip(jnp.rint(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def update_paged_kv_cache(k_pages, v_pages, k_new, v_new, block_tables,
                           context_lens, active=None):
     """Scatter one new token per sequence into its page.
@@ -91,6 +122,54 @@ def prefill_paged_kv_cache(k_pages, v_pages, k_seq, v_seq, block_tables,
     v_pages = v_pages.at[:, page_ids.reshape(-1), slots.reshape(-1), :].set(
         vv, mode="drop")
     return k_pages, v_pages
+
+
+def update_paged_kv_cache_q8(k_pages, k_scales, v_pages, v_scales,
+                             k_new, v_new, block_tables, context_lens,
+                             active=None):
+    """int8 variant of `update_paged_kv_cache`: quantize the incoming
+    token per (seq, head) and scatter value + scale."""
+    page_size = k_pages.shape[2]
+    page_ids = jnp.take_along_axis(
+        block_tables, (context_lens // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        page_ids = jnp.where(active, page_ids, k_pages.shape[1])
+    slots = context_lens % page_size
+    kq, ks = _quant_kv_token(k_new)  # [b, kvh, d] int8, [b, kvh] f32
+    vq, vs = _quant_kv_token(v_new)
+    k_pages = k_pages.at[:, page_ids, slots, :].set(
+        kq.transpose(1, 0, 2), mode="drop")
+    v_pages = v_pages.at[:, page_ids, slots, :].set(
+        vq.transpose(1, 0, 2), mode="drop")
+    k_scales = k_scales.at[:, page_ids, slots].set(ks.T, mode="drop")
+    v_scales = v_scales.at[:, page_ids, slots].set(vs.T, mode="drop")
+    return k_pages, k_scales, v_pages, v_scales
+
+
+def prefill_paged_kv_cache_q8(k_pages, k_scales, v_pages, v_scales,
+                              k_seq, v_seq, block_tables, seq_lens):
+    """int8 variant of `prefill_paged_kv_cache` (whole prompts)."""
+    b, s = k_seq.shape[0], k_seq.shape[1]
+    kvh = k_seq.shape[2]
+    page_size = k_pages.shape[2]
+    pos = jnp.arange(s)[None, :]
+    page_ids = jnp.take_along_axis(block_tables, pos // page_size, axis=1)
+    slots = jnp.broadcast_to(pos % page_size, (b, s))
+    valid = pos < seq_lens[:, None]
+    page_ids = jnp.where(valid, page_ids, k_pages.shape[1])
+    kq, ks = _quant_kv_token(k_seq)  # [b, s, kvh, d], [b, s, kvh]
+    vq, vs = _quant_kv_token(v_seq)
+    flat_pages = page_ids.reshape(-1)
+    flat_slots = slots.reshape(-1)
+    kk = kq.transpose(2, 0, 1, 3).reshape(kvh, b * s, -1)
+    vv = vq.transpose(2, 0, 1, 3).reshape(kvh, b * s, -1)
+    k_pages = k_pages.at[:, flat_pages, flat_slots, :].set(kk, mode="drop")
+    v_pages = v_pages.at[:, flat_pages, flat_slots, :].set(vv, mode="drop")
+    k_scales = k_scales.at[:, flat_pages, flat_slots].set(
+        ks.transpose(2, 0, 1).reshape(kvh, b * s), mode="drop")
+    v_scales = v_scales.at[:, flat_pages, flat_slots].set(
+        vs.transpose(2, 0, 1).reshape(kvh, b * s), mode="drop")
+    return k_pages, k_scales, v_pages, v_scales
 
 
 # ---------------------------------------------------------------------------
@@ -141,8 +220,58 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
+def _decode_kernel_q8(lens_ref, tables_ref, q_ref, k_ref, v_ref, ks_ref,
+                      vs_ref, o_ref, m_scr, l_scr, acc, *, page_size,
+                      scale, n_pages):
+    """int8-KV decode: identical online softmax, with per-slot scales
+    applied algebraically — K scales multiply the score columns after
+    q·k_int8, V scales multiply the softmax weights before p·v_int8."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    ctx = lens_ref[b]
+
+    @pl.when(p * page_size < ctx)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)   # [group, d]
+        k = k_ref[0, 0].astype(jnp.float32)   # [page_size, d] (int8 vals)
+        ks = ks_ref[0, 0][:page_size]         # [page_size] f32
+        vs = vs_ref[0, 0][:page_size]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s * ks[None, :] * np.float32(scale)
+        kpos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < ctx, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
+                                                      keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            pexp * vs[None, :], v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * alpha + pv
+        m_scr[:, :1] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
-                    scale=None):
+                    scale=None, k_scales=None, v_scales=None):
     """Single-token decode attention over a paged KV cache.
 
     q: [batch, num_q_heads, head_dim]
@@ -150,6 +279,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     block_tables: [batch, pages_per_seq] int32 (page indices)
     context_lens: [batch] int32 — tokens valid in the cache (q attends over
         these; the current token's K/V must already be written)
+    k_scales/v_scales: [num_kv_heads, n_pages, 128] f32 — present iff the
+        pages hold int8 (see `alloc_page_scales`)
     -> [batch, num_q_heads, head_dim]
     """
     b, n_q_heads, head_dim = q.shape
@@ -158,6 +289,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     group = n_q_heads // n_kv_heads
     if scale is None:
         scale = 1.0 / float(np.sqrt(head_dim))
+    quant = k_scales is not None
 
     # [b, kv_heads, group, d]; pad group to the sublane tile (8)
     qg = q.reshape(b, n_kv_heads, group, head_dim)
@@ -166,23 +298,31 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - group), (0, 0)))
 
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=scale,
-        n_pages=pages_per_seq)
+        _decode_kernel_q8 if quant else _decode_kernel,
+        page_size=page_size, scale=scale, n_pages=pages_per_seq)
+
+    page_spec = pl.BlockSpec((1, 1, page_size, head_dim),
+                             lambda b, h, p, lens, tables:
+                             (h, tables[b, p], 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, gpad, head_dim),
+                     lambda b, h, p, lens, tables: (b, h, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        scale_spec = pl.BlockSpec((1, 1, _SCALE_LANES),
+                                  lambda b, h, p, lens, tables:
+                                  (h, tables[b, p], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
 
     with jax.enable_x64(False):
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_kv_heads, pages_per_seq),
-            in_specs=[
-                pl.BlockSpec((1, 1, gpad, head_dim),
-                             lambda b, h, p, lens, tables: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, head_dim),
-                             lambda b, h, p, lens, tables:
-                             (h, tables[b, p], 0, 0)),
-                pl.BlockSpec((1, 1, page_size, head_dim),
-                             lambda b, h, p, lens, tables:
-                             (h, tables[b, p], 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, gpad, head_dim),
                 lambda b, h, p, lens, tables: (b, h, 0, 0)),
@@ -200,12 +340,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
             interpret=_interpret(),
         )(context_lens.astype(jnp.int32),
           block_tables.astype(jnp.int32),
-          qg, k_pages, v_pages)
+          *operands)
     return out[:, :, :group, :].reshape(b, n_q_heads, head_dim)
 
 
 def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
-                        scale=None):
+                        scale=None, k_scales=None, v_scales=None):
     """Dense-gather reference: materialize [b, S, kv_h, d] then masked
     attention. Used for testing and as the non-TPU fallback path."""
     b, n_q_heads, head_dim = q.shape
@@ -219,6 +359,11 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
     S = block_tables.shape[1] * page_size
     k_dense = k_dense.reshape(n_kv_heads, b, S, head_dim)
     v_dense = v_dense.reshape(n_kv_heads, b, S, head_dim)
+    if k_scales is not None:  # int8 pages: dequantize the dense gather
+        ks = k_scales[:, block_tables, :page_size].reshape(n_kv_heads, b, S)
+        vs = v_scales[:, block_tables, :page_size].reshape(n_kv_heads, b, S)
+        k_dense = k_dense.astype(jnp.float32) * ks[..., None]
+        v_dense = v_dense.astype(jnp.float32) * vs[..., None]
     qf = q.reshape(b, n_kv_heads, group, head_dim).astype(jnp.float32)
     s = jnp.einsum("bhgd,hbsd->bhgs", qf,
                    k_dense.astype(jnp.float32)) * scale

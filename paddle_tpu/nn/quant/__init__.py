@@ -266,13 +266,13 @@ class WeightOnlyLinear(Layer):
         return obj
 
     def forward(self, x):
+        if self._pre_shard is not None:  # row-parallel input stays sharded
+            from ...distributed.sharding_utils import shard_tensor
+            x = shard_tensor(x, *self._pre_shard)
         if self._algo == "llm.int8":
             out = llm_int8_linear(x, self.quant_weight, self.bias,
                                   self.weight_scale)
         else:
-            if self._pre_shard is not None:
-                from ...distributed.sharding_utils import shard_tensor
-                x = shard_tensor(x, *self._pre_shard)
             out = weight_only_linear(x, self.quant_weight, self.bias,
                                      self.weight_scale, self._weight_dtype,
                                      group_size=self._group_size)

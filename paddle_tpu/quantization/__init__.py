@@ -89,6 +89,12 @@ class QuantedLinear(Layer):
         self.weight_quanter = weight_quanter
 
     def forward(self, x):
+        # replay the source's full tp contract FIRST (mp_layers.py): a QAT
+        # graph with different GSPMD layout than the float/deployed model
+        # would observe quantization noise under different collectives
+        from ..distributed.sharding_utils import shard_tensor
+        if getattr(self.source, "input_is_parallel", False):
+            x = shard_tensor(x, None, None, "tp")  # RowParallel input
         if self.activation_quanter is not None:
             x = self.activation_quanter(x)
         w = self.source.weight
@@ -97,9 +103,10 @@ class QuantedLinear(Layer):
         from ..nn import functional as F
         out = F.linear(x, w, self.source.bias)
         post = getattr(self.source, "gather_output", None)
-        if post is not None:  # replay ColumnParallelLinear's contract
-            from ..distributed.sharding_utils import shard_tensor
+        if post is not None:  # ColumnParallel output contract
             out = shard_tensor(out, None, None, None if post else "tp")
+        elif hasattr(self.source, "input_is_parallel"):
+            out = shard_tensor(out, None, None, None)  # RowParallel: psum'd
         return out
 
 
@@ -165,7 +172,9 @@ class PTQ(_Quantization):
 
 
 def _convert_to_weight_only(model, inplace=True):
-    """Shared QAT/PTQ endpoint: QuantedLinear → WeightOnlyLinear (int8)."""
+    """Shared QAT/PTQ endpoint: QuantedLinear → WeightOnlyLinear, at the
+    bit width the weight quanter was configured with (a model trained
+    against the int4 lattice must not silently deploy as int8)."""
     from ..nn.quant import WeightOnlyLinear
 
     if not inplace:
@@ -175,9 +184,10 @@ def _convert_to_weight_only(model, inplace=True):
     def _walk(parent):
         for name, child in list(parent._sub_layers.items()):
             if isinstance(child, QuantedLinear):
+                bits = getattr(child.weight_quanter, "_quant_bits", 8)
+                algo = {4: "weight_only_int4"}.get(bits, "weight_only_int8")
                 setattr(parent, name,
-                        WeightOnlyLinear.from_source(child.source,
-                                                     "weight_only_int8"))
+                        WeightOnlyLinear.from_source(child.source, algo))
             else:
                 _walk(child)
 

@@ -318,11 +318,65 @@ class TestQATPTQ:
         assert a2 is None and w2 is not None  # type config
 
 
+class TestConvertBits:
+    def test_convert_honors_int4_quant_bits(self):
+        """A model QAT-trained against the int4 lattice must deploy as
+        int4 storage, not silently as int8."""
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+        cfg = QuantConfig(weight=FakeQuanterWithAbsMaxObserver(quant_bits=4))
+        qat = QAT(cfg)
+        m = qat.quantize(m)
+        infer = qat.convert(m)
+        assert infer[0]._algo == "weight_only_int4"
+        assert infer[0].quant_weight.shape[0] == 8  # nibble-packed k/2
+
+
 class TestQuantTP:
-    def test_tp_parity_with_single_device(self):
+    @pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
+    def test_qat_tp_parity_with_single_device(self, algo):
+        """QAT fake-quant through Row/ColumnParallel layers under a tp-2
+        mesh equals the single-device QAT forward (the wrapped layer must
+        replay the source's full shard contract, incl. RowParallel's
+        input_is_parallel)."""
+        import paddle_tpu.distributed.mesh as mesh_mod
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        rng = np.random.RandomState(14)
+        x = paddle.to_tensor(rng.randn(2, 4, 32).astype(np.float32))
+
+        def build_and_run():
+            paddle.seed(5)
+            col = ColumnParallelLinear(32, 16, has_bias=True,
+                                       gather_output=False)
+            row = RowParallelLinear(16, 8, has_bias=True,
+                                    input_is_parallel=True)
+            m = paddle.nn.Sequential(col, row)
+            cfg = QuantConfig(weight=FakeQuanterWithAbsMaxObserver())
+            m = QAT(cfg).quantize(m)
+            m.eval()
+            return _np(m(x))
+
+        ref = build_and_run()
+        mesh_mod.set_mesh(None)
+        try:
+            import jax
+
+            mesh_mod.set_mesh(mesh_mod.build_mesh(
+                tp=2, devices=np.asarray(jax.devices("cpu")[:2])))
+            out = build_and_run()
+        finally:
+            mesh_mod.set_mesh(None)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
+    def test_tp_parity_with_single_device(self, algo):
         """Quantized ColumnParallel/RowParallel forward under a tp-2 mesh
         equals the single-device quantized forward bit-for-bit (same int8
-        lattice, GSPMD only changes the layout)."""
+        lattice, GSPMD only changes the layout). llm.int8 exercises the
+        RowParallel pre-shard on its branch too."""
         import paddle_tpu.distributed.mesh as mesh_mod
         from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
             ColumnParallelLinear,
@@ -341,7 +395,7 @@ class TestQuantTP:
             row = RowParallelLinear(16, 8, has_bias=True,
                                     input_is_parallel=True)
             m = paddle.nn.Sequential(col, row)
-            quantize_for_inference(m)
+            quantize_for_inference(m, algo=algo)
             return _np(m(x))
 
         ref = build_and_run()

@@ -1,0 +1,103 @@
+"""Bisect the axon remote-compile-helper failure on the ~0.74B config.
+
+BASELINE row 3's single-chip proxy (hidden 2048, 12 layers, vocab 32k,
+seq 2048) has failed to compile through the tunnel's compile helper in
+two sessions (HTTP 500, `tpu_compile_helper subprocess exit code 1`) —
+for BOTH the unrolled and the lax.scan'd program, so program SIZE is not
+the trigger. This ladder walks one geometry axis at a time from the known
+-good base config (hidden 1024, 8 layers — compiles and trains at 54%
+MFU) toward the failing 1b point, recording compile success per rung in
+BISECT_1B.json. The first failing rung isolates the axis (activation
+footprint? vocab-sized logits? layer count?) and gives the infra owners a
+minimal repro; until then the largest passing rung becomes the row-3
+proxy evidence.
+
+Each rung is a bench.py subprocess (same measurement codepath; geometry
+comes from the BENCH_* overrides) with a hard timeout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# (name, env overrides) — one axis moves per rung where possible
+RUNGS = [
+    ("base_control", {"BENCH_MODEL": "base", "BENCH_ITERS": "3"}),
+    ("base_12layers", {"BENCH_MODEL": "base", "BENCH_LAYERS": "12",
+                       "BENCH_ITERS": "3"}),
+    ("base_seq2048_b4", {"BENCH_MODEL": "base", "BENCH_SEQ": "2048",
+                         "BENCH_BATCH": "4", "BENCH_ITERS": "3"}),
+    ("base_hidden2048", {"BENCH_MODEL": "base", "BENCH_HIDDEN": "2048",
+                         "BENCH_INTER": "5504", "BENCH_BATCH": "4",
+                         "BENCH_ITERS": "3"}),
+    # the 1b point minus one axis each
+    ("1b_vocab8k", {"BENCH_MODEL": "1b", "BENCH_VOCAB": "8000",
+                    "BENCH_ITERS": "3"}),
+    ("1b_seq512", {"BENCH_MODEL": "1b", "BENCH_SEQ": "512",
+                   "BENCH_ITERS": "3"}),
+    ("1b_6layers", {"BENCH_MODEL": "1b", "BENCH_LAYERS": "6",
+                    "BENCH_ITERS": "3"}),
+    ("1b_batch1", {"BENCH_MODEL": "1b", "BENCH_BATCH": "1",
+                   "BENCH_ITERS": "3"}),
+    # the full failing point, scanned and unrolled, for the record
+    ("1b_full_scan", {"BENCH_MODEL": "1b", "BENCH_ITERS": "3"}),
+    ("1b_full_unrolled", {"BENCH_MODEL": "1b", "BENCH_SCAN_LAYERS": "0",
+                          "BENCH_ITERS": "3"}),
+]
+
+
+def main():
+    budget = float(os.environ.get("BISECT_BUDGET", "2400"))
+    per_rung = float(os.environ.get("BISECT_RUNG_TIMEOUT", "420"))
+    out_path = os.path.join(REPO, "BISECT_1B.json")
+    deadline = time.monotonic() + budget
+    results = {}
+    for name, over in RUNGS:
+        remaining = deadline - time.monotonic()
+        if remaining < 30:
+            results[name] = {"skipped": "budget exhausted"}
+            continue
+        env = dict(os.environ, BENCH_CONFIG="llama", BENCH_KERNELS="0",
+                   BENCH_EXTRA="0", BENCH_PROBE_RETRIES="1",
+                   BENCH_PROBE_TIMEOUT="120", **over)
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+                timeout=min(per_rung, remaining), capture_output=True,
+                text=True)
+            line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() \
+                else ""
+            res = json.loads(line) if line else {"error": "no output"}
+        except subprocess.TimeoutExpired:
+            res = {"error": f"timeout after {min(per_rung, remaining):.0f}s"}
+        except Exception as e:  # noqa: BLE001
+            res = {"error": f"{type(e).__name__}: {e}"[:300]}
+        extra = res.get("extra") or {}
+        row = {"elapsed_s": round(time.perf_counter() - t0, 1),
+               "env": over}
+        if extra.get("backend") == "tpu" and res.get("value", 0) > 0:
+            row.update(ok=True, tok_per_sec=res["value"],
+                       mfu=extra.get("mfu"), params_b=extra.get("params_b"))
+        else:
+            row.update(ok=False,
+                       error=(res.get("error") or "cpu fallback")[:400])
+        results[name] = row
+        print(json.dumps({name: row}), file=sys.stderr)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(tmp, out_path)
+    ok = [n for n, r in results.items() if r.get("ok")]
+    bad = [n for n, r in results.items() if r.get("ok") is False]
+    print(json.dumps({"passed": ok, "failed": bad}))
+
+
+if __name__ == "__main__":
+    main()
