@@ -169,6 +169,10 @@ def main():
         cfg.use_recompute = os.environ["BENCH_RECOMPUTE"] == "1"
     if size != "1b" and "BENCH_SCAN_LAYERS" in os.environ:
         cfg.scan_layers = os.environ["BENCH_SCAN_LAYERS"] == "1"
+    if "BENCH_FUSED_CE" in os.environ:
+        # chunked fused head+CE: logits never materialize (the f32 logits
+        # allocation is what OOMed batch 64 — MFU_SWEEP.json)
+        cfg.fused_ce_chunks = int(os.environ["BENCH_FUSED_CE"])
     # geometry overrides for bisecting tunnel compile-helper failures
     # (the 0.74B program 500s in the helper; these find the boundary)
     for env, attr in (("BENCH_HIDDEN", "hidden_size"),
@@ -312,6 +316,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         from paddle_tpu.nn.quant import quantize_for_inference
 
         quantize_for_inference(model, algo=quant, exclude=("lm_head",))
+    # BENCH_SERVING_KV=int8 stores KV pages as int8 + per-token scales
+    kv_quant = os.environ.get("BENCH_SERVING_KV", "") or None
     # multi-step scheduling: K decode iterations per compiled call (one
     # host sync per burst) — the engine's answer to per-step dispatch
     # latency dominating single-token decode on a tunneled chip
@@ -320,7 +326,7 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     engine = ServingEngine(model, max_batch=max_batch,
                            max_seq_len=prompt_len + new_tokens,
                            page_size=16, decode_strategy="greedy_search",
-                           decode_burst=burst)
+                           decode_burst=burst, kv_cache_quant=kv_quant)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
                for _ in range(max_batch)]
@@ -346,6 +352,7 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         "extra": {"requests": len(finished), "batch": max_batch,
                   "prompt_len": prompt_len, "new_tokens": new_tokens,
                   "decode_burst": burst, "quant": quant or None,
+                  "kv_quant": kv_quant,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers}}

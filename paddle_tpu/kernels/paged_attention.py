@@ -177,8 +177,20 @@ def prefill_paged_kv_cache_q8(k_pages, k_scales, v_pages, v_scales,
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc, *, page_size, scale, n_pages):
+def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size, scale, n_pages, quant=False):
+    """Online-softmax decode over the page grid dimension.
+
+    One body serves both storage formats: with `quant` the pages hold
+    int8 and `rest` leads with the per-slot scale refs — K scales
+    multiply the score COLUMNS after q·k_int8 and V scales multiply the
+    softmax weights before p·v_int8, which is algebraically exact
+    dequantization (the l normalizer uses unscaled pexp in both modes).
+    """
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc = rest
+    else:
+        o_ref, m_scr, l_scr, acc = rest
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -197,6 +209,8 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * np.float32(scale)
+        if quant:
+            s = s * ks_ref[0, 0][:page_size][None, :]
         kpos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(kpos < ctx, s, NEG_INF)
@@ -207,58 +221,9 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
                                                       keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)
+        pw = pexp * vs_ref[0, 0][:page_size][None, :] if quant else pexp
         pv = jax.lax.dot_general(
-            pexp, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc[:] = acc[:] * alpha + pv
-        m_scr[:, :1] = m_new
-
-    @pl.when(p == n_pages - 1)
-    def _():
-        l = l_scr[:, :1]
-        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
-            o_ref.dtype)
-
-
-def _decode_kernel_q8(lens_ref, tables_ref, q_ref, k_ref, v_ref, ks_ref,
-                      vs_ref, o_ref, m_scr, l_scr, acc, *, page_size,
-                      scale, n_pages):
-    """int8-KV decode: identical online softmax, with per-slot scales
-    applied algebraically — K scales multiply the score columns after
-    q·k_int8, V scales multiply the softmax weights before p·v_int8."""
-    b = pl.program_id(0)
-    p = pl.program_id(2)
-
-    @pl.when(p == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc[:] = jnp.zeros_like(acc)
-
-    ctx = lens_ref[b]
-
-    @pl.when(p * page_size < ctx)
-    def _():
-        q = q_ref[0, 0].astype(jnp.float32)   # [group, d]
-        k = k_ref[0, 0].astype(jnp.float32)   # [page_size, d] (int8 vals)
-        ks = ks_ref[0, 0][:page_size]         # [page_size] f32
-        vs = vs_ref[0, 0][:page_size]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        s = s * ks[None, :] * np.float32(scale)
-        kpos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < ctx, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
-                                                      keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)
-        pv = jax.lax.dot_general(
-            pexp * vs[None, :], v, (((1,), (0,)), ((), ())),
+            pw, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc[:] = acc[:] * alpha + pv
         m_scr[:, :1] = m_new
@@ -298,8 +263,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - group), (0, 0)))
 
     kernel = functools.partial(
-        _decode_kernel_q8 if quant else _decode_kernel,
-        page_size=page_size, scale=scale, n_pages=pages_per_seq)
+        _decode_kernel, page_size=page_size, scale=scale,
+        n_pages=pages_per_seq, quant=quant)
 
     page_spec = pl.BlockSpec((1, 1, page_size, head_dim),
                              lambda b, h, p, lens, tables:

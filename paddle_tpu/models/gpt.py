@@ -42,6 +42,8 @@ class GPTConfig:
     # one lax.scan over weight-stacked layers instead of L unrolled copies
     # (models.scan_stack; same contract as LlamaConfig.scan_layers)
     scan_layers: bool = False
+    # chunked fused head+CE (same contract as LlamaConfig.fused_ce_chunks)
+    fused_ce_chunks: int = 0
     dtype: str = "float32"
 
     def __post_init__(self):

@@ -57,6 +57,10 @@ class LlamaConfig:
     # their layer blocks already) and by pure-eager execution (the
     # autograd tape needs per-op dispatch).
     scan_layers: bool = False
+    # fuse the lm_head matmul into a chunked cross entropy: the [tokens,
+    # vocab] logits are never materialized (peak memory / chunks), the
+    # backward recomputes each chunk (jax.checkpoint). 0 = dense CE.
+    fused_ce_chunks: int = 0
     dtype: str = "float32"
 
     @staticmethod

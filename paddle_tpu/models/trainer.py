@@ -468,10 +468,25 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
     # unwrap the eager sharding facade: under jit the stage IS the layout
     inner_opt = getattr(optimizer, "_inner_opt", optimizer)
     mesh = mesh or _mesh.get_mesh(optional=True)
+    fused_ce = int(getattr(getattr(model, "config", None),
+                           "fused_ce_chunks", 0) or 0)
+    use_pp = (mesh is not None and "pp" in mesh.axis_names
+              and int(mesh.shape["pp"]) > 1 and hasattr(model, "pp_layers"))
+    model_call = None
     if criterion is None:
-        criterion = model.compute_loss
-    if (mesh is not None and "pp" in mesh.axis_names
-            and int(mesh.shape["pp"]) > 1 and hasattr(model, "pp_layers")):
+        if fused_ce > 0 and not use_pp \
+                and hasattr(model, "compute_loss_hidden"):
+            # fused chunked head+CE: the step never materializes the
+            # [tokens, vocab] logits (CausalLMBase.compute_loss_hidden).
+            # The pipeline path keeps the dense CE: its last stage
+            # computes logits via pp_head, so the hidden-states criterion
+            # would contract the vocab axis against the head weight AGAIN.
+            model_call = lambda m, x: m.forward_hidden(x)  # noqa: E731
+            criterion = lambda h, y: model.compute_loss_hidden(  # noqa: E731
+                h, y, chunks=fused_ce)
+        else:
+            criterion = model.compute_loss
+    if use_pp:
         if gradient_merge_steps > 1:
             raise NotImplementedError(
                 "gradient_merge with the pipeline schedule: raise "
@@ -483,6 +498,7 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
             sharding_stage=sharding_stage, schedule=pipeline_schedule,
             virtual_pp_degree=virtual_pp_degree)
     step = _jit.train_step(model, criterion, inner_opt, donate=donate,
+                           model_call=model_call,
                            sharding_stage=sharding_stage, mesh=mesh,
                            gradient_merge_steps=gradient_merge_steps,
                            gradient_merge_avg=merge_avg)

@@ -332,8 +332,7 @@ class TestConvertBits:
 
 
 class TestQuantTP:
-    @pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
-    def test_qat_tp_parity_with_single_device(self, algo):
+    def test_qat_tp_parity_with_single_device(self):
         """QAT fake-quant through Row/ColumnParallel layers under a tp-2
         mesh equals the single-device QAT forward (the wrapped layer must
         replay the source's full shard contract, incl. RowParallel's

@@ -37,38 +37,37 @@ REPO = os.path.dirname(HERE)
 # program the tunnel's compile helper must swallow
 GRIDS = {
     "base": [
-        # (batch, seq, recompute, scan_layers)
-        (8, 1024, 0, 0),    # the banked row-0 point (control)
-        (16, 1024, 0, 0),
-        (32, 1024, 0, 0),
-        (64, 1024, 0, 0),
-        (16, 2048, 0, 0),
-        (32, 2048, 0, 0),
-        (8, 1024, 0, 1),    # scanned program, same shapes as control
-        (32, 1024, 1, 0),   # remat at the big point (HBM headroom probe)
+        # (batch, seq, recompute, scan_layers, fused_ce_chunks)
+        (32, 1024, 0, 0, 0),   # the measured optimum (bench default)
+        (32, 1024, 0, 0, 8),   # fused-CE control at the same point
+        (64, 1024, 0, 0, 8),   # the OOM point, logits chunked away
+        (128, 1024, 0, 0, 16),
+        (64, 2048, 0, 0, 16),
     ],
     "1b": [
-        (4, 2048, 0, 1),    # the banked 1b point (scan default)
-        (8, 2048, 0, 1),
-        (8, 2048, 1, 1),
-        (4, 2048, 0, 0),    # unrolled: the program the helper 500'd on
-        (16, 1024, 0, 1),
+        (4, 2048, 0, 1, 0),    # the banked 1b point (scan default)
+        (8, 2048, 0, 1, 8),
+        (8, 2048, 1, 1, 8),
+        (4, 2048, 0, 0, 0),    # unrolled: the program the helper 500'd on
+        (16, 1024, 0, 1, 8),
     ],
 }
 
 
-def run_combo(model, batch, seq, recompute, scan, timeout):
+def run_combo(model, batch, seq, recompute, scan, fused_ce, timeout):
     env = dict(
         os.environ,
         BENCH_CONFIG="llama", BENCH_MODEL=model,
         BENCH_BATCH=str(batch), BENCH_SEQ=str(seq),
         BENCH_RECOMPUTE=str(recompute), BENCH_SCAN_LAYERS=str(scan),
+        BENCH_FUSED_CE=str(fused_ce),
         BENCH_KERNELS="0", BENCH_EXTRA="0",
         BENCH_PROBE_RETRIES="1",
         BENCH_PROBE_TIMEOUT=os.environ.get("BENCH_PROBE_TIMEOUT", "150"),
     )
     row = {"model": model, "batch": batch, "seq": seq,
-           "recompute": recompute, "scan_layers": scan}
+           "recompute": recompute, "scan_layers": scan,
+           "fused_ce": fused_ce}
     t0 = time.perf_counter()
     try:
         r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],

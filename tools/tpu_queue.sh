@@ -45,7 +45,8 @@ run_task() {
 }
 
 all_done() {
-  for t in kernel_bench serving_int8 serving_int4 bisect_1b mfu_1b; do
+  for t in kernel_bench serving_int8 serving_int4 serving_full_int8 \
+           bisect_1b mfu_1b mfu_base_fused; do
     [ -f "$STATE/$t" ] || return 1
   done
   return 0
@@ -83,12 +84,21 @@ while :; do
       BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
       python bench.py > SERVING_QUANT_INT4.json \
       && grep -q "\"backend\": \"tpu\"" SERVING_QUANT_INT4.json'
+    run_task serving_full_int8 600 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_QUANT=weight_only_int8 BENCH_SERVING_KV=int8 \
+      BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_QUANT_FULL_INT8.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_QUANT_FULL_INT8.json'
     run_task kernel_bench 2400 bash -c 'python tools/tpu_kernel_bench.py \
       --json KERNEL_BENCH.json \
       && grep -q "\"backend\": \"tpu\"" KERNEL_BENCH.json \
       && grep -q "\"seq\": 4096" KERNEL_BENCH.json'
     run_task bisect_1b 2700 bash -c 'python tools/bisect_1b.py \
       && grep -q "\"ok\": true" BISECT_1B.json'
+    run_task mfu_base_fused 2400 bash -c \
+      'python tools/mfu_sweep.py --model base --budget 2100 \
+       && grep -q "\"fused_ce\": 8" MFU_SWEEP.json'
     run_task mfu_1b 2400 bash -c \
       'python tools/mfu_sweep.py --model 1b --budget 2100 \
        && grep -q "\"model\": \"1b\"" MFU_SWEEP.json'
