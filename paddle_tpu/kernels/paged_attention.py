@@ -210,7 +210,7 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * np.float32(scale)
         if quant:
-            s = s * ks_ref[0, 0][:page_size][None, :]
+            s = s * ks_ref[0, 0, 0][:page_size][None, :]
         kpos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(kpos < ctx, s, NEG_INF)
@@ -221,7 +221,7 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
         l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
                                                       keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)
-        pw = pexp * vs_ref[0, 0][:page_size][None, :] if quant else pexp
+        pw = pexp * vs_ref[0, 0, 0][:page_size][None, :] if quant else pexp
         pv = jax.lax.dot_general(
             pw, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -277,11 +277,16 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     ]
     operands = [qg, k_pages, v_pages]
     if quant:
-        scale_spec = pl.BlockSpec((1, 1, _SCALE_LANES),
+        # Scales ride in with a singleton sublane dim: a (1, lanes) trailing
+        # tile over the 3D [kvh, n_pages, lanes] pool is illegal on Mosaic
+        # (second-to-minor must be a multiple of 8 or the full dim), but
+        # (1, 1, 1, lanes) over [kvh, n_pages, 1, lanes] matches the array
+        # dims exactly and lowers clean.
+        scale_spec = pl.BlockSpec((1, 1, 1, _SCALE_LANES),
                                   lambda b, h, p, lens, tables:
-                                  (h, tables[b, p], 0))
+                                  (h, tables[b, p], 0, 0))
         in_specs += [scale_spec, scale_spec]
-        operands += [k_scales, v_scales]
+        operands += [k_scales[:, :, None, :], v_scales[:, :, None, :]]
 
     with jax.enable_x64(False):
         grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -237,6 +237,29 @@ def main():
             t_xla_ms=t_x * 1e3, ctx=page * ppseq, batch=b_dec)
         print(f"paged decode: err={paged_err:.4f} pallas {t_p*1e3:.3f}ms "
               f"xla {t_x*1e3:.3f}ms ({t_x/t_p:.2f}x)")
+
+        # int8-KV variant: the quant BlockSpecs lower differently (4D
+        # scale tiles) — interpret mode can't catch Mosaic tiling rejects,
+        # so the real-compiler run here is the coverage that matters
+        kpq = (kp * 127).astype(jnp.int8)
+        vpq = (vp * 127).astype(jnp.int8)
+        sc = jnp.full((kvh, n_pages, 128), 1.0 / 127, jnp.float32)
+        o_pq = np.asarray(f_pal(qd, kpq, vpq, tables, lens,
+                                k_scales=sc, v_scales=sc), np.float32)
+        o_xq = np.asarray(f_xla(qd, kpq, vpq, tables, lens,
+                                k_scales=sc, v_scales=sc), np.float32)
+        q_err = float(np.max(np.abs(o_pq - o_xq)))
+
+        def paged_q8(qq, kp_, vp_, tb_, ln_, s1, s2):
+            return pa.paged_attention(qq, kp_, vp_, tb_, ln_,
+                                      k_scales=s1, v_scales=s2)
+
+        t_pq = timeit(paged_q8, qd, kpq, vpq, tables, lens, sc, sc)
+        extra["paged_decode_q8"] = dict(
+            err_vs_xla=q_err, t_pallas_ms=t_pq * 1e3,
+            ctx=page * ppseq, batch=b_dec)
+        print(f"paged decode int8-kv: err={q_err:.4f} "
+              f"pallas {t_pq*1e3:.3f}ms")
     except Exception as e:  # noqa: BLE001 — record, don't kill the sweep
         extra["paged_decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         print(f"paged decode FAILED: {e}", file=sys.stderr)

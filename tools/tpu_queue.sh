@@ -17,7 +17,9 @@ PROBE_INTERVAL=${PROBE_INTERVAL:-240}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-120}
 START=$(date +%s)
 
-log() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+# append-only (no tee): launching the queue with stdout redirected into
+# $LOG would otherwise double every line
+log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$LOG"; }
 
 probe() {
   timeout "$PROBE_TIMEOUT" python -c "
@@ -39,7 +41,8 @@ run_task() {
     touch "$marker"
     log "task $(basename "$marker"): DONE"
   else
-    log "task $(basename "$marker"): rc=$? (will retry next revival)"
+    local rc=$?  # before any command substitution can clobber it
+    log "task $(basename "$marker"): rc=$rc (will retry next revival)"
     return 1
   fi
 }
