@@ -323,10 +323,14 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     # latency dominating single-token decode on a tunneled chip
     burst = int(os.environ.get("BENCH_SERVING_BURST", "16" if on_tpu
                                else "4"))
+    # BENCH_SERVING_ASYNC=N keeps N bursts in flight (device-side decode
+    # carry): the host round-trip + token replay overlap device compute
+    async_depth = int(os.environ.get("BENCH_SERVING_ASYNC", "0"))
     engine = ServingEngine(model, max_batch=max_batch,
                            max_seq_len=prompt_len + new_tokens,
                            page_size=16, decode_strategy="greedy_search",
-                           decode_burst=burst, kv_cache_quant=kv_quant)
+                           decode_burst=burst, kv_cache_quant=kv_quant,
+                           async_depth=async_depth)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
                for _ in range(max_batch)]
@@ -351,7 +355,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         "vs_baseline": 0.0,
         "extra": {"requests": len(finished), "batch": max_batch,
                   "prompt_len": prompt_len, "new_tokens": new_tokens,
-                  "decode_burst": burst, "quant": quant or None,
+                  "decode_burst": burst, "async_depth": async_depth,
+                  "quant": quant or None,
                   "kv_quant": kv_quant,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,

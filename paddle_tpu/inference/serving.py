@@ -57,7 +57,7 @@ class ServingEngine:
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
                  decode_strategy="greedy_search", temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
-                 decode_burst=1, kv_cache_quant=None):
+                 decode_burst=1, kv_cache_quant=None, async_depth=0):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         self.model = model
@@ -135,6 +135,11 @@ class ServingEngine:
         self._req_params: Dict[int, dict] = {}  # per-request sampling
         self._next_rid = 0
         self._admit_seq = 0
+        # bumped by every _release_slot (finish/abort/preempt): the async
+        # pipeline snapshots it around replay to detect ANY page release —
+        # freed pages must not be reallocated while stale-carry bursts are
+        # still in flight writing to them
+        self._release_gen = 0
         self._key = jax.random.PRNGKey(seed)
         self._decode_fns: Dict[bool, object] = {}
         self._burst_fns: Dict[tuple, object] = {}
@@ -149,6 +154,16 @@ class ServingEngine:
         # order, after the burst), so streaming semantics are unchanged;
         # abort() from a callback takes effect at burst granularity.
         self.decode_burst = max(1, int(decode_burst))
+        # async scheduling (vLLM-style lookahead): during pure decode the
+        # scalar state (last token, lens, active, budget, rng key) stays
+        # ON DEVICE — burst N+1 is dispatched off burst N's output
+        # futures BEFORE burst N's tokens are harvested, keeping up to
+        # `async_depth` bursts in flight so the host round-trip and token
+        # replay overlap device compute. Greedy token streams are
+        # bitwise-identical to the sync path; sampling streams differ
+        # only in rng consumption order (the key chains on device instead
+        # of being re-split per burst on the host).
+        self.async_depth = max(0, int(async_depth))
         # params pytree cached across steps (round-2 verdict weak #5:
         # rebuilding it every decode step); call refresh_params() after
         # mutating model weights
@@ -333,6 +348,7 @@ class ServingEngine:
             self.block_tables[slot_idx, :s.n_pages].tolist())
         s.n_pages = 0
         s.active = False
+        self._release_gen += 1
 
     def abort(self, request_id: int) -> bool:
         """Drop a request: dequeue it if still pending, or free its slot
@@ -572,12 +588,53 @@ class ServingEngine:
                     one, (tokens, k_pages, v_pages, k_scales, v_scales,
                           lens, active, rem, key),
                     None, length=n_steps)
-                _, nk, nv, nks, nvs, _, _, _, _ = carry
-            return toks, emits, nk, nv, nks, nvs
+                tok_f, nk, nv, nks, nvs, ln_f, act_f, rm_f, key_f = carry
+            # the scalar decode state rides back out so an async scheduler
+            # can chain burst N+1 directly off burst N's DEVICE outputs
+            # (no host round-trip between dispatches); the sync path just
+            # ignores these leaves
+            return (toks, emits, nk, nv, nks, nvs,
+                    tok_f, ln_f, act_f, rm_f, jax.random.key_data(key_f))
 
         fn = self._burst_fns[(all_greedy, n_steps)] = jax.jit(
             pure_burst, donate_argnums=(2, 3, 4, 5))
         return fn
+
+    def _decode_launch_state(self, active):
+        """Per-row launch arrays for a decode dispatch, shared by the sync
+        and async paths — one assembly point keeps their documented greedy
+        bitwise parity true by construction."""
+        defaults = dict(greedy=True, temperature=1.0, top_k=0, top_p=1.0)
+
+        def _rp(s):
+            return self._req_params.get(s.request_id, defaults) \
+                if s.active else defaults
+
+        rem_of = {i: self.slots[i].max_new_tokens
+                  - len(self.slots[i].tokens) for i in active}
+        act_mask = np.asarray([s.active and i in active
+                               for i, s in enumerate(self.slots)], bool)
+        return dict(
+            rem_of=rem_of,
+            act_mask=act_mask,
+            lens=np.asarray([s.context_len if s.active else 0
+                             for s in self.slots], np.int32),
+            all_greedy=all(self.slots[i].greedy for i in active),
+            greedy=np.asarray([_rp(s)["greedy"] for s in self.slots],
+                              bool),
+            temp=np.asarray([_rp(s)["temperature"] for s in self.slots],
+                            np.float32),
+            tk=np.asarray([_rp(s)["top_k"] for s in self.slots], np.int32),
+            tp=np.asarray([_rp(s)["top_p"] for s in self.slots],
+                          np.float32),
+            rem=np.asarray(
+                [max(rem_of.get(i, 0), 0) if act_mask[i] else 0
+                 for i in range(self.max_batch)], np.int32),
+            eos=np.asarray(
+                [e if s.active and
+                 (e := self._req_eos(s.request_id)) is not None else -1
+                 for s in self.slots], np.int32),
+        )
 
     def step(self) -> List[FinishedRequest]:
         """Run one decode step for all active slots; returns requests that
@@ -635,64 +692,30 @@ class ServingEngine:
             active = [j for j in active if j != victim]
             if not active:
                 return finished_early
-        lens = np.asarray([s.context_len if s.active else 0
-                           for s in self.slots], np.int32)
-        act_mask = np.asarray([s.active and i in active
-                               for i, s in enumerate(self.slots)], bool)
-        all_greedy = all(self.slots[i].greedy for i in active)
+        st = self._decode_launch_state(active)
+        all_greedy = st["all_greedy"]
+        lens, act_mask = st["lens"], st["act_mask"]
+        greedy, temp, tk, tp_arr = (st["greedy"], st["temp"], st["tk"],
+                                    st["tp"])
         self._key, sk = jax.random.split(self._key)
         params, buffers = self._cached_params()
-        defaults = dict(greedy=True, temperature=1.0, top_k=0, top_p=1.0)
-
-        def _rp(s):
-            return self._req_params.get(s.request_id, defaults) \
-                if s.active else defaults
-
-        greedy = np.asarray([_rp(s)["greedy"] for s in self.slots], bool)
-        temp = np.asarray([_rp(s)["temperature"] for s in self.slots],
-                          np.float32)
-        tk = np.asarray([_rp(s)["top_k"] for s in self.slots], np.int32)
-        tp_arr = np.asarray([_rp(s)["top_p"] for s in self.slots],
-                            np.float32)
         if k_burst > 1:
-            rem = np.asarray([max(rem_of.get(i, 0), 0) if act_mask[i] else 0
-                              for i in range(self.max_batch)], np.int32)
-            eos_arr = np.asarray(
-                [e if s.active and
-                 (e := self._req_eos(s.request_id)) is not None else -1
-                 for s in self.slots], np.int32)
             fn = self._get_burst_fn(all_greedy, k_burst)
-            toks, emits, nk, nv, nks, nvs = fn(
+            (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
                 params, buffers, tuple(self.k_pages), tuple(self.v_pages),
                 tuple(self.k_scales or ()), tuple(self.v_scales or ()),
                 jnp.asarray(tokens), jnp.asarray(self.block_tables),
-                jnp.asarray(lens), jnp.asarray(act_mask), jnp.asarray(rem),
-                jnp.asarray(eos_arr), jax.random.key_data(sk),
+                jnp.asarray(lens), jnp.asarray(act_mask),
+                jnp.asarray(st["rem"]), jnp.asarray(st["eos"]),
+                jax.random.key_data(sk),
                 jnp.asarray(greedy), jnp.asarray(temp), jnp.asarray(tk),
                 jnp.asarray(tp_arr))
             self.k_pages, self.v_pages = list(nk), list(nv)
             if self.k_scales is not None:
                 self.k_scales, self.v_scales = list(nks), list(nvs)
-            toks = np.asarray(toks)     # [K, B]
-            emits = np.asarray(emits)   # [K, B] bool
             finished = finished_early
-            # replay the burst token-by-token: identical host semantics to
-            # K single steps (stream order, finish rules, abort from a
-            # callback skips the rest of that request's burst)
-            for j in range(k_burst):
-                for i in active:
-                    s = self.slots[i]
-                    if not s.active or not emits[j, i]:
-                        continue
-                    s.context_len += 1
-                    s.tokens.append(int(toks[j, i]))
-                    self._stream(s.request_id, s.tokens[-1])
-                    if not s.active:
-                        continue  # the callback above aborted THIS request
-                    eos = self._req_eos(s.request_id)
-                    if len(s.tokens) >= s.max_new_tokens or (
-                            eos is not None and s.tokens[-1] == eos):
-                        finished.append(self._finish(i))
+            finished.extend(self._replay_burst(
+                np.asarray(toks), np.asarray(emits), active))
             if finished:
                 self._admit()
             return finished
@@ -728,6 +751,28 @@ class ServingEngine:
             self._admit()
         return finished
 
+    def _replay_burst(self, toks, emits, active):
+        """Token-by-token host replay of one harvested burst: identical
+        semantics to K single steps (stream order, finish rules, abort
+        from an on_token callback skips the rest of that request's
+        burst). toks/emits: [K, B] numpy."""
+        finished = []
+        for j in range(toks.shape[0]):
+            for i in active:
+                s = self.slots[i]
+                if not s.active or not emits[j, i]:
+                    continue
+                s.context_len += 1
+                s.tokens.append(int(toks[j, i]))
+                self._stream(s.request_id, s.tokens[-1])
+                if not s.active:
+                    continue  # the callback above aborted THIS request
+                eos = self._req_eos(s.request_id)
+                if len(s.tokens) >= s.max_new_tokens or (
+                        eos is not None and s.tokens[-1] == eos):
+                    finished.append(self._finish(i))
+        return finished
+
     def _finish(self, slot_idx) -> FinishedRequest:
         s = self.slots[slot_idx]
         self._release_slot(slot_idx)
@@ -744,10 +789,128 @@ class ServingEngine:
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.active for s in self.slots)
 
+    def _async_ok(self) -> bool:
+        """Pipelined decode is only entered in the steady pure-decode
+        state: no admissible queue (admission reuses slots whose pages an
+        in-flight burst may still write), no prefill-time samples pending,
+        and at least one row with >1 tokens of budget (single-tail rows
+        take the classic single-step program)."""
+        if self.async_depth <= 0 or self.decode_burst <= 1 or self._pending:
+            return False
+        active = [s for s in self.slots if s.active]
+        if not active:
+            return False
+        if any(s.needs_first_sample for s in active):
+            return False
+        return max(s.max_new_tokens - len(s.tokens) for s in active) > 1
+
+    def _decode_async(self, max_bursts):
+        """Dispatch up to `async_depth` bursts ahead of the harvest point.
+
+        The compiled burst returns its scalar carry (token/lens/active/
+        budget/key) as device arrays; each next dispatch consumes them as
+        futures, so the chain runs back-to-back on device while the host
+        replays older bursts' tokens. Page growth is reserved
+        CONSERVATIVELY before each dispatch (host lens lag the device by
+        the in-flight count, so reservation covers (inflight+1) bursts);
+        any finish/abort during replay releases pages, so the pipeline
+        drains before the next dispatch could reallocate them. Returns
+        (finished, bursts_dispatched)."""
+        from collections import deque
+
+        k = self.decode_burst
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        st = self._decode_launch_state(active)
+        rem_of = st["rem_of"]
+        n_bursts = min(int(max_bursts), -(-max(rem_of.values()) // k))
+        if n_bursts <= 0:
+            return [], 0
+        params, buffers = self._cached_params()
+        fn = self._get_burst_fn(st["all_greedy"], k)
+        tokens = np.zeros((self.max_batch,), np.int64)
+        for i in active:
+            tokens[i] = self.slots[i].tokens[-1]
+        # the max context each row can ever reach in this phase — the
+        # page-reservation cap (sync step() caps at min(burst, rem) the
+        # same way; without it a nearly-done row beside a long-running one
+        # would reserve past its budget and overrun its block-table row)
+        final_ctx = {i: self.slots[i].context_len + rem_of[i]
+                     for i in active}
+        self._key, sk = jax.random.split(self._key)
+        greedy, temp = jnp.asarray(st["greedy"]), jnp.asarray(st["temp"])
+        tk, tp_arr = jnp.asarray(st["tk"]), jnp.asarray(st["tp"])
+        eos_arr = jnp.asarray(st["eos"])
+        carry = (jnp.asarray(tokens), jnp.asarray(st["lens"]),
+                 jnp.asarray(st["act_mask"]), jnp.asarray(st["rem"]),
+                 jax.random.key_data(sk))
+        pages = (tuple(self.k_pages), tuple(self.v_pages),
+                 tuple(self.k_scales or ()), tuple(self.v_scales or ()))
+        inflight = deque()
+        finished = []
+        dispatched = 0
+        stop = False
+
+        def _reserve():
+            # cover every in-flight burst plus the one about to dispatch,
+            # capped at the row's final context
+            for i in active:
+                s = self.slots[i]
+                if not s.active:
+                    continue
+                steps = min(k * (len(inflight) + 1),
+                            final_ctx[i] - s.context_len)
+                if steps > 0 and not self._ensure_pages(i, steps):
+                    return False
+            return True
+
+        while (dispatched < n_bursts and not stop) or inflight:
+            if dispatched < n_bursts and not stop:
+                if _reserve():
+                    (toks, emits, nk, nv, nks, nvs,
+                     tok_f, ln_f, act_f, rm_f, key_f) = fn(
+                        params, buffers, *pages, carry[0],
+                        jnp.asarray(self.block_tables), carry[1], carry[2],
+                        carry[3], eos_arr, carry[4], greedy, temp, tk,
+                        tp_arr)
+                    pages = (nk, nv, nks, nvs)
+                    carry = (tok_f, ln_f, act_f, rm_f, key_f)
+                    inflight.append((toks, emits))
+                    dispatched += 1
+                else:
+                    # page-pool pressure: drain, then let the classic
+                    # step() run its preemption policy
+                    stop = True
+            if inflight and (stop or len(inflight) > self.async_depth
+                             or dispatched >= n_bursts):
+                toks, emits = inflight.popleft()
+                gen0 = self._release_gen
+                finished.extend(self._replay_burst(
+                    np.asarray(toks), np.asarray(emits), active))
+                if self._release_gen != gen0:
+                    # pages were freed (finish OR a callback abort): the
+                    # remaining in-flight bursts still write to them via
+                    # their stale carry, so drain before any dispatch
+                    # could hand those pages to another request
+                    stop = True
+        self.k_pages, self.v_pages = list(pages[0]), list(pages[1])
+        if self.k_scales is not None:
+            self.k_scales, self.v_scales = list(pages[2]), list(pages[3])
+        if finished:
+            self._admit()
+        return finished, dispatched
+
     def run(self, max_steps=10_000) -> List[FinishedRequest]:
         out = []
         steps = 0
         while self.has_work() and steps < max_steps:
+            if self._async_ok():
+                got, n = self._decode_async(max_steps - steps)
+                if n > 0:
+                    out.extend(got)
+                    steps += n
+                    continue
+                # nothing could be dispatched (page pressure on entry):
+                # fall through to the classic step, which preempts
             out.extend(self.step())
             steps += 1
         return out

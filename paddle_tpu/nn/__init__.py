@@ -37,6 +37,7 @@ from .clip import (  # noqa: F401
 )
 from .common_layers import (  # noqa: F401
     AlphaDropout,
+    FeatureAlphaDropout,
     Bilinear,
     ChannelShuffle,
     CosineSimilarity,

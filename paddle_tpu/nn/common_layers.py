@@ -93,6 +93,15 @@ class AlphaDropout(Layer):
         return F.alpha_dropout(x, p=self.p, training=self.training)
 
 
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
 class Embedding(Layer):
     """paddle layout: [num_embeddings, embedding_dim]."""
 

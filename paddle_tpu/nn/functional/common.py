@@ -56,7 +56,11 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=axis, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
+def _alpha_dropout_impl(x, p, training, mask_shape, name):
+    """SELU-preserving dropout core: dropped positions go to alpha' with
+    an affine correction keeping zero mean / unit variance. `mask_shape`
+    maps the input shape to the bernoulli mask shape (full shape for
+    per-element, [N, C, 1...] for per-feature-map)."""
     if not training or p == 0.0:
         from ...ops import math as _math
 
@@ -67,13 +71,28 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
 
     def f(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape(a.shape))
         q = 1.0 - p
         a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
         b_coef = -a_coef * alpha_p * p
         return a_coef * jnp.where(keep, a, alpha_p) + b_coef
 
-    return _apply_op(f, x, _name="alpha_dropout")
+    return _apply_op(f, x, _name=name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    return _alpha_dropout_impl(x, p, training, lambda s: s,
+                               "alpha_dropout")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole feature maps: the keep/drop decision
+    is shared across every spatial position of a [N, C, ...] channel
+    (reference: paddle.nn.FeatureAlphaDropout), preserving SELU
+    self-normalizing statistics like `alpha_dropout`."""
+    return _alpha_dropout_impl(
+        x, p, training, lambda s: s[:2] + (1,) * (len(s) - 2),
+        "feature_alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

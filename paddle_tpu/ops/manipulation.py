@@ -232,6 +232,26 @@ def combinations(x, r=2, with_replacement=False, name=None):
     return _apply_op(lambda a: a[jnp.asarray(idx)], x, _name="combinations")
 
 
+def cartesian_prod(x, name=None):
+    """paddle.cartesian_prod parity: cartesian product of 1-D tensors.
+
+    Takes a list/tuple of 1-D tensors and returns [prod(len_i), k] rows
+    enumerating the product in odometer (last-axis-fastest) order, matching
+    the reference (python/paddle/tensor/math.py cartesian_prod via
+    meshgrid+stack)."""
+    xs = [as_array(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    if any(a.ndim != 1 for a in xs):
+        raise ValueError("cartesian_prod expects 1-D tensors")
+
+    def _prod(*arrs):
+        if len(arrs) == 1:  # single input stays 1-D (reference semantics)
+            return arrs[0]
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return _apply_op(_prod, *xs, _name="cartesian_prod")
+
+
 def split(x, num_or_sections, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())

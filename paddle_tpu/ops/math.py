@@ -79,6 +79,7 @@ sign = _unop(jnp.sign, "sign")
 sgn = sign
 neg = _unop(jnp.negative, "neg")
 negative = neg
+positive = _unop(jnp.positive, "positive")
 reciprocal = _unop(jnp.reciprocal, "reciprocal")
 floor = _unop(jnp.floor, "floor")
 ceil = _unop(jnp.ceil, "ceil")

@@ -277,3 +277,41 @@ class TestMaxUnpool:
         o, m = pool(paddle.to_tensor(x))
         u = unpool(o, m)
         assert u.shape == [1, 1, 6, 6]
+
+
+class TestRound4Tail:
+    def test_positive(self):
+        x = paddle.to_tensor([1.5, -2.0, 0.0])
+        out = paddle.positive(x)
+        assert np.allclose(out.numpy(), x.numpy())
+
+    def test_cartesian_prod(self):
+        a = paddle.to_tensor([1, 2, 3])
+        b = paddle.to_tensor([10, 20])
+        out = paddle.cartesian_prod([a, b])
+        exp = np.array([[1, 10], [1, 20], [2, 10], [2, 20],
+                        [3, 10], [3, 20]])
+        assert np.array_equal(out.numpy(), exp)
+        # single input stays 1-D (reference semantics)
+        assert paddle.cartesian_prod([a]).shape == [3]
+
+    def test_feature_alpha_dropout(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8, 5, 5).astype("float32"))
+        layer = paddle.nn.FeatureAlphaDropout(p=0.4)
+        layer.train()
+        y = layer(x).numpy()
+        # the keep/drop decision is per (sample, channel): within one
+        # channel, every position must share one affine of the input
+        alpha_p = -1.6732632423543772 * 1.0507009873554805
+        q, p = 0.6, 0.4
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        kept = np.isclose(y, a_coef * x.numpy() + b_coef, atol=1e-5)
+        dropped = np.isclose(y, a_coef * alpha_p + b_coef, atol=1e-5)
+        per_chan_kept = kept.reshape(4, 8, -1).all(-1)
+        per_chan_drop = dropped.reshape(4, 8, -1).all(-1)
+        assert np.all(per_chan_kept | per_chan_drop)
+        assert per_chan_drop.any() and per_chan_kept.any()
+        layer.eval()
+        assert np.allclose(layer(x).numpy(), x.numpy())

@@ -49,7 +49,8 @@ run_task() {
 
 all_done() {
   for t in kernel_bench serving_int8 serving_int4 serving_full_int8 \
-           bisect_1b mfu_1b mfu_base_fused; do
+           serving_burst64 serving_burst127 serving_async serving_async64 \
+           bisect_1b mfu_1b mfu_base_fused mfu_long; do
     [ -f "$STATE/$t" ] || return 1
   done
   return 0
@@ -77,6 +78,31 @@ while :; do
     # every task ends with an artifact check: bench.py & friends exit 0
     # on CPU fallback, and a marker written for a fallback run would
     # permanently skip the real measurement
+    # burst scaling: the ~300 ms/burst host sync through the tunnel is
+    # the row-5 long pole (371.8 tok/s at burst 16 = ~19 ms/step of sync
+    # vs ~3 ms/step of compute) — bigger bursts divide it further
+    run_task serving_burst64 600 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_BURST=64 BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_BURST64.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_BURST64.json'
+    run_task serving_burst127 600 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_BURST=127 BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_BURST127.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_BURST127.json'
+    run_task serving_async 600 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_BURST=16 BENCH_SERVING_ASYNC=4 \
+      BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_ASYNC.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_ASYNC.json'
+    run_task serving_async64 600 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_BURST=64 BENCH_SERVING_ASYNC=2 \
+      BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_ASYNC64.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_ASYNC64.json'
     run_task serving_int8 600 bash -c 'BENCH_CONFIG=serving \
       BENCH_SERVING_QUANT=weight_only_int8 BENCH_KERNELS=0 BENCH_EXTRA=0 \
       BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
@@ -101,10 +127,14 @@ while :; do
       && grep -q "\"ok\": true" BISECT_1B.json'
     run_task mfu_base_fused 2400 bash -c \
       'python tools/mfu_sweep.py --model base --budget 2100 \
+         --require-success \
        && grep -q "\"fused_ce\": 8" MFU_SWEEP.json'
     run_task mfu_1b 2400 bash -c \
       'python tools/mfu_sweep.py --model 1b --budget 2100 \
-       && grep -q "\"model\": \"1b\"" MFU_SWEEP.json'
+         --require-success'
+    run_task mfu_long 2400 bash -c \
+      'python tools/mfu_sweep.py --model long --budget 2100 \
+         --require-success'
   else
     log "probe $ATTEMPT: down"
   fi
