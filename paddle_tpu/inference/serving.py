@@ -600,6 +600,13 @@ class ServingEngine:
             pure_burst, donate_argnums=(2, 3, 4, 5))
         return fn
 
+    def _rem_of(self, active):
+        """Remaining new-token budget per active slot — the ONE place the
+        budget rule lives (k_burst sizing, page reservation, and the
+        device rem array all derive from it)."""
+        return {i: self.slots[i].max_new_tokens - len(self.slots[i].tokens)
+                for i in active}
+
     def _decode_launch_state(self, active):
         """Per-row launch arrays for a decode dispatch, shared by the sync
         and async paths — one assembly point keeps their documented greedy
@@ -610,8 +617,7 @@ class ServingEngine:
             return self._req_params.get(s.request_id, defaults) \
                 if s.active else defaults
 
-        rem_of = {i: self.slots[i].max_new_tokens
-                  - len(self.slots[i].tokens) for i in active}
+        rem_of = self._rem_of(active)
         act_mask = np.asarray([s.active and i in active
                                for i, s in enumerate(self.slots)], bool)
         return dict(
@@ -674,8 +680,7 @@ class ServingEngine:
         # burst is correct, just not free; it only occurs while the queue
         # drains. max rem == 1 (every row on its last token) drops to the
         # single-step program.
-        rem_of = {i: self.slots[i].max_new_tokens - len(self.slots[i].tokens)
-                  for i in active}
+        rem_of = self._rem_of(active)
         k_burst = self.decode_burst if (
             self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
         # on-demand page growth for the positions this step writes (one per

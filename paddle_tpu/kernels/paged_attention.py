@@ -35,6 +35,32 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+# Measured on real Mosaic (KERNEL_BENCH.json round-4): at a mapped
+# context of 1024 the XLA dense-gather path decodes 2.2x faster than the
+# Pallas page-grid kernel (one 16-token page per grid step starves the
+# MXU), while the gather's HBM traffic grows linearly with the MAPPED
+# context (pages_per_seq * page_size), so the paged kernel owns long
+# contexts. The crossover default is overridable for re-tuning via the
+# kernel bench's ctx sweep.
+_XLA_DECODE_MAX_CTX = 2048
+
+
+def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
+                             context_lens, scale=None, k_scales=None,
+                             v_scales=None):
+    """Decode-attention dispatch: XLA dense-gather below the measured
+    crossover of mapped context, Pallas page-grid kernel above it (and
+    always under interpret mode, where the Pallas path is emulation)."""
+    mapped_ctx = block_tables.shape[1] * k_pages.shape[2]
+    if _interpret() or mapped_ctx <= _XLA_DECODE_MAX_CTX:
+        return paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                   context_lens, scale=scale,
+                                   k_scales=k_scales, v_scales=v_scales)
+    return paged_attention(q, k_pages, v_pages, block_tables,
+                           context_lens, scale=scale, k_scales=k_scales,
+                           v_scales=v_scales)
+
+
 # ---------------------------------------------------------------------------
 # cache management (XLA scatter — one token per sequence per step)
 # ---------------------------------------------------------------------------

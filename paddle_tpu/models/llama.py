@@ -276,8 +276,7 @@ class LlamaAttention(nn.Layer):
                                    position_offset=lens)
             qq = apply_rope(qq, cos, sin)
             kk = apply_rope(kk, cos, sin)
-            attn = _pa.paged_attention_xla if _pa._interpret() \
-                else _pa.paged_attention
+            attn = _pa.paged_attention_dispatch
             if kv_quant:
                 ksc, vsc = scales
                 kp2, ksc2, vp2, vsc2 = _pa.update_paged_kv_cache_q8(

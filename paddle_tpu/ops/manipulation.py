@@ -239,8 +239,8 @@ def cartesian_prod(x, name=None):
     enumerating the product in odometer (last-axis-fastest) order, matching
     the reference (python/paddle/tensor/math.py cartesian_prod via
     meshgrid+stack)."""
-    xs = [as_array(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
-    if any(a.ndim != 1 for a in xs):
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    if any(as_array(t).ndim != 1 for t in xs):
         raise ValueError("cartesian_prod expects 1-D tensors")
 
     def _prod(*arrs):
