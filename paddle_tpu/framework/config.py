@@ -74,6 +74,19 @@ define_flag("FLAGS_static_strict_placeholders", False,
 define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
 define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")
+define_flag("FLAGS_flash_fwd_min_seq", 0,
+            "Min seq for the Pallas flash forward in no-grad attention; "
+            "0 defers to the built-in measured default (4096 — the v5e "
+            "crossover where XLA fused attention stops winning, "
+            "KERNEL_BENCH.json round-4).", type_=int)
+define_flag("FLAGS_flash_bwd_min_seq", 0,
+            "Min seq for the Pallas streamed backward in training "
+            "attention; 0 defers to the built-in default (4096). At "
+            "exactly 4096 XLA's recompute grad is ~1.3x faster on the "
+            "isolated kernel but materializes the O(s^2) probs (the OOM "
+            "cliff the seq-8192 XLA reference hit); the streamed kernel "
+            "is the memory-safe default from 4096 and measured 8.3x "
+            "faster at 8192.", type_=int)
 
 
 # ---------------------------------------------------------------------------

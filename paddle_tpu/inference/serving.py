@@ -802,12 +802,12 @@ class ServingEngine:
         take the classic single-step program)."""
         if self.async_depth <= 0 or self.decode_burst <= 1 or self._pending:
             return False
-        active = [s for s in self.slots if s.active]
+        active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
             return False
-        if any(s.needs_first_sample for s in active):
+        if any(self.slots[i].needs_first_sample for i in active):
             return False
-        return max(s.max_new_tokens - len(s.tokens) for s in active) > 1
+        return max(self._rem_of(active).values()) > 1
 
     def _decode_async(self, max_bursts):
         """Dispatch up to `async_depth` bursts ahead of the harvest point.

@@ -417,6 +417,21 @@ def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
 
 
 _PALLAS_BWD_MIN_SEQ = 4096
+# measured v5e forward-only crossover (KERNEL_BENCH.json round-4 ctx
+# sweep): XLA fused attention wins below, flash above (19.8x at 8192)
+_PALLAS_FWD_MIN_SEQ = 4096
+
+
+def _bwd_use_xla(s_q):
+    """Backward dispatch: XLA recompute grad below the threshold,
+    streamed Pallas kernels above — see FLAGS_flash_bwd_min_seq for the
+    measured rationale. Flag value 0 defers to the module constant (which
+    tests monkeypatch to force the streamed path at small seq)."""
+    from ..framework import config as _config
+
+    thr = _config.get_flag("FLAGS_flash_bwd_min_seq", 0) \
+        or _PALLAS_BWD_MIN_SEQ
+    return s_q < thr
 
 
 def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
@@ -464,7 +479,7 @@ def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
 
 def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
     s_q = res[0].shape[1]
-    if s_q < _PALLAS_BWD_MIN_SEQ:
+    if _bwd_use_xla(s_q):
         return _xla_ref_bwd(res, g, scale, causal)
     return _flash_bwd(res, g, scale, causal, block_q, block_k)
 
@@ -495,7 +510,7 @@ def _flash_bhsd_seg_fwd(q, k, v, seg_q8, seg_k8, scale, causal, block_q,
 def _flash_bhsd_seg_bwd(scale, causal, block_q, block_k, heads, res, g):
     q, k, v, out, lse, seg_q8, seg_k8 = res
     s_q = q.shape[1]
-    if s_q < _PALLAS_BWD_MIN_SEQ:
+    if _bwd_use_xla(s_q):
         dq, dk, dv = _xla_ref_bwd((q, k, v, out, lse), g, scale, causal,
                                   seg_q=seg_q8, seg_k=seg_k8, heads=heads)
     else:
@@ -522,7 +537,7 @@ def _flash_bhsd_lse_bwd(scale, causal, block_q, block_k, res, g):
     g_out, g_lse = g
     q, k, v, out, lse = res
     s_q = q.shape[1]
-    if s_q < _PALLAS_BWD_MIN_SEQ:
+    if _bwd_use_xla(s_q):
         return _xla_ref_bwd((q, k, v, out, lse), g_out, scale, causal,
                             d_lse=g_lse)
     return _flash_bwd((q, k, v, out, lse), g_out, scale, causal, block_q,

@@ -62,9 +62,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             s_q = as_array(query).shape[1]
             s_kv = as_array(key).shape[1]
             d = as_array(query).shape[3]
-            # measured on v5lite: pallas wins fwd-only from ~1k seq, and
-            # fwd+bwd from ~4k; below that XLA's fused attention grad wins
-            min_seq = 1024 if not training else fa._PALLAS_BWD_MIN_SEQ
+            # measured on v5e (KERNEL_BENCH.json, in-scan timing): the
+            # flash forward crosses over XLA's fused attention at ~4096
+            # (1.17x there, 19.8x at 8192 where the s^2 scores thrash);
+            # in training the streamed backward is the memory-safe
+            # choice from 4096 (see FLAGS_flash_bwd_min_seq)
+            if training:
+                min_seq = (_config.get_flag("FLAGS_flash_bwd_min_seq", 0)
+                           or fa._PALLAS_BWD_MIN_SEQ)
+            else:
+                min_seq = (_config.get_flag("FLAGS_flash_fwd_min_seq", 0)
+                           or fa._PALLAS_FWD_MIN_SEQ)
             if fa.supports(s_q, s_kv, d) and s_q >= min_seq:
 
                 def f(q, k, v):

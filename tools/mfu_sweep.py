@@ -50,6 +50,7 @@ GRIDS = {
     # from OOMing at 8k+ tokens x 32k vocab
     "long": [
         (8, 4096, 0, 0, 16),
+        (4, 4096, 0, 0, 16),   # smaller-batch fallback if 8x4096 OOMs
         (4, 8192, 0, 0, 16),
         (4, 8192, 1, 0, 16),   # remat headroom variant
         (2, 16384, 1, 0, 32),  # deep flash regime

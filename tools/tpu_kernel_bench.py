@@ -245,9 +245,10 @@ def main():
             print(f"paged decode ctx={page*ppseq:5d}: err={paged_err:.4f}"
                   f" pallas {t_p*1e3:.3f}ms xla {t_x*1e3:.3f}ms "
                   f"({t_x/t_p:.2f}x)")
-            _dump(args.json, backend, rows, dict(extra,
-                                                 paged_decode=rows_dec))
-        extra["paged_decode"] = rows_dec
+            # bank into `extra` itself so a later failure (next ctx, q8
+            # variant) can't drop already-measured rows at the final dump
+            extra["paged_decode"] = rows_dec
+            _dump(args.json, backend, rows, extra)
 
         # int8-KV variant: the quant BlockSpecs lower differently (4D
         # scale tiles) — interpret mode can't catch Mosaic tiling rejects,
