@@ -10,3 +10,6 @@ from .segment_ops import (  # noqa: F401
     segment_sum,
 )
 from . import autograd  # noqa: F401
+from .nn.functional import (  # noqa: F401
+    softmax_mask_fuse_upper_triangle,
+)

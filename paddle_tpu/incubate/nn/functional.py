@@ -59,3 +59,22 @@ def fused_bias_dropout_residual_layer_norm(
             args.append(t)
     return _apply_op(f, *args,
                      _name="fused_bias_dropout_residual_layer_norm")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """paddle.incubate.softmax_mask_fuse_upper_triangle parity: causal
+    (upper-triangle-masked) softmax over the last axis of a
+    [batch, heads, seq_q, seq_k] score tensor (reference:
+    fused_softmax_mask_upper_triangle_op). On TPU this is one traced
+    where+softmax expression XLA fuses into the surrounding matmuls — no
+    custom kernel needed."""
+    def f(a):
+        if a.ndim != 4:
+            raise ValueError(
+                "softmax_mask_fuse_upper_triangle expects [b, h, sq, sk]")
+        sq, sk = a.shape[-2], a.shape[-1]
+        mask = jnp.arange(sq)[:, None] + (sk - sq) >= jnp.arange(sk)[None]
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, a.dtype)
+        return jax.nn.softmax(jnp.where(mask, a, neg), axis=-1)
+
+    return _apply_op(f, x, _name="softmax_mask_fuse_upper_triangle")

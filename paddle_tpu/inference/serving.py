@@ -52,6 +52,12 @@ class ServingEngine:
     engine = ServingEngine(model, max_batch=8, max_seq_len=512)
     rid = engine.add_request(prompt_ids, max_new_tokens=64)
     finished = engine.run()          # or: engine.step() in a loop
+
+    page_size: 16 (vLLM-style) minimizes fragmentation; on TPU at long
+    max_seq_len prefer 128 — the Pallas decode kernel processes one page
+    per grid step, so 128-token pages feed the MXU full 128x128 K-tiles
+    (8x the arithmetic per step of 16-token pages; KERNEL_BENCH.json
+    paged-decode rows measure both).
     """
 
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,

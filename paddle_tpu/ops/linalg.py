@@ -375,23 +375,46 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         jnp.swapaxes(vh, -1, -2)[..., :q])
 
 
+def _householder_q(a, t):
+    """Accumulate the full m x m orthogonal Q from geqrf-packed
+    reflectors `a` (lower triangle) and `t` — batch-aware (the reflector
+    products broadcast over leading dims). Shared by
+    householder_product (truncates to n columns) and ormqr (applies the
+    full Q)."""
+    m = a.shape[-2]
+    eye = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+    idx = jnp.arange(m)
+    for i in range(t.shape[-1]):
+        v = jnp.where(idx < i, 0.0, a[..., :, i])  # [..., m]
+        v = jnp.where(idx == i, jnp.asarray(1.0, a.dtype), v)
+        h = eye - t[..., i][..., None, None] * (
+            v[..., :, None] * v[..., None, :])
+        q = q @ h
+    return q
+
+
 def householder_product(x, tau, name=None):
     def f(a, t):
-        m, n = a.shape[-2], a.shape[-1]
-        eye = jnp.eye(m, dtype=a.dtype)
-        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
-
-        def body(i, q):
-            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
-            v = v.at[i].set(1.0)
-            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
-            return q @ h
-
-        for i in range(t.shape[-1]):
-            q = body(i, q)
-        return q[..., :, :n]
+        return _householder_q(a, t)[..., :, :a.shape[-1]]
 
     return _apply_op(f, x, tau, _name="householder_product")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """paddle.linalg.ormqr parity: multiply `y` by the orthogonal Q
+    encoded as householder reflectors (geqrf output `x`, `tau`).
+
+    TPU stance: LAPACK's ormqr avoids forming Q to skip an m*m temp; on
+    TPU the reflector loop is sequential scalar work while forming Q
+    (shared `_householder_q` accumulation) turns the application into
+    one MXU matmul — the right trade at these sizes."""
+    def f(a, t, b):
+        q = _householder_q(a, t)
+        qm = q.swapaxes(-2, -1) if transpose else q
+        return qm @ b if left else b @ qm
+
+    return _apply_op(f, x, tau, y, _name="ormqr")
 
 
 def pdist(x, p=2.0, name=None):

@@ -10,6 +10,7 @@ masked dense (documented), but the API surface here matches the reference.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
@@ -19,7 +20,8 @@ from ..tensor import Tensor, as_array
 __all__ = [
     "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
     "SparseCsrTensor", "add", "subtract", "multiply", "matmul",
-    "masked_matmul", "relu", "is_same_shape",
+    "masked_matmul", "relu", "is_same_shape", "transpose", "sum",
+    "softmax",
 ]
 
 
@@ -289,6 +291,68 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
     if index_dtype is not None:
         idx = idx.astype(_fdtype.to_np_dtype(index_dtype))
     return SparseCooTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+
+
+def transpose(x, perm, name=None):
+    """paddle.sparse.transpose parity: permute a COO/CSR tensor's dims.
+
+    COO-native: the stored [nnz, ndim] index matrix is column-permuted and
+    re-sorted (BCOO keeps unsorted indices valid, but canonical row-major
+    order keeps downstream CSR conversion cheap); CSR round-trips through
+    COO."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x = _coo(x)
+    perm = [int(p) for p in perm]
+    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    shape = tuple(x._bcoo.shape[p] for p in perm)
+    out = SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx),
+                                       shape=shape).sort_indices())
+    return out.to_sparse_csr() if was_csr else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """paddle.sparse.sum parity: reduce over `axis`, returning a sparse
+    tensor (paddle semantics). Dense reduce + re-sparsify: a reduction
+    changes the sparsity structure wholesale, and on TPU the dense
+    reduction is an XLA one-pass anyway."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    dense = as_array(_coo(x).to_dense())
+    red = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..framework import dtype as _fdtype
+
+        red = red.astype(_fdtype.to_np_dtype(dtype))
+    if red.ndim == 0:
+        red = red.reshape(1)  # paddle returns a sparse 1-elem tensor
+    out = SparseCooTensor(jsparse.BCOO.fromdense(red))
+    if was_csr and red.ndim == 2:
+        return out.to_sparse_csr()
+    return out
+
+
+def softmax(x, axis=-1, name=None):
+    """paddle.sparse.softmax parity: softmax over the STORED entries of
+    each row — absent entries act as -inf, so only the nnz participate
+    (reference: paddle/phi/kernels/sparse/softmax_kernel). COO-native via
+    segment max/sum over the row ids."""
+    if axis not in (-1, 1):
+        raise ValueError("sparse softmax supports the last axis (2-D)")
+    was_csr = isinstance(x, SparseCsrTensor)
+    x = _coo(x)
+    if len(x._bcoo.shape) != 2:
+        raise ValueError("sparse softmax expects a 2-D tensor")
+    n_rows = x._bcoo.shape[0]
+    rows = x._bcoo.indices[:, 0]
+    v = x._bcoo.data.astype(jnp.float32)
+    row_max = jax.ops.segment_max(v, rows, num_segments=n_rows,
+                                  indices_are_sorted=False)
+    # rows with no entries give -inf max; harmless (no values to touch)
+    e = jnp.exp(v - row_max[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    out_vals = (e / denom[rows]).astype(x._bcoo.data.dtype)
+    out = SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
+                                       shape=x._bcoo.shape))
+    return out.to_sparse_csr() if was_csr else out
 
 
 from . import nn  # noqa: E402,F401 — paddle.sparse.nn (conv/attention/norm)

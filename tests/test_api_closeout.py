@@ -315,3 +315,93 @@ class TestRound4Tail:
         assert per_chan_drop.any() and per_chan_kept.any()
         layer.eval()
         assert np.allclose(layer(x).numpy(), x.numpy())
+
+
+class TestRound4TailB:
+    def test_ormqr(self):
+        from scipy.linalg import lapack
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 3).astype("float64")
+        qr_, tau_, _, _ = lapack.dgeqrf(a)
+        y = rng.randn(5, 4).astype("float64")
+        q, _, _ = lapack.dorgqr(qr_.copy()[:, :3], tau_)
+        # full Q (5x5) via applying to identity with dormqr
+        qfull, _, _ = lapack.dormqr("L", "N", qr_, tau_,
+                                    np.eye(5, order="F"), 5 * 5)
+        ref = qfull @ y
+        out = paddle.linalg.ormqr(paddle.to_tensor(qr_),
+                                  paddle.to_tensor(tau_),
+                                  paddle.to_tensor(y))
+        assert np.allclose(out.numpy(), ref, atol=1e-8)
+        # transpose + right-side variants against qfull
+        out_t = paddle.linalg.ormqr(paddle.to_tensor(qr_),
+                                    paddle.to_tensor(tau_),
+                                    paddle.to_tensor(y), transpose=True)
+        assert np.allclose(out_t.numpy(), qfull.T @ y, atol=1e-8)
+        z = rng.randn(4, 5).astype("float64")
+        out_r = paddle.linalg.ormqr(paddle.to_tensor(qr_),
+                                    paddle.to_tensor(tau_),
+                                    paddle.to_tensor(z), left=False)
+        assert np.allclose(out_r.numpy(), z @ qfull, atol=1e-8)
+
+    def test_sparse_transpose_sum_softmax(self):
+        rng = np.random.RandomState(1)
+        d = rng.randn(4, 6).astype("float32")
+        d[d < 0.3] = 0.0
+        sp = paddle.to_tensor(d).to_sparse_coo(2) if hasattr(
+            paddle.to_tensor(d), "to_sparse_coo") else None
+        import paddle_tpu.sparse as S
+        coo = S.SparseCooTensor.__new__(S.SparseCooTensor)
+        from jax.experimental import sparse as jsp
+        coo._bcoo = jsp.BCOO.fromdense(d)
+        t = S.transpose(coo, [1, 0])
+        assert np.allclose(t.to_dense().numpy(), d.T)
+        s_all = S.sum(coo)
+        assert np.allclose(s_all.to_dense().numpy(), d.sum())
+        s_ax = S.sum(coo, axis=1)
+        assert np.allclose(s_ax.to_dense().numpy(), d.sum(1))
+        sm = S.softmax(coo)
+        dn = sm.to_dense().numpy()
+        for r in range(4):
+            nz = d[r] != 0
+            if nz.any():
+                ref = np.exp(d[r][nz] - d[r][nz].max())
+                ref = ref / ref.sum()
+                assert np.allclose(dn[r][nz], ref, atol=1e-5)
+                assert np.allclose(dn[r][~nz], 0.0)
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 2, 4, 4).astype("float32")
+        out = paddle.incubate.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x)).numpy()
+        for b in range(2):
+            for h in range(2):
+                for i in range(4):
+                    row = x[b, h, i, :i + 1]
+                    ref = np.exp(row - row.max()); ref /= ref.sum()
+                    assert np.allclose(out[b, h, i, :i + 1], ref,
+                                       atol=1e-5)
+                    assert np.allclose(out[b, h, i, i + 1:], 0.0)
+
+    def test_ormqr_batched(self):
+        from scipy.linalg import lapack
+        rng = np.random.RandomState(4)
+        xs, taus, ys, refs = [], [], [], []
+        for b in range(2):
+            a = rng.randn(5, 3)
+            qr_, tau_, _, _ = lapack.dgeqrf(a)
+            qf, _, _ = lapack.dormqr("L", "N", qr_, tau_,
+                                     np.eye(5, order="F"), 25)
+            y = rng.randn(5, 2)
+            xs.append(qr_); taus.append(tau_); ys.append(y)
+            refs.append(qf @ y)
+        out = paddle.linalg.ormqr(paddle.to_tensor(np.stack(xs)),
+                                  paddle.to_tensor(np.stack(taus)),
+                                  paddle.to_tensor(np.stack(ys)))
+        assert np.allclose(out.numpy(), np.stack(refs), atol=1e-6)
+        # batched householder_product against per-batch dorgqr
+        qs = [lapack.dorgqr(x.copy(), t)[0] for x, t in zip(xs, taus)]
+        hp = paddle.linalg.householder_product(
+            paddle.to_tensor(np.stack(xs)), paddle.to_tensor(np.stack(taus)))
+        assert np.allclose(hp.numpy(), np.stack(qs), atol=1e-6)

@@ -216,13 +216,19 @@ def main():
     try:
         from paddle_tpu.kernels import paged_attention as pa
 
-        b_dec, kvh, hd, page = 8, 8, 128, 16
+        b_dec, kvh, hd = 8, 8, 128
         f_pal = jax.jit(pa.paged_attention)
         f_xla = jax.jit(pa.paged_attention_xla)
         # ctx sweep: locates the dense-gather vs page-grid crossover that
-        # paged_attention_dispatch's _XLA_DECODE_MAX_CTX encodes
+        # paged_attention_dispatch's _XLA_DECODE_MAX_CTX encodes. Each
+        # ctx also runs with 128-token pages: one page per grid step
+        # means page_size IS the K-block, so 16-token pages starve the
+        # MXU 8-fold while 128-token pages feed it full 128x128 tiles
+        # (the engine supports either; fragmentation is the trade).
         rows_dec = []
-        for ppseq in (64, 256, 512):  # 1k / 4k / 8k mapped context
+        for page, ppseq in ((16, 64), (128, 8),      # 1k mapped ctx
+                            (16, 256), (128, 32),    # 4k
+                            (16, 512), (128, 64)):   # 8k
             n_pages = b_dec * ppseq
             key = jax.random.PRNGKey(1)
             kq, kk2, kv2 = jax.random.split(key, 3)
@@ -241,8 +247,10 @@ def main():
             t_x = timeit(f_xla, qd, kp, vp, tables, lens)
             rows_dec.append(dict(
                 err_vs_xla=paged_err, t_pallas_ms=t_p * 1e3,
-                t_xla_ms=t_x * 1e3, ctx=page * ppseq, batch=b_dec))
-            print(f"paged decode ctx={page*ppseq:5d}: err={paged_err:.4f}"
+                t_xla_ms=t_x * 1e3, ctx=page * ppseq, page_size=page,
+                batch=b_dec))
+            print(f"paged decode ctx={page*ppseq:5d} page={page:3d}: "
+                  f"err={paged_err:.4f}"
                   f" pallas {t_p*1e3:.3f}ms xla {t_x*1e3:.3f}ms "
                   f"({t_x/t_p:.2f}x)")
             # bank into `extra` itself so a later failure (next ctx, q8
@@ -256,7 +264,7 @@ def main():
         # Rebuilt at the 1024-token context explicitly (NOT the sweep
         # loop's last geometry): comparable to prior rounds and far from
         # the XLA reference's dense-dequant OOM regime.
-        ppseq = 64
+        page, ppseq = 16, 64
         n_pages = b_dec * ppseq
         key = jax.random.PRNGKey(1)
         kq, kk2, kv2 = jax.random.split(key, 3)
