@@ -294,7 +294,18 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     from paddle_tpu.inference import ServingEngine
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    if on_tpu:
+    size = os.environ.get("BENCH_SERVING_MODEL", "base")
+    if on_tpu and size == "3b":
+        # 2.2B-param proxy for the row-5 LLaMA-2-7B intent: bf16 weights
+        # (4.4 GB) fit one v5e for instantiation, then weight-only quant
+        # (BENCH_SERVING_QUANT) halves/quarters them — serving decode is
+        # weight-bandwidth-bound, so this is the representative measure
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2560,
+                          intermediate_size=6912, num_hidden_layers=26,
+                          num_attention_heads=20, num_key_value_heads=20,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        max_batch, prompt_len, new_tokens = 8, 128, 128
+    elif on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=8,
                           num_attention_heads=8, num_key_value_heads=8,
@@ -306,6 +317,9 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         max_batch, prompt_len, new_tokens = 2, 8, 8
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
+    # count BEFORE weight-only quant repacks [k,n] into nibble/byte pools
+    params_b = round(sum(int(np.prod(p.shape))
+                         for p in model.parameters()) / 1e9, 3)
     if on_tpu:
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     # BENCH_SERVING_QUANT=weight_only_int8|weight_only_int4 swaps the
@@ -360,7 +374,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "kv_quant": kv_quant,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
-                  "layers": cfg.num_hidden_layers}}
+                  "layers": cfg.num_hidden_layers,
+                  "params_b": params_b}}
     if not on_tpu:
         result["tpu_probe_error"] = PROBE_DIAG
     print(json.dumps(result))

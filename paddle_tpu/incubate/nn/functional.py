@@ -75,6 +75,9 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         sq, sk = a.shape[-2], a.shape[-1]
         mask = jnp.arange(sq)[:, None] + (sk - sq) >= jnp.arange(sk)[None]
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, a.dtype)
-        return jax.nn.softmax(jnp.where(mask, a, neg), axis=-1)
+        sm = jax.nn.softmax(jnp.where(mask, a, neg), axis=-1)
+        # rows with every position masked (sq > sk tail rows) would
+        # otherwise softmax the uniform fill to plausible-looking weights
+        return jnp.where(mask.any(-1)[:, None], sm, 0.0)
 
     return _apply_op(f, x, _name="softmax_mask_fuse_upper_triangle")

@@ -331,28 +331,49 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 
 def softmax(x, axis=-1, name=None):
-    """paddle.sparse.softmax parity: softmax over the STORED entries of
-    each row — absent entries act as -inf, so only the nnz participate
-    (reference: paddle/phi/kernels/sparse/softmax_kernel). COO-native via
-    segment max/sum over the row ids."""
-    if axis not in (-1, 1):
-        raise ValueError("sparse softmax supports the last axis (2-D)")
-    was_csr = isinstance(x, SparseCsrTensor)
+    """paddle.sparse.softmax parity (same op as
+    paddle.sparse.nn.functional.softmax — nn delegates here): softmax
+    over the STORED entries of each row, absent entries act as -inf so
+    only the nnz participate (reference:
+    paddle/phi/kernels/sparse/softmax_kernel). 2-D COO runs jit-native
+    via segment max/sum over row ids; CSR softmaxes each crow slice;
+    N-D COO falls back to a dense -inf mask."""
+    if axis != -1 and axis != len(getattr(x, "shape", [0, 0])) - 1:
+        raise ValueError("sparse softmax supports only the last axis")
+    if isinstance(x, SparseCsrTensor):
+        crows = np.asarray(x._crows)
+        vals = np.asarray(x._values, np.float64)
+        out = np.zeros_like(vals)
+        for r in range(len(crows) - 1):
+            lo, hi = crows[r], crows[r + 1]
+            if hi > lo:
+                seg = vals[lo:hi]
+                e = np.exp(seg - seg.max())
+                out[lo:hi] = e / e.sum()
+        return SparseCsrTensor(x._crows, x._cols,
+                               jnp.asarray(out, as_array(x._values).dtype),
+                               x.shape)
     x = _coo(x)
-    if len(x._bcoo.shape) != 2:
-        raise ValueError("sparse softmax expects a 2-D tensor")
-    n_rows = x._bcoo.shape[0]
-    rows = x._bcoo.indices[:, 0]
-    v = x._bcoo.data.astype(jnp.float32)
-    row_max = jax.ops.segment_max(v, rows, num_segments=n_rows,
-                                  indices_are_sorted=False)
-    # rows with no entries give -inf max; harmless (no values to touch)
-    e = jnp.exp(v - row_max[rows])
-    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-    out_vals = (e / denom[rows]).astype(x._bcoo.data.dtype)
-    out = SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
-                                       shape=x._bcoo.shape))
-    return out.to_sparse_csr() if was_csr else out
+    if len(x._bcoo.shape) == 2:
+        n_rows = x._bcoo.shape[0]
+        rows = x._bcoo.indices[:, 0]
+        v = x._bcoo.data.astype(jnp.float32)
+        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows,
+                                      indices_are_sorted=False)
+        # rows with no entries give -inf max; harmless (no values there)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        out_vals = (e / denom[rows]).astype(x._bcoo.data.dtype)
+        return SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
+                                            shape=x._bcoo.shape))
+    # N-D COO: dense -inf mask fallback
+    dense = as_array(x.to_dense())
+    idx = x._bcoo.indices
+    occ = jnp.zeros(dense.shape, bool).at[
+        tuple(idx[:, i] for i in range(idx.shape[1]))].set(True)
+    sm = jax.nn.softmax(jnp.where(occ, dense, -jnp.inf), axis=-1)
+    vals = sm[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
 
 
 from . import nn  # noqa: E402,F401 — paddle.sparse.nn (conv/attention/norm)

@@ -153,32 +153,11 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 
 
 def softmax(x, axis=-1, name=None):
-    """Softmax over the sparse pattern of the last dim (CSR rows): only
-    stored entries participate (paddle.sparse.nn.functional.softmax)."""
-    if isinstance(x, SparseCsrTensor):
-        crows = np.asarray(x._crows)
-        vals = np.asarray(x._values, np.float64)
-        out = np.zeros_like(vals)
-        nrows_total = len(crows) - 1
-        for r in range(nrows_total):
-            lo, hi = crows[r], crows[r + 1]
-            if hi > lo:
-                seg = vals[lo:hi]
-                e = np.exp(seg - seg.max())
-                out[lo:hi] = e / e.sum()
-        return SparseCsrTensor(x._crows, x._cols,
-                               jnp.asarray(out, as_array(x._values).dtype),
-                               x.shape)
-    x = _coo(x)
-    dense = as_array(x.to_dense())
-    occ = jnp.zeros(dense.shape, bool).at[
-        tuple(x._bcoo.indices[:, i] for i in range(x._bcoo.indices.shape[1]))
-    ].set(True)
-    masked = jnp.where(occ, dense, -jnp.inf)
-    sm = jax.nn.softmax(masked, axis=axis)
-    idx = x._bcoo.indices
-    vals = sm[tuple(idx[:, i] for i in range(idx.shape[1]))]
-    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
+    """Softmax over the sparse pattern of the last dim: same op as
+    paddle.sparse.softmax — one implementation lives there."""
+    from . import softmax as _sparse_softmax
+
+    return _sparse_softmax(x, axis=axis, name=name)
 
 
 def attention(query, key, value, sparse_mask, key_padding_mask=None,

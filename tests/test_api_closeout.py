@@ -348,8 +348,6 @@ class TestRound4TailB:
         rng = np.random.RandomState(1)
         d = rng.randn(4, 6).astype("float32")
         d[d < 0.3] = 0.0
-        sp = paddle.to_tensor(d).to_sparse_coo(2) if hasattr(
-            paddle.to_tensor(d), "to_sparse_coo") else None
         import paddle_tpu.sparse as S
         coo = S.SparseCooTensor.__new__(S.SparseCooTensor)
         from jax.experimental import sparse as jsp

@@ -160,3 +160,20 @@ class TestAsyncGreedyParity:
             prompts, max_news)
         for a, b in zip(out_sync, out_async):
             np.testing.assert_array_equal(a, b)
+
+    def test_warmup_on_async_engine(self):
+        # the on-chip bench path: warmup() then traffic, async enabled
+        m, cfg = _tiny_model()
+        rng = np.random.RandomState(19)
+        eng = ServingEngine(m, max_batch=2, max_seq_len=48, page_size=8,
+                            decode_burst=4, async_depth=2,
+                            decode_strategy="greedy_search")
+        assert eng.warmup() > 0
+        prompts = [rng.randint(0, cfg.vocab_size, (6,)) for _ in range(2)]
+        out = _run(eng, prompts, [8, 8])
+        ref = _run(ServingEngine(m, max_batch=2, max_seq_len=48,
+                                 page_size=8, decode_burst=4,
+                                 decode_strategy="greedy_search"),
+                   prompts, [8, 8])
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, b)

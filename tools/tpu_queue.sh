@@ -50,7 +50,7 @@ run_task() {
 all_done() {
   for t in kernel_bench serving_int8 serving_int4 serving_full_int8 \
            serving_burst64 serving_burst127 serving_async serving_async64 \
-           bisect_1b mfu_1b mfu_base_fused mfu_long; do
+           serving_3b_int8 bisect_1b mfu_1b mfu_base_fused mfu_long; do
     [ -f "$STATE/$t" ] || return 1
   done
   return 0
@@ -119,6 +119,12 @@ while :; do
       BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
       python bench.py > SERVING_QUANT_FULL_INT8.json \
       && grep -q "\"backend\": \"tpu\"" SERVING_QUANT_FULL_INT8.json'
+    run_task serving_3b_int8 900 bash -c 'BENCH_CONFIG=serving \
+      BENCH_SERVING_MODEL=3b BENCH_SERVING_QUANT=weight_only_int8 \
+      BENCH_SERVING_BURST=64 BENCH_KERNELS=0 BENCH_EXTRA=0 \
+      BENCH_PROBE_RETRIES=1 BENCH_PROBE_TIMEOUT=120 \
+      python bench.py > SERVING_3B_INT8.json \
+      && grep -q "\"backend\": \"tpu\"" SERVING_3B_INT8.json'
     run_task kernel_bench 2400 bash -c 'python tools/tpu_kernel_bench.py \
       --json KERNEL_BENCH.json \
       && grep -q "\"backend\": \"tpu\"" KERNEL_BENCH.json \
