@@ -76,8 +76,9 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         mask = jnp.arange(sq)[:, None] + (sk - sq) >= jnp.arange(sk)[None]
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, a.dtype)
         sm = jax.nn.softmax(jnp.where(mask, a, neg), axis=-1)
-        # rows with every position masked (sq > sk tail rows) would
-        # otherwise softmax the uniform fill to plausible-looking weights
+        # rows with every position masked (the LEADING i < sq-sk rows
+        # under bottom-right alignment when sq > sk) would otherwise
+        # softmax the uniform fill to plausible-looking weights
         return jnp.where(mask.any(-1)[:, None], sm, 0.0)
 
     return _apply_op(f, x, _name="softmax_mask_fuse_upper_triangle")

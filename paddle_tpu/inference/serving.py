@@ -66,6 +66,15 @@ class ServingEngine:
                  decode_burst=1, kv_cache_quant=None, async_depth=0):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
+        max_pos = getattr(model.config, "max_position_embeddings", None)
+        if max_pos is not None and max_seq_len > max_pos:
+            # learned-position models would silently clamp the gather at
+            # max_pos and decode garbage; rope models shouldn't serve
+            # past their trained window either — fail at construction,
+            # where the mismatch is statically knowable
+            raise ValueError(
+                f"max_seq_len={max_seq_len} exceeds the model's "
+                f"max_position_embeddings={max_pos}")
         self.model = model
         # TP-sharded serving (reference: fused_multi_transformer_op with
         # mp_degree>1, SURVEY.md §2.1): params lay out per their GSPMD
@@ -89,7 +98,9 @@ class ServingEngine:
         n_pages = max_batch * self.pages_per_seq
         self._free_pages = list(range(n_pages))
         L = self.cfg.num_hidden_layers
-        kvh = self.cfg.num_key_value_heads
+        # GPT-family configs have no GQA field: kv heads == heads
+        kvh = getattr(self.cfg, "num_key_value_heads",
+                      self.cfg.num_attention_heads)
         hd = self.cfg.hidden_size // self.cfg.num_attention_heads
         # KV pages in the MODEL's dtype (round-2 verdict weak #5: hard-coded
         # f32 pages made a bf16 model pay 2x KV memory + bandwidth); the

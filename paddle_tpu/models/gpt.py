@@ -145,6 +145,22 @@ class GPTAttention(nn.Layer):
         out = reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.out_proj(out), (nk, nv)
 
+    def forward_paged(self, hidden_states, paged_cache, block_tables,
+                      context_lens, active=None, mesh=None):
+        """Single-token decode over a paged KV cache: the GPT serving
+        path (reference: fused_multi_transformer GPT configs). Positions
+        are learned embeddings applied at the model level, so unlike
+        LLaMA there is no per-step rotation — the shared
+        `paged_attention_step` runs with rotate=None."""
+        from .paged_step import paged_attention_step
+
+        b = hidden_states.shape[0]
+        q, k, v = self._split_qkv(self.qkv_proj(hidden_states), b, 1)
+        out, new_cache = paged_attention_step(
+            q, k, v, paged_cache, block_tables, context_lens,
+            active=active, mesh=mesh, kv_heads=self.num_heads)
+        return self.out_proj(out), new_cache
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -185,6 +201,14 @@ class GPTDecoderLayer(nn.Layer):
     def forward_cached(self, hidden_states, kv_cache, cur_len):
         a, new_cache = self.attn.forward_cached(
             self.ln_1(hidden_states), kv_cache, cur_len)
+        h = hidden_states + a
+        return h + self.mlp(self.ln_2(h)), new_cache
+
+    def forward_paged(self, hidden_states, paged_cache, block_tables,
+                      context_lens, active=None, mesh=None):
+        a, new_cache = self.attn.forward_paged(
+            self.ln_1(hidden_states), paged_cache, block_tables,
+            context_lens, active=active, mesh=mesh)
         h = hidden_states + a
         return h + self.mlp(self.ln_2(h)), new_cache
 
@@ -246,6 +270,20 @@ class GPTModel(nn.Layer):
             new_caches.append(nc)
         return self.ln_f(h), new_caches
 
+    def forward_paged(self, input_ids, paged_caches, block_tables,
+                      context_lens, active=None, mesh=None):
+        # per-ROW learned positions: slot b's new token sits at
+        # context_lens[b] (unlike forward_cached's shared scalar offset)
+        pos = Tensor(as_array(context_lens).astype(jnp.int64)[:, None])
+        h = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        new_caches = []
+        for layer, cache in zip(self.layers, paged_caches):
+            h, nc = layer.forward_paged(h, cache, block_tables,
+                                        context_lens, active=active,
+                                        mesh=mesh)
+            new_caches.append(nc)
+        return self.ln_f(h), new_caches
+
 
 class GPTForCausalLM(CausalLMBase):
     """GPT causal LM with the same trainer/serving contracts as the LLaMA
@@ -268,6 +306,13 @@ class GPTForCausalLM(CausalLMBase):
 
     def forward_cached(self, input_ids, caches, cur_len):
         h, new_caches = self.gpt.forward_cached(input_ids, caches, cur_len)
+        return self._head(h), new_caches
+
+    def forward_paged(self, input_ids, paged_caches, block_tables,
+                      context_lens, active=None, mesh=None):
+        h, new_caches = self.gpt.forward_paged(
+            input_ids, paged_caches, block_tables, context_lens,
+            active=active, mesh=mesh)
         return self._head(h), new_caches
 
     def _backbone_embed_weight(self):

@@ -248,8 +248,8 @@ class LlamaAttention(nn.Layer):
         token lands there); active[b]=False rows skip the cache write
         (retired serving slots with stale block tables). Returns
         (out [b, 1, hidden], new_cache)."""
-        from ..kernels import paged_attention as _pa
         from ..ops.manipulation import reshape
+        from .paged_step import paged_attention_step
 
         b = hidden_states.shape[0]
         q = reshape(self.q_proj(hidden_states),
@@ -258,85 +258,19 @@ class LlamaAttention(nn.Layer):
                     [b, 1, self.num_kv_heads, self.head_dim])
         v = reshape(self.v_proj(hidden_states),
                     [b, 1, self.num_kv_heads, self.head_dim])
-        # 2-tuple: float pages; 4-tuple: int8 pages + per-slot scale pools
-        # (engine kv_cache_quant="int8" — reference: fused_multi_transformer
-        # int8 cachekv)
-        kv_quant = len(paged_cache) == 4
-        if kv_quant:
-            k_pages, v_pages, k_scales, v_scales = paged_cache
-        else:
-            k_pages, v_pages = paged_cache
         theta = self.rope_theta
         head_dim = self.head_dim
-        act = active if active is not None else True
 
-        def step(qq, kk, vv, kp, vp, tables, lens, act_mask, *scales):
+        def rotate(qq, kk, lens):
             # per-slot rope at position lens[b] (shared tables, rope.py)
             cos, sin = rope_tables(1, head_dim, base=theta, dtype=qq.dtype,
                                    position_offset=lens)
-            qq = apply_rope(qq, cos, sin)
-            kk = apply_rope(kk, cos, sin)
-            attn = _pa.paged_attention_dispatch
-            if kv_quant:
-                ksc, vsc = scales
-                kp2, ksc2, vp2, vsc2 = _pa.update_paged_kv_cache_q8(
-                    kp, ksc, vp, vsc, kk[:, 0], vv[:, 0],
-                    tables, lens, active=act_mask)
-                out = attn(qq[:, 0], kp2, vp2, tables, lens + 1,
-                           k_scales=ksc2, v_scales=vsc2)
-                return out[:, None], kp2, vp2, ksc2, vsc2
-            kp2, vp2 = _pa.update_paged_kv_cache(
-                kp, vp, kk[:, 0].astype(kp.dtype), vv[:, 0].astype(vp.dtype),
-                tables, lens, active=act_mask)
-            out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
-            return out[:, None], kp2, vp2
+            return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
 
-        import jax as _jax
-        import jax.numpy as _jnp
-        from jax.sharding import PartitionSpec as _P
-
-        from ..distributed import mesh as _mesh
-        from ..distributed.sharding_utils import in_manual_region
-
-        # TP-sharded decode (reference: fused_multi_transformer_op's
-        # mp_degree serving config — SURVEY.md §2.1): attention is
-        # embarrassingly parallel over heads, so the step runs inside a
-        # shard_map manual over tp — q/k/v shard on the head dim, the KV
-        # page pools on their kv-head dim, ZERO collectives inside. This
-        # is also what lets the Pallas decode kernel run multi-chip: each
-        # tp rank launches it on its local heads.
-        run = step
-        if mesh is None:  # engine-provided mesh wins over the global one
-            mesh = _mesh.get_mesh(optional=True)
-        tp = int(mesh.shape["tp"]) if mesh is not None \
-            and "tp" in mesh.axis_names else 1
-        if tp > 1 and not in_manual_region() \
-                and self.num_kv_heads % tp == 0:
-            hs = _P(None, None, "tp")      # [b, 1, heads, hd]
-            ps = _P("tp")                  # [kvh, n_pages, page, hd]
-            rs = _P()
-            # scale pools shard with their kv heads too: [kvh, n_pages, 128]
-            in_specs = (hs, hs, hs, ps, ps, rs, rs, rs) + \
-                ((ps, ps) if kv_quant else ())
-            out_specs = (hs, ps, ps) + ((ps, ps) if kv_quant else ())
-            run = _jax.shard_map(
-                step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                axis_names=frozenset({"tp"}))
-
-        args = [q, k, v, Tensor(as_array(k_pages)),
-                Tensor(as_array(v_pages)), Tensor(as_array(block_tables)),
-                Tensor(as_array(context_lens)),
-                Tensor(_jnp.broadcast_to(_jnp.asarray(act, bool), (b,)))]
-        if kv_quant:
-            args += [Tensor(as_array(k_scales)), Tensor(as_array(v_scales))]
-        res = _apply_op(run, *args, _name="paged_attention")
-        if kv_quant:
-            out, new_k, new_v, new_ks, new_vs = res
-            new_cache = (new_k, new_v, new_ks, new_vs)
-        else:
-            out, new_k, new_v = res
-            new_cache = (new_k, new_v)
-        out = reshape(out, [b, 1, self.num_heads * self.head_dim])
+        out, new_cache = paged_attention_step(
+            q, k, v, paged_cache, block_tables, context_lens,
+            active=active, mesh=mesh, kv_heads=self.num_kv_heads,
+            rotate=rotate)
         return self.o_proj(out), new_cache
 
     def _cached_attention(self, q, k, v, kv_cache, cur_len, b, s):

@@ -1,0 +1,97 @@
+"""Shared single-token paged-attention step for serving decode.
+
+The serving path (reference: fused_multi_transformer_op, SURVEY.md §2.1)
+is model-agnostic once q/k/v for the new token exist: write the token's
+K/V into the paged pools (float or int8+scales), run decode attention
+over the pages (measured XLA-gather/Pallas dispatch), all inside an
+optional shard_map manual over tp — heads are embarrassingly parallel,
+so q/k/v shard on the head dim, pools on their kv-head dim, ZERO
+collectives inside. Model-specific position encoding (LLaMA rope) plugs
+in via `rotate(q, k, lens)` applied INSIDE the mapped step, where the
+per-slot positions are available.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, _apply_op, as_array
+
+
+def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
+                         active=None, mesh=None, kv_heads=None,
+                         rotate=None):
+    """q: [b, 1, heads, d]; k/v: [b, 1, kv_heads, d] (Tensors).
+    paged_cache: (k_pages, v_pages) or (k_pages, v_pages, k_scales,
+    v_scales) for int8 pages. Returns (out [b, 1, heads*d] Tensor,
+    new_cache tuple)."""
+    from ..distributed import mesh as _mesh
+    from ..distributed.sharding_utils import in_manual_region
+    from ..kernels import paged_attention as _pa
+
+    b = q.shape[0]
+    n_heads = q.shape[2]
+    head_dim = q.shape[3]
+    if kv_heads is None:
+        kv_heads = k.shape[2]
+    kv_quant = len(paged_cache) == 4
+    if kv_quant:
+        k_pages, v_pages, k_scales, v_scales = paged_cache
+    else:
+        k_pages, v_pages = paged_cache
+    act = active if active is not None else True
+
+    def step(qq, kk, vv, kp, vp, tables, lens, act_mask, *scales):
+        if rotate is not None:
+            qq, kk = rotate(qq, kk, lens)
+        attn = _pa.paged_attention_dispatch
+        if kv_quant:
+            ksc, vsc = scales
+            kp2, ksc2, vp2, vsc2 = _pa.update_paged_kv_cache_q8(
+                kp, ksc, vp, vsc, kk[:, 0], vv[:, 0],
+                tables, lens, active=act_mask)
+            out = attn(qq[:, 0], kp2, vp2, tables, lens + 1,
+                       k_scales=ksc2, v_scales=vsc2)
+            return out[:, None], kp2, vp2, ksc2, vsc2
+        kp2, vp2 = _pa.update_paged_kv_cache(
+            kp, vp, kk[:, 0].astype(kp.dtype), vv[:, 0].astype(vp.dtype),
+            tables, lens, active=act_mask)
+        out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
+        return out[:, None], kp2, vp2
+
+    from jax.sharding import PartitionSpec as _P
+
+    run = step
+    if mesh is None:  # engine-provided mesh wins over the global one
+        mesh = _mesh.get_mesh(optional=True)
+    tp = int(mesh.shape["tp"]) if mesh is not None \
+        and "tp" in mesh.axis_names else 1
+    if tp > 1 and not in_manual_region() and kv_heads % tp == 0:
+        hs = _P(None, None, "tp")      # [b, 1, heads, hd]
+        ps = _P("tp")                  # [kvh, n_pages, page, hd]
+        rs = _P()
+        # scale pools shard with their kv heads too: [kvh, n_pages, 128]
+        in_specs = (hs, hs, hs, ps, ps, rs, rs, rs) + \
+            ((ps, ps) if kv_quant else ())
+        out_specs = (hs, ps, ps) + ((ps, ps) if kv_quant else ())
+        run = jax.shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=frozenset({"tp"}))
+
+    args = [q, k, v, Tensor(as_array(k_pages)),
+            Tensor(as_array(v_pages)), Tensor(as_array(block_tables)),
+            Tensor(as_array(context_lens)),
+            Tensor(jnp.broadcast_to(jnp.asarray(act, bool), (b,)))]
+    if kv_quant:
+        args += [Tensor(as_array(k_scales)), Tensor(as_array(v_scales))]
+    res = _apply_op(run, *args, _name="paged_attention")
+    if kv_quant:
+        out, new_k, new_v, new_ks, new_vs = res
+        new_cache = (new_k, new_v, new_ks, new_vs)
+    else:
+        out, new_k, new_v = res
+        new_cache = (new_k, new_v)
+    from ..ops.manipulation import reshape
+
+    out = reshape(out, [b, 1, n_heads * head_dim])
+    return out, new_cache

@@ -340,19 +340,10 @@ def softmax(x, axis=-1, name=None):
     N-D COO falls back to a dense -inf mask."""
     if axis != -1 and axis != len(getattr(x, "shape", [0, 0])) - 1:
         raise ValueError("sparse softmax supports only the last axis")
-    if isinstance(x, SparseCsrTensor):
-        crows = np.asarray(x._crows)
-        vals = np.asarray(x._values, np.float64)
-        out = np.zeros_like(vals)
-        for r in range(len(crows) - 1):
-            lo, hi = crows[r], crows[r + 1]
-            if hi > lo:
-                seg = vals[lo:hi]
-                e = np.exp(seg - seg.max())
-                out[lo:hi] = e / e.sum()
-        return SparseCsrTensor(x._crows, x._cols,
-                               jnp.asarray(out, as_array(x._values).dtype),
-                               x.shape)
+    # CSR rides the COO segment path (jit-native, no host row loop) and
+    # converts back: every stored entry softmaxes to a nonzero value, so
+    # the round trip preserves the sparsity pattern
+    was_csr = isinstance(x, SparseCsrTensor)
     x = _coo(x)
     if len(x._bcoo.shape) == 2:
         n_rows = x._bcoo.shape[0]
@@ -364,8 +355,9 @@ def softmax(x, axis=-1, name=None):
         e = jnp.exp(v - row_max[rows])
         denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
         out_vals = (e / denom[rows]).astype(x._bcoo.data.dtype)
-        return SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
-                                            shape=x._bcoo.shape))
+        out = SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
+                                           shape=x._bcoo.shape))
+        return out.to_sparse_csr() if was_csr else out
     # N-D COO: dense -inf mask fallback
     dense = as_array(x.to_dense())
     idx = x._bcoo.indices

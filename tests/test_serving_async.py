@@ -177,3 +177,29 @@ class TestAsyncGreedyParity:
                    prompts, [8, 8])
         for a, b in zip(ref, out):
             np.testing.assert_array_equal(a, b)
+
+
+class TestGPTServing:
+    """The serving engine is model-agnostic (reference:
+    fused_multi_transformer serves GPT-family too): GPT decodes over the
+    shared paged_attention_step with learned per-row positions instead
+    of rope."""
+
+    def test_gpt_engine_matches_generate(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=97, hidden_size=128,
+                        num_hidden_layers=2, num_attention_heads=1,
+                        max_position_embeddings=64)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(0, 97, (5,)) for _ in range(2)]
+        eng = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                            decode_burst=4, async_depth=1,
+                            decode_strategy="greedy_search")
+        outs = _run(eng, prompts, [8, 8])
+        for p, o in zip(prompts, outs):
+            ref = m.generate(paddle.to_tensor(p[None]),
+                             max_new_tokens=8)[0]
+            np.testing.assert_array_equal(o, np.asarray(ref.numpy())[0])
