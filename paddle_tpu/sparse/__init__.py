@@ -340,24 +340,32 @@ def softmax(x, axis=-1, name=None):
     N-D COO falls back to a dense -inf mask."""
     if axis != -1 and axis != len(getattr(x, "shape", [0, 0])) - 1:
         raise ValueError("sparse softmax supports only the last axis")
-    # CSR rides the COO segment path (jit-native, no host row loop) and
-    # converts back: every stored entry softmaxes to a nonzero value, so
-    # the round trip preserves the sparsity pattern
-    was_csr = isinstance(x, SparseCsrTensor)
-    x = _coo(x)
-    if len(x._bcoo.shape) == 2:
-        n_rows = x._bcoo.shape[0]
-        rows = x._bcoo.indices[:, 0]
-        v = x._bcoo.data.astype(jnp.float32)
+
+    def _segment_softmax(vals, rows, n_rows):
+        v = vals.astype(jnp.float32)
         row_max = jax.ops.segment_max(v, rows, num_segments=n_rows,
                                       indices_are_sorted=False)
         # rows with no entries give -inf max; harmless (no values there)
         e = jnp.exp(v - row_max[rows])
         denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-        out_vals = (e / denom[rows]).astype(x._bcoo.data.dtype)
-        out = SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
-                                           shape=x._bcoo.shape))
-        return out.to_sparse_csr() if was_csr else out
+        return (e / denom[rows]).astype(vals.dtype)
+
+    if isinstance(x, SparseCsrTensor):
+        # O(nnz), structure-preserving: softmax the stored values in CSR
+        # order and rebuild with the INPUT's crows/cols (no densify — an
+        # underflowed weight stays as an explicit stored zero, matching
+        # the reference's pattern-preserving sparse softmax)
+        n_rows = x._shape[0]
+        counts = x._crows[1:] - x._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=x.nnz())
+        vals = _segment_softmax(as_array(x._values), rows, n_rows)
+        return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+    if len(x._bcoo.shape) == 2:
+        out_vals = _segment_softmax(x._bcoo.data, x._bcoo.indices[:, 0],
+                                    x._bcoo.shape[0])
+        return SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
+                                            shape=x._bcoo.shape))
     # N-D COO: dense -inf mask fallback
     dense = as_array(x.to_dense())
     idx = x._bcoo.indices
