@@ -1,0 +1,32 @@
+"""Shared pieces of the compile-scale rehearsal tools
+(scale_rehearsal.py: training; serving_rehearsal.py: serving decode).
+One copy of the zero-init patch and the XLA memory-analysis extraction so
+the two rehearsals cannot silently diverge."""
+
+
+def patch_zero_init():
+    """Make every random initializer a Constant(0): values never run in a
+    rehearsal (lowering only needs shapes), and np.zeros is lazy calloc —
+    a 13B-param model materializes for free on the host."""
+    import paddle_tpu.nn.initializer as I
+    from paddle_tpu.nn.initializer import Constant
+
+    zero = Constant(0.0)
+    for name in ("XavierNormal", "XavierUniform", "Normal",
+                 "KaimingNormal", "KaimingUniform", "Uniform",
+                 "TruncatedNormal"):
+        if hasattr(I, name):
+            setattr(I, name, lambda *a, **k: zero)
+
+
+def memory_fields(compiled):
+    """XLA per-device memory analysis as a plain dict (0 when a field is
+    missing on this backend)."""
+    mem = compiled.memory_analysis()
+    return {
+        "arguments": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "outputs": int(getattr(mem, "output_size_in_bytes", 0)),
+        "temps": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "generated_code": int(getattr(
+            mem, "generated_code_size_in_bytes", 0)),
+    }

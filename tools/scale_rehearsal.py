@@ -62,7 +62,6 @@ def main():
     from paddle_tpu.distributed.sharding_utils import clean_spec
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, \
         build_train_step
-    from paddle_tpu.nn.initializer import Constant
 
     if geometry == "13b":
         cfg = LlamaConfig.llama2_13b()
@@ -81,13 +80,9 @@ def main():
     cfg.max_position_embeddings = max(cfg.max_position_embeddings, seq)
 
     # values never run: zero-init params (np.zeros = lazy calloc pages)
-    import paddle_tpu.nn.initializer as I
+    from _rehearsal_common import patch_zero_init
 
-    zero = Constant(0.0)
-    for name in ("XavierNormal", "XavierUniform", "Normal", "KaimingNormal",
-                 "KaimingUniform", "Uniform", "TruncatedNormal"):
-        if hasattr(I, name):
-            setattr(I, name, lambda *a, **k: zero)
+    patch_zero_init()
 
     t_build0 = time.perf_counter()
     paddle.seed(0)
@@ -145,7 +140,7 @@ def main():
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t0
 
-    mem = compiled.memory_analysis()
+    from _rehearsal_common import memory_fields
     n_params = sum(int(np.prod(a.shape)) for a in holder["params"].values())
     result = {
         "geometry": geometry,
@@ -160,13 +155,7 @@ def main():
         "build_s": round(t_build, 1),
         "lower_s": round(t_lower, 1),
         "compile_s": round(t_compile, 1),
-        "per_device_bytes": {
-            "arguments": int(getattr(mem, "argument_size_in_bytes", 0)),
-            "outputs": int(getattr(mem, "output_size_in_bytes", 0)),
-            "temps": int(getattr(mem, "temp_size_in_bytes", 0)),
-            "generated_code": int(getattr(
-                mem, "generated_code_size_in_bytes", 0)),
-        },
+        "per_device_bytes": memory_fields(compiled),
     }
     args_gb = result["per_device_bytes"]["arguments"] / 2**30
     temps_gb = result["per_device_bytes"]["temps"] / 2**30

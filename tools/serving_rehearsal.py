@@ -36,16 +36,24 @@ jax.config.update("jax_platforms", "cpu")
 def main():
     geometry = "7b"
     if "--geometry" in sys.argv:
-        geometry = sys.argv[sys.argv.index("--geometry") + 1]
+        try:
+            geometry = sys.argv[sys.argv.index("--geometry") + 1]
+        except IndexError:
+            raise SystemExit("--geometry takes a value: 7b, 13b or smoke")
+    if geometry not in ("7b", "13b", "smoke"):
+        raise SystemExit(f"unknown --geometry {geometry!r}: 7b, 13b or "
+                         "smoke (a typo here would bank a smoke-sized "
+                         "run under a real-looking key)")
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed.mesh as mesh_mod
     from paddle_tpu.inference import ServingEngine
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.nn.initializer import Constant
 
     if geometry == "7b":
         cfg = LlamaConfig.llama2_7b()
+    elif geometry == "13b":
+        cfg = LlamaConfig.llama2_13b()
     else:  # smoke geometry for CI-speed runs
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=8,
@@ -53,15 +61,9 @@ def main():
     cfg.dtype = "bfloat16"
     cfg.max_position_embeddings = 2048
 
-    # values never run: zero-init (lazy calloc) keeps the 13.5 GB of 7B
-    # bf16 weights cheap to materialize on the host
-    import paddle_tpu.nn.initializer as I
+    from _rehearsal_common import patch_zero_init
 
-    zero = Constant(0.0)
-    for name in ("XavierNormal", "XavierUniform", "Normal", "KaimingNormal",
-                 "KaimingUniform", "Uniform", "TruncatedNormal"):
-        if hasattr(I, name):
-            setattr(I, name, lambda *a, **k: zero)
+    patch_zero_init()
 
     t0 = time.perf_counter()
     paddle.seed(0)
@@ -100,7 +102,7 @@ def main():
     t0 = time.perf_counter()
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t0
-    mem = compiled.memory_analysis()
+    from _rehearsal_common import memory_fields
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     kv_bytes = sum(int(np.prod(p.shape)) * p.dtype.itemsize
@@ -117,13 +119,7 @@ def main():
         "build_s": round(t_build, 1),
         "lower_s": round(t_lower, 1),
         "compile_s": round(t_compile, 1),
-        "per_device_bytes": {
-            "arguments": int(getattr(mem, "argument_size_in_bytes", 0)),
-            "outputs": int(getattr(mem, "output_size_in_bytes", 0)),
-            "temps": int(getattr(mem, "temp_size_in_bytes", 0)),
-            "generated_code": int(getattr(
-                mem, "generated_code_size_in_bytes", 0)),
-        },
+        "per_device_bytes": memory_fields(compiled),
     }
     pd = result["per_device_bytes"]
     result["per_device_gb"] = round(
