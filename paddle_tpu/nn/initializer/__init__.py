@@ -195,3 +195,40 @@ def calculate_gain(nonlinearity, param=None):
         "selu": 3.0 / 4.0,
     }
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation weights for transposed-conv upsampling
+    (reference: paddle.nn.initializer.Bilinear): each [kh, kw] kernel
+    gets the separable triangle filter; channels are diagonal."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Bilinear expects a conv weight of rank >= 3")
+        spatial = shape[2:]
+        grids = []
+        for s in spatial:
+            f = (s + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            grids.append(1 - np.abs(np.arange(s) / f - c))
+        filt = grids[0]
+        for g in grids[1:]:
+            filt = np.multiply.outer(filt, g)
+        # the reference fills EVERY [out, in] kernel slot with the filter
+        # (not just diagonal channels): each output channel sums the
+        # upsampled contribution of every input channel
+        arr = np.broadcast_to(filt.astype(np.float32), tuple(shape))
+        return jnp.asarray(arr, dtype=dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer parity: default
+    initializers for subsequently-created parameters (create_parameter
+    consults these when no explicit initializer is given)."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

@@ -125,6 +125,11 @@ class Layer:
             elif isinstance(attr, str):
                 name = attr
         if init is None:
+            # user-set global defaults (set_global_initializer) override
+            # the layers' built-in defaults but not an explicit ParamAttr
+            # initializer (reference semantics)
+            init = I._global_bias_init if is_bias else I._global_weight_init
+        if init is None:
             init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()

@@ -145,6 +145,14 @@ class Tensor:
     def element_size(self):
         return np.dtype(self._data.dtype).itemsize
 
+    @property
+    def itemsize(self):
+        return self.element_size()
+
+    @property
+    def nbytes(self):
+        return self.size * self.element_size()
+
     def is_dense(self):
         return True
 

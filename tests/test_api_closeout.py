@@ -403,3 +403,33 @@ class TestRound4TailB:
         hp = paddle.linalg.householder_product(
             paddle.to_tensor(np.stack(xs)), paddle.to_tensor(np.stack(taus)))
         assert np.allclose(hp.numpy(), np.stack(qs), atol=1e-6)
+
+
+class TestRound4TailC:
+    def test_itemsize_nbytes(self):
+        t = paddle.to_tensor(np.ones((2, 3), "float32"))
+        assert t.itemsize == 4 and t.nbytes == 24
+
+    def test_bilinear_initializer(self):
+        from paddle_tpu.nn.initializer import Bilinear
+        w = np.asarray(Bilinear()((2, 3, 4, 4), np.float32))
+        # reference semantics: EVERY [out, in] kernel slot carries the
+        # separable triangle filter (paddle fills the flat array with
+        # the spatial formula, so channels are indistinguishable)
+        f = np.array([0.25, 0.75, 0.75, 0.25])
+        for o in range(2):
+            for i in range(3):
+                np.testing.assert_allclose(w[o, i], np.outer(f, f),
+                                           atol=1e-6)
+
+    def test_set_global_initializer(self):
+        import paddle_tpu.nn.initializer as I
+        I.set_global_initializer(I.Constant(0.5), I.Constant(-1.0))
+        try:
+            lin = paddle.nn.Linear(3, 4)
+            assert np.allclose(lin.weight.numpy(), 0.5)
+            assert np.allclose(lin.bias.numpy(), -1.0)
+        finally:
+            I.set_global_initializer(None, None)
+        lin2 = paddle.nn.Linear(3, 4)
+        assert not np.allclose(lin2.weight.numpy(), 0.5)
