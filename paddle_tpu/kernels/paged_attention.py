@@ -56,6 +56,16 @@ def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
         return paged_attention_xla(q, k_pages, v_pages, block_tables,
                                    context_lens, scale=scale,
                                    k_scales=k_scales, v_scales=v_scales)
+    if (k_scales is None and v_scales is None
+            and k_pages.shape[2] == 16
+            and block_tables.shape[1] % _GROUP_PAGES == 0):
+        # float 16-token pages above the crossover: the grouped-fetch
+        # kernel feeds the MXU full K-tiles (G pages per step). Gated to
+        # the benchmarked page size — 128-token pages already fill a
+        # K-tile per page, and this session's int8 lesson says never
+        # route an un-Mosaic-validated shape into the serving hot path.
+        return paged_attention_grouped(q, k_pages, v_pages, block_tables,
+                                       context_lens, scale=scale)
     return paged_attention(q, k_pages, v_pages, block_tables,
                            context_lens, scale=scale, k_scales=k_scales,
                            v_scales=v_scales)
@@ -203,6 +213,47 @@ def prefill_paged_kv_cache_q8(k_pages, k_scales, v_pages, v_scales,
 # ---------------------------------------------------------------------------
 
 
+def _decode_accumulate(q, k, v, base_pos, ctx, scale, m_scr, l_scr, acc,
+                       k_col_scale=None, v_col_scale=None):
+    """One online-softmax block update shared by the per-page and
+    grouped decode kernels: scores for a K/V block starting at absolute
+    position `base_pos`, masked at `ctx`, folded into the running
+    (m, l, acc) state. Optional per-COLUMN scales implement exact int8
+    dequantization (K scales after q·k, V scales on the weights; the l
+    normalizer uses unscaled pexp)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * np.float32(scale)
+    if k_col_scale is not None:
+        s = s * k_col_scale[None, :]
+    kpos = base_pos + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kpos < ctx, s, NEG_INF)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new)
+    l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
+                                                  keepdims=True)
+    pw = pexp if v_col_scale is None else pexp * v_col_scale[None, :]
+    pv = jax.lax.dot_general(
+        pw, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc[:] = acc[:] * alpha + pv
+    m_scr[:, :1] = m_new
+
+
+def _decode_init(m_scr, l_scr, acc):
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc[:] = jnp.zeros_like(acc)
+
+
+def _decode_epilogue(o_ref, m_scr, l_scr, acc):
+    l = l_scr[:, :1]
+    o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+        o_ref.dtype)
+
+
 def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
                    page_size, scale, n_pages, quant=False):
     """Online-softmax decode over the page grid dimension.
@@ -222,43 +273,160 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest,
 
     @pl.when(p == 0)
     def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc[:] = jnp.zeros_like(acc)
+        _decode_init(m_scr, l_scr, acc)
 
     ctx = lens_ref[b]
 
     @pl.when(p * page_size < ctx)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)  # [group, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * np.float32(scale)
-        if quant:
-            s = s * ks_ref[0, 0, 0][:page_size][None, :]
-        kpos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < ctx, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        pexp = jnp.exp(s - m_new)
-        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(pexp, axis=-1,
-                                                      keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)
-        pw = pexp * vs_ref[0, 0, 0][:page_size][None, :] if quant else pexp
-        pv = jax.lax.dot_general(
-            pw, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc[:] = acc[:] * alpha + pv
-        m_scr[:, :1] = m_new
+        _decode_accumulate(
+            q_ref[0, 0].astype(jnp.float32),
+            k_ref[0, 0].astype(jnp.float32),
+            v_ref[0, 0].astype(jnp.float32),
+            p * page_size, ctx, scale, m_scr, l_scr, acc,
+            k_col_scale=ks_ref[0, 0, 0][:page_size] if quant else None,
+            v_col_scale=vs_ref[0, 0, 0][:page_size] if quant else None)
 
     @pl.when(p == n_pages - 1)
     def _():
-        l = l_scr[:, :1]
-        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
-            o_ref.dtype)
+        _decode_epilogue(o_ref, m_scr, l_scr, acc)
+
+
+def _decode_grouped_kernel(lens_ref, tables_ref, q_ref, k_hbm, v_hbm,
+                           o_ref, k_vmem, v_vmem, ksem, vsem, m_scr,
+                           l_scr, acc, *, page_size, G, scale, n_groups):
+    """Grouped-fetch decode: G pages (G*page_size tokens) per grid step.
+
+    The page pools stay in HBM (memory_space=ANY); each step's pages are
+    gathered by per-page async copies into a double-buffered VMEM block,
+    so the score matmul runs on a [G*page_size, d] K-tile (full MXU
+    lanes) instead of one page — the per-page kernel's 16-token blocks
+    starve the systolic array 8-fold. Group g+2's fetch is issued after
+    group g's compute (classic two-slot pipeline: its slot was last read
+    at step g, and step g+1 computes from the other slot while the copy
+    flies)."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    g = pl.program_id(2)
+    gp = G * page_size
+
+    def start_group(gi, slot):
+        for p in range(G):  # static unroll: G tiny parallel DMAs
+            pid = tables_ref[b, gi * G + p]
+            pltpu.make_async_copy(
+                k_hbm.at[h, pid],
+                k_vmem.at[slot, pl.ds(p * page_size, page_size), :],
+                ksem.at[slot, p]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[h, pid],
+                v_vmem.at[slot, pl.ds(p * page_size, page_size), :],
+                vsem.at[slot, p]).start()
+
+    def wait_group(slot):
+        # wait descriptors only need a shape/sem match with the started
+        # copy; page id 0 stands in for the (traced) real id
+        for p in range(G):
+            pltpu.make_async_copy(
+                k_hbm.at[h, 0],
+                k_vmem.at[slot, pl.ds(p * page_size, page_size), :],
+                ksem.at[slot, p]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[h, 0],
+                v_vmem.at[slot, pl.ds(p * page_size, page_size), :],
+                vsem.at[slot, p]).wait()
+
+    @pl.when(g == 0)
+    def _():
+        _decode_init(m_scr, l_scr, acc)
+        start_group(0, 0)
+        if n_groups > 1:
+            start_group(1, 1)
+
+    slot = jax.lax.rem(g, 2)
+    wait_group(slot)
+
+    ctx = lens_ref[b]
+
+    @pl.when(g * gp < ctx)
+    def _():
+        _decode_accumulate(
+            q_ref[0, 0].astype(jnp.float32),
+            k_vmem[slot].astype(jnp.float32),
+            v_vmem[slot].astype(jnp.float32),
+            g * gp, ctx, scale, m_scr, l_scr, acc)
+
+    # issue group g+2 into this slot AFTER the compute read it
+    @pl.when(g + 2 < n_groups)
+    def _():
+        start_group(g + 2, slot)
+
+    @pl.when(g == n_groups - 1)
+    def _():
+        _decode_epilogue(o_ref, m_scr, l_scr, acc)
+
+
+_GROUP_PAGES = 8  # pages per grouped-fetch step (8 x 16 = one 128 K-tile)
+
+
+def paged_attention_grouped(q, k_pages, v_pages, block_tables,
+                            context_lens, scale=None):
+    """Grouped-fetch variant of `paged_attention` (float pages only):
+    same contract, G pages per grid step via double-buffered HBM->VMEM
+    DMAs. Requires pages_per_seq % G == 0 (the engine's max_seq_len is a
+    page multiple; callers fall back to the per-page kernel otherwise)."""
+    b, n_q_heads, head_dim = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    G = _GROUP_PAGES
+    if pages_per_seq % G:
+        raise ValueError(f"pages_per_seq {pages_per_seq} % {G} != 0")
+    n_groups = pages_per_seq // G
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(head_dim))
+
+    qg = q.reshape(b, n_kv_heads, group, head_dim)
+    gpad = max(8, ((group + 7) // 8) * 8)
+    if gpad != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gpad - group), (0, 0)))
+
+    kernel = functools.partial(
+        _decode_grouped_kernel, page_size=page_size, G=G, scale=scale,
+        n_groups=n_groups)
+    hbm = pl.BlockSpec(memory_space=pl.ANY)
+    with jax.enable_x64(False):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_kv_heads, n_groups),
+            in_specs=[
+                pl.BlockSpec((1, 1, gpad, head_dim),
+                             lambda b, h, g, lens, tables: (b, h, 0, 0)),
+                hbm,
+                hbm,
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gpad, head_dim),
+                lambda b, h, g, lens, tables: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, G * page_size, head_dim), k_pages.dtype),
+                pltpu.VMEM((2, G * page_size, head_dim), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, G)),
+                pltpu.SemaphoreType.DMA((2, G)),
+                pltpu.VMEM((gpad, 128), jnp.float32),
+                pltpu.VMEM((gpad, 128), jnp.float32),
+                pltpu.VMEM((gpad, head_dim), jnp.float32),
+            ],
+        )
+        out = _pc(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, n_kv_heads, gpad, head_dim),
+                                           q.dtype),
+            interpret=_interpret(),
+        )(context_lens.astype(jnp.int32),
+          block_tables.astype(jnp.int32),
+          qg, k_pages, v_pages)
+    return out[:, :, :group, :].reshape(b, n_q_heads, head_dim)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,

@@ -245,14 +245,25 @@ def main():
             paged_err = float(np.max(np.abs(o_p - o_x)))
             t_p = timeit(f_pal, qd, kp, vp, tables, lens)
             t_x = timeit(f_xla, qd, kp, vp, tables, lens)
-            rows_dec.append(dict(
+            row = dict(
                 err_vs_xla=paged_err, t_pallas_ms=t_p * 1e3,
                 t_xla_ms=t_x * 1e3, ctx=page * ppseq, page_size=page,
-                batch=b_dec))
+                batch=b_dec)
+            if page == 16 and ppseq % pa._GROUP_PAGES == 0:
+                # grouped-fetch kernel: G pages per step via HBM DMA
+                f_grp = jax.jit(pa.paged_attention_grouped)
+                o_g = np.asarray(f_grp(qd, kp, vp, tables, lens),
+                                 np.float32)
+                row["grouped_err"] = float(np.max(np.abs(o_g - o_x)))
+                row["t_grouped_ms"] = timeit(
+                    f_grp, qd, kp, vp, tables, lens) * 1e3
+            rows_dec.append(row)
+            extra_g = (f" grouped {row['t_grouped_ms']:.3f}ms"
+                       if "t_grouped_ms" in row else "")
             print(f"paged decode ctx={page*ppseq:5d} page={page:3d}: "
                   f"err={paged_err:.4f}"
                   f" pallas {t_p*1e3:.3f}ms xla {t_x*1e3:.3f}ms "
-                  f"({t_x/t_p:.2f}x)")
+                  f"({t_x/t_p:.2f}x){extra_g}")
             # bank into `extra` itself so a later failure (next ctx, q8
             # variant) can't drop already-measured rows at the final dump
             extra["paged_decode"] = rows_dec
