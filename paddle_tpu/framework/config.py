@@ -74,6 +74,10 @@ define_flag("FLAGS_static_strict_placeholders", False,
 define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
 define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")
+define_flag("FLAGS_paged_grouped_kernel", True,
+            "Route long-context float paged decode to the grouped-fetch "
+            "kernel (8 pages per grid step via HBM DMA); disable to fall "
+            "back to the per-page kernel.")
 define_flag("FLAGS_flash_fwd_min_seq", 0,
             "Min seq for the Pallas flash forward in no-grad attention; "
             "0 defers to the built-in measured default (4096 — the v5e "

@@ -56,9 +56,12 @@ def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
         return paged_attention_xla(q, k_pages, v_pages, block_tables,
                                    context_lens, scale=scale,
                                    k_scales=k_scales, v_scales=v_scales)
+    from ..framework import config as _config
+
     if (k_scales is None and v_scales is None
             and k_pages.shape[2] == 16
-            and block_tables.shape[1] % _GROUP_PAGES == 0):
+            and block_tables.shape[1] % _GROUP_PAGES == 0
+            and _config.get_flag("FLAGS_paged_grouped_kernel", True)):
         # float 16-token pages above the crossover: the grouped-fetch
         # kernel feeds the MXU full K-tiles (G pages per step). Gated to
         # the benchmarked page size — 128-token pages already fill a
