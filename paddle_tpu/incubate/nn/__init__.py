@@ -15,3 +15,48 @@ from .fused_transformer import (  # noqa: F401
 )
 from . import functional  # noqa: F401
 from .fused_linear import FusedLinear, fused_linear, fused_matmul_bias  # noqa: F401
+from ...nn.layer_base import Layer as _Layer
+
+
+class FusedDropoutAdd(_Layer):
+    """Layer form of functional.fused_dropout_add (reference:
+    paddle.incubate.nn.FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return functional.fused_dropout_add(x, y, p=self.p,
+                                            training=self.training,
+                                            mode=self.mode)
+
+
+class FusedEcMoe(_Layer):
+    """Layer form of functional.fused_ec_moe (reference:
+    paddle.incubate.nn.FusedEcMoe): dense soft-mixture expert FFN."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, gate):
+        # reference signature: the caller computes the [b, s, e] gate
+        # logits (typically x @ gate_weight)
+        return functional.fused_ec_moe(
+            x, gate, self.bmm0_weight, self.bmm0_bias,
+            self.bmm1_weight, self.bmm1_bias, act_type=self.act_type)

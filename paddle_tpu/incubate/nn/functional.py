@@ -22,8 +22,6 @@ def fused_bias_dropout_residual_layer_norm(
         name=None):
     """out = LayerNorm(residual + dropout(x + bias)) — one fused
     expression (reference: fused_bias_dropout_residual_layer_norm)."""
-    from ...framework import random as _random
-
     def f(x_, res, *rest):
         i = 0
         b = None
@@ -34,15 +32,7 @@ def fused_bias_dropout_residual_layer_norm(
         i += 1 if ln_scale is not None else 0
         lb = rest[i] if ln_bias is not None else None
         y = x_ if b is None else x_ + b
-        if training and dropout_rate > 0:
-            k = _random.next_key()
-            keep = jax.random.bernoulli(k, 1.0 - dropout_rate, y.shape)
-            if mode == "upscale_in_train":
-                y = jnp.where(keep, y / (1.0 - dropout_rate), 0.0)
-            else:
-                y = jnp.where(keep, y, 0.0)
-        elif not training and mode == "downscale_in_infer":
-            y = y * (1.0 - dropout_rate)
+        y = _dropout_expr(y, dropout_rate, training, mode)
         h = res + y
         mean = h.mean(-1, keepdims=True)
         var = h.var(-1, keepdims=True)
@@ -82,3 +72,121 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         return jnp.where(mask.any(-1)[:, None], sm, 0.0)
 
     return _apply_op(f, x, _name="softmax_mask_fuse_upper_triangle")
+
+
+def _dropout_expr(z, p, training, mode):
+    """ONE traced dropout expression for the incubate fused ops (keep
+    mask + upscale_in_train/downscale_in_infer semantics); draws its key
+    eagerly from the framework stream like nn.functional.dropout."""
+    from ...framework import random as _random
+
+    if training and p > 0:
+        k = _random.next_key()
+        keep = jax.random.bernoulli(k, 1.0 - p, z.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, z / (1.0 - p), 0.0)
+        return jnp.where(keep, z, 0.0)
+    if not training and mode == "downscale_in_infer":
+        return z * (1.0 - p)
+    return z
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """out = dropout(x) + y in one traced expression (reference:
+    paddle.incubate.nn.functional.fused_dropout_add)."""
+    def f(x_, y_):
+        return _dropout_expr(x_, p, training, mode) + y_
+
+    return _apply_op(f, x, y, _name="fused_dropout_add")
+
+
+def _fused_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                residual, bias, kind):
+    """Shared body for fused_rms_norm / fused_layer_norm: fold bias +
+    residual into the pre-norm activation, normalize every axis from
+    `begin_norm_axis` on (reference semantics), and return BOTH
+    (out, residual_out) — the contract that lets the next layer consume
+    the pre-norm sum without re-adding."""
+    def f(x_, *rest):
+        i = 0
+        b = res = w = nb = None
+        if bias is not None:
+            b = rest[i]; i += 1
+        if residual is not None:
+            res = rest[i]; i += 1
+        if norm_weight is not None:
+            w = rest[i]; i += 1
+        if norm_bias is not None:
+            nb = rest[i]
+        h = x_ if b is None else x_ + b
+        if res is not None:
+            h = h + res
+        ax = begin_norm_axis % h.ndim
+        axes = tuple(range(ax, h.ndim))
+        hf = h.astype(jnp.float32)
+        if kind == "rms":
+            r = jax.lax.rsqrt(jnp.mean(jnp.square(hf), axes,
+                                       keepdims=True) + epsilon)
+            out = hf * r
+        else:
+            mean = hf.mean(axes, keepdims=True)
+            var = hf.var(axes, keepdims=True)
+            out = (hf - mean) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            # weight/bias cover the normalized trailing axes
+            out = out * w.astype(jnp.float32).reshape(h.shape[ax:])
+        if nb is not None:
+            out = out + nb.astype(jnp.float32).reshape(h.shape[ax:])
+        return out.astype(x_.dtype), h
+
+    args = [x] + [a for a in (bias, residual, norm_weight, norm_bias)
+                  if a is not None]
+    return _apply_op(f, *args, _name=f"fused_{kind}_norm")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   name=None):
+    """(out, residual_out) = RMSNorm(x + bias + residual) (reference:
+    paddle.incubate.nn.functional.fused_rms_norm; the residual_out is
+    the pre-norm sum). Normalizes axes from `begin_norm_axis` on
+    (-1 = last axis, the transformer-block configuration)."""
+    return _fused_norm(x, norm_weight, norm_bias, epsilon,
+                       begin_norm_axis, residual, bias, "rms")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     name=None):
+    """(out, residual_out) = LayerNorm(x + bias + residual) (reference:
+    paddle.incubate.nn.functional.fused_layer_norm). Normalizes axes
+    from `begin_norm_axis` on."""
+    return _fused_norm(x, norm_weight, norm_bias, epsilon,
+                       begin_norm_axis, residual, bias, "layer")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Dense soft-mixture MoE (reference: fused_ec_moe): every token
+    runs every expert's FFN as batched GEMMs and the outputs mix by the
+    softmax of EXTERNALLY computed gate logits — the jit/MXU-friendly
+    dense formulation the fused GPU op implements (no routing scatter).
+
+    x: [b, s, d]; gate: [b, s, e] logits (reference signature — the
+    caller computes them, typically x @ gate_weight); bmm0_weight:
+    [e, d, d_ff]; bmm0_bias: [e, 1, d_ff]; bmm1_weight: [e, d_ff, d];
+    bmm1_bias: [e, 1, d]."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("fused_ec_moe: act_type must be gelu or relu")
+
+    def f(x_, g_, w0, b0, w1, b1):
+        probs = jax.nn.softmax(g_.astype(jnp.float32), axis=-1)
+        h = jnp.einsum("bsd,edf->ebsf", x_, w0) + b0[:, None]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("ebsf,efd->ebsd", h, w1) + b1[:, None]
+        return jnp.einsum("ebsd,bse->bsd",
+                          o.astype(jnp.float32), probs).astype(x_.dtype)
+
+    return _apply_op(f, x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                     bmm1_bias, _name="fused_ec_moe")

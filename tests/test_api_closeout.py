@@ -433,3 +433,79 @@ class TestRound4TailC:
             I.set_global_initializer(None, None)
         lin2 = paddle.nn.Linear(3, 4)
         assert not np.allclose(lin2.weight.numpy(), 0.5)
+
+
+class TestIncubateFusedTail:
+    def test_fused_dropout_add(self):
+        import paddle_tpu.incubate.nn.functional as innf
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        # eval mode: exact x + y
+        out = innf.fused_dropout_add(x, y, p=0.3, training=False)
+        np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy(),
+                                   rtol=1e-6)
+        # train mode: kept entries are x/(1-p) + y, dropped are y
+        out_t = innf.fused_dropout_add(x, y, p=0.3, training=True).numpy()
+        diff = out_t - y.numpy()
+        kept = ~np.isclose(diff, 0.0)
+        np.testing.assert_allclose(diff[kept],
+                                   (x.numpy() / 0.7)[kept], rtol=1e-5)
+
+    def test_fused_rms_and_layer_norm(self):
+        import paddle_tpu.incubate.nn.functional as innf
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8).astype("float32")
+        res = rng.randn(2, 3, 8).astype("float32")
+        b = rng.randn(8).astype("float32")
+        w = rng.rand(8).astype("float32") + 0.5
+        out, res_out = innf.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w), bias=paddle.to_tensor(b),
+            residual=paddle.to_tensor(res))
+        h = x + b + res
+        np.testing.assert_allclose(res_out.numpy(), h, rtol=1e-5)
+        ref = h / np.sqrt((h ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+        out2, res2 = innf.fused_layer_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            residual=paddle.to_tensor(res))
+        h2 = x + res
+        ref2 = (h2 - h2.mean(-1, keepdims=True)) / np.sqrt(
+            h2.var(-1, keepdims=True) + 1e-5) * w
+        np.testing.assert_allclose(out2.numpy(), ref2, rtol=1e-4)
+        np.testing.assert_allclose(res2.numpy(), h2, rtol=1e-5)
+
+    def test_fused_ec_moe(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+        paddle.seed(0)
+        layer = FusedEcMoe(hidden_size=8, inter_size=16, num_experts=3,
+                           act_type="relu")
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 4, 8).astype("float32")
+        logits = rng.randn(2, 4, 3).astype("float32")
+        # reference signature: gate LOGITS come from the caller
+        out = layer(paddle.to_tensor(x), paddle.to_tensor(logits)).numpy()
+        w0 = layer.bmm0_weight.numpy()
+        b0 = layer.bmm0_bias.numpy(); w1 = layer.bmm1_weight.numpy()
+        b1 = layer.bmm1_bias.numpy()
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = np.zeros_like(x)
+        for e in range(3):
+            h = np.maximum(x @ w0[e] + b0[e], 0.0)
+            ref += probs[..., e:e + 1] * (h @ w1[e] + b1[e])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_fused_layer_norm_begin_axis(self):
+        import paddle_tpu.incubate.nn.functional as innf
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 4).astype("float32")
+        w = rng.rand(3, 4).astype("float32") + 0.5
+        out, _ = innf.fused_layer_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w.reshape(-1)),
+            begin_norm_axis=1)
+        flat = x.reshape(2, -1)
+        ref = ((flat - flat.mean(-1, keepdims=True))
+               / np.sqrt(flat.var(-1, keepdims=True) + 1e-5)
+               ).reshape(2, 3, 4) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
