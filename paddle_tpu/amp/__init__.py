@@ -13,6 +13,7 @@ from ..framework import dtype as _dtype
 from ..tensor import Tensor, as_array
 from ..framework import amp_state as _state
 from .grad_scaler import GradScaler  # noqa: F401
+from . import debugging  # noqa: F401
 
 
 @contextlib.contextmanager

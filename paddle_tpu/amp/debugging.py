@@ -1,0 +1,58 @@
+"""paddle.amp.debugging parity (reference: python/paddle/amp/debugging.py):
+numeric-stability tooling. On TPU the per-op nan/inf guard lives in the
+dispatch layer (`_apply_op` + FLAGS_check_nan_inf with per-op
+attribution), so these are thin controls over that machinery plus an
+eager check_numerics."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import config as _config
+from ..tensor import Tensor, as_array
+
+
+def enable_tensor_checker(checker_config=None):
+    """Turn on the per-op NaN/Inf guard (every op output checked, failure
+    names the op — the reference's check_numerics debug mode). Accepts a
+    TensorCheckerConfig like the reference; its `enable` field gates the
+    flag."""
+    if checker_config is not None and not getattr(
+            checker_config, "enable", True):
+        return
+    _config.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _config.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Eager NaN/Inf check on one tensor; raises with attribution
+    (reference: paddle.amp.debugging.check_numerics)."""
+    a = np.asarray(as_array(tensor))
+    n_nan = int(np.isnan(a).sum())
+    n_inf = int(np.isinf(a).sum())
+    if n_nan or n_inf:
+        raise FloatingPointError(
+            f"check_numerics: {n_nan} NaN / {n_inf} Inf in "
+            f"{op_type or 'tensor'} {var_name} (shape {list(a.shape)})")
+    return tensor
+
+
+class TensorCheckerConfig:
+    """Accepted for API parity; enable_* flags map onto the dispatch
+    guard (per-op attribution is always on when the guard is)."""
+
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=None):
+        self.enable = enable
+
+
+def enable_operator_stats_collection():
+    """Per-op timing/count dumps (maps onto FLAGS_benchmark)."""
+    _config.set_flags({"FLAGS_benchmark": True})
+
+
+def disable_operator_stats_collection():
+    _config.set_flags({"FLAGS_benchmark": False})

@@ -10,6 +10,8 @@ from .collective import (  # noqa: F401
     all_gather,
     all_reduce,
     alltoall,
+    alltoall_single,
+    gather,
     barrier,
     broadcast,
     get_group,
@@ -46,6 +48,7 @@ from .auto_parallel import (  # noqa: F401
     Shard,
     dtensor_from_fn,
     reshard,
+    shard_op,
     shard_layer,
 )
 from .pipeline import spmd_pipeline  # noqa: F401

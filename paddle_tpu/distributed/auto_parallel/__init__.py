@@ -22,5 +22,6 @@ from .api import (  # noqa: F401
     reshard,
     set_mesh,
     shard_layer,
+    shard_op,
     shard_tensor,
 )

@@ -221,3 +221,43 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements: List[Placement],
                     *args, **kwargs):
     """Build a tensor via fn then distribute it (reference dtensor_from_fn)."""
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_op(op_fn, mesh: ProcessMesh, in_placements=None,
+             out_placements=None):
+    """paddle.distributed.shard_op parity: wrap a callable so its inputs
+    (and optionally outputs) carry the given mesh/placements. Under
+    GSPMD the annotation IS the implementation — with_sharding_constraint
+    on the tensors is exactly what the reference's op-level DistAttr
+    lowers to."""
+    def wrapped(*args, **kwargs):
+        if in_placements is not None:
+            if len(in_placements) != len(args):
+                raise ValueError(
+                    f"shard_op: {len(in_placements)} in_placements for "
+                    f"{len(args)} positional args")
+            args = tuple(
+                shard_tensor(a, mesh, p) if p is not None and isinstance(
+                    a, Tensor) else a
+                for a, p in zip(args, in_placements))
+        out = op_fn(*args, **kwargs)
+        if out_placements is not None:
+            seq = isinstance(out, (list, tuple))
+            outs = list(out) if seq else [out]
+            if len(out_placements) != len(outs):
+                raise ValueError(
+                    f"shard_op: {len(out_placements)} out_placements for "
+                    f"{len(outs)} outputs")
+            outs = [shard_tensor(o, mesh, p)
+                    if p is not None and isinstance(o, Tensor) else o
+                    for o, p in zip(outs, out_placements)]
+            if not seq:
+                return outs[0]
+            # namedtuples construct positionally, plain tuples/lists from
+            # one iterable
+            if hasattr(out, "_fields"):
+                return type(out)(*outs)
+            return type(out)(outs)
+        return out
+
+    return wrapped

@@ -111,6 +111,31 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     raise NotImplementedError("eager multi-rank reduce_scatter: jit path only")
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """paddle.distributed.gather parity (single-process eager: only the
+    dst rank's list receives tensors; multi-rank gathers live on the jit
+    path via all_gather)."""
+    from .env import get_rank
+
+    if gather_list is not None and get_rank() == dst:
+        gather_list.append(Tensor(as_array(tensor)))
+    return tensor
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """paddle.distributed.alltoall_single parity (single-process eager:
+    identity copy; multi-rank all_to_all lives on the jit path)."""
+    src = as_array(in_tensor)
+    dst_shape = tuple(as_array(out_tensor).shape)
+    if tuple(src.shape) != dst_shape:
+        raise ValueError(
+            f"alltoall_single: out shape {list(dst_shape)} != in shape "
+            f"{list(src.shape)}")
+    out_tensor._rebind(src)
+    return out_tensor
+
+
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     if out_tensor_list is None:
         out_tensor_list = []

@@ -649,3 +649,24 @@ def load(program, model_path, executor=None, var_list=None):
         key = f"var_{vid}"
         if key in state:
             t._rebind(jnp.asarray(state[key]))
+
+
+@contextlib.contextmanager
+def name_scope(prefix="my_scope"):
+    """paddle.static.name_scope parity: names are cosmetic here — ops
+    capture under their own names and XLA ignores name hierarchies — so
+    the scope is a no-op context kept for source compatibility."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """paddle.static.device_guard parity: single-logical-device XLA
+    programs have no per-op device pinning (the compiler owns placement;
+    host offload would be jax.device_put/host_callback territory), so
+    the guard is accepted and ignored — "cpu" / "gpu" / "gpu:all" are
+    all valid inputs for source compatibility."""
+    if device is not None and not str(device).startswith(
+            ("cpu", "gpu", "xpu", "npu", "tpu")):
+        raise ValueError(f"device_guard: unknown device {device!r}")
+    yield

@@ -509,3 +509,47 @@ class TestIncubateFusedTail:
                / np.sqrt(flat.var(-1, keepdims=True) + 1e-5)
                ).reshape(2, 3, 4) * w
         np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+
+class TestDistAmpStaticTail:
+    def test_gather_and_alltoall_single(self):
+        import paddle_tpu.distributed as dist
+        t = paddle.to_tensor([1.0, 2.0])
+        gl = []
+        dist.gather(t, gl, dst=0)
+        assert len(gl) == 1
+        np.testing.assert_allclose(gl[0].numpy(), [1.0, 2.0])
+        out = paddle.to_tensor([0.0, 0.0])
+        dist.alltoall_single(out, t)
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+    def test_amp_debugging(self):
+        import paddle_tpu.amp.debugging as dbg
+        dbg.check_numerics(paddle.to_tensor([1.0]))
+        with pytest.raises(FloatingPointError):
+            dbg.check_numerics(paddle.to_tensor([float("inf")]),
+                               op_type="matmul", var_name="x")
+        from paddle_tpu.framework import config as cfg
+        dbg.enable_tensor_checker()
+        assert cfg.get_flag("FLAGS_check_nan_inf", False)
+        dbg.disable_tensor_checker()
+        assert not cfg.get_flag("FLAGS_check_nan_inf", True)
+
+    def test_static_scopes(self):
+        import paddle_tpu.static as st
+        with st.name_scope("block"), st.device_guard("gpu:0"):
+            out = paddle.to_tensor([1.0]) + 1.0
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        with pytest.raises(ValueError):
+            with st.device_guard("quantum"):
+                pass
+
+    def test_shard_op_annotates(self):
+        import paddle_tpu.distributed as dist
+        mesh = dist.ProcessMesh([0], dim_names=["x"])
+        f = dist.shard_op(lambda a: a * 2, mesh,
+                          in_placements=[[dist.Replicate()]],
+                          out_placements=[[dist.Replicate()]])
+        out = f(paddle.to_tensor([3.0]))
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        assert out.process_mesh is mesh
