@@ -35,6 +35,10 @@ from .framework.device import (
     TPUPlace,
     get_device,
     is_compiled_with_cuda,
+    is_compiled_with_xpu,
+    is_compiled_with_rocm,
+    is_compiled_with_custom_device,
+    get_cudnn_version,
     is_compiled_with_distribute,
     is_compiled_with_tpu,
     set_device,

@@ -50,9 +50,24 @@ class TensorCheckerConfig:
 
 
 def enable_operator_stats_collection():
-    """Per-op timing/count dumps (maps onto FLAGS_benchmark)."""
+    """Start counting eager op dispatches (reference: the operator-stats
+    summary). Counts accumulate in framework.op_stats until disabled."""
+    from ..framework import op_stats
+
+    op_stats.reset()
     _config.set_flags({"FLAGS_benchmark": True})
 
 
-def disable_operator_stats_collection():
+def disable_operator_stats_collection(print_summary=True):
+    """Stop collection; returns {op_name: count} and prints a summary
+    (reference behavior prints the stats table on disable)."""
+    from ..framework import op_stats
+
     _config.set_flags({"FLAGS_benchmark": False})
+    stats = op_stats.snapshot()
+    if print_summary and stats:
+        width = max(len(k) for k in stats)
+        print("operator stats (eager dispatches):")
+        for name, n in sorted(stats.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<{width}}  {n}")
+    return stats

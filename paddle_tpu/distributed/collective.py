@@ -117,6 +117,10 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     path via all_gather)."""
     from .env import get_rank
 
+    if _jc.tracing():
+        raise RuntimeError(
+            "distributed.gather mutates a host list and cannot run under "
+            "jit tracing; use all_gather inside compiled code")
     if gather_list is not None and get_rank() == dst:
         gather_list.append(Tensor(as_array(tensor)))
     return tensor
@@ -126,13 +130,9 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """paddle.distributed.alltoall_single parity (single-process eager:
     identity copy; multi-rank all_to_all lives on the jit path)."""
-    src = as_array(in_tensor)
-    dst_shape = tuple(as_array(out_tensor).shape)
-    if tuple(src.shape) != dst_shape:
-        raise ValueError(
-            f"alltoall_single: out shape {list(dst_shape)} != in shape "
-            f"{list(src.shape)}")
-    out_tensor._rebind(src)
+    # set_value validates the shape and preserves out_tensor's dtype
+    # (paddle keeps the out tensor's dtype)
+    out_tensor.set_value(as_array(in_tensor))
     return out_tensor
 
 

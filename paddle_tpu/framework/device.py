@@ -148,6 +148,26 @@ def is_compiled_with_cuda() -> bool:
     return False
 
 
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """TPU is the first-class 'custom device' of this build (reference:
+    plugin device registry); everything else is absent."""
+    return str(device_type).lower() in ("tpu", "axon")
+
+
+def get_cudnn_version():
+    """No CUDA backend: the reference returns None when not compiled
+    with cuDNN."""
+    return None
+
+
 def is_compiled_with_tpu() -> bool:
     try:
         return len(jax.devices("tpu")) > 0

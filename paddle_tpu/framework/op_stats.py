@@ -1,0 +1,25 @@
+"""Per-op dispatch counters behind FLAGS_benchmark (consumed by
+paddle.amp.debugging.enable/disable_operator_stats_collection — the
+reference's operator stats summary)."""
+from __future__ import annotations
+
+import collections
+import threading
+
+_lock = threading.Lock()
+_counts: collections.Counter = collections.Counter()
+
+
+def record(name: str):
+    with _lock:
+        _counts[name] += 1
+
+
+def snapshot():
+    with _lock:
+        return dict(_counts)
+
+
+def reset():
+    with _lock:
+        _counts.clear()

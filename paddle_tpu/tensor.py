@@ -475,6 +475,14 @@ def _apply_op(fn, *inputs, _name: str = "", **static_kwargs):
     # (paddle/fluid/framework/details/nan_inf_utils — SURVEY.md §5 "Race
     # detection / sanitizers"): abort with op attribution on NaN/Inf.
     # Eager-only; under jit use jax.config debug_nans.
+    # FLAGS_benchmark: per-op invocation counts for
+    # amp.debugging.enable_operator_stats_collection (eager dispatches
+    # only; jitted programs are one op to the host)
+    if _config.get_flag("FLAGS_benchmark") and not _jc.tracing():
+        from .framework import op_stats as _op_stats
+
+        _op_stats.record(_name or fn.__name__)
+
     if _config.get_flag("FLAGS_check_nan_inf") and not _jc.tracing():
         for i, o in enumerate(outs):
             # jnp.issubdtype, not np: bfloat16 must count as floating

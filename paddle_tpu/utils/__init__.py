@@ -1,5 +1,6 @@
 """paddle.utils (SURVEY.md §2.2): cpp_extension toolchain and helpers."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
 import functools as _functools
 import importlib as _importlib
 import threading as _threading

@@ -1,0 +1,44 @@
+"""paddle.utils.dlpack parity: zero-copy tensor exchange via the DLPack
+protocol (jax arrays implement it natively)."""
+from __future__ import annotations
+
+import jax
+
+from ..tensor import Tensor, as_array
+
+
+def to_dlpack(x):
+    """Export a Tensor as a host DLPack capsule (reference:
+    paddle.utils.dlpack.to_dlpack). TPU buffers have no DLPack view, so
+    the array is brought to host first — matching the kDLCPU contract
+    the import shim assumes."""
+    import numpy as np
+
+    # copy: device_get hands back a read-only view, which numpy's DLPack
+    # export refuses (no read-only signalling in the protocol)
+    host = np.array(jax.device_get(as_array(x)), copy=True)
+    return host.__dlpack__()
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule (or any object with __dlpack__, e.g. a
+    torch/numpy array) as a Tensor (reference:
+    paddle.utils.dlpack.from_dlpack)."""
+    if hasattr(capsule, "__dlpack__"):
+        return Tensor(jax.numpy.from_dlpack(capsule))
+
+    class _Capsule:
+        """Array-API shim: modern jax.from_dlpack wants an object with
+        __dlpack__/__dlpack_device__, while the paddle API hands around
+        raw capsules (which to_dlpack produces on the host: kDLCPU)."""
+
+        def __init__(self, c):
+            self._c = c
+
+        def __dlpack__(self, **kw):
+            return self._c
+
+        def __dlpack_device__(self):
+            return (1, 0)  # kDLCPU, device 0
+
+    return Tensor(jax.numpy.from_dlpack(_Capsule(capsule)))

@@ -553,3 +553,30 @@ class TestDistAmpStaticTail:
         out = f(paddle.to_tensor([3.0]))
         np.testing.assert_allclose(out.numpy(), [6.0])
         assert out.process_mesh is mesh
+
+
+class TestDevicePredicatesAndDlpack:
+    def test_device_predicates(self):
+        assert not paddle.is_compiled_with_xpu()
+        assert not paddle.is_compiled_with_rocm()
+        assert paddle.get_cudnn_version() is None
+        assert paddle.is_compiled_with_custom_device("tpu")
+        assert not paddle.is_compiled_with_custom_device("npu")
+
+    def test_dlpack_roundtrip_and_torch(self):
+        t = paddle.to_tensor(np.arange(4, dtype="float32"))
+        back = paddle.utils.dlpack.from_dlpack(
+            paddle.utils.dlpack.to_dlpack(t))
+        np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+        torch = pytest.importorskip("torch")
+        j = paddle.utils.dlpack.from_dlpack(
+            torch.arange(3, dtype=torch.float32))
+        np.testing.assert_allclose(j.numpy(), [0, 1, 2])
+
+    def test_operator_stats_collection(self):
+        import paddle_tpu.amp.debugging as dbg
+        dbg.enable_operator_stats_collection()
+        t = paddle.to_tensor([1.0]) + 1
+        t = t * 2
+        stats = dbg.disable_operator_stats_collection(print_summary=False)
+        assert stats.get("add", 0) >= 1 and stats.get("multiply", 0) >= 1
