@@ -188,6 +188,7 @@ from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
+from .hapi import callbacks  # noqa: F401 — paddle.callbacks namespace
 from . import incubate  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401

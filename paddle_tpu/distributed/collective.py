@@ -130,6 +130,10 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """paddle.distributed.alltoall_single parity (single-process eager:
     identity copy; multi-rank all_to_all lives on the jit path)."""
+    if _jc.tracing():
+        raise RuntimeError(
+            "distributed.alltoall_single mutates a host tensor and cannot "
+            "run under jit tracing; use all_to_all inside compiled code")
     # set_value validates the shape and preserves out_tensor's dtype
     # (paddle keeps the out tensor's dtype)
     out_tensor.set_value(as_array(in_tensor))

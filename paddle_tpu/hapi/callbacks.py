@@ -249,3 +249,42 @@ class VisualDL(Callback):
                 f.close()
             except Exception:
                 pass
+
+
+class WandbCallback(Callback):
+    """paddle.callbacks.WandbCallback parity: requires the wandb package
+    (not available in this environment — zero egress); constructing
+    without it raises the same guidance the reference gives. When wandb
+    IS importable, scalars log per step/epoch like VisualDL."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package: "
+                "pip install wandb") from e
+        self._run = wandb.init(
+            project=project, entity=entity, name=name, dir=dir,
+            mode=mode, job_type=job_type, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._run.log({f"train/{k}": float(np.mean(v))})
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._run.log({f"eval/{k}": float(np.mean(v))})
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        # finalize so a second fit/init starts a fresh run and offline
+        # buffers flush (reference behavior)
+        self._run.finish()
