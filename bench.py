@@ -35,6 +35,102 @@ def model_flops_per_token(cfg, seq_len, causal=True):
 
 PROBE_DIAG = {"attempts": []}
 
+# ---------------------------------------------------------------------------
+# Last-known-good on-chip capture bank (round-4 verdict item 2): every
+# successful on-TPU bench run banks its result row here, keyed by config;
+# when the live probe fails (the tunnel is down in most driver windows),
+# the CPU-fallback artifact embeds these rows as `tpu_cached` so the
+# driver artifact is never evidence-free. Seeded from the round-4 banked
+# artifacts (MFU_SWEEP.json / BISECT_1B.json / SERVING_QUANT_*.json).
+# ---------------------------------------------------------------------------
+_TPU_CACHE_PATH = None  # resolved lazily next to this file
+
+
+def _tpu_cache_path():
+    import os
+
+    global _TPU_CACHE_PATH
+    if _TPU_CACHE_PATH is None:
+        _TPU_CACHE_PATH = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_TPU_CACHE.json")
+    return _TPU_CACHE_PATH
+
+
+def _load_tpu_cache():
+    """Returns the cache dict, {} when absent, or None when the file
+    exists but cannot be parsed — callers must not overwrite the file in
+    that case (a truncated cache must never cost the banked evidence)."""
+    try:
+        with open(_tpu_cache_path()) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {}
+    except Exception as e:  # noqa: BLE001 — corrupt file: preserve it
+        print(f"tpu-cache unreadable ({e}); banking disabled this run",
+              file=sys.stderr)
+        return None
+
+
+def _bank_tpu_result(key, result):
+    """Record a successful on-chip capture (atomic write; never raises)."""
+    import os
+    import subprocess
+
+    try:
+        try:
+            commit = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(_tpu_cache_path())).stdout.strip()
+        except Exception:  # noqa: BLE001
+            commit = "unknown"
+        cache = _load_tpu_cache()
+        if cache is None:
+            return  # unreadable cache on disk: never clobber it
+        cache[key] = {
+            "metric": result["metric"],
+            "value": result["value"],
+            "unit": result["unit"],
+            "vs_baseline": result.get("vs_baseline", 0.0),
+            "extra": result.get("extra", {}),
+            "commit": commit,
+            "date": time.strftime("%Y-%m-%d", time.gmtime()),
+        }
+        tmp = _tpu_cache_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+        os.replace(tmp, _tpu_cache_path())
+    except Exception as e:  # noqa: BLE001
+        print(f"tpu-cache banking failed: {e}", file=sys.stderr)
+
+
+def _attach_cached_evidence(result):
+    """On a CPU fallback, embed the banked on-chip rows in the artifact."""
+    cache = _load_tpu_cache()
+    if cache:  # None (unreadable) and {} (absent) both skip
+        result["tpu_cached"] = {
+            "note": ("live TPU probe failed this run; these are the "
+                     "last-known-good ON-CHIP captures (backend=tpu at "
+                     "the recorded commit/date), banked by bench.py on "
+                     "every successful TPU run"),
+            "backend": "tpu-cached",
+            "rows": cache,
+        }
+
+
+def _env_override_tag():
+    """Deterministic key suffix from geometry/tuning env overrides so a
+    bisect rung never overwrites the canonical config row."""
+    import os
+
+    keys = ("BENCH_HIDDEN", "BENCH_LAYERS", "BENCH_INTER", "BENCH_VOCAB",
+            "BENCH_BATCH", "BENCH_SEQ", "BENCH_RECOMPUTE",
+            "BENCH_SCAN_LAYERS", "BENCH_FUSED_CE")
+    parts = [f"{k[6:].lower()}={os.environ[k]}" for k in sorted(keys)
+             if k in os.environ]
+    return (":" + ",".join(parts)) if parts else ""
+
 
 def _probe_accelerator(timeout=None, retries=None):
     """Check in a SUBPROCESS whether the default jax backend initializes
@@ -238,8 +334,11 @@ def main():
             "loss_last": round(final, 4),
         },
     }
-    if not on_tpu:
+    if on_tpu:
+        _bank_tpu_result(f"llama:{size}{_env_override_tag()}", result)
+    else:
         result["tpu_probe_error"] = PROBE_DIAG
+        _attach_cached_evidence(result)
     print(json.dumps(result))
 
 
@@ -279,8 +378,11 @@ def bench_resnet(paddle, jax, on_tpu, n_dev):
                   "devices": n_dev, "backend": jax.default_backend(),
                   "loss_first": round(loss0, 4),
                   "loss_last": round(final, 4)}}
-    if not on_tpu:
+    if on_tpu:
+        _bank_tpu_result("resnet", result)
+    else:
         result["tpu_probe_error"] = PROBE_DIAG
+        _attach_cached_evidence(result)
     print(json.dumps(result))
 
 
@@ -335,8 +437,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     # multi-step scheduling: K decode iterations per compiled call (one
     # host sync per burst) — the engine's answer to per-step dispatch
     # latency dominating single-token decode on a tunneled chip
-    burst = int(os.environ.get("BENCH_SERVING_BURST", "16" if on_tpu
-                               else "4"))
+    default_burst = 16 if on_tpu else 4
+    burst = int(os.environ.get("BENCH_SERVING_BURST", str(default_burst)))
     # BENCH_SERVING_ASYNC=N keeps N bursts in flight (device-side decode
     # carry): the host round-trip + token replay overlap device compute
     async_depth = int(os.environ.get("BENCH_SERVING_ASYNC", "0"))
@@ -376,8 +478,18 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers,
                   "params_b": params_b}}
-    if not on_tpu:
+    if on_tpu:
+        tags = [t for t in (f"quant={quant}" if quant else "",
+                            f"kv={kv_quant}" if kv_quant else "",
+                            f"burst={burst}" if burst != default_burst
+                            else "",
+                            f"async={async_depth}" if async_depth else "")
+                if t]
+        key = f"serving:{size}" + ((":" + ",".join(tags)) if tags else "")
+        _bank_tpu_result(key, result)
+    else:
         result["tpu_probe_error"] = PROBE_DIAG
+        _attach_cached_evidence(result)
     print(json.dumps(result))
 
 
@@ -471,12 +583,14 @@ if __name__ == "__main__":
             _piggyback_kernel_bench()
             _piggyback_extra_configs()
     except BaseException as e:  # noqa: BLE001 — always emit a parseable line
-        print(json.dumps({
+        out = {
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
             "tpu_probe_error": PROBE_DIAG,
             "error": f"{type(e).__name__}: {e}"[:500],
-        }))
+        }
+        _attach_cached_evidence(out)
+        print(json.dumps(out))
         sys.exit(0)

@@ -74,10 +74,18 @@ define_flag("FLAGS_static_strict_placeholders", False,
 define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
 define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")
-define_flag("FLAGS_paged_grouped_kernel", True,
+define_flag("FLAGS_paged_grouped_kernel", False,
             "Route long-context float paged decode to the grouped-fetch "
-            "kernel (8 pages per grid step via HBM DMA); disable to fall "
-            "back to the per-page kernel.")
+            "kernel (8 pages per grid step via HBM DMA). Opt-in until the "
+            "kernel is validated under real Mosaic (only interpret-mode "
+            "parity is tested so far); the dispatch policy is to never "
+            "route un-Mosaic-validated shapes into the serving hot path.")
+define_flag("FLAGS_paged_xla_max_ctx", 0,
+            "Mapped-context crossover below which decode attention uses "
+            "the XLA dense-gather path instead of the Pallas page-grid "
+            "kernel; 0 defers to the built-in default (2048, extrapolated "
+            "from the measured 2.2x XLA win at ctx 1024 — re-tune via the "
+            "kernel bench ctx sweep).", type_=int)
 define_flag("FLAGS_flash_fwd_min_seq", 0,
             "Min seq for the Pallas flash forward in no-grad attention; "
             "0 defers to the built-in measured default (4096 — the v5e "

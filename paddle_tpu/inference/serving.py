@@ -885,38 +885,49 @@ class ServingEngine:
                     return False
             return True
 
-        while (dispatched < n_bursts and not stop) or inflight:
-            if dispatched < n_bursts and not stop:
-                if _reserve():
-                    (toks, emits, nk, nv, nks, nvs,
-                     tok_f, ln_f, act_f, rm_f, key_f) = fn(
-                        params, buffers, *pages, carry[0],
-                        jnp.asarray(self.block_tables), carry[1], carry[2],
-                        carry[3], eos_arr, carry[4], greedy, temp, tk,
-                        tp_arr)
-                    pages = (nk, nv, nks, nvs)
-                    carry = (tok_f, ln_f, act_f, rm_f, key_f)
-                    inflight.append((toks, emits))
-                    dispatched += 1
-                else:
-                    # page-pool pressure: drain, then let the classic
-                    # step() run its preemption policy
-                    stop = True
-            if inflight and (stop or len(inflight) > self.async_depth
-                             or dispatched >= n_bursts):
-                toks, emits = inflight.popleft()
-                gen0 = self._release_gen
-                finished.extend(self._replay_burst(
-                    np.asarray(toks), np.asarray(emits), active))
-                if self._release_gen != gen0:
-                    # pages were freed (finish OR a callback abort): the
-                    # remaining in-flight bursts still write to them via
-                    # their stale carry, so drain before any dispatch
-                    # could hand those pages to another request
-                    stop = True
-        self.k_pages, self.v_pages = list(pages[0]), list(pages[1])
-        if self.k_scales is not None:
-            self.k_scales, self.v_scales = list(pages[2]), list(pages[3])
+        # Inside this loop self.k_pages/v_pages still name buffers the
+        # compiled call donated (deleted); the finally re-points them at
+        # the live `pages` tuple so an exception mid-pipeline (or from an
+        # on_token callback) cannot leave the engine holding freed arrays.
+        # Callbacks must NOT re-enter the engine (step()/run()/cache
+        # reads) during async decode — the live cache is in `pages`, not
+        # on the engine, until the drain completes.
+        try:
+            while (dispatched < n_bursts and not stop) or inflight:
+                if dispatched < n_bursts and not stop:
+                    if _reserve():
+                        (toks, emits, nk, nv, nks, nvs,
+                         tok_f, ln_f, act_f, rm_f, key_f) = fn(
+                            params, buffers, *pages, carry[0],
+                            jnp.asarray(self.block_tables), carry[1],
+                            carry[2], carry[3], eos_arr, carry[4], greedy,
+                            temp, tk, tp_arr)
+                        pages = (nk, nv, nks, nvs)
+                        carry = (tok_f, ln_f, act_f, rm_f, key_f)
+                        inflight.append((toks, emits))
+                        dispatched += 1
+                    else:
+                        # page-pool pressure: drain, then let the classic
+                        # step() run its preemption policy
+                        stop = True
+                if inflight and (stop or len(inflight) > self.async_depth
+                                 or dispatched >= n_bursts):
+                    toks, emits = inflight.popleft()
+                    gen0 = self._release_gen
+                    finished.extend(self._replay_burst(
+                        np.asarray(toks), np.asarray(emits), active))
+                    if self._release_gen != gen0:
+                        # pages were freed (finish OR a callback abort):
+                        # the remaining in-flight bursts still write to
+                        # them via their stale carry, so drain before any
+                        # dispatch could hand those pages to another
+                        # request
+                        stop = True
+        finally:
+            self.k_pages, self.v_pages = list(pages[0]), list(pages[1])
+            if self.k_scales is not None:
+                self.k_scales, self.v_scales = (list(pages[2]),
+                                                list(pages[3]))
         if finished:
             self._admit()
         return finished, dispatched

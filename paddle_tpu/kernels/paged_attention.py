@@ -40,9 +40,17 @@ def _interpret():
 # Pallas page-grid kernel (one 16-token page per grid step starves the
 # MXU), while the gather's HBM traffic grows linearly with the MAPPED
 # context (pages_per_seq * page_size), so the paged kernel owns long
-# contexts. The crossover default is overridable for re-tuning via the
-# kernel bench's ctx sweep.
+# contexts. 2048 is the extrapolated crossover (the 2048-ctx row itself
+# is pending a tunnel window); override via FLAGS_paged_xla_max_ctx
+# after re-tuning with the kernel bench's ctx sweep.
 _XLA_DECODE_MAX_CTX = 2048
+
+
+def _xla_decode_max_ctx():
+    from ..framework import config as _config
+
+    v = _config.get_flag("FLAGS_paged_xla_max_ctx", 0)
+    return v if v else _XLA_DECODE_MAX_CTX
 
 
 def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
@@ -52,7 +60,7 @@ def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
     crossover of mapped context, Pallas page-grid kernel above it (and
     always under interpret mode, where the Pallas path is emulation)."""
     mapped_ctx = block_tables.shape[1] * k_pages.shape[2]
-    if _interpret() or mapped_ctx <= _XLA_DECODE_MAX_CTX:
+    if _interpret() or mapped_ctx <= _xla_decode_max_ctx():
         return paged_attention_xla(q, k_pages, v_pages, block_tables,
                                    context_lens, scale=scale,
                                    k_scales=k_scales, v_scales=v_scales)
@@ -61,7 +69,7 @@ def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
     if (k_scales is None and v_scales is None
             and k_pages.shape[2] == 16
             and block_tables.shape[1] % _GROUP_PAGES == 0
-            and _config.get_flag("FLAGS_paged_grouped_kernel", True)):
+            and _config.get_flag("FLAGS_paged_grouped_kernel", False)):
         # float 16-token pages above the crossover: the grouped-fetch
         # kernel feeds the MXU full K-tiles (G pages per step). Gated to
         # the benchmarked page size — 128-token pages already fill a

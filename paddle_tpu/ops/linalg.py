@@ -388,8 +388,9 @@ def _householder_q(a, t):
     for i in range(t.shape[-1]):
         v = jnp.where(idx < i, 0.0, a[..., :, i])  # [..., m]
         v = jnp.where(idx == i, jnp.asarray(1.0, a.dtype), v)
+        # Elementary reflector H = I - tau * v * v^H (v^H = v^T for real).
         h = eye - t[..., i][..., None, None] * (
-            v[..., :, None] * v[..., None, :])
+            v[..., :, None] * jnp.conj(v)[..., None, :])
         q = q @ h
     return q
 
@@ -411,7 +412,8 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
     one MXU matmul — the right trade at these sizes."""
     def f(a, t, b):
         q = _householder_q(a, t)
-        qm = q.swapaxes(-2, -1) if transpose else q
+        # transpose means Q^H (conjugate transpose) for complex inputs.
+        qm = jnp.conj(q).swapaxes(-2, -1) if transpose else q
         return qm @ b if left else b @ qm
 
     return _apply_op(f, x, tau, y, _name="ormqr")
