@@ -124,6 +124,7 @@ from .ops.linalg import (  # noqa: F401
     matmul,
     matrix_transpose,
     mm,
+    multi_dot,
     mv,
     norm,
     pdist,

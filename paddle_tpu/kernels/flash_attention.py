@@ -38,6 +38,58 @@ def _interpret():
 
 
 # ---------------------------------------------------------------------------
+# in-kernel counter-based PRNG for attention dropout
+#
+# The reference's flashattn applies dropout to the softmax weights inside
+# the fused kernel (paddle flash_attn dropout_p — SURVEY.md §2.1 fusion
+# row, §5 long-context). TPU-native version: threefry2x32 evaluated with
+# plain int32 vector ops (adds/xors/logical shifts), so the SAME bits are
+# produced under real Mosaic and interpret mode (pltpu.prng_* has no CPU
+# lowering), and the mask is keyed by (seed, batch-head, GLOBAL q pos,
+# GLOBAL k pos) — the backward kernels regenerate it bit-exactly from the
+# same coordinates regardless of their different grid iteration order.
+# ---------------------------------------------------------------------------
+
+_TF_C240 = np.int32(0x1BD11BDA)  # threefry key-schedule parity constant
+
+
+def _rotl32(x, r):
+    return jax.lax.shift_left(x, np.int32(r)) | \
+        jax.lax.shift_right_logical(x, np.int32(32 - r))
+
+
+def _threefry2x32(k0, k1, c0, c1):
+    """Standard 20-round threefry2x32; int32 lanes (wraparound adds are
+    two's-complement, bit-identical to the uint32 definition)."""
+    ks = (k0, k1, k0 ^ k1 ^ _TF_C240)
+    x0 = c0 + k0
+    x1 = c1 + k1
+    rounds = ((13, 15, 26, 6), (17, 29, 16, 24))
+    for blk in range(5):
+        for r in rounds[blk % 2]:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r)
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(blk + 1) % 3]
+        x1 = x1 + ks[(blk + 2) % 3] + np.int32(blk + 1)
+    return x0
+
+
+def _dropout_keep(seed, bh, i, j, block_q, block_k, rate):
+    """Boolean keep-mask for one (block_q, block_k) attention tile.
+    Counters are the global (q, k) token positions, key is (seed, bh)."""
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    bits = _threefry2x32(seed, bh, rows, cols)
+    # low 23 bits -> uniform [0, 1): non-negative regardless of sign bit
+    u = (bits & np.int32(0x7FFFFF)).astype(jnp.float32) * np.float32(
+        1.0 / (1 << 23))
+    return u >= np.float32(rate)
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
@@ -58,7 +110,8 @@ def _sds(shape, dtype, like):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
                 scale, causal, block_q, block_k, n_kv, offset,
-                seg_q_ref=None, seg_k_ref=None):
+                seg_q_ref=None, seg_k_ref=None, dropout=0.0, seed_ref=None):
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -106,8 +159,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
+        p_v = p
+        if dropout:
+            # dropout hits the (eventually l-normalized) weights feeding
+            # the value matmul; l itself accumulates the UNdropped sum —
+            # exactly softmax followed by inverted dropout
+            keep = _dropout_keep(seed_ref[0], bh, i, j,
+                                 block_q, block_k, dropout)
+            p_v = jnp.where(keep, p, 0.0) * np.float32(1.0 / (1.0 - dropout))
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p_v, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc[:] = acc[:] * alpha + pv
         m_scr[:, :1] = m_new
@@ -129,17 +190,40 @@ def _fwd_kernel_seg(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
                 seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref, **params)
 
 
+def _fwd_kernel_drop(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc,
+                     m_scr, l_scr, **params):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                seed_ref=seed_ref, **params)
+
+
+def _fwd_kernel_seg_drop(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
+                         seed_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                         **params):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref,
+                seed_ref=seed_ref, **params)
+
+
+def _seed_arg(seed):
+    return jnp.asarray(seed, jnp.int32).reshape(1)
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
-               seg_k=None, heads=1):
+               seg_k=None, heads=1, dropout=0.0, seed=None):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     n_q = s_q // block_q
     n_kv = s_kv // block_k
     seg = seg_q is not None
+    drop = dropout > 0.0
     params = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
-    kernel = functools.partial(
-        _fwd_kernel_seg if seg else _fwd_kernel, **params)
+                  block_k=block_k, n_kv=n_kv, offset=s_kv - s_q,
+                  dropout=float(dropout))
+    kern_fn = {(False, False): _fwd_kernel,
+               (True, False): _fwd_kernel_seg,
+               (False, True): _fwd_kernel_drop,
+               (True, True): _fwd_kernel_seg_drop}[(seg, drop)]
+    kernel = functools.partial(kern_fn, **params)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -155,6 +239,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
             pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h_, 0, j)),
         ]
         args += [seg_q, seg_k]
+    if drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(_seed_arg(seed))
     with jax.enable_x64(False):
         out, lse = _pc(
         kernel,
@@ -186,7 +273,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
                     block_q, block_k, n_q, offset,
-                    seg_q_ref=None, seg_k_ref=None):
+                    seg_q_ref=None, seg_k_ref=None, dropout=0.0,
+                    seed_ref=None):
+    bh = pl.program_id(0)
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -225,14 +314,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # mask p (not just s): fully-masked rows have lse == NEG_INF and
             # exp(s - lse) == 1, which would leak garbage into dk/dv
             p = jnp.where(seg_m, p, 0.0)
+        # regenerate the forward's dropout tile: dv sees the DROPPED
+        # normalized weights; the softmax-grad dot product folds into the
+        # SAME delta = rowsum(do*o), so only dp gets masked in ds
+        p_d = p
+        dp_mask = None
+        if dropout:
+            keep = _dropout_keep(seed_ref[0], bh, i, j,
+                                 block_q, block_k, dropout)
+            inv = np.float32(1.0 / (1.0 - dropout))
+            p_d = jnp.where(keep, p, 0.0) * inv
+            dp_mask = (keep, inv)
         # dv += p^T do
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_d, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dp = do v^T ; ds = p * (dp - delta) * scale
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dp_mask is not None:
+            dp = jnp.where(dp_mask[0], dp, 0.0) * dp_mask[1]
         ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -246,7 +348,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale, causal, block_q, block_k, n_kv, offset,
-                   seg_q_ref=None, seg_k_ref=None):
+                   seg_q_ref=None, seg_k_ref=None, dropout=0.0,
+                   seed_ref=None):
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -285,6 +389,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout:
+            keep = _dropout_keep(seed_ref[0], bh, i, j,
+                                 block_q, block_k, dropout)
+            dp = jnp.where(keep, dp, 0.0) * np.float32(1.0 / (1.0 - dropout))
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -310,8 +418,38 @@ def _bwd_dq_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    **params)
 
 
+def _bwd_dkv_kernel_drop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         seed_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                         **params):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, seed_ref=seed_ref,
+                    **params)
+
+
+def _bwd_dq_kernel_drop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        seed_ref, dq_ref, dq_acc, **params):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, seed_ref=seed_ref, **params)
+
+
+def _bwd_dkv_kernel_seg_drop(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             delta_ref, seg_q_ref, seg_k_ref, seed_ref,
+                             dk_ref, dv_ref, dk_acc, dv_acc, **params):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, seg_q_ref=seg_q_ref,
+                    seg_k_ref=seg_k_ref, seed_ref=seed_ref, **params)
+
+
+def _bwd_dq_kernel_seg_drop(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, seg_q_ref, seg_k_ref, seed_ref,
+                            dq_ref, dq_acc, **params):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref,
+                   seed_ref=seed_ref, **params)
+
+
 def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
-               seg_k=None, heads=1, d_lse=None):
+               seg_k=None, heads=1, d_lse=None, dropout=0.0, seed=None):
     q, k, v, out, lse = res
     do = g
     bh, s_q, d = q.shape
@@ -328,10 +466,15 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
 
     seg = seg_q is not None
+    drop = dropout > 0.0
     dkv_params = dict(scale=scale, causal=causal, block_q=block_q,
-                      block_k=block_k, n_q=n_q, offset=s_kv - s_q)
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel_seg if seg else _bwd_dkv_kernel, **dkv_params)
+                      block_k=block_k, n_q=n_q, offset=s_kv - s_q,
+                      dropout=float(dropout))
+    dkv_fn = {(False, False): _bwd_dkv_kernel,
+              (True, False): _bwd_dkv_kernel_seg,
+              (False, True): _bwd_dkv_kernel_drop,
+              (True, True): _bwd_dkv_kernel_seg_drop}[(seg, drop)]
+    dkv_kernel = functools.partial(dkv_fn, **dkv_params)
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -348,6 +491,9 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
             pl.BlockSpec((1, 8, block_k), lambda b, j, i: (b // h_, 0, j)),
         ]
         dkv_args += [seg_q, seg_k]
+    if drop:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_args.append(_seed_arg(seed))
     with jax.enable_x64(False):
         dk, dv = _pc(
         dkv_kernel,
@@ -369,9 +515,13 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
     )(*dkv_args)
 
     dq_params = dict(scale=scale, causal=causal, block_q=block_q,
-                     block_k=block_k, n_kv=n_kv, offset=s_kv - s_q)
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel_seg if seg else _bwd_dq_kernel, **dq_params)
+                     block_k=block_k, n_kv=n_kv, offset=s_kv - s_q,
+                     dropout=float(dropout))
+    dq_fn = {(False, False): _bwd_dq_kernel,
+             (True, False): _bwd_dq_kernel_seg,
+             (False, True): _bwd_dq_kernel_drop,
+             (True, True): _bwd_dq_kernel_seg_drop}[(seg, drop)]
+    dq_kernel = functools.partial(dq_fn, **dq_params)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -387,6 +537,9 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
             pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h_, 0, j)),
         ]
         dq_args += [seg_q, seg_k]
+    if drop:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_args.append(_seed_arg(seed))
     with jax.enable_x64(False):
         dq = _pc(
         dq_kernel,
@@ -523,6 +676,66 @@ def _flash_bhsd_seg_bwd(scale, causal, block_q, block_k, heads, res, g):
 _flash_bhsd_seg.defvjp(_flash_bhsd_seg_fwd, _flash_bhsd_seg_bwd)
 
 
+# dropout variants: the backward ALWAYS runs the Pallas kernels — the
+# in-kernel threefry mask must be regenerated bit-exactly, which the XLA
+# short-seq fallback cannot do.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd_drop(q, k, v, seed, scale, causal, block_q, block_k,
+                     dropout):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        dropout=dropout, seed=seed)
+    return out
+
+
+def _flash_bhsd_drop_fwd(q, k, v, seed, scale, causal, block_q, block_k,
+                         dropout):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          dropout=dropout, seed=seed)
+    return out, (q, k, v, out, lse, seed)
+
+
+def _flash_bhsd_drop_bwd(scale, causal, block_q, block_k, dropout, res, g):
+    q, k, v, out, lse, seed = res
+    dq, dk, dv = _flash_bwd((q, k, v, out, lse), g, scale, causal, block_q,
+                            block_k, dropout=dropout, seed=seed)
+    return dq, dk, dv, None
+
+
+_flash_bhsd_drop.defvjp(_flash_bhsd_drop_fwd, _flash_bhsd_drop_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_bhsd_seg_drop(q, k, v, seg_q8, seg_k8, seed, scale, causal,
+                         block_q, block_k, heads, dropout):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        seg_q=seg_q8, seg_k=seg_k8, heads=heads,
+                        dropout=dropout, seed=seed)
+    return out
+
+
+def _flash_bhsd_seg_drop_fwd(q, k, v, seg_q8, seg_k8, seed, scale, causal,
+                             block_q, block_k, heads, dropout):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          seg_q=seg_q8, seg_k=seg_k8, heads=heads,
+                          dropout=dropout, seed=seed)
+    return out, (q, k, v, out, lse, seg_q8, seg_k8, seed)
+
+
+def _flash_bhsd_seg_drop_bwd(scale, causal, block_q, block_k, heads,
+                             dropout, res, g):
+    q, k, v, out, lse, seg_q8, seg_k8, seed = res
+    dq, dk, dv = _flash_bwd((q, k, v, out, lse), g, scale, causal, block_q,
+                            block_k, seg_q=seg_q8, seg_k=seg_k8,
+                            heads=heads, dropout=dropout, seed=seed)
+    return dq, dk, dv, None, None, None
+
+
+_flash_bhsd_seg_drop.defvjp(_flash_bhsd_seg_drop_fwd,
+                            _flash_bhsd_seg_drop_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_bhsd_lse(q, k, v, scale, causal, block_q, block_k):
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
@@ -587,12 +800,18 @@ def _seg8(seg, b, s):
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         segment_ids_q=None, segment_ids_k=None):
+                         segment_ids_q=None, segment_ids_k=None,
+                         dropout=0.0, dropout_seed=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout) -> same shape.
 
     segment_ids_q/k ([batch, seq] int32) activate varlen masking: tokens
     attend only within equal segment ids (the packed-sequence contract of
     the reference's flash_attn varlen kernels).
+
+    dropout > 0 applies in-kernel inverted dropout to the softmax weights
+    (reference flash_attn dropout_p); `dropout_seed` (int or int32
+    scalar) keys the counter-based threefry mask, so the same seed
+    reproduces the same mask — pass a fresh seed per training step.
 
     Raises ValueError for unsupported shapes — callers (F.sdpa) catch and
     fall back to the fused XLA path.
@@ -604,6 +823,8 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
             f"flash_attention: unsupported shape seq_q={s_q} seq_kv={s_kv} "
             f"d={d} (need multiples of {block_q}/{block_k}/128)"
         )
+    if dropout and dropout_seed is None:
+        raise ValueError("flash_attention: dropout requires dropout_seed")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     # bshd -> (b*h, s, d)
@@ -611,11 +832,20 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
     kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s_kv, d)
     vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s_kv, d)
     if segment_ids_q is not None:
-        out = _flash_bhsd_seg(qt, kt, vt,
-                              _seg8(segment_ids_q, b, s_q),
-                              _seg8(segment_ids_k, b, s_kv),
-                              float(scale), bool(causal), block_q, block_k,
-                              h)
+        sq8 = _seg8(segment_ids_q, b, s_q)
+        sk8 = _seg8(segment_ids_k, b, s_kv)
+        if dropout:
+            out = _flash_bhsd_seg_drop(qt, kt, vt, sq8, sk8,
+                                       _seed_arg(dropout_seed),
+                                       float(scale), bool(causal), block_q,
+                                       block_k, h, float(dropout))
+        else:
+            out = _flash_bhsd_seg(qt, kt, vt, sq8, sk8, float(scale),
+                                  bool(causal), block_q, block_k, h)
+    elif dropout:
+        out = _flash_bhsd_drop(qt, kt, vt, _seed_arg(dropout_seed),
+                               float(scale), bool(causal), block_q,
+                               block_k, float(dropout))
     else:
         out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), block_q,
                           block_k)
@@ -625,7 +855,7 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
 def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                         max_seqlen_k, scale=None, dropout=0.0, causal=False,
                         return_softmax=False, block_q=DEFAULT_BLOCK_Q,
-                        block_k=DEFAULT_BLOCK_K):
+                        block_k=DEFAULT_BLOCK_K, dropout_seed=None):
     """Varlen flash attention over PACKED sequences (reference:
     paddle.nn.functional.flash_attention.flash_attn_unpadded /
     phi flash_attn_varlen kernels — SURVEY.md §2.1 fusion row).
@@ -639,9 +869,9 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
     (self-attention packing — global causal + segment equality is then
     exactly per-sequence causal).
     """
-    if dropout:
-        raise NotImplementedError("flash_attn_unpadded: dropout"
-                                  " unsupported on the fused path")
+    if dropout and dropout_seed is None:
+        raise ValueError("flash_attn_unpadded: dropout requires "
+                         "dropout_seed")
     q = jnp.asarray(q)
     k = jnp.asarray(k)
     v = jnp.asarray(v)
@@ -688,7 +918,8 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
     out = flash_attention_bshd(
         qp[None], kp[None], vp[None], causal=causal, scale=scale,
         block_q=block_q, block_k=block_k,
-        segment_ids_q=seg_q[None], segment_ids_k=seg_k[None])
+        segment_ids_q=seg_q[None], segment_ids_k=seg_k[None],
+        dropout=dropout, dropout_seed=dropout_seed)
     out = out[0, :total_q]
     if return_softmax:
         return out, None

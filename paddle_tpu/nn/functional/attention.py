@@ -12,6 +12,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework import config as _config
 from ...tensor import Tensor, _apply_op, as_array
@@ -55,7 +56,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         rng_key = _random.next_key()
 
     use_pallas = _config.get_flag("FLAGS_use_pallas_kernels", True)
-    if use_pallas and dropout_p == 0.0 and attn_mask is None:
+    eff_dropout = dropout_p if training else 0.0
+    if use_pallas and attn_mask is None:
         try:
             from ...kernels import flash_attention as fa
 
@@ -76,6 +78,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             if fa.supports(s_q, s_kv, d) and s_q >= min_seq:
 
                 def f(q, k, v):
+                    if eff_dropout > 0.0:
+                        # in-kernel threefry dropout; a fresh per-step
+                        # int32 seed derived from the framework RNG
+                        seed = jax.random.randint(
+                            rng_key, (), 0, np.iinfo(np.int32).max,
+                            dtype=jnp.int32)
+                        return fa.flash_attention_bshd(
+                            q, k, v, causal=is_causal,
+                            dropout=eff_dropout, dropout_seed=seed)
                     return fa.flash_attention_bshd(q, k, v, causal=is_causal)
 
                 return _apply_op(f, query, key, value,
@@ -120,12 +131,24 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     via the segment-masked Pallas kernel."""
     from ...kernels import flash_attention as fa
 
+    eff_dropout = dropout if training else 0.0
+    rng_key = None
+    if eff_dropout > 0.0:
+        from ...framework import random as _random
+
+        rng_key = _random.next_key()
+
     d = as_array(query).shape[-1]
     if d % 128 == 0:
         def f(q, k, v, cq, ck):
+            seed = None
+            if eff_dropout > 0.0:
+                seed = jax.random.randint(rng_key, (), 0,
+                                          np.iinfo(np.int32).max,
+                                          dtype=jnp.int32)
             out, _ = fa.flash_attn_unpadded(
                 q, k, v, cq, ck, max_seqlen_q, max_seqlen_k, scale=scale,
-                dropout=dropout if training else 0.0, causal=causal)
+                dropout=eff_dropout, causal=causal, dropout_seed=seed)
             return out
 
         out = _apply_op(f, query, key, value, cu_seqlens_q, cu_seqlens_k,

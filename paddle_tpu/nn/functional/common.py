@@ -242,10 +242,11 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
     return _apply_op(f, x, _name="channel_shuffle")
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    def _pair(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
 
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
     dh, dw = _pair(dilations)
@@ -277,7 +278,45 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
          name=None):
-    raise NotImplementedError("fold: planned (inverse of unfold)")
+    """Inverse of unfold: sum sliding-window patches `[N, C*kh*kw, L]`
+    back into images `[N, C, H, W]` (overlaps accumulate). Reference
+    paddle.nn.functional.fold (SURVEY.md §2.2 nn functional tail); built
+    as strided scatter-adds — the exact transpose of unfold's strided
+    slices, so fold(unfold(x)) equals x times the window multiplicity."""
+    oh_out, ow_out = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+
+    def f(a):
+        n, ckk, length = a.shape
+        c = ckk // (kh * kw)
+        hp, wp = oh_out + pt + pb, ow_out + pl + pr
+        oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        if oh * ow != length:
+            raise ValueError(
+                f"fold: input holds {length} blocks but output_sizes/"
+                f"kernel/stride/padding/dilation imply {oh}x{ow}={oh * ow}")
+        patches = a.reshape(n, c, kh * kw, oh, ow)
+        out = jnp.zeros((n, c, hp, wp), a.dtype)
+        idx = 0
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh: i * dh + sh * (oh - 1) + 1: sh,
+                             j * dw: j * dw + sw * (ow - 1) + 1: sw].add(
+                    patches[:, :, idx])
+                idx += 1
+        return out[:, :, pt:pt + oh_out, pl:pl + ow_out]
+
+    return _apply_op(f, x, _name="fold")
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...tensor import Tensor, _apply_op, as_array
+from .common import fold  # noqa: F401 — canonical col2im lives beside unfold
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):
@@ -84,37 +85,6 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return (va * wa + vb * wb + vc * wc + vd * wd).astype(im.dtype)
 
     return _apply_op(f, x, grid, _name="grid_sample")
-
-
-def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
-         name=None):
-    """col2im: x [N, C*kh*kw, L] -> [N, C, H, W] (paddle.nn.functional.fold
-    — the inverse of unfold; overlaps SUM)."""
-    def _pair(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
-
-    oh, ow = _pair(output_sizes)
-    kh, kw = _pair(kernel_sizes)
-    sh, sw = _pair(strides)
-    ph, pw = _pair(paddings)
-    dh, dw = _pair(dilations)
-    n_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
-    n_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
-
-    def f(a):
-        n, ckk, l = a.shape
-        c = ckk // (kh * kw)
-        cols = a.reshape(n, c, kh, kw, n_h, n_w)
-        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
-        for i in range(kh):
-            for j in range(kw):
-                ys = i * dh
-                xs = j * dw
-                out = out.at[:, :, ys:ys + sh * n_h:sh,
-                             xs:xs + sw * n_w:sw].add(cols[:, :, i, j])
-        return out[:, :, ph:ph + oh, pw:pw + ow]
-
-    return _apply_op(f, x, _name="fold")
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,

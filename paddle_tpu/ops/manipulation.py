@@ -726,16 +726,24 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         a = a.reshape(-1)
         change = np.concatenate([[True], a[1:] != a[:-1]])
         vals = a[change]
-        outs = [Tensor(jnp.asarray(vals))]
-        if return_inverse:
-            inv = np.cumsum(change) - 1
-            outs.append(Tensor(jnp.asarray(inv)))
-        if return_counts:
-            idx = np.flatnonzero(change)
-            counts = np.diff(np.append(idx, a.size))
-            outs.append(Tensor(jnp.asarray(counts)))
-        return outs[0] if len(outs) == 1 else tuple(outs)
-    raise NotImplementedError("unique_consecutive with axis")
+    else:
+        # consecutive-duplicate removal of whole slices along `axis`: a
+        # slice is new when ANY element differs from its predecessor
+        m = np.moveaxis(a, axis, 0)
+        flat = m.reshape(m.shape[0], -1)
+        change = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+        vals = np.moveaxis(m[change], 0, axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    n = a.size if axis is None else a.shape[axis]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.append(idx, n))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def rot90(x, k=1, axes=(0, 1), name=None):
