@@ -1,0 +1,116 @@
+"""Execute (not just AOT-compile) the big-model training path at TRUE
+production width on the 8-device CPU mesh (round-4 verdict item 4:
+"Execute - don't just compile - the big-model paths"; SURVEY.md §7
+phase 8/10).
+
+Case: 13B-geometry hybrid train step — hidden 5120 / intermediate 13824 /
+head_dim 128 (the exact LLaMA-13B tensor shapes the partitioner must
+handle) at reduced layer count (2, one per pipeline stage) and small
+vocab/seq so a single host core can execute it. Runs pp2 x dp2 x tp2 with
+ZeRO-2 and asserts loss parity against a serial run of the same model —
+the width-dependent sharding program (column/row splits of 5120-wide
+projections, vocab-parallel CE, manual-batch-axes fold) is fully
+exercised and EXECUTED.
+
+The 7B-true-width serving decode (hidden 4096, tp8) executes in
+`__graft_entry__.dryrun_multichip` case `serving_7b_width`.
+
+Writes WIDEGEOM_EXEC.json. Wall-clock on one host core: ~2-5 min
+(dominated by the ~0.5 TFLOP/step serial reference).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main():
+    n_devices = 8
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices("cpu")[:n_devices]
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.mesh as mesh_mod
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_train_step)
+
+    result = {"case": "13b_width_train",
+              "geometry": {"hidden": 5120, "intermediate": 13824,
+                           "heads": 40, "head_dim": 128, "layers": 2,
+                           "vocab": 2048, "seq": 32, "batch": 4,
+                           "mesh": "pp2xdp2xtp2", "sharding_stage": 2,
+                           "num_microbatches": 2},
+              "note": ("true-width tensor shapes of LLaMA-13B; layer "
+                       "count reduced to one per pipeline stage so one "
+                       "host core can EXECUTE the step")}
+
+    def make_model():
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=5120,
+                          intermediate_size=13824, num_hidden_layers=2,
+                          num_attention_heads=40, num_key_value_heads=40,
+                          max_position_embeddings=32)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return model, opt
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 2048, (4, 32)))
+    y = paddle.to_tensor(rng.randint(0, 2048, (4, 32)))
+    steps = 2
+
+    t0 = time.perf_counter()
+    mesh_mod.set_mesh(None)
+    model_s, opt_s = make_model()
+    step_s = build_train_step(model_s, opt_s, mesh=None)
+    serial = [float(step_s(x, y)) for _ in range(steps)]
+    result["serial_losses"] = serial
+    result["serial_elapsed_s"] = round(time.perf_counter() - t0, 1)
+    # free the serial model/optimizer before the parallel one allocates
+    del model_s, opt_s, step_s
+
+    t0 = time.perf_counter()
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh(
+        pp=2, dp=2, tp=2, devices=np.asarray(devs)))
+    try:
+        model_p, opt_p = make_model()
+        step_p = build_train_step(model_p, opt_p, mesh=mesh,
+                                  sharding_stage=2, num_microbatches=2)
+        par = [float(step_p(x, y)) for _ in range(steps)]
+    finally:
+        mesh_mod.set_mesh(None)
+    result["parallel_losses"] = par
+    result["parallel_elapsed_s"] = round(time.perf_counter() - t0, 1)
+
+    deltas = [abs(a - b) / max(abs(a), 1e-9) for a, b in zip(serial, par)]
+    result["max_rel_delta"] = max(deltas)
+    ok = all(np.isfinite(par)) and max(deltas) < 5e-4 and par[-1] < par[0]
+    result["ok"] = bool(ok)
+
+    out = os.path.join(REPO, "WIDEGEOM_EXEC.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out)
+    print(json.dumps(result))
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
