@@ -72,19 +72,28 @@ def _load_tpu_cache():
         return None
 
 
-def _bank_tpu_result(key, result):
-    """Record a successful on-chip capture (atomic write; never raises)."""
+def _git_commit():
+    """Short HEAD commit of the repo this file lives in ("unknown" when
+    git is unavailable)."""
     import os
     import subprocess
 
     try:
-        try:
-            commit = subprocess.run(
-                ["git", "rev-parse", "--short", "HEAD"],
-                capture_output=True, text=True, timeout=10,
-                cwd=os.path.dirname(_tpu_cache_path())).stdout.strip()
-        except Exception:  # noqa: BLE001
-            commit = "unknown"
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+        return out or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def _bank_tpu_result(key, result):
+    """Record a successful on-chip capture (atomic write; never raises)."""
+    import os
+
+    try:
+        commit = _git_commit()
         cache = _load_tpu_cache()
         if cache is None:
             return  # unreadable cache on disk: never clobber it
@@ -106,15 +115,21 @@ def _bank_tpu_result(key, result):
 
 
 def _attach_cached_evidence(result):
-    """On a CPU fallback, embed the banked on-chip rows in the artifact."""
+    """On a CPU fallback, embed the banked on-chip rows in the artifact.
+
+    `live_commit` is the commit of THIS (failed-probe) run — compare it
+    against each row's banked `commit` to see how stale the evidence is
+    (ADVICE.md round-5: staleness must be explicit, not inferred)."""
     cache = _load_tpu_cache()
     if cache:  # None (unreadable) and {} (absent) both skip
         result["tpu_cached"] = {
             "note": ("live TPU probe failed this run; these are the "
                      "last-known-good ON-CHIP captures (backend=tpu at "
                      "the recorded commit/date), banked by bench.py on "
-                     "every successful TPU run"),
+                     "every successful TPU run. Rows whose `commit` != "
+                     "`live_commit` predate the code being measured."),
             "backend": "tpu-cached",
+            "live_commit": _git_commit(),
             "rows": cache,
         }
 

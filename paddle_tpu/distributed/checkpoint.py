@@ -86,13 +86,48 @@ def _abstract_like(obj, mesh=None, spec_fn=None):
     return rec(obj, ())
 
 
+_ckpt_cache = None
+
+
+def _make_checkpoint_metrics(reg):
+    return (
+        reg.counter("checkpoint_saves_total",
+                    "Checkpoint save calls (async saves count at "
+                    "dispatch)."),
+        reg.histogram("checkpoint_save_seconds",
+                      "Wall time inside the save call (async "
+                      "managers: dispatch time only)."),
+    )
+
+
+def _checkpoint_metrics():
+    """Lazy handles (README.md "Observability"): checkpoint saves are the
+    canonical non-productive interval — goodput regressions surface here
+    first; the HandleCache re-resolves after a registry swap/reset."""
+    global _ckpt_cache
+    from ..observability import metrics as _om
+
+    if _ckpt_cache is None:
+        _ckpt_cache = _om.HandleCache(_make_checkpoint_metrics)
+    return _ckpt_cache.get()
+
+
 def save_state_dict(state_dict, path, overwrite=True):
     """Blocking sharded save of a (nested) state_dict to `path`."""
+    import time as _time
+
     import orbax.checkpoint as ocp
 
+    from ..observability import flight_recorder as _flight
+
+    saves_c, save_h = _checkpoint_metrics()
+    t0 = _time.perf_counter()
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, _to_arrays(state_dict), force=overwrite)
+    saves_c.inc()
+    save_h.observe(_time.perf_counter() - t0)
+    _flight.record_event("checkpoint.save", path=path)
 
 
 def load_state_dict(path, template=None, mesh=None, spec_fn=None,
@@ -140,11 +175,23 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self._dir, options=opts)
 
     def save(self, step: int, state_dict, force: bool = False) -> bool:
+        import time as _time
+
         import orbax.checkpoint as ocp
 
-        return self._mgr.save(
+        from ..observability import flight_recorder as _flight
+
+        saves_c, save_h = _checkpoint_metrics()
+        t0 = _time.perf_counter()
+        saved = self._mgr.save(
             int(step), args=ocp.args.StandardSave(_to_arrays(state_dict)),
             force=force)
+        if saved:
+            saves_c.inc()
+            save_h.observe(_time.perf_counter() - t0)
+            _flight.record_event("checkpoint.save", step=int(step),
+                                 dir=self._dir)
+        return saved
 
     def restore(self, step: Optional[int] = None, template=None,
                 mesh=None, spec_fn=None, return_tensors=True):

@@ -34,6 +34,50 @@ class ReduceOp:
     AVG = "avg"
 
 
+# --- telemetry (README.md "Observability"): per-collective call counts
+# and bytes moved. Eager calls count executions; the jit-path helpers
+# (psum/all_gather_jit/...) count TRACE-time emissions — one per compile,
+# not per device launch (XLA owns the executed schedule). Child cells
+# cache per op name; HandleCache re-resolves after a registry
+# swap/reset, so the steady-state cost is one dict hit + float adds.
+_coll_cache = None
+
+
+def _make_coll_handles(reg):
+    return {
+        "calls": reg.counter(
+            "collective_calls_total",
+            "Collective API invocations (jit-path helpers count "
+            "trace-time emissions).", labels=("op",)),
+        "bytes": reg.counter(
+            "collective_bytes_total",
+            "Input bytes handed to each collective.", labels=("op",)),
+        "children": {},
+    }
+
+
+def _count_collective(op: str, array=None, arrays=None):
+    """One call-count increment per API invocation; bytes summed over
+    `array` or every entry of `arrays`."""
+    global _coll_cache
+    from ..observability import metrics as _om
+
+    if _coll_cache is None:
+        _coll_cache = _om.HandleCache(_make_coll_handles)
+    h = _coll_cache.get()
+    cell = h["children"].get(op)
+    if cell is None:
+        cell = (h["calls"].labels(op), h["bytes"].labels(op))
+        h["children"][op] = cell
+    cell[0].inc()
+    for a in (arrays if arrays is not None
+              else (array,) if array is not None else ()):
+        try:  # works for concrete arrays AND tracers (shape/dtype known)
+            cell[1].inc(float(np.prod(a.shape)) * a.dtype.itemsize)
+        except Exception:
+            pass
+
+
 def _axes_for_group(group):
     m = _mesh.get_mesh(optional=True)
     if m is None:
@@ -54,6 +98,11 @@ def _world(axes):
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce (eager identity at world=1; psum under jit)."""
+    _count_collective("all_reduce", as_array(tensor))
+    return _all_reduce_impl(tensor, op, group)
+
+
+def _all_reduce_impl(tensor, op, group):
     axes = _axes_for_group(group)
     if _world(axes) == 1:
         if not _jc.tracing():
@@ -79,6 +128,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    _count_collective("all_gather", as_array(tensor))
     axes = _axes_for_group(group)
     if _world(axes) == 1:
         tensor_list.append(Tensor(as_array(tensor)))
@@ -89,14 +139,18 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    _count_collective("broadcast", as_array(tensor))
     return tensor
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    # counts as "reduce", not "all_reduce": one API call, one increment
+    _count_collective("reduce", as_array(tensor))
+    return _all_reduce_impl(tensor, op, group)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _count_collective("scatter", as_array(tensor))
     if tensor_list:
         tensor._rebind(as_array(tensor_list[src]))
     return tensor
@@ -104,6 +158,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    _count_collective("reduce_scatter", as_array(tensor))
     axes = _axes_for_group(group)
     if _world(axes) == 1:
         tensor._rebind(as_array(tensor_list[0]))
@@ -117,6 +172,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     path via all_gather)."""
     from .env import get_rank
 
+    _count_collective("gather", as_array(tensor))
     if _jc.tracing():
         raise RuntimeError(
             "distributed.gather mutates a host list and cannot run under "
@@ -130,6 +186,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """paddle.distributed.alltoall_single parity (single-process eager:
     identity copy; multi-rank all_to_all lives on the jit path)."""
+    _count_collective("alltoall_single", as_array(in_tensor))
     if _jc.tracing():
         raise RuntimeError(
             "distributed.alltoall_single mutates a host tensor and cannot "
@@ -141,6 +198,8 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    _count_collective("alltoall",
+                      arrays=[as_array(t) for t in in_tensor_list])
     if out_tensor_list is None:
         out_tensor_list = []
     out_tensor_list.extend(Tensor(as_array(t)) for t in in_tensor_list)
@@ -148,6 +207,9 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    # counted even though it raises: attempted eager p2p is exactly the
+    # misuse an operator wants visible on a dashboard
+    _count_collective("send", as_array(tensor))
     raise NotImplementedError(
         "point-to-point eager send: multi-host eager is jit-path-only "
         "(SURVEY.md §7 hard part #5); PP uses ppermute inside the compiled "
@@ -156,10 +218,12 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    _count_collective("recv", as_array(tensor))
     raise NotImplementedError("see send()")
 
 
 def barrier(group=None):
+    _count_collective("barrier")
     (jax.device_put(0) + 0).block_until_ready()
 
 
@@ -177,24 +241,29 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 # jit-path collectives (used inside shard_map'd/pjit'd programs)
 def psum(x, axis_name):
+    _count_collective("psum", x)
     return jax.lax.psum(x, axis_name)
 
 
 def all_gather_jit(x, axis_name, axis=0, tiled=True):
+    _count_collective("all_gather_jit", x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def psum_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    _count_collective("psum_scatter", x)
     return jax.lax.psum_scatter(x, axis_name,
                                 scatter_dimension=scatter_dimension,
                                 tiled=tiled)
 
 
 def ppermute(x, axis_name, perm):
+    _count_collective("ppermute", x)
     return jax.lax.ppermute(x, axis_name, perm)
 
 
 def all_to_all_jit(x, axis_name, split_axis, concat_axis, tiled=True):
+    _count_collective("all_to_all_jit", x)
     return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
                               tiled=tiled)
 

@@ -91,6 +91,15 @@ define_flag("FLAGS_flash_fwd_min_seq", 0,
             "0 defers to the built-in measured default (4096 — the v5e "
             "crossover where XLA fused attention stops winning, "
             "KERNEL_BENCH.json round-4).", type_=int)
+define_flag("FLAGS_flash_dropout_kernel", False,
+            "Route training SDPA with dropout_p>0 to the in-kernel "
+            "threefry flash-attention dropout path. Opt-in until the "
+            "dropout kernel is validated under real Mosaic (only "
+            "interpret-mode parity is tested so far) — the same policy "
+            "as FLAGS_paged_grouped_kernel: never route un-Mosaic-"
+            "validated kernels into a hot path by default. Off: dropout "
+            "attention takes the XLA reference path; dropout-free "
+            "attention still uses the flash kernel.")
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

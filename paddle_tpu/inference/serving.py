@@ -10,6 +10,8 @@ the model's dense-cache path, then scatters K/V into that request's pages.
 """
 from __future__ import annotations
 
+import time as _time_mod
+
 from dataclasses import dataclass, field
 from typing import Dict, List
 
@@ -19,7 +21,74 @@ import numpy as np
 
 from ..autograd import tape as _tape
 from ..kernels import paged_attention as _pa
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _om
 from ..tensor import Tensor, as_array
+
+
+class _EngineMetrics:
+    """Serving metric handles, resolved ONCE per engine against the
+    current default registry — the decode loop then only touches plain
+    float cells (the overhead guard test asserts zero registry
+    allocations per step). Metric names documented in README.md
+    ("Observability")."""
+
+    __slots__ = ("ttft", "step_lat", "token_lat", "queue_depth",
+                 "queue_wait", "occupancy", "page_util", "prefill_hits",
+                 "prefill_misses", "preemptions", "aborts", "tokens",
+                 "finished", "poisoned")
+
+    def __init__(self, reg=None):
+        reg = reg or _om.default_registry()
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "Time from add_request() to the request's first committed "
+            "token (queue wait + prefill).")
+        self.step_lat = reg.histogram(
+            "serving_decode_step_seconds",
+            "Wall time of one compiled decode dispatch + token harvest "
+            "(a burst counts as one step).")
+        self.token_lat = reg.histogram(
+            "serving_token_decode_seconds",
+            "Per-token decode latency: step wall time / tokens committed "
+            "that step (one observation per step).")
+        self.queue_depth = reg.gauge(
+            "serving_queue_depth",
+            "Requests waiting for a slot (pending, not yet prefilled).")
+        self.queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "Time a request spent queued before admission to a slot.")
+        self.occupancy = reg.gauge(
+            "serving_batch_occupancy",
+            "Active slots / max_batch at the last decode step.")
+        self.page_util = reg.gauge(
+            "serving_page_pool_utilization",
+            "Fraction of KV pages allocated (1 - free/total).")
+        self.prefill_hits = reg.counter(
+            "serving_prefill_bucket_hits_total",
+            "Prefill calls served by an already-compiled "
+            "(batch, token-bucket) program.")
+        self.prefill_misses = reg.counter(
+            "serving_prefill_bucket_misses_total",
+            "Prefill calls that compiled a new bucket program "
+            "(in-traffic compiles; warmup() prepays these).")
+        self.preemptions = reg.counter(
+            "serving_preemptions_total",
+            "Slots evicted by page-pool exhaustion (recompute policy).")
+        self.aborts = reg.counter(
+            "serving_aborts_total", "Requests dropped via abort().")
+        self.tokens = reg.counter(
+            "serving_tokens_total",
+            "Tokens committed to request streams (prefill-sampled first "
+            "tokens included).")
+        self.finished = reg.counter(
+            "serving_requests_finished_total",
+            "Requests that ran to eos or their max_new_tokens budget.")
+        self.poisoned = reg.gauge(
+            "serving_engine_poisoned",
+            "1 once a compiled decode call raised after donating the KV "
+            "page pools (engine must be recreated; step()/run() fail "
+            "fast).")
 
 
 @dataclass
@@ -186,6 +255,14 @@ class ServingEngine:
         # mutating model weights
         self._params = None
         self._buffers = None
+        # telemetry: handles resolved once (README.md "Observability");
+        # set when a compiled decode call raises AFTER donating the page
+        # pools — the engine then holds deleted buffers and every
+        # subsequent step()/run() fails fast instead of crashing on
+        # deleted-buffer access (ADVICE.md round-5)
+        self._poisoned = None
+        self._n_pages_total = n_pages
+        self._m = _EngineMetrics()
 
     def _pin_pages(self):
         """Lay the page pools out in the serving sharding (kv heads over
@@ -251,10 +328,15 @@ class ServingEngine:
             top_p=float(top_p if top_p is not None else self.top_p),
             eos=eos_token_id if eos_token_id is not None
             else self.eos_token_id,
-            on_token=on_token)
+            on_token=on_token,
+            t_enq=_time_mod.perf_counter())
         # queue only — admission happens at the next step() so requests
         # arriving together prefill together in one batched compiled call
         self._pending.append((rid, ids, int(max_new_tokens), []))
+        self._m.queue_depth.set(len(self._pending))
+        _flight.record_event("serving.add_request", rid=rid,
+                             prompt_len=len(ids),
+                             max_new=int(max_new_tokens))
         return rid
 
     def _admit(self):
@@ -279,6 +361,15 @@ class ServingEngine:
             if len(self._free_pages) < need:
                 break
             self._pending.pop(0)
+            rp = self._req_params.get(rid)
+            # one-shot: a preempted request re-enters _pending with its
+            # original t_enq — re-observing would book its prior decode
+            # time as "queue wait"
+            if rp is not None and "t_enq" in rp \
+                    and not rp.get("qw_seen"):
+                rp["qw_seen"] = True
+                self._m.queue_wait.observe(
+                    _time_mod.perf_counter() - rp["t_enq"])
             pages = [self._free_pages.pop() for _ in range(need)]
             self.block_tables[slot_idx, :need] = np.asarray(pages, np.int32)
             s = self.slots[slot_idx]
@@ -293,6 +384,7 @@ class ServingEngine:
             s.needs_first_sample = True
             s.active = True
             new.append((slot_idx, ctx))
+        self._m.queue_depth.set(len(self._pending))
         if new:
             self._prefill_batch(new)
 
@@ -352,6 +444,10 @@ class ServingEngine:
         return rp["eos"] if rp is not None else self.eos_token_id
 
     def _stream(self, rid, token):
+        # ONE commit point for every token that enters a request's
+        # stream — the token counter lives here so sync/burst/async
+        # paths can't drift apart
+        self._m.tokens.inc()
         rp = self._req_params.get(rid)
         cb = rp.get("on_token") if rp is not None else None
         if cb is not None:
@@ -377,12 +473,19 @@ class ServingEngine:
                 self._pending.pop(i)
                 self._prompts.pop(request_id, None)
                 self._req_params.pop(request_id, None)
+                self._m.aborts.inc()
+                self._m.queue_depth.set(len(self._pending))
+                _flight.record_event("serving.abort", rid=request_id,
+                                     where="queue")
                 return True
         for idx, s in enumerate(self.slots):
             if s.active and s.request_id == request_id:
                 self._release_slot(idx)
                 self._prompts.pop(request_id, None)
                 self._req_params.pop(request_id, None)
+                self._m.aborts.inc()
+                _flight.record_event("serving.abort", rid=request_id,
+                                     where="slot")
                 return True
         return False
 
@@ -409,6 +512,10 @@ class ServingEngine:
         self._pending.insert(
             0, (s.request_id, self._prompts[s.request_id],
                 s.max_new_tokens, list(s.tokens)))
+        self._m.preemptions.inc()
+        self._m.queue_depth.set(len(self._pending))
+        _flight.record_event("serving.preempt", rid=s.request_id,
+                             tokens_so_far=len(s.tokens))
 
     # ------------------------------------------------------------------
     # prefill: batched dense-cache forward on the admitted prompts, then
@@ -421,7 +528,11 @@ class ServingEngine:
         sampler's vocab sort entirely (argmax only)."""
         fn = self._prefill_fns.get((nb, bucket, all_greedy))
         if fn is not None:
+            self._m.prefill_hits.inc()
             return fn
+        self._m.prefill_misses.inc()
+        _flight.record_event("serving.prefill_compile", nb=nb,
+                             bucket=bucket, all_greedy=all_greedy)
         model = self.model
         from ..jit.api import _LayerScope
         from ..models.generation import (sample_logits,
@@ -659,9 +770,45 @@ class ServingEngine:
                  for s in self.slots], np.int32),
         )
 
+    @staticmethod
+    def _buffers_deleted(buffers) -> bool:
+        """True when any of the page buffers handed to a failed compiled
+        call was actually donated (deleted). Distinguishes a post-
+        donation failure (engine must be poisoned) from a pre-donation
+        one — argument conversion or trace/compile errors — where the
+        pools are intact and the engine can keep serving. Unknowable
+        states poison (fail safe)."""
+        try:
+            return any(b.is_deleted() for b in buffers)
+        except Exception:
+            return True
+
+    def _poison_if_donated(self, why: str, *page_lists):
+        for pages in page_lists:
+            if pages and self._buffers_deleted(pages):
+                self._poison(why)
+                return
+
+    def _poison(self, why: str):
+        """Mark the engine unusable: a compiled call raised after its
+        donated KV page arguments were already deleted, so the pools the
+        engine holds are dead buffers (ADVICE.md round-5)."""
+        self._poisoned = why
+        self._m.poisoned.set(1.0)
+        _flight.record_event("serving.poisoned", why=why)
+
+    def _check_poisoned(self):
+        if self._poisoned:
+            raise RuntimeError(
+                f"ServingEngine is poisoned ({self._poisoned}): a "
+                f"compiled decode call raised after donating the KV page "
+                f"pools, so the engine holds deleted buffers. Recreate "
+                f"the engine; in-flight requests must be re-submitted.")
+
     def step(self) -> List[FinishedRequest]:
         """Run one decode step for all active slots; returns requests that
         finished this step."""
+        self._check_poisoned()
         self._admit()  # batched prefill of everything admissible
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
@@ -670,12 +817,21 @@ class ServingEngine:
         # sample; afterwards the decode fn both samples and advances
         tokens = np.zeros((self.max_batch,), np.int64)
         first_done = []
+        now = _time_mod.perf_counter()
         for i, s in enumerate(self.slots):
             if not s.active:
                 continue
             if s.needs_first_sample:
                 s.needs_first_sample = False
                 s.tokens.append(s._first_token)
+                rp = self._req_params.get(s.request_id)
+                # popping t_enq makes TTFT one-shot: a request preempted
+                # AFTER its first token re-prefills (needs_first_sample
+                # fires again) but must not record a second "TTFT"; one
+                # preempted BEFORE it still records the true
+                # enqueue-to-first-token time, preemption delay included
+                if rp is not None and "t_enq" in rp:
+                    self._m.ttft.observe(now - rp.pop("t_enq"))
                 self._stream(s.request_id, s._first_token)
                 eos = self._req_eos(s.request_id)
                 if (eos is not None and s.tokens[-1] == eos) or \
@@ -721,34 +877,50 @@ class ServingEngine:
                                     st["tp"])
         self._key, sk = jax.random.split(self._key)
         params, buffers = self._cached_params()
+        t0 = _time_mod.perf_counter()
+        tok0 = self._m.tokens.value
         if k_burst > 1:
             fn = self._get_burst_fn(all_greedy, k_burst)
-            (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
-                params, buffers, tuple(self.k_pages), tuple(self.v_pages),
-                tuple(self.k_scales or ()), tuple(self.v_scales or ()),
-                jnp.asarray(tokens), jnp.asarray(self.block_tables),
-                jnp.asarray(lens), jnp.asarray(act_mask),
-                jnp.asarray(st["rem"]), jnp.asarray(st["eos"]),
-                jax.random.key_data(sk),
-                jnp.asarray(greedy), jnp.asarray(temp), jnp.asarray(tk),
-                jnp.asarray(tp_arr))
+            try:
+                (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
+                    params, buffers, tuple(self.k_pages),
+                    tuple(self.v_pages),
+                    tuple(self.k_scales or ()), tuple(self.v_scales or ()),
+                    jnp.asarray(tokens), jnp.asarray(self.block_tables),
+                    jnp.asarray(lens), jnp.asarray(act_mask),
+                    jnp.asarray(st["rem"]), jnp.asarray(st["eos"]),
+                    jax.random.key_data(sk),
+                    jnp.asarray(greedy), jnp.asarray(temp),
+                    jnp.asarray(tk), jnp.asarray(tp_arr))
+            except BaseException:
+                self._poison_if_donated(
+                    "burst decode fn raised after donating the KV pages",
+                    self.k_pages, self.v_pages)
+                raise
             self.k_pages, self.v_pages = list(nk), list(nv)
             if self.k_scales is not None:
                 self.k_scales, self.v_scales = list(nks), list(nvs)
             finished = finished_early
             finished.extend(self._replay_burst(
                 np.asarray(toks), np.asarray(emits), active))
+            self._step_metrics(t0, len(active), tok0)
             if finished:
                 self._admit()
             return finished
         fn = self._get_decode_fn(all_greedy)
-        nxt, nk, nv, nks, nvs = fn(
-            params, buffers, tuple(self.k_pages), tuple(self.v_pages),
-            tuple(self.k_scales or ()), tuple(self.v_scales or ()),
-            jnp.asarray(tokens), jnp.asarray(self.block_tables),
-            jnp.asarray(lens), jnp.asarray(act_mask),
-            jax.random.key_data(sk), jnp.asarray(greedy),
-            jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp_arr))
+        try:
+            nxt, nk, nv, nks, nvs = fn(
+                params, buffers, tuple(self.k_pages), tuple(self.v_pages),
+                tuple(self.k_scales or ()), tuple(self.v_scales or ()),
+                jnp.asarray(tokens), jnp.asarray(self.block_tables),
+                jnp.asarray(lens), jnp.asarray(act_mask),
+                jax.random.key_data(sk), jnp.asarray(greedy),
+                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp_arr))
+        except BaseException:
+            self._poison_if_donated(
+                "decode fn raised after donating the KV pages",
+                self.k_pages, self.v_pages)
+            raise
         self.k_pages, self.v_pages = list(nk), list(nv)
         if self.k_scales is not None:
             self.k_scales, self.v_scales = list(nks), list(nvs)
@@ -769,9 +941,25 @@ class ServingEngine:
             if len(s.tokens) >= s.max_new_tokens or (
                     eos is not None and s.tokens[-1] == eos):
                 finished.append(self._finish(i))
+        self._step_metrics(t0, len(active), tok0)
         if finished:
             self._admit()
         return finished
+
+    def _step_metrics(self, t0, n_active, tok0):
+        """Per-step telemetry close-out: ZERO registry allocations —
+        handle attribute reads + float ops only (the overhead guard test
+        pins this)."""
+        dt = _time_mod.perf_counter() - t0
+        n_tok = self._m.tokens.value - tok0
+        self._m.step_lat.observe(dt)
+        self._m.token_lat.observe(dt / n_tok if n_tok > 0 else dt)
+        self._m.occupancy.set(n_active / self.max_batch)
+        self._m.page_util.set(
+            1.0 - len(self._free_pages) / self._n_pages_total)
+        _flight.record_event("serving.step", active=n_active,
+                             tokens=n_tok, seconds=round(dt, 6))
+        _flight.beat_all()
 
     def _replay_burst(self, toks, emits, active):
         """Token-by-token host replay of one harvested burst: identical
@@ -798,6 +986,9 @@ class ServingEngine:
     def _finish(self, slot_idx) -> FinishedRequest:
         s = self.slots[slot_idx]
         self._release_slot(slot_idx)
+        self._m.finished.inc()
+        _flight.record_event("serving.finish", rid=s.request_id,
+                             tokens=len(s.tokens))
         self._req_params.pop(s.request_id, None)
         # pop with default: an on_token callback may have abort()ed the
         # request between the decode step and this finish
@@ -896,15 +1087,28 @@ class ServingEngine:
             while (dispatched < n_bursts and not stop) or inflight:
                 if dispatched < n_bursts and not stop:
                     if _reserve():
-                        (toks, emits, nk, nv, nks, nvs,
-                         tok_f, ln_f, act_f, rm_f, key_f) = fn(
-                            params, buffers, *pages, carry[0],
-                            jnp.asarray(self.block_tables), carry[1],
-                            carry[2], carry[3], eos_arr, carry[4], greedy,
-                            temp, tk, tp_arr)
+                        try:
+                            (toks, emits, nk, nv, nks, nvs,
+                             tok_f, ln_f, act_f, rm_f, key_f) = fn(
+                                params, buffers, *pages, carry[0],
+                                jnp.asarray(self.block_tables), carry[1],
+                                carry[2], carry[3], eos_arr, carry[4],
+                                greedy, temp, tk, tp_arr)
+                        except BaseException:
+                            # on a post-donation failure `pages` names
+                            # deleted buffers and the finally below
+                            # re-points the engine at them — poison so
+                            # step()/run() fail fast (ADVICE.md round-5);
+                            # pre-donation failures keep the engine live
+                            self._poison_if_donated(
+                                "async burst decode fn raised after "
+                                "donating the KV pages",
+                                pages[0], pages[1])
+                            raise
                         pages = (nk, nv, nks, nvs)
                         carry = (tok_f, ln_f, act_f, rm_f, key_f)
-                        inflight.append((toks, emits))
+                        inflight.append(
+                            (toks, emits, _time_mod.perf_counter()))
                         dispatched += 1
                     else:
                         # page-pool pressure: drain, then let the classic
@@ -912,10 +1116,17 @@ class ServingEngine:
                         stop = True
                 if inflight and (stop or len(inflight) > self.async_depth
                                  or dispatched >= n_bursts):
-                    toks, emits = inflight.popleft()
+                    # step latency measured from the burst's DISPATCH:
+                    # np.asarray below blocks on the device result, so
+                    # the observation covers compute + pipeline queueing
+                    # + replay (bursts overlap, so individual spans do
+                    # too — honest per-burst completion latency)
+                    toks, emits, t_disp = inflight.popleft()
                     gen0 = self._release_gen
+                    tok0 = self._m.tokens.value
                     finished.extend(self._replay_burst(
                         np.asarray(toks), np.asarray(emits), active))
+                    self._step_metrics(t_disp, len(active), tok0)
                     if self._release_gen != gen0:
                         # pages were freed (finish OR a callback abort):
                         # the remaining in-flight bursts still write to
@@ -933,6 +1144,7 @@ class ServingEngine:
         return finished, dispatched
 
     def run(self, max_steps=10_000) -> List[FinishedRequest]:
+        self._check_poisoned()
         out = []
         steps = 0
         while self.has_work() and steps < max_steps:

@@ -68,6 +68,39 @@ _END = "__pdtpu_worker_end__"
 _ERR = "__pdtpu_worker_err__"
 
 
+# telemetry (README.md "Observability"): lazy handles shared by every
+# iterator — resolving here keeps worker forks clean (children never
+# call into observability) and the per-batch cost to float ops; the
+# HandleCache re-resolves after a registry swap/reset
+_dl_cache = None
+
+
+def _make_loader_metrics(reg):
+    return (
+        reg.histogram(
+            "dataloader_fetch_seconds",
+            "Time to produce one collated batch (dataset reads + "
+            "collate; for worker processes: ring wait seen by the "
+            "consumer)."),
+        reg.gauge(
+            "dataloader_queue_depth",
+            "Batches sitting in the prefetch queue (threaded "
+            "transport only)."),
+        reg.counter(
+            "dataloader_batches_total",
+            "Batches handed to the training loop."),
+    )
+
+
+def _loader_metrics():
+    global _dl_cache
+    from ..observability import metrics as _om
+
+    if _dl_cache is None:
+        _dl_cache = _om.HandleCache(_make_loader_metrics)
+    return _dl_cache.get()
+
+
 def _mp_worker_loop(dataset, batch_lists, ring_name, collate, init_fn,
                     worker_id, num_workers=1):
     """Runs in a forked child: numpy-only; ships pickled batches by shm."""
@@ -162,6 +195,9 @@ class _MultiProcessIter:
                     raise
 
     def __next__(self):
+        import time as _time
+
+        fetch_h, _, batches_c = _loader_metrics()
         while True:
             if all(self._done):
                 raise StopIteration
@@ -169,6 +205,7 @@ class _MultiProcessIter:
             if self._done[w]:
                 self._next += 1
                 continue
+            t0 = _time.perf_counter()
             item = self._get(w)
             if isinstance(item, str) and item == _END:
                 self._done[w] = True
@@ -181,6 +218,10 @@ class _MultiProcessIter:
                 raise RuntimeError(
                     f"DataLoader worker {item[1]} raised:\n{item[2]}")
             self._next += 1
+            # only REAL batches count as fetches: the _END sentinel and
+            # error exits above must not skew the latency distribution
+            fetch_h.observe(_time.perf_counter() - t0)
+            batches_c.inc()
             return _tensorize(item) if self._wrap else item
 
     def __iter__(self):
@@ -225,15 +266,23 @@ class _Iter:
         return collate(samples)
 
     def _producer(self):
+        import time as _time
+
+        fetch_h, depth_g, _ = _loader_metrics()
         try:
             for indices in self._batches:
                 if self._stop.is_set():
                     return
-                self._prefetch_q.put(self._load_batch(indices))
+                t0 = _time.perf_counter()
+                batch = self._load_batch(indices)
+                fetch_h.observe(_time.perf_counter() - t0)
+                self._prefetch_q.put(batch)
+                depth_g.set(self._prefetch_q.qsize())
         finally:
             self._prefetch_q.put(StopIteration)
 
     def __next__(self):
+        fetch_h, depth_g, batches_c = _loader_metrics()
         if self.iterable:
             batch = []
             try:
@@ -243,14 +292,23 @@ class _Iter:
                 if not batch or self.loader.drop_last:
                     raise
             collate = self.loader.collate_fn or default_collate_fn
+            batches_c.inc()
             return collate(batch)
         if self._prefetch_q is not None:
             item = self._prefetch_q.get()
+            depth_g.set(self._prefetch_q.qsize())
             if item is StopIteration:
                 raise StopIteration
+            batches_c.inc()
             return item
+        import time as _time
+
+        t0 = _time.perf_counter()
         indices = next(self._batches)
-        return self._load_batch(indices)
+        out = self._load_batch(indices)
+        fetch_h.observe(_time.perf_counter() - t0)
+        batches_c.inc()
+        return out
 
     def __iter__(self):
         return self

@@ -33,6 +33,65 @@ from ..nn.layer_base import Layer
 from ..tensor import Tensor
 
 
+def _instrument_step(step_fn):
+    """Wrap a compiled step(input_ids, labels) with runtime telemetry
+    (README.md "Observability"): `train_steps_total`,
+    `train_step_seconds` (dispatch wall time of the compiled call),
+    `train_data_wait_seconds` (host gap since the previous step returned
+    — dataloader stalls show up here), `train_tokens_total`, and a
+    watchdog beat + flight-recorder breadcrumb per step. Handles resolve
+    ONCE at build time; the per-step cost is a few float ops.
+
+    The compiled call dispatches asynchronously, so step_seconds is
+    dispatch+trace time unless the caller blocks on the loss; the
+    PerfMeter gauges (tokens/sec, MFU, goodput) remain the throughput
+    source of truth."""
+    import time as _time
+
+    from ..observability import flight_recorder as _flight
+    from ..observability import metrics as _om
+
+    if getattr(step_fn, "_observed", False):
+        return step_fn
+    reg = _om.default_registry()
+    steps_c = reg.counter("train_steps_total",
+                          "Completed train-step dispatches.")
+    step_h = reg.histogram(
+        "train_step_seconds",
+        "Wall time inside the compiled train step call (async dispatch: "
+        "excludes device tail unless the caller blocks on the loss).")
+    wait_h = reg.histogram(
+        "train_data_wait_seconds",
+        "Host time between a step returning and the next step being "
+        "called — dataloader/input stalls.")
+    tokens_c = reg.counter("train_tokens_total",
+                           "Input tokens fed to the train step.")
+    state = {"last_end": None}
+
+    def instrumented(input_ids, labels):
+        t0 = _time.perf_counter()
+        if state["last_end"] is not None:
+            wait_h.observe(t0 - state["last_end"])
+        out = step_fn(input_ids, labels)
+        t1 = _time.perf_counter()
+        state["last_end"] = t1
+        step_h.observe(t1 - t0)
+        steps_c.inc()
+        x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
+        n_tok = int(np.prod(x.shape)) if hasattr(x, "shape") else 0
+        tokens_c.inc(n_tok)
+        _flight.record_event("train.step", tokens=n_tok,
+                             seconds=round(t1 - t0, 6))
+        _flight.beat_all()
+        return out
+
+    for k, v in step_fn.__dict__.items():
+        setattr(instrumented, k, v)
+    instrumented._observed = True
+    instrumented._raw_step = step_fn
+    return instrumented
+
+
 def place_model(model: Layer, mesh=None):
     """Lay out parameters on the mesh per their recorded specs."""
     mesh = mesh or _mesh.get_mesh(optional=True)
@@ -432,7 +491,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
     step._jitted = jitted          # AOT lowering (tools/scale_rehearsal.py)
     step._flat_specs = flat_specs
     step._data_put = _data_put
-    return step
+    return _instrument_step(step)
 
 
 def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
@@ -504,7 +563,7 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                            gradient_merge_avg=merge_avg)
 
     if mesh is None:
-        return step
+        return _instrument_step(step)
 
     # lay params out ONCE in their between-steps (stored) layout: the
     # zero-sharded spec at stage 3, the compute spec otherwise
@@ -534,4 +593,4 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         return step(Tensor(_data_put(x)), Tensor(_data_put(y)))
 
     sharded_step._inner = step
-    return sharded_step
+    return _instrument_step(sharded_step)

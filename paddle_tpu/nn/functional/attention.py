@@ -75,7 +75,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             else:
                 min_seq = (_config.get_flag("FLAGS_flash_fwd_min_seq", 0)
                            or fa._PALLAS_FWD_MIN_SEQ)
-            if fa.supports(s_q, s_kv, d) and s_q >= min_seq:
+            # in-kernel dropout is opt-in (ADVICE.md round-5: same policy
+            # as FLAGS_paged_grouped_kernel — un-Mosaic-validated kernels
+            # never route into a hot path by default); with the flag off,
+            # dropout attention falls through to the XLA reference path
+            dropout_ok = eff_dropout == 0.0 or _config.get_flag(
+                "FLAGS_flash_dropout_kernel", False)
+            if fa.supports(s_q, s_kv, d) and s_q >= min_seq and dropout_ok:
 
                 def f(q, k, v):
                     if eff_dropout > 0.0:

@@ -1,0 +1,31 @@
+"""Runtime telemetry: metrics registry + exporters + stall flight
+recorder (SURVEY.md §5 "Metrics / logging").
+
+- `metrics` — Counter/Gauge/Histogram cells, labeled families, the
+  process-default registry, Prometheus-text and JSONL exporters.
+- `flight_recorder` — bounded event ring + watchdog thread that turns a
+  silent hang into a thread-stack dump and a `stalls_total` increment.
+
+Exported metric names are documented in README.md ("Observability").
+"""
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    HandleCache,
+    Histogram,
+    Registry,
+    default_registry,
+    set_default_registry,
+    snapshot,
+    to_prometheus,
+    write_jsonl,
+    write_prometheus,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    Watchdog,
+    beat_all,
+    default_recorder,
+    record_event,
+)

@@ -65,7 +65,8 @@ class PerfMeter:
 
     def __init__(self, model_flops_per_token: Optional[float] = None,
                  peak_flops: Optional[float] = None, n_devices: int = 1,
-                 log_every_steps: int = 50):
+                 log_every_steps: int = 50, publish_metrics: bool = True,
+                 registry=None):
         self.flops_per_token = model_flops_per_token
         self.peak_flops = peak_flops or detect_peak_flops()
         self.n_devices = max(n_devices, 1)
@@ -74,25 +75,64 @@ class PerfMeter:
         self._t_window = self._t_start
         self._paused_total = 0.0
         self._pause_t0: Optional[float] = None
+        self._pause_reason: Optional[str] = None
         self._steps = 0
         self._tokens = 0
         self._tokens_window = 0
+        # publish tokens/sec + MFU + goodput as registry gauges and the
+        # pause()/resume() intervals as a by-reason counter (README.md
+        # "Observability"); handles resolve once here
+        self._g_tps = self._g_mfu = self._g_goodput = self._c_paused = None
+        if publish_metrics:
+            from ..observability import metrics as _om
+
+            reg = registry or _om.default_registry()
+            self._g_tps = reg.gauge(
+                "train_tokens_per_sec",
+                "PerfMeter running tokens/sec over productive time.")
+            self._g_mfu = reg.gauge(
+                "train_mfu",
+                "Model-FLOPs utilization; stays at its initial 0 when "
+                "the device peak or per-token FLOPs is unknown (no-data, "
+                "not zero utilization).")
+            self._g_goodput = reg.gauge(
+                "train_goodput",
+                "productive_time / wall_time (pause() intervals "
+                "excluded from productive).")
+            self._c_paused = reg.counter(
+                "train_paused_seconds_total",
+                "Seconds spent in recorded non-productive intervals, by "
+                "pause(reason=...) — checkpoint saves, eval, restarts.",
+                labels=("reason",))
 
     # -- non-productive intervals -------------------------------------
-    def pause(self):
+    def pause(self, reason: str = "checkpoint"):
         if self._pause_t0 is None:
             self._pause_t0 = time.perf_counter()
+            self._pause_reason = reason
 
     def resume(self):
         if self._pause_t0 is not None:
-            self._paused_total += time.perf_counter() - self._pause_t0
+            dt = time.perf_counter() - self._pause_t0
+            self._paused_total += dt
+            if self._c_paused is not None:
+                self._c_paused.labels(
+                    self._pause_reason or "checkpoint").inc(dt)
             self._pause_t0 = None
+            self._pause_reason = None
 
     # -- accounting ----------------------------------------------------
     def step(self, tokens: int = 0):
         self._steps += 1
         self._tokens += tokens
         self._tokens_window += tokens
+        if self._g_tps is not None:
+            tps = self.tokens_per_sec(window=False)
+            self._g_tps.set(tps)
+            self._g_goodput.set(self.goodput)
+            m = self.mfu(tps)
+            if m is not None:
+                self._g_mfu.set(m)
 
     def should_log(self) -> bool:
         return self._steps % self.log_every == 0

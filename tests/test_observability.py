@@ -1,0 +1,517 @@
+"""Unified runtime telemetry: metrics registry, Prometheus/JSONL
+exporters, serving + trainer + collective + dataloader instrumentation,
+and the stall flight-recorder watchdog.
+
+The instrumented subsystems publish into the PROCESS-DEFAULT registry,
+so these tests assert on before/after deltas (values are monotonic);
+registry-shape tests use fresh Registry instances.
+"""
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.observability import metrics as om
+
+
+def _parse_prom(text):
+    """Tiny Prometheus text parser: {(name, sorted-label-items): value}.
+    Raises on any malformed sample line — the golden test doubles as a
+    format validator."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(
+            r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})? (\S+)$', line)
+        assert m is not None, f"unparseable exposition line: {line!r}"
+        name, labels, val = m.groups()
+        lab = tuple(sorted(
+            (k, v) for k, v in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"',
+                                          labels or "")))
+        out[(name, lab)] = float(val.replace("+Inf", "inf"))
+    return out
+
+
+class TestRegistryCells:
+    def test_counter(self):
+        reg = om.Registry()
+        c = reg.counter("c_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # create-or-get: same cell back
+        assert reg.counter("c_total") is c
+
+    def test_gauge_and_callback(self):
+        reg = om.Registry()
+        g = reg.gauge("g", "")
+        g.set(2.0)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 2.5
+        g2 = reg.gauge("g_fn", "")
+        g2.set_function(lambda: 42.0)
+        assert g2.value == 42.0
+
+    def test_histogram_buckets(self):
+        reg = om.Registry()
+        h = reg.histogram("h", "", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count == 4
+        assert abs(h.sum - 55.55) < 1e-9
+        bc = h.bucket_counts()
+        assert bc[0.1] == 1 and bc[1.0] == 2 and bc[10.0] == 3
+        assert bc[float("inf")] == 4
+
+    def test_labeled_family_children_cached(self):
+        reg = om.Registry()
+        fam = reg.counter("ops_total", "", labels=("op",))
+        a0 = reg.allocations
+        fam.labels("x").inc()
+        assert reg.allocations == a0 + 1
+        fam.labels("x").inc(2)          # cached: no new allocation
+        fam.labels(op="y").inc()        # kwargs resolve too
+        assert reg.allocations == a0 + 2
+        assert fam.labels("x").value == 3.0
+        assert fam.labels("y").value == 1.0
+
+    def test_kind_mismatch_raises(self):
+        reg = om.Registry()
+        reg.counter("m", "")
+        with pytest.raises(ValueError):
+            reg.gauge("m", "")
+
+    def test_default_registry_swap(self):
+        fresh = om.Registry()
+        prev = om.set_default_registry(fresh)
+        try:
+            assert om.default_registry() is fresh
+        finally:
+            om.set_default_registry(prev)
+
+
+class TestExporters:
+    def _driven_registry(self):
+        reg = om.Registry()
+        reg.counter("requests_total", "Requests.").inc(3)
+        reg.gauge("depth", "Depth.").set(2.5)
+        h = reg.histogram("lat_seconds", "Latency.", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        fam = reg.counter("calls_total", "Calls.", labels=("op",))
+        fam.labels("psum").inc(2)
+        fam.labels("ppermute").inc()
+        return reg
+
+    def test_prometheus_golden(self):
+        reg = self._driven_registry()
+        text = om.to_prometheus(reg)
+        # HELP/TYPE headers present for every family
+        for name, kind in (("requests_total", "counter"),
+                           ("depth", "gauge"),
+                           ("lat_seconds", "histogram"),
+                           ("calls_total", "counter")):
+            assert f"# TYPE {name} {kind}" in text
+            assert f"# HELP {name} " in text
+        s = _parse_prom(text)
+        assert s[("requests_total", ())] == 3
+        assert s[("depth", ())] == 2.5
+        # histogram: cumulative buckets + +Inf + sum + count
+        assert s[("lat_seconds_bucket", (("le", "0.1"),))] == 1
+        assert s[("lat_seconds_bucket", (("le", "1"),))] == 2
+        assert s[("lat_seconds_bucket", (("le", "+Inf"),))] == 3
+        assert abs(s[("lat_seconds_sum", ())] - 5.55) < 1e-9
+        assert s[("lat_seconds_count", ())] == 3
+        assert s[("calls_total", (("op", "psum"),))] == 2
+        assert s[("calls_total", (("op", "ppermute"),))] == 1
+
+    def test_jsonl_snapshot(self, tmp_path):
+        reg = self._driven_registry()
+        p = tmp_path / "snap.jsonl"
+        om.write_jsonl(str(p), reg)
+        om.write_jsonl(str(p), reg)  # append mode: a scrape history
+        rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+        # 5 samples per snapshot (2 labeled children), appended twice
+        assert len(rows) == 10
+        by_name = {}
+        for r in rows[:5]:
+            assert "ts" in r and "kind" in r
+            by_name.setdefault(r["name"], r)
+        assert by_name["requests_total"]["value"] == 3
+        assert by_name["lat_seconds"]["count"] == 3
+        assert by_name["lat_seconds"]["buckets"]["+Inf"] == 3
+        assert by_name["calls_total"]["labels"]["op"] in ("psum",
+                                                          "ppermute")
+
+    def test_write_prometheus_file(self, tmp_path):
+        p = tmp_path / "m.prom"
+        om.write_prometheus(str(p), self._driven_registry())
+        assert "# TYPE requests_total counter" in p.read_text()
+
+
+def _tiny_engine(**kw):
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, seq=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("page_size", 8)
+    return ServingEngine(m, **kw), cfg
+
+
+class TestServingTelemetry:
+    def test_run_populates_default_registry(self):
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        before = {n: reg.value(n) for n in (
+            "serving_requests_finished_total", "serving_tokens_total",
+            "serving_ttft_seconds", "serving_queue_wait_seconds",
+            "serving_decode_step_seconds",
+            "serving_prefill_bucket_misses_total")}
+        rng = np.random.RandomState(0)
+        n_req, max_new = 2, 5
+        for _ in range(n_req):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                            max_new_tokens=max_new)
+        finished = eng.run()
+        assert len(finished) == n_req
+        generated = sum(len(f.output_ids) for f in finished)
+        d = {n: reg.value(n) - before[n] for n in before}
+        assert d["serving_requests_finished_total"] == n_req
+        assert d["serving_tokens_total"] == generated
+        assert d["serving_ttft_seconds"] == n_req      # histogram count
+        assert d["serving_queue_wait_seconds"] == n_req
+        assert d["serving_decode_step_seconds"] >= 1
+        assert d["serving_prefill_bucket_misses_total"] >= 1
+        assert 0.0 <= reg.value("serving_batch_occupancy") <= 1.0
+        assert 0.0 <= reg.value("serving_page_pool_utilization") <= 1.0
+        # the exposition of the LIVE registry parses and matches
+        s = _parse_prom(om.to_prometheus(reg))
+        assert s[("serving_requests_finished_total", ())] == \
+            reg.value("serving_requests_finished_total")
+        assert s[("serving_tokens_total", ())] == \
+            reg.value("serving_tokens_total")
+        assert s[("serving_ttft_seconds_count", ())] == \
+            reg.value("serving_ttft_seconds")
+
+    def test_prefill_bucket_hits(self):
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(1)
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=2)
+        eng.run()
+        h0 = reg.value("serving_prefill_bucket_hits_total")
+        # same prompt shape => same (nb, bucket) program => a hit
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=2)
+        eng.run()
+        assert reg.value("serving_prefill_bucket_hits_total") == h0 + 1
+
+    def test_preemption_observes_latencies_once_per_request(self):
+        # a preempted request re-enters the pending queue with its
+        # original enqueue time: TTFT and queue-wait must stay one-shot
+        # (re-observing would book decode time as queue/first-token
+        # latency), while serving_preemptions_total records the event
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        before = {n: reg.value(n) for n in (
+            "serving_ttft_seconds", "serving_queue_wait_seconds",
+            "serving_preemptions_total")}
+        rng = np.random.RandomState(11)
+        rid = eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=6)
+        eng.step()  # admit + first token: TTFT observed here
+        # evict the slot (the recompute-preemption policy page
+        # exhaustion takes); the request re-queues with tokens so far
+        eng._preempt(0)
+        out = eng.run()
+        assert len(out) == 1 and out[0].request_id == rid
+        assert len(out[0].output_ids) == 6
+        d = {n: reg.value(n) - before[n] for n in before}
+        assert d["serving_preemptions_total"] == 1
+        assert d["serving_ttft_seconds"] == 1      # NOT re-observed
+        assert d["serving_queue_wait_seconds"] == 1
+
+    def test_abort_counter(self):
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        a0 = reg.value("serving_aborts_total")
+        rid = eng.add_request(np.arange(4), max_new_tokens=4)
+        assert eng.abort(rid)
+        assert reg.value("serving_aborts_total") == a0 + 1
+
+    def test_decode_loop_allocation_overhead(self):
+        # the acceptance guard: a warm decode loop costs <= 2 registry
+        # allocations per step (labels resolved once at engine build —
+        # in steady state it is actually ZERO)
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(2)
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=6)
+        eng.run()  # warm: compiles + resolves every metric child
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=6)
+        a0 = reg.allocations
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        assert steps >= 2
+        delta = reg.allocations - a0
+        assert delta <= 2 * steps, (
+            f"decode loop allocated {delta} registry objects over "
+            f"{steps} steps (> 2/step): per-step label/dict churn")
+        assert delta == 0  # the real steady state
+
+    def test_poisoned_engine_fails_fast(self):
+        reg = om.default_registry()
+        eng, cfg = _tiny_engine()
+        eng.add_request(np.arange(4), max_new_tokens=4)
+
+        def boom(all_greedy):
+            def fn(params, buffers, k_pages, v_pages, *a, **k):
+                # simulate a failure AFTER donation: the compiled call
+                # consumed (deleted) its donated page arguments
+                for p in list(k_pages) + list(v_pages):
+                    p.delete()
+                raise RuntimeError("simulated mid-call failure")
+            return fn
+
+        eng._get_decode_fn = boom
+        with pytest.raises(RuntimeError, match="simulated"):
+            eng.step()
+        assert eng._poisoned
+        assert reg.value("serving_engine_poisoned") == 1.0
+        # subsequent calls fail fast with the clear poisoned error, NOT
+        # a deleted-buffer crash
+        with pytest.raises(RuntimeError, match="poisoned"):
+            eng.step()
+        with pytest.raises(RuntimeError, match="poisoned"):
+            eng.run()
+
+    def test_pre_donation_failure_does_not_poison(self):
+        # a trace/compile/argument failure BEFORE donation leaves the
+        # page pools intact — the engine must stay usable
+        eng, cfg = _tiny_engine()
+        eng.add_request(np.arange(4), max_new_tokens=4)
+        real = eng._get_decode_fn
+
+        def boom_once(all_greedy):
+            eng._get_decode_fn = real  # next step uses the real program
+
+            def fn(*a, **k):
+                raise RuntimeError("pre-donation failure")
+            return fn
+
+        eng._get_decode_fn = boom_once
+        with pytest.raises(RuntimeError, match="pre-donation"):
+            eng.step()
+        assert not eng._poisoned
+        finished = eng.run()  # retry on the SAME engine succeeds
+        assert len(finished) == 1
+
+
+class TestTrainTelemetry:
+    def test_train_loop_populates_default_registry(self):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       build_train_step)
+
+        reg = om.default_registry()
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               seq=32)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=m.parameters())
+        step = build_train_step(m, opt)
+        b, s = 2, 16
+        x = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (b, s)))
+        y = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (b, s)))
+        before = {n: reg.value(n) for n in (
+            "train_steps_total", "train_tokens_total",
+            "train_step_seconds", "train_data_wait_seconds")}
+        n_steps = 3
+        for _ in range(n_steps):
+            loss = step(x, y)
+        assert np.isfinite(float(loss))
+        d = {n: reg.value(n) - before[n] for n in before}
+        assert d["train_steps_total"] == n_steps
+        assert d["train_tokens_total"] == n_steps * b * s
+        assert d["train_step_seconds"] == n_steps
+        # data-wait is the gap BETWEEN steps: n-1 observations
+        assert d["train_data_wait_seconds"] == n_steps - 1
+        s_ = _parse_prom(om.to_prometheus(reg))
+        assert s_[("train_steps_total", ())] == \
+            reg.value("train_steps_total")
+        assert s_[("train_step_seconds_count", ())] == \
+            reg.value("train_step_seconds")
+
+
+class TestCollectiveTelemetry:
+    def test_all_reduce_counts_calls_and_bytes(self):
+        import paddle_tpu.distributed.collective as coll
+
+        reg = om.default_registry()
+        t = paddle.to_tensor(np.ones((8, 4), np.float32))
+        coll.all_reduce(t)
+        c0 = reg.value("collective_calls_total", op="all_reduce")
+        b0 = reg.value("collective_bytes_total", op="all_reduce")
+        coll.all_reduce(t)
+        assert reg.value("collective_calls_total", op="all_reduce") == \
+            c0 + 1
+        assert reg.value("collective_bytes_total", op="all_reduce") == \
+            b0 + 8 * 4 * 4
+
+    def test_handles_reresolve_after_registry_swap_and_reset(self):
+        # library-internal handle caches must notice both a swapped and
+        # a reset default registry instead of feeding detached cells
+        import paddle_tpu.distributed.collective as coll
+
+        fresh = om.Registry()
+        prev = om.set_default_registry(fresh)
+        try:
+            t = paddle.to_tensor(np.ones((2,), np.float32))
+            coll.all_reduce(t)
+            assert fresh.value("collective_calls_total",
+                               op="all_reduce") == 1
+            fresh.reset()
+            coll.all_reduce(t)
+            assert fresh.value("collective_calls_total",
+                               op="all_reduce") == 1
+        finally:
+            om.set_default_registry(prev)
+
+    def test_barrier_counts(self):
+        import paddle_tpu.distributed.collective as coll
+
+        reg = om.default_registry()
+        coll.barrier()
+        c0 = reg.value("collective_calls_total", op="barrier")
+        coll.barrier()
+        assert reg.value("collective_calls_total", op="barrier") == c0 + 1
+
+
+class TestDataloaderTelemetry:
+    def test_loader_counts_batches_and_fetch_latency(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class _DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32)
+
+        from paddle_tpu.io.dataloader import _loader_metrics
+
+        _loader_metrics()  # handles are lazy; resolve before baselining
+        reg = om.default_registry()
+        b0 = reg.value("dataloader_batches_total")
+        f0 = reg.value("dataloader_fetch_seconds")
+        loader = DataLoader(_DS(), batch_size=2)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert reg.value("dataloader_batches_total") == b0 + 4
+        assert reg.value("dataloader_fetch_seconds") == f0 + 4
+
+    def test_threaded_loader_queue_depth_gauge(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class _DS(Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        from paddle_tpu.io.dataloader import _loader_metrics
+
+        _loader_metrics()
+        reg = om.default_registry()
+        b0 = reg.value("dataloader_batches_total")
+        loader = DataLoader(_DS(), batch_size=2, num_workers=1)
+        assert len(list(loader)) == 3
+        assert reg.value("dataloader_batches_total") == b0 + 3
+        assert reg.value("dataloader_queue_depth") >= 0
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_tail(self):
+        rec = fr.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("ev", i=i)
+        assert len(rec) == 4
+        tail = rec.tail(2)
+        assert [f["i"] for _, _, f in tail] == [8, 9]
+
+    def test_watchdog_stall_dump(self, tmp_path):
+        # a simulated stalled serving loop: events flow in, then no step
+        # completes (no beat) past the deadline
+        reg = om.Registry()
+        rec = fr.FlightRecorder(capacity=32)
+        for i in range(5):
+            rec.record("serving.step", active=2, tokens=2, i=i)
+        wd = fr.Watchdog(deadline=0.15, dump_dir=str(tmp_path),
+                         recorder=rec, registry=reg, name="test",
+                         tail_events=4, poll_interval=0.02)
+        wd.start()
+        try:
+            time.sleep(0.6)  # several deadlines pass with no beat
+            # stalls_total incremented EXACTLY once per stall
+            assert reg.value("stalls_total") == 1
+            assert len(wd.dumps) == 1
+            txt = open(wd.dumps[0]).read()
+            # thread stacks: every live thread, incl. the main one
+            assert "python thread stacks" in txt
+            assert "MainThread" in txt
+            assert "test_observability.py" in txt  # a real stack frame
+            # the trailing event ring (tail_events=4 of the 5 recorded)
+            assert txt.count("serving.step") >= 4
+            assert "'i': 4" in txt and "'i': 0" not in txt
+            # a beat re-arms; a second stall is a SECOND increment
+            wd.beat()
+            time.sleep(0.4)
+            assert reg.value("stalls_total") == 2
+            assert len(wd.dumps) == 2
+        finally:
+            wd.stop()
+
+    def test_serving_steps_beat_watchdogs(self):
+        reg = om.Registry()
+        wd = fr.Watchdog(deadline=60.0, registry=reg)
+        wd.start()
+        try:
+            t0 = wd._last_beat
+            time.sleep(0.01)
+            eng, cfg = _tiny_engine()
+            eng.add_request(np.arange(4), max_new_tokens=3)
+            eng.run()
+            assert wd._last_beat > t0  # steps fed the watchdog
+            assert reg.value("stalls_total") == 0
+        finally:
+            wd.stop()
+
+    def test_no_stall_when_beating(self, tmp_path):
+        reg = om.Registry()
+        wd = fr.Watchdog(deadline=0.2, dump_dir=str(tmp_path),
+                         registry=reg, poll_interval=0.02)
+        wd.start()
+        try:
+            for _ in range(10):
+                time.sleep(0.05)
+                wd.beat()
+            assert reg.value("stalls_total") == 0
+            assert wd.dumps == []
+        finally:
+            wd.stop()

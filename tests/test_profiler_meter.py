@@ -1,0 +1,136 @@
+"""Profiler edge cases (round-6 satellite): PerfMeter pause()/resume()
+goodput accounting, mfu() None-ness on unrecognized devices, and
+make_scheduler window boundaries."""
+import time
+
+import pytest
+
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.profiler import (
+    PerfMeter,
+    detect_peak_flops,
+    transformer_flops_per_token,
+)
+from paddle_tpu.profiler import (
+    ProfilerState,
+    make_scheduler,
+)
+
+
+class TestPerfMeterGoodput:
+    def test_pause_resume_excludes_interval(self):
+        meter = PerfMeter(publish_metrics=False)
+        meter.step(tokens=10)
+        time.sleep(0.03)
+        meter.pause()
+        time.sleep(0.12)
+        meter.resume()
+        time.sleep(0.03)
+        paused = meter.wall_time - meter.productive_time
+        assert 0.10 <= paused <= 0.5   # the slept pause, not the work
+        assert meter.goodput < 1.0
+        # goodput re-reads the live clock; compare loosely
+        assert meter.goodput == pytest.approx(
+            meter.productive_time / meter.wall_time, rel=0.05)
+
+    def test_open_pause_counts_in_productive_time_exclusion(self):
+        meter = PerfMeter(publish_metrics=False)
+        meter.pause()
+        time.sleep(0.05)
+        # still paused: the OPEN interval must already be excluded
+        assert meter.wall_time - meter.productive_time >= 0.04
+        meter.resume()
+
+    def test_double_pause_and_resume_are_idempotent(self):
+        meter = PerfMeter(publish_metrics=False)
+        meter.pause()
+        t0 = meter._pause_t0
+        meter.pause()              # no-op: keeps the original start
+        assert meter._pause_t0 == t0
+        meter.resume()
+        paused = meter._paused_total
+        meter.resume()             # no-op: nothing accrues
+        assert meter._paused_total == paused
+
+    def test_pause_reason_counter_published(self):
+        reg = om.Registry()
+        meter = PerfMeter(publish_metrics=True, registry=reg)
+        meter.pause(reason="eval")
+        time.sleep(0.02)
+        meter.resume()
+        meter.pause()              # default reason: checkpoint
+        meter.resume()
+        assert reg.value("train_paused_seconds_total",
+                         reason="eval") >= 0.02
+        assert reg.value("train_paused_seconds_total",
+                         reason="checkpoint") >= 0.0
+        meter.step(tokens=100)
+        # gauges exist after a step
+        assert reg.value("train_tokens_per_sec") > 0
+        assert 0.0 < reg.value("train_goodput") <= 1.0
+
+
+class TestPerfMeterMfu:
+    def test_mfu_none_on_unrecognized_device(self):
+        # CPU test backend: detect_peak_flops finds no TPU generation
+        assert detect_peak_flops() is None
+        meter = PerfMeter(model_flops_per_token=6 * 1_000_000,
+                          publish_metrics=False)
+        meter.step(tokens=100)
+        assert meter.peak_flops is None
+        assert meter.mfu() is None
+        assert "mfu" not in meter.summary()
+
+    def test_mfu_none_without_flops_per_token(self):
+        meter = PerfMeter(peak_flops=197e12, publish_metrics=False)
+        meter.step(tokens=100)
+        assert meter.mfu() is None
+
+    def test_mfu_computed_with_both_known(self):
+        meter = PerfMeter(model_flops_per_token=2.0, peak_flops=10.0,
+                          n_devices=2, publish_metrics=False)
+        assert meter.mfu(tokens_per_sec=5.0) == pytest.approx(
+            (5.0 * 2.0) / (10.0 * 2))
+
+    def test_transformer_flops_accounting(self):
+        # 6N matmul term + 12*s*h*L attention term
+        assert transformer_flops_per_token(
+            n_params=100, seq_len=8, hidden=4, layers=2) == \
+            6 * 100 + 12 * 8 * 4 * 2
+
+
+class TestMakeSchedulerBoundaries:
+    def test_window_states_and_skip_first(self):
+        sched = make_scheduler(closed=2, ready=1, record=2, skip_first=1)
+        # step 0: inside skip_first
+        assert sched(0) == ProfilerState.CLOSED
+        # s = step-1: 0,1 closed; 2 ready; 3 record; 4 = period-1
+        assert sched(1) == ProfilerState.CLOSED
+        assert sched(2) == ProfilerState.CLOSED
+        assert sched(3) == ProfilerState.READY
+        assert sched(4) == ProfilerState.RECORD
+        assert sched(5) == ProfilerState.RECORD_AND_RETURN
+        # wraps into the next window
+        assert sched(6) == ProfilerState.CLOSED
+
+    def test_record_and_return_is_last_slot_only(self):
+        sched = make_scheduler(closed=0, ready=0, record=3)
+        assert sched(0) == ProfilerState.RECORD
+        assert sched(1) == ProfilerState.RECORD
+        assert sched(2) == ProfilerState.RECORD_AND_RETURN
+
+    def test_repeat_closes_after_n_periods(self):
+        sched = make_scheduler(closed=1, ready=1, record=1, repeat=2)
+        period = 3
+        states = [sched(s) for s in range(2 * period)]
+        assert states[period - 1] == ProfilerState.RECORD_AND_RETURN
+        assert states[2 * period - 1] == ProfilerState.RECORD_AND_RETURN
+        # every step from repeat*period on is CLOSED forever
+        for s in range(2 * period, 2 * period + 5):
+            assert sched(s) == ProfilerState.CLOSED
+
+    def test_ready_only_boundary(self):
+        sched = make_scheduler(closed=0, ready=2, record=1)
+        assert sched(0) == ProfilerState.READY
+        assert sched(1) == ProfilerState.READY
+        assert sched(2) == ProfilerState.RECORD_AND_RETURN

@@ -48,9 +48,19 @@ fi
 
 JAX_PLATFORMS=cpu python -m pytest tests/ "${ARGS[@]}"
 rc=$?
+
+# observability gate: the serving smoke must run AND report — emits the
+# machine-readable metrics snapshot (/tmp/ci_metrics.prom) as a CI
+# artifact (the observability tests themselves run in the suite above)
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/serving_metrics_snapshot.py --out /tmp/ci_metrics.prom; then
+  echo "CI: serving metrics snapshot FAILED" >&2
+  rc=1
+fi
+
 if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
-  echo "CI GREEN (mode=$MODE)"
+  echo "CI GREEN (mode=$MODE) — metrics artifact: /tmp/ci_metrics.prom"
 fi
 exit $rc
