@@ -93,6 +93,31 @@ class DistributedStrategy:
             "unused params get zero grads without graph walking; the "
             "torch-DDP-style bucket rebuild has no analog here")
 
+    @property
+    def asp(self):
+        return False
+
+    @asp.setter
+    def asp(self, value):
+        self._reject_toggle(
+            "asp", value,
+            "2:4 automatic sparsity is an Ampere sparse-tensor-core "
+            "feature; the TPU MXU has no structured-sparsity mode, so "
+            "the pass could only cost accuracy without the speedup")
+
+    @property
+    def fp16_allreduce(self):
+        return False
+
+    @fp16_allreduce.setter
+    def fp16_allreduce(self, value):
+        self._reject_toggle(
+            "fp16_allreduce", value,
+            "the grad-cast rewrite is subsumed: with amp O2 the grads "
+            "are ALREADY bf16 end to end inside the jit step, and XLA "
+            "fuses any cast into the psum — there is no fp32 wire "
+            "format to compress")
+
     def __repr__(self):
         keys = ("hybrid_configs", "amp", "recompute", "sharding", "pipeline")
         return "DistributedStrategy(" + ", ".join(

@@ -100,6 +100,21 @@ define_flag("FLAGS_flash_dropout_kernel", False,
             "validated kernels into a hot path by default. Off: dropout "
             "attention takes the XLA reference path; dropout-free "
             "attention still uses the flash kernel.")
+define_flag("FLAGS_autotune", "off",
+            "Measured-dispatch autotuner for the Pallas kernels "
+            "(kernels/autotune.py): 'off' (default) keeps the legacy "
+            "hand-set flag dispatch bit-identical; 'on' times XLA vs the "
+            "Pallas block-size grid per (op, shape-bucket, dtype, "
+            "device-kind) on first call and caches the winner in "
+            "~/.cache/paddle_tpu/autotune_<device>.json; 'readonly' uses "
+            "cached winners but never re-times (serving hot paths must "
+            "not absorb measurement jitter). Explicit flags "
+            "(FLAGS_flash_*_min_seq, FLAGS_paged_xla_max_ctx) override "
+            "the tuner when set non-zero.")
+define_flag("FLAGS_autotune_cache_dir", "",
+            "Override directory for the autotune cache tables (empty: "
+            "~/.cache/paddle_tpu). CI points this at a temp dir so smoke "
+            "runs never touch the user cache.")
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

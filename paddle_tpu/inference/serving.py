@@ -424,6 +424,11 @@ class ServingEngine:
                 f"the compile in-traffic. Use a shorter prompt_len (<= "
                 f"{self.max_seq_len - max_new}) or a smaller decode_burst.")
         max_new = max(2, min(max_new, self.max_seq_len - plen))
+        # measured-dispatch warm: with FLAGS_autotune=on the decode
+        # bucket's candidate timing runs HERE, not under traffic (and in
+        # readonly mode this is a pure cache lookup / no-op). The tuned
+        # winner is then baked into the compiled decode program below.
+        self._autotune_decode_bucket()
         budgets = [max_new] + ([2] if self.decode_burst > 1 and
                                max_new > 2 else [])
         strategies = ["greedy_search"] + (["sampling"] if sampling else [])
@@ -438,6 +443,36 @@ class ServingEngine:
                                  decode_strategy=strategy, eos_token_id=-1)
                 self.run()
         return _time.perf_counter() - t0
+
+    def _autotune_decode_bucket(self):
+        """Resolve the paged-decode autotune winner for THIS engine's
+        exact cache geometry (kv heads, page size, pages/seq, dtype,
+        quant) ahead of traffic. No-op unless FLAGS_autotune is on (or
+        readonly with a warm cache); never raises — a tuner failure must
+        not take warmup down with it."""
+        try:
+            from ..kernels import autotune as _at
+
+            if not _at.enabled():
+                return
+            kvh, _n, page, hd = self.k_pages[0].shape
+            qh = self.cfg.num_attention_heads
+            # under TP the decode dispatch runs INSIDE a shard_map with
+            # per-shard head counts (models/paged_step.py shards q and
+            # the pools over 'tp') — pre-tune the bucket the real
+            # dispatch will actually look up, not the full-head one
+            tp = 1
+            if self.mesh is not None and "tp" in self.mesh.axis_names:
+                tp = int(self.mesh.shape["tp"])
+            if tp > 1 and kvh % tp == 0:
+                qh //= tp
+                kvh //= tp
+            _at.choose_paged_decode(
+                self.max_batch, qh, kvh, hd, page, self.pages_per_seq,
+                jnp.dtype(self.kv_dtype).name,
+                self.kv_cache_quant == "int8")
+        except Exception:  # noqa: BLE001
+            pass
 
     def _req_eos(self, rid):
         rp = self._req_params.get(rid)

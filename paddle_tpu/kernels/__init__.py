@@ -2,3 +2,21 @@
 "Phi fusion kernels", §7 phase 9): flash attention, fused rope, rmsnorm,
 ring attention, paged-KV decode. Kernels fall back to interpret mode on CPU
 so the same tests run in CI without a TPU."""
+import jax as _jax
+
+try:
+    # some jax versions alias the context manager at the top level
+    _enable_x64 = _jax.enable_x64
+except AttributeError:
+    # jax 0.4.37 here only ships it under experimental; without this the
+    # kernels' `with x64_off():` regions raised AttributeError and every
+    # guarded call site silently fell back to XLA — the Pallas library
+    # was dead code on this jax until ISSUE 2
+    from jax.experimental import enable_x64 as _enable_x64
+
+
+def x64_off():
+    """Context manager running its body with jax x64 disabled (pallas
+    index maps / kernel constants must stay 32-bit; the package enables
+    x64 globally for paddle int64 semantics)."""
+    return _enable_x64(False)

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import x64_off as _x64_off
+
 # pallas_call runs under x64-off so index maps / constants stay 32-bit
 # (the package enables jax x64 globally for paddle int64 semantics)
 _pc = pl.pallas_call
@@ -156,7 +158,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             # NEG_INF is finite: a fully-masked row has s == m_new == NEG_INF
             # and exp(0) == 1 everywhere — zero p by the mask itself so l
             # stays 0 and the epilogue's safe_l emits a zero output row
-            p = jnp.where(mask, p, 0.0)
+            p = jnp.where(mask, p, np.float32(0.0))
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         p_v = p
@@ -166,7 +168,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             # exactly softmax followed by inverted dropout
             keep = _dropout_keep(seed_ref[0], bh, i, j,
                                  block_q, block_k, dropout)
-            p_v = jnp.where(keep, p, 0.0) * np.float32(1.0 / (1.0 - dropout))
+            p_v = jnp.where(keep, p, np.float32(0.0)) * np.float32(
+                1.0 / (1.0 - dropout))
         pv = jax.lax.dot_general(
             p_v, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -177,7 +180,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
     @pl.when(j == n_kv - 1)
     def _():
         l = l_scr[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == 0.0, np.float32(1.0), l)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
         lse_row = (m_scr[:, :1] + jnp.log(safe_l))[:, 0]
         # (8, block_q) sublane-replicated layout satisfies TPU tiling
@@ -242,7 +245,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None,
     if drop:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(_seed_arg(seed))
-    with jax.enable_x64(False):
+    with _x64_off():
         out, lse = _pc(
         kernel,
         grid=(bh, n_q, n_kv),
@@ -308,12 +311,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(cmask, s, NEG_INF)
         p = jnp.exp(s - lse)
         if causal:
-            p = jnp.where(cmask, p, 0.0)
+            p = jnp.where(cmask, p, np.float32(0.0))
         if seg_q_ref is not None:
             seg_m = seg_q_ref[0, 0][:, None] == seg_k_ref[0, 0][None, :]
             # mask p (not just s): fully-masked rows have lse == NEG_INF and
             # exp(s - lse) == 1, which would leak garbage into dk/dv
-            p = jnp.where(seg_m, p, 0.0)
+            p = jnp.where(seg_m, p, np.float32(0.0))
         # regenerate the forward's dropout tile: dv sees the DROPPED
         # normalized weights; the softmax-grad dot product folds into the
         # SAME delta = rowsum(do*o), so only dp gets masked in ds
@@ -323,7 +326,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             keep = _dropout_keep(seed_ref[0], bh, i, j,
                                  block_q, block_k, dropout)
             inv = np.float32(1.0 / (1.0 - dropout))
-            p_d = jnp.where(keep, p, 0.0) * inv
+            p_d = jnp.where(keep, p, np.float32(0.0)) * inv
             dp_mask = (keep, inv)
         # dv += p^T do
         dv_acc[:] += jax.lax.dot_general(
@@ -334,7 +337,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dp_mask is not None:
-            dp = jnp.where(dp_mask[0], dp, 0.0) * dp_mask[1]
+            dp = jnp.where(dp_mask[0], dp, np.float32(0.0)) * dp_mask[1]
         ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -382,17 +385,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(cmask, s, NEG_INF)
         p = jnp.exp(s - lse)
         if causal:
-            p = jnp.where(cmask, p, 0.0)
+            p = jnp.where(cmask, p, np.float32(0.0))
         if seg_q_ref is not None:
             seg_m = seg_q_ref[0, 0][:, None] == seg_k_ref[0, 0][None, :]
-            p = jnp.where(seg_m, p, 0.0)
+            p = jnp.where(seg_m, p, np.float32(0.0))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout:
             keep = _dropout_keep(seed_ref[0], bh, i, j,
                                  block_q, block_k, dropout)
-            dp = jnp.where(keep, dp, 0.0) * np.float32(1.0 / (1.0 - dropout))
+            dp = jnp.where(keep, dp, np.float32(0.0)) * np.float32(
+                1.0 / (1.0 - dropout))
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -448,14 +452,13 @@ def _bwd_dq_kernel_seg_drop(q_ref, k_ref, v_ref, do_ref, lse_ref,
                    seed_ref=seed_ref, **params)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
-               seg_k=None, heads=1, d_lse=None, dropout=0.0, seed=None):
+def _bwd_delta(res, g, d_lse=None):
+    """Shared backward prologue: delta = rowsum(do*o) (with the lse
+    cotangent folded in) plus the sublane-replicated lse/delta layouts
+    both passes stream."""
     q, k, v, out, lse = res
     do = g
-    bh, s_q, d = q.shape
-    s_kv = k.shape[1]
-    n_q = s_q // block_q
-    n_kv = s_kv // block_k
+    bh, s_q, _ = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [bh, s_q]
     if d_lse is not None:
@@ -464,7 +467,19 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
         delta = delta - d_lse.astype(jnp.float32)
     lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, s_q))
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
+    return do, lse8, delta8
 
+
+def _run_dkv_pass(q, k, v, do, lse8, delta8, scale, causal, block_q,
+                  block_k, seg_q=None, seg_k=None, heads=1, dropout=0.0,
+                  seed=None):
+    """dkv backward pass: grid parallel over k blocks (contraction over q
+    blocks innermost, accumulators in VMEM scratch) with its OWN
+    block_q/block_k choice, independent of the dq pass."""
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    n_q = s_q // block_q
+    n_kv = s_kv // block_k
     seg = seg_q is not None
     drop = dropout > 0.0
     dkv_params = dict(scale=scale, causal=causal, block_q=block_q,
@@ -494,7 +509,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
     if drop:
         dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dkv_args.append(_seed_arg(seed))
-    with jax.enable_x64(False):
+    with _x64_off():
         dk, dv = _pc(
         dkv_kernel,
         grid=(bh, n_kv, n_q),
@@ -513,7 +528,21 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
         ],
         interpret=_interpret(),
     )(*dkv_args)
+    return dk, dv
 
+
+def _run_dq_pass(q, k, v, do, lse8, delta8, scale, causal, block_q,
+                 block_k, seg_q=None, seg_k=None, heads=1, dropout=0.0,
+                 seed=None):
+    """dq backward pass: grid parallel over q blocks (contraction over k
+    blocks innermost) with its OWN block_q/block_k choice."""
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    n_q = s_q // block_q
+    n_kv = s_kv // block_k
+    seg = seg_q is not None
+    drop = dropout > 0.0
+    h_ = heads
     dq_params = dict(scale=scale, causal=causal, block_q=block_q,
                      block_k=block_k, n_kv=n_kv, offset=s_kv - s_q,
                      dropout=float(dropout))
@@ -540,7 +569,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
     if drop:
         dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dq_args.append(_seed_arg(seed))
-    with jax.enable_x64(False):
+    with _x64_off():
         dq = _pc(
         dq_kernel,
         grid=(bh, n_q, n_kv),
@@ -550,7 +579,61 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*dq_args)
+    return dq
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, seg_q=None,
+               seg_k=None, heads=1, d_lse=None, dropout=0.0, seed=None):
+    """Legacy fused backward: both passes share one block_q/block_k
+    choice (the pre-autotune behavior, bit-identical under
+    FLAGS_autotune=off)."""
+    do, lse8, delta8 = _bwd_delta(res, g, d_lse)
+    q, k, v = res[0], res[1], res[2]
+    dk, dv = _run_dkv_pass(q, k, v, do, lse8, delta8, scale, causal,
+                           block_q, block_k, seg_q=seg_q, seg_k=seg_k,
+                           heads=heads, dropout=dropout, seed=seed)
+    dq = _run_dq_pass(q, k, v, do, lse8, delta8, scale, causal, block_q,
+                      block_k, seg_q=seg_q, seg_k=seg_k, heads=heads,
+                      dropout=dropout, seed=seed)
     return dq, dk, dv
+
+
+def _flash_bwd_split(res, g, scale, causal, dq_blocks=(DEFAULT_BLOCK_Q,
+                                                       DEFAULT_BLOCK_K),
+                     dkv_blocks=(DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K),
+                     seg_q=None, seg_k=None, heads=1, d_lse=None,
+                     dropout=0.0, seed=None):
+    """Split backward: the dq and dkv passes run with INDEPENDENT
+    grid/block choices so each gets MXU-friendly tiling instead of one
+    compromise (ISSUE 2 tentpole). Dropout regenerates the forward's
+    threefry mask from GLOBAL (q, k) coordinates, so the mask is
+    bit-identical regardless of either pass's block choice."""
+    do, lse8, delta8 = _bwd_delta(res, g, d_lse)
+    q, k, v = res[0], res[1], res[2]
+    dk, dv = _run_dkv_pass(q, k, v, do, lse8, delta8, scale, causal,
+                           dkv_blocks[0], dkv_blocks[1], seg_q=seg_q,
+                           seg_k=seg_k, heads=heads, dropout=dropout,
+                           seed=seed)
+    dq = _run_dq_pass(q, k, v, do, lse8, delta8, scale, causal,
+                      dq_blocks[0], dq_blocks[1], seg_q=seg_q,
+                      seg_k=seg_k, heads=heads, dropout=dropout,
+                      seed=seed)
+    return dq, dk, dv
+
+
+def _flash_bwd_dq(res, g, scale, causal, block_q, block_k):
+    """Standalone dq pass (autotune candidate: the tuner times each pass
+    in isolation to pick its blocks)."""
+    do, lse8, delta8 = _bwd_delta(res, g)
+    return _run_dq_pass(res[0], res[1], res[2], do, lse8, delta8, scale,
+                        causal, block_q, block_k)
+
+
+def _flash_bwd_dkv(res, g, scale, causal, block_q, block_k):
+    """Standalone dkv pass (autotune candidate)."""
+    do, lse8, delta8 = _bwd_delta(res, g)
+    return _run_dkv_pass(res[0], res[1], res[2], do, lse8, delta8, scale,
+                         causal, block_q, block_k)
 
 
 # ---------------------------------------------------------------------------
@@ -587,6 +670,49 @@ def _bwd_use_xla(s_q):
     return s_q < thr
 
 
+def _xla_ref_fwd(q_, k_, v_, scale, causal, seg_q=None, seg_k=None,
+                 heads=1):
+    """Dense XLA reference forward over [bh, s, d]: (out, lse). Serves
+    the recompute backward's vjp AND the autotuner's XLA forward
+    candidate."""
+    s_ = jax.lax.dot_general(
+        q_, k_, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * np.float32(scale)
+    mask = None
+    if causal:
+        sq, sk = s_.shape[-2], s_.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    if seg_q is not None:
+        # [b, 8, s] -> per-(b*h) rows via repeat on the batch dim
+        sq = jnp.repeat(seg_q[:, 0, :], heads, axis=0)
+        sk = jnp.repeat(seg_k[:, 0, :], heads, axis=0)
+        seg_m = sq[:, :, None] == sk[:, None, :]
+        mask = seg_m if mask is None else (mask & seg_m)
+    if mask is not None:
+        s_ = jnp.where(mask, s_, NEG_INF)
+    lse_ = jax.scipy.special.logsumexp(s_, axis=-1)
+    p = jnp.exp(s_ - lse_[..., None]).astype(q_.dtype)
+    if mask is not None:
+        # NEG_INF is finite: a fully-masked row's p is uniform (not
+        # NaN) — zero it by the mask so those rows emit 0
+        p = jnp.where(mask, p, np.float32(0.0)).astype(q_.dtype)
+    o_ = jax.lax.dot_general(
+        p, v_, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(q_.dtype)
+    return o_, lse_
+
+
+def _xla_sdpa_bhsd(q, k, v, scale, causal):
+    """Forward-only XLA reference (autotune candidate)."""
+    return _xla_ref_fwd(q, k, v, scale, causal)[0]
+
+
+def _flash_call(q, k, v, scale, causal, block_q, block_k):
+    """Differentiable flash entry at explicit blocks (autotune
+    candidate — timing its grad exercises the real custom-vjp path)."""
+    return _flash_bhsd(q, k, v, scale, causal, block_q, block_k)
+
+
 def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
                  d_lse=None):
     """XLA-fused backward via recompute: at short sequence the O(s^2)
@@ -598,31 +724,8 @@ def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
     q, k, v, _, _ = res
 
     def ref(q_, k_, v_):
-        s_ = jax.lax.dot_general(
-            q_, k_, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * np.float32(scale)
-        mask = None
-        if causal:
-            sq, sk = s_.shape[-2], s_.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        if seg_q is not None:
-            # [b, 8, s] -> per-(b*h) rows via repeat on the batch dim
-            sq = jnp.repeat(seg_q[:, 0, :], heads, axis=0)
-            sk = jnp.repeat(seg_k[:, 0, :], heads, axis=0)
-            seg_m = sq[:, :, None] == sk[:, None, :]
-            mask = seg_m if mask is None else (mask & seg_m)
-        if mask is not None:
-            s_ = jnp.where(mask, s_, NEG_INF)
-        lse_ = jax.scipy.special.logsumexp(s_, axis=-1)
-        p = jnp.exp(s_ - lse_[..., None]).astype(q_.dtype)
-        if mask is not None:
-            # NEG_INF is finite: a fully-masked row's p is uniform (not
-            # NaN) — zero it by the mask so those rows emit 0
-            p = jnp.where(mask, p, 0.0).astype(q_.dtype)
-        o_ = jax.lax.dot_general(
-            p, v_, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32).astype(q_.dtype)
-        return o_, lse_
+        return _xla_ref_fwd(q_, k_, v_, scale, causal, seg_q=seg_q,
+                            seg_k=seg_k, heads=heads)
 
     _, vjp = jax.vjp(ref, q, k, v)
     if d_lse is None:
@@ -630,11 +733,52 @@ def _xla_ref_bwd(res, g, scale, causal, seg_q=None, seg_k=None, heads=1,
     return vjp((g, d_lse.astype(jnp.float32)))
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
-    s_q = res[0].shape[1]
+def _dispatch_bwd(res, g, scale, causal, block_q, block_k, d_lse=None):
+    """Backward dispatch for the plain (non-seg, non-dropout) path.
+
+    Precedence: explicit flag override (FLAGS_flash_bwd_min_seq != 0)
+    beats everything; then, with FLAGS_autotune on/readonly, the measured
+    winner for this shape bucket (XLA vjp / fused pair / split dq+dkv at
+    per-pass tuned blocks); FLAGS_autotune=off is bit-identical to the
+    legacy threshold dispatch."""
+    from ..framework import config as _config
+
+    q = res[0]
+    s_q, s_kv, d = q.shape[1], res[1].shape[1], q.shape[2]
+    flag_override = bool(_config.get_flag("FLAGS_flash_bwd_min_seq", 0))
+    if not flag_override:
+        from . import autotune as _at
+
+        if _at.enabled():
+            try:
+                # a tuner failure (e.g. OOM allocating bucket-shaped
+                # example arrays) must degrade to legacy dispatch, not
+                # crash the train step's backward
+                win = _at.choose_flash_bwd(q.shape[0], s_q, s_kv, d,
+                                           jnp.dtype(q.dtype).name,
+                                           scale, causal, block_q,
+                                           block_k)
+            except Exception:  # noqa: BLE001
+                win = None
+            if win is not None:
+                impl = win.meta["impl"]
+                if impl == "xla":
+                    return _xla_ref_bwd(res, g, scale, causal,
+                                        d_lse=d_lse)
+                if impl == "split":
+                    return _flash_bwd_split(
+                        res, g, scale, causal, dq_blocks=win.meta["dq"],
+                        dkv_blocks=win.meta["dkv"], d_lse=d_lse)
+                return _flash_bwd(res, g, scale, causal, block_q,
+                                  block_k, d_lse=d_lse)
     if _bwd_use_xla(s_q):
-        return _xla_ref_bwd(res, g, scale, causal)
-    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+        return _xla_ref_bwd(res, g, scale, causal, d_lse=d_lse)
+    return _flash_bwd(res, g, scale, causal, block_q, block_k,
+                      d_lse=d_lse)
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, g):
+    return _dispatch_bwd(res, g, scale, causal, block_q, block_k)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -749,12 +893,8 @@ def _flash_bhsd_lse_fwd(q, k, v, scale, causal, block_q, block_k):
 def _flash_bhsd_lse_bwd(scale, causal, block_q, block_k, res, g):
     g_out, g_lse = g
     q, k, v, out, lse = res
-    s_q = q.shape[1]
-    if _bwd_use_xla(s_q):
-        return _xla_ref_bwd((q, k, v, out, lse), g_out, scale, causal,
-                            d_lse=g_lse)
-    return _flash_bwd((q, k, v, out, lse), g_out, scale, causal, block_q,
-                      block_k, d_lse=g_lse)
+    return _dispatch_bwd((q, k, v, out, lse), g_out, scale, causal,
+                         block_q, block_k, d_lse=g_lse)
 
 
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)

@@ -26,6 +26,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import x64_off as _x64_off
+
 NEG_INF = np.float32(-1e30)
 
 _pc = pl.pallas_call
@@ -58,13 +60,51 @@ def paged_attention_dispatch(q, k_pages, v_pages, block_tables,
                              v_scales=None):
     """Decode-attention dispatch: XLA dense-gather below the measured
     crossover of mapped context, Pallas page-grid kernel above it (and
-    always under interpret mode, where the Pallas path is emulation)."""
+    always under interpret mode, where the Pallas path is emulation).
+
+    With FLAGS_autotune on/readonly and no explicit
+    FLAGS_paged_xla_max_ctx override, the measured winner for this
+    decode bucket (xla / per-page pallas / grouped-fetch) takes over
+    the hand-pinned crossover. Interpret mode still short-circuits to
+    XLA unless a custom timer is installed (CPU emulation timings of the
+    page-grid kernel are meaningless)."""
+    from ..framework import config as _config
+    from . import autotune as _at
+
+    quant = k_scales is not None
+    if (_at.enabled()
+            and not _config.get_flag("FLAGS_paged_xla_max_ctx", 0)
+            and (not _interpret() or _at.has_custom_timer())):
+        b, n_q_heads, head_dim = q.shape
+        try:
+            # a tuner failure (e.g. OOM on the pow2-rounded example page
+            # pools) must degrade to the legacy crossover — an exception
+            # escaping the compiled decode call poisons the engine
+            win = _at.choose_paged_decode(
+                b, n_q_heads, k_pages.shape[0], head_dim,
+                k_pages.shape[2], block_tables.shape[1],
+                jnp.dtype(k_pages.dtype).name, quant)
+        except Exception:  # noqa: BLE001
+            win = None
+        if win is not None:
+            impl = win.meta["impl"]
+            if impl == "xla":
+                return paged_attention_xla(
+                    q, k_pages, v_pages, block_tables, context_lens,
+                    scale=scale, k_scales=k_scales, v_scales=v_scales)
+            if impl == "grouped":
+                return paged_attention_grouped(
+                    q, k_pages, v_pages, block_tables, context_lens,
+                    scale=scale)
+            return paged_attention(
+                q, k_pages, v_pages, block_tables, context_lens,
+                scale=scale, k_scales=k_scales, v_scales=v_scales)
+
     mapped_ctx = block_tables.shape[1] * k_pages.shape[2]
     if _interpret() or mapped_ctx <= _xla_decode_max_ctx():
         return paged_attention_xla(q, k_pages, v_pages, block_tables,
                                    context_lens, scale=scale,
                                    k_scales=k_scales, v_scales=v_scales)
-    from ..framework import config as _config
 
     if (k_scales is None and v_scales is None
             and k_pages.shape[2] == 16
@@ -261,7 +301,7 @@ def _decode_init(m_scr, l_scr, acc):
 
 def _decode_epilogue(o_ref, m_scr, l_scr, acc):
     l = l_scr[:, :1]
-    o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+    o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, np.float32(1.0), l)).astype(
         o_ref.dtype)
 
 
@@ -405,7 +445,7 @@ def paged_attention_grouped(q, k_pages, v_pages, block_tables,
         _decode_grouped_kernel, page_size=page_size, G=G, scale=scale,
         n_groups=n_groups)
     hbm = pl.BlockSpec(memory_space=pl.ANY)
-    with jax.enable_x64(False):
+    with _x64_off():
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_kv_heads, n_groups),
@@ -493,7 +533,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         in_specs += [scale_spec, scale_spec]
         operands += [k_scales[:, :, None, :], v_scales[:, :, None, :]]
 
-    with jax.enable_x64(False):
+    with _x64_off():
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_kv_heads, pages_per_seq),

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import x64_off as _x64_off
+
 # pallas_call runs under x64-off so index maps / constants stay 32-bit
 # (the package enables jax x64 globally for paddle int64 semantics)
 _pc = pl.pallas_call
@@ -53,17 +55,24 @@ def _bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, dw_acc, *,
         dw_ref[:] = dw_acc[:].astype(dw_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def rms_norm_2d(x, w, eps):
-    out, _ = _fwd(x, w, eps)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm_2d(x, w, eps, block_rows=None):
+    """block_rows: rows per grid step for BOTH passes (None: the legacy
+    min(BLOCK_ROWS, rows) choice). The autotuner sweeps it (128/256/512)
+    per shape bucket; explicit callers keep the default."""
+    out, _ = _fwd(x, w, eps, block_rows)
     return out
 
 
-def _fwd(x, w, eps):
+def _block(rows, block_rows):
+    return min(BLOCK_ROWS, rows) if block_rows is None else block_rows
+
+
+def _fwd(x, w, eps, block_rows=None):
     rows, cols = x.shape
-    block = min(BLOCK_ROWS, rows)
+    block = _block(rows, block_rows)
     kernel = functools.partial(_fwd_kernel, eps=eps)
-    with jax.enable_x64(False):
+    with _x64_off():
         out, rstd = _pc(
         kernel,
         grid=(rows // block,),
@@ -84,18 +93,18 @@ def _fwd(x, w, eps):
     return out, rstd
 
 
-def _rms_fwd(x, w, eps):
-    out, rstd = _fwd(x, w, eps)
+def _rms_fwd(x, w, eps, block_rows=None):
+    out, rstd = _fwd(x, w, eps, block_rows)
     return out, (x, w, rstd)
 
 
-def _rms_bwd(eps, res, g):
+def _rms_bwd(eps, block_rows, res, g):
     x, w, rstd = res
     rows, cols = x.shape
-    block = min(BLOCK_ROWS, rows)
+    block = _block(rows, block_rows)
     n_blocks = rows // block
     kernel = functools.partial(_bwd_kernel, n_rows_blocks=n_blocks)
-    with jax.enable_x64(False):
+    with _x64_off():
         dx, dw = _pc(
         kernel,
         grid=(n_blocks,),
@@ -122,16 +131,17 @@ def _rms_bwd(eps, res, g):
 rms_norm_2d.defvjp(_rms_fwd, _rms_bwd)
 
 
-def supports(rows, cols):
+def supports(rows, cols, block_rows=None):
     if rows <= 0:
         return False
-    block = min(BLOCK_ROWS, rows)
-    return rows % block == 0 and cols % 128 == 0 and cols <= 8192
+    block = _block(rows, block_rows)
+    return (rows % block == 0 and rows >= block and cols % 128 == 0
+            and cols <= 8192)
 
 
-def rms_norm(x, weight, eps=1e-6):
+def rms_norm(x, weight, eps=1e-6, block_rows=None):
     """x: [..., hidden]; weight: [hidden]."""
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
-    out = rms_norm_2d(x2, weight, float(eps))
+    out = rms_norm_2d(x2, weight, float(eps), block_rows)
     return out.reshape(shape)

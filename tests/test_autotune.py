@@ -1,0 +1,337 @@
+"""Shape-bucketed kernel autotuner (ISSUE 2 tentpole;
+paddle_tpu/kernels/autotune.py).
+
+Everything runs with the injectable deterministic timer — no test here
+depends on wall clock. Covers the acceptance contract: cache hit/miss +
+persistence round-trip, readonly never re-times, explicit flag overrides
+beat cached winners, FLAGS_autotune=off is bit-identical legacy dispatch,
+the winner is never a Pallas candidate that measured slower than XLA
+(property-tested), and the on-disk schema is golden-file stable."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import config as _config
+from paddle_tpu.kernels import autotune as at
+from paddle_tpu.kernels import flash_attention as fa
+
+
+@pytest.fixture
+def tuner_env(tmp_path, monkeypatch):
+    """Fresh tuner against a temp cache dir; restores flags/timer."""
+    monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value", "on")
+    monkeypatch.setattr(_config._FLAGS["FLAGS_autotune_cache_dir"],
+                        "value", str(tmp_path))
+    at.reset_tuner()
+    yield tmp_path
+    at.set_timer(None)
+    at.reset_tuner()
+
+
+def _timed_candidates(table):
+    """Candidates whose fns self-identify to the fake timer by name."""
+    cands = []
+    for name, (kind, _t) in table.items():
+        def fn(*a):
+            return None
+
+        fn.__autotune_name__ = name
+        cands.append(at.Candidate(name, kind, fn, {"name": name}))
+    return cands
+
+
+def _timer_for(table, calls=None):
+    def timer(fn, args):
+        if calls is not None:
+            calls.append(getattr(fn, "__autotune_name__", "?"))
+        return table[fn.__autotune_name__][1]
+
+    return timer
+
+
+BUCKET = (("sq", 256), ("dt", "float32"))
+
+
+class TestCore:
+    def test_miss_measures_then_hits_cache(self, tuner_env):
+        table = {"xla": ("xla", 2.0), "pallas:a": ("pallas", 1.0)}
+        calls = []
+        at.set_timer(_timer_for(table, calls))
+        t = at.get_tuner()
+        cands = _timed_candidates(table)
+        win = t.pick("flash_fwd", BUCKET, cands, lambda: (None,))
+        assert win.name == "pallas:a"
+        assert sorted(calls) == ["pallas:a", "xla"]  # miss: timed both
+        calls.clear()
+        win2 = t.pick("flash_fwd", BUCKET, cands, lambda: (None,))
+        assert win2.name == "pallas:a"
+        assert calls == []  # hit: nothing re-timed
+
+    def test_persistence_round_trip(self, tuner_env):
+        table = {"xla": ("xla", 1.0), "pallas:a": ("pallas", 3.0)}
+        at.set_timer(_timer_for(table))
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        cands = _timed_candidates(table)
+        t.pick("flash_fwd", BUCKET, cands, lambda: (None,))
+        path = t.cache_path()
+        assert os.path.basename(path) == "autotune_fake.json"
+        payload = json.load(open(path))
+        assert payload["schema_version"] == at.SCHEMA_VERSION
+        # a brand-new tuner instance (fresh process stand-in) reads the
+        # same winner WITHOUT timing anything
+        boom = _timer_for(table, calls := [])
+        at.set_timer(boom)
+        t2 = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        win = t2.pick("flash_fwd", BUCKET, cands, lambda: (None,))
+        assert win.name == "xla" and calls == []
+
+    def test_readonly_never_times(self, tuner_env, monkeypatch):
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "readonly")
+        calls = []
+        at.set_timer(_timer_for({"xla": ("xla", 1.0)}, calls))
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        win = t.pick("flash_fwd", BUCKET,
+                     _timed_candidates({"xla": ("xla", 1.0)}),
+                     lambda: (None,))
+        # miss in readonly: no measurement, caller takes legacy dispatch
+        assert win is None and calls == []
+
+    def test_off_mode_skips_everything(self, tuner_env, monkeypatch):
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "off")
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        win = t.pick("flash_fwd", BUCKET,
+                     _timed_candidates({"xla": ("xla", 1.0)}),
+                     lambda: (None,))
+        assert win is None
+
+    def test_kernel_version_tag_in_key(self, tuner_env):
+        key = at.Autotuner.make_key("flash_bwd", BUCKET)
+        assert key.split("|")[1] == at.KERNEL_VERSIONS["flash_bwd"]
+
+    def test_ineligible_winner_falls_to_fastest_eligible(self, tuner_env):
+        table = {"xla": ("xla", 3.0), "pallas:512": ("pallas", 1.0),
+                 "pallas:128": ("pallas", 2.0)}
+        at.set_timer(_timer_for(table))
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        cands = _timed_candidates(table)
+        # concrete shape can't run the 512 blocks: next-fastest wins
+        win = t.pick("flash_fwd", BUCKET, cands, lambda: (None,),
+                     eligible=lambda c: c.name != "pallas:512")
+        assert win.name == "pallas:128"
+
+    def test_corrupt_cache_is_empty_cache(self, tuner_env):
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        os.makedirs(str(tuner_env), exist_ok=True)
+        with open(t.cache_path(), "w") as f:
+            f.write("{not json")
+        table = {"xla": ("xla", 1.0)}
+        at.set_timer(_timer_for(table))
+        win = t.pick("flash_fwd", BUCKET, _timed_candidates(table),
+                     lambda: (None,))
+        assert win.name == "xla"  # re-measured, no crash
+
+
+class TestNeverSlowerThanXla:
+    """Acceptance: the tuner never selects a Pallas kernel that measured
+    slower than the XLA candidate for that bucket."""
+
+    def test_property_random_timings(self, tuner_env):
+        rng = np.random.RandomState(0)
+        for trial in range(50):
+            names = ["xla"] + [f"pallas:{i}" for i in range(4)]
+            table = {"xla": ("xla", float(rng.uniform(0.1, 10)))}
+            for n in names[1:]:
+                table[n] = ("pallas", float(rng.uniform(0.1, 10)))
+            at.set_timer(_timer_for(table))
+            t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+            win = t.pick("flash_fwd",
+                         (("trial", trial),) + BUCKET,
+                         _timed_candidates(table), lambda: (None,))
+            if win.kind == "pallas":
+                assert table[win.name][1] <= table["xla"][1], \
+                    f"trial {trial}: pallas {win.name} " \
+                    f"{table[win.name][1]} > xla {table['xla'][1]}"
+
+    def test_tie_breaks_to_xla(self, tuner_env):
+        table = {"pallas:a": ("pallas", 1.0), "xla": ("xla", 1.0)}
+        at.set_timer(_timer_for(table))
+        t = at.Autotuner(cache_dir=str(tuner_env), device="fake")
+        win = t.pick("flash_fwd", BUCKET, _timed_candidates(table),
+                     lambda: (None,))
+        assert win.name == "xla"
+
+
+def _rand(shape, seed):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+class TestDispatchWiring:
+    def test_sdpa_uses_tuned_flash_blocks(self, tuner_env, monkeypatch):
+        """With the tuner reporting flash:128x256 fastest, sdpa routes to
+        the flash kernel with those blocks."""
+        import paddle_tpu.nn.functional as F
+
+        def timer(fn, args):
+            name = getattr(fn, "__name__", "")
+            return 1.0 if name == "flash_fwd" else 10.0
+
+        # fn names inside choose_flash_fwd: xla_fwd / flash_fwd closures;
+        # every flash candidate gets 1.0, xla 10.0 -> first flash pair
+        # (the 128x128 grid entry) wins
+        at.set_timer(timer)
+        seen = {}
+        orig = fa.flash_attention_bshd
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fa, "flash_attention_bshd", spy)
+        b, s, h, d = 1, 256, 2, 128
+        q = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 0)))
+        k = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 1)))
+        v = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 2)))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        assert out.shape == q.shape
+        assert seen.get("block_q") in at.BLOCK_GRID
+        assert seen.get("block_k") in at.BLOCK_GRID
+
+    def test_explicit_flag_override_beats_cached_winner(self, tuner_env,
+                                                        monkeypatch):
+        """A cached flash winner must lose to an explicit
+        FLAGS_flash_fwd_min_seq override — hand-set flags bypass the
+        tuner entirely (ISSUE 2 contract)."""
+        import paddle_tpu.nn.functional as F
+
+        at.set_timer(lambda fn, args: 1.0
+                     if getattr(fn, "__name__", "") == "flash_fwd"
+                     else 10.0)
+        b, s, h, d = 1, 256, 2, 128
+        q = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 0)))
+        k = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 1)))
+        v = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 2)))
+        # populate the cache: flash wins the bucket
+        F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                       training=False)
+        called = {"flash": False}
+        orig = fa.flash_attention_bshd
+
+        def spy(*a, **kw):
+            called["flash"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fa, "flash_attention_bshd", spy)
+        # explicit override: flash only from seq 10^9 -> XLA path
+        monkeypatch.setattr(_config._FLAGS["FLAGS_flash_fwd_min_seq"],
+                            "value", 10**9)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        assert out.shape == q.shape
+        assert not called["flash"], \
+            "explicit flag override must beat the cached winner"
+
+    def test_off_is_bit_identical_to_legacy(self, tmp_path, monkeypatch):
+        """FLAGS_autotune=off: same outputs, same code path (no tuner
+        consultation) as the pre-autotune dispatch."""
+        import paddle_tpu.nn.functional as F
+
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "off")
+        at.reset_tuner()
+
+        def boom(*a, **kw):
+            raise AssertionError("tuner consulted with FLAGS_autotune=off")
+
+        monkeypatch.setattr(at, "choose_flash_fwd", boom)
+        monkeypatch.setattr(at, "choose_flash_bwd", boom)
+        b, s, h, d = 1, 256, 2, 128
+        q = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 0)))
+        k = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 1)))
+        v = paddle.to_tensor(np.asarray(_rand((b, s, h, d), 2)))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+        ref = _sdpa_reference(jnp.asarray(q.numpy()),
+                              jnp.asarray(k.numpy()),
+                              jnp.asarray(v.numpy()), causal=True)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_paged_decode_tuned_winner_routes(self, tuner_env):
+        """Fake timer makes the per-page Pallas kernel win; dispatch
+        must execute it (interpret mode allows tuning only because a
+        custom timer is installed)."""
+        from paddle_tpu.kernels import paged_attention as pa
+
+        def timer(fn, args):
+            name = getattr(fn, "__name__", "")
+            return 1.0 if name == "pallas_fn" else 10.0
+
+        at.set_timer(timer)
+        b, kvh, hd, page, pps = 2, 2, 128, 16, 8
+        n_pages = b * pps
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(kq, (b, kvh, hd), jnp.float32)
+        kp = jax.random.normal(kk, (kvh, n_pages, page, hd), jnp.float32)
+        vp = jax.random.normal(kv, (kvh, n_pages, page, hd), jnp.float32)
+        tables = jnp.arange(n_pages, dtype=jnp.int32).reshape(b, pps)
+        lens = jnp.full((b,), page * pps - 3, jnp.int32)
+        out = pa.paged_attention_dispatch(q, kp, vp, tables, lens)
+        ref = pa.paged_attention_xla(q, kp, vp, tables, lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        win = at.get_tuner().lookup(at.Autotuner.make_key(
+            "paged_decode",
+            (("b", 2), ("qh", kvh), ("kvh", kvh), ("d", hd),
+             ("page", page), ("pps", pps), ("dt", "float32"),
+             ("quant", 0))))
+        assert win is not None and win["winner"] == "pallas"
+
+    def test_rms_norm_tuned_block_rows(self, tuner_env):
+        import paddle_tpu.nn.functional as F
+
+        at.set_timer(lambda fn, args: 1.0
+                     if getattr(fn, "__name__", "") == "pal_fn" else 5.0)
+        x = paddle.to_tensor(np.asarray(_rand((512, 256), 3)))
+        w = paddle.to_tensor(np.ones((256,), np.float32))
+        y = F.rms_norm(x, w)
+        ref = x.numpy() / np.sqrt(
+            (x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y.numpy(), ref, atol=2e-5)
+        entry = at.get_tuner().lookup(at.Autotuner.make_key(
+            "rms_norm", (("rows", 512), ("cols", 256),
+                         ("dt", "float32"))))
+        assert entry is not None
+        assert entry["winner"].startswith("pallas:")
+
+
+class TestGoldenSchema:
+    def test_cache_schema_is_stable(self, tuner_env):
+        """The on-disk cache schema is a cross-process/cross-PR contract
+        (tables written on-chip are read by later sessions) — lock it
+        with a golden file."""
+        table = {"xla": ("xla", 2.5), "flash:128x128": ("pallas", 1.25)}
+        at.set_timer(_timer_for(table))
+        t = at.Autotuner(cache_dir=str(tuner_env), device="goldenkind")
+        t.pick("flash_fwd",
+               (("bh", 8), ("sq", 512), ("skv", 512), ("d", 128),
+                ("causal", 1), ("dt", "bfloat16")),
+               _timed_candidates(table), lambda: (None,))
+        got = json.load(open(t.cache_path()))
+        golden_path = os.path.join(os.path.dirname(__file__), "data",
+                                   "autotune_cache_golden.json")
+        golden = json.load(open(golden_path))
+        assert got == golden, (
+            "autotune cache schema drifted from the golden file; if the "
+            "change is INTENTIONAL bump SCHEMA_VERSION and regenerate "
+            "tests/data/autotune_cache_golden.json")

@@ -1,0 +1,306 @@
+"""Split dq/dkv flash-attention backward (ISSUE 2 tentpole, second half).
+
+The backward is restructured into separately-callable dq and dkv Pallas
+passes with INDEPENDENT block choices (kernels/flash_attention.py
+`_flash_bwd_split` / `_flash_bwd_dq` / `_flash_bwd_dkv`). Acceptance:
+grad-check against the XLA recompute vjp to <= 1e-3 rel error in
+interpret mode across causal / GQA / dropout variants, matching the
+rigor of tests/test_flash_dropout.py (finite differences for the dropout
+variant, where the XLA vjp cannot regenerate the in-kernel mask)."""
+import functools
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import autotune as at
+from paddle_tpu.kernels import flash_attention as fa
+from paddle_tpu.framework import config as _config
+
+
+def _rand(shape, seed):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+def _rel_err(a, b):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return float(np.max(np.abs(a - b)) / max(np.max(np.abs(b)), 1e-30))
+
+
+def _bhsd(q):
+    b, s, h, d = q.shape
+    return jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+
+
+def _make_res(b, s, h, d, causal, kv_heads=None, seed0=0):
+    """(res, g, scale) over [bh, s, d]; kv_heads < h emulates GQA the way
+    the training path does (kv heads repeat_interleave'd per group before
+    the kernel)."""
+    q = _rand((b, s, h, d), seed0)
+    kvh = kv_heads or h
+    k = _rand((b, s, kvh, d), seed0 + 1)
+    v = _rand((b, s, kvh, d), seed0 + 2)
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    g = _rand((b, s, h, d), seed0 + 3)
+    scale = 1.0 / math.sqrt(d)
+    qt, kt, vt, gt = map(_bhsd, (q, k, v, g))
+    out, lse = fa._flash_fwd(qt, kt, vt, scale, causal, 128, 128)
+    return (qt, kt, vt, out, lse), gt, scale
+
+
+BLOCK_COMBOS = [((128, 128), (128, 128)),
+                ((128, 256), (256, 128)),
+                ((256, 256), (128, 128))]
+
+
+class TestSplitVsXlaVjp:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("kv_heads", [None, 2])  # None=MHA, 2=GQA 4:2
+    def test_grads_match_xla_vjp(self, causal, kv_heads):
+        b, s, h, d = 1, 256, 4, 128
+        res, g, scale = _make_res(b, s, h, d, causal, kv_heads=kv_heads)
+        want = fa._xla_ref_bwd(res, g, scale, causal)
+        for dq_blocks, dkv_blocks in BLOCK_COMBOS:
+            got = fa._flash_bwd_split(res, g, scale, causal,
+                                      dq_blocks=dq_blocks,
+                                      dkv_blocks=dkv_blocks)
+            for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+                err = _rel_err(a, b_)
+                assert err <= 1e-3, \
+                    f"{name} blocks={dq_blocks}/{dkv_blocks} " \
+                    f"causal={causal} gqa={kv_heads}: rel err {err}"
+
+    def test_standalone_passes_equal_split(self):
+        b, s, h, d = 1, 256, 2, 128
+        res, g, scale = _make_res(b, s, h, d, True)
+        dq, dk, dv = fa._flash_bwd_split(res, g, scale, True,
+                                         dq_blocks=(128, 128),
+                                         dkv_blocks=(256, 256))
+        dq2 = fa._flash_bwd_dq(res, g, scale, True, 128, 128)
+        dk2, dv2 = fa._flash_bwd_dkv(res, g, scale, True, 256, 256)
+        np.testing.assert_array_equal(np.asarray(dq), np.asarray(dq2))
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(dk2))
+        np.testing.assert_array_equal(np.asarray(dv), np.asarray(dv2))
+
+    def test_split_equals_fused_at_shared_blocks(self):
+        """With both passes at the caller's shared blocks the split path
+        IS the legacy fused pair — bit-identical."""
+        b, s, h, d = 1, 256, 2, 128
+        res, g, scale = _make_res(b, s, h, d, True)
+        fused = fa._flash_bwd(res, g, scale, True, 128, 128)
+        split = fa._flash_bwd_split(res, g, scale, True,
+                                    dq_blocks=(128, 128),
+                                    dkv_blocks=(128, 128))
+        for a, b_ in zip(fused, split):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    def test_rectangular_seq_kv(self):
+        """Cross-attention shape (s_q != s_kv) with asymmetric per-pass
+        blocks exercises the causal offset in both grids."""
+        b, h, d = 1, 2, 128
+        s_q, s_kv = 128, 384
+        q = _bhsd(_rand((b, s_q, h, d), 0))
+        k = _bhsd(_rand((b, s_kv, h, d), 1))
+        v = _bhsd(_rand((b, s_kv, h, d), 2))
+        g = _bhsd(_rand((b, s_q, h, d), 3))
+        scale = 1.0 / math.sqrt(d)
+        out, lse = fa._flash_fwd(q, k, v, scale, True, 128, 128)
+        res = (q, k, v, out, lse)
+        want = fa._xla_ref_bwd(res, g, scale, True)
+        got = fa._flash_bwd_split(res, g, scale, True,
+                                  dq_blocks=(128, 384),
+                                  dkv_blocks=(128, 128))
+        for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+            assert _rel_err(a, b_) <= 1e-3, name
+
+
+class TestSplitDropout:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_dropout_finite_differences(self, causal):
+        """The XLA vjp cannot regenerate the in-kernel threefry mask, so
+        the dropout variant grad-checks against finite differences — the
+        split passes must regenerate the forward's mask bit-exactly from
+        GLOBAL coordinates regardless of their (different) block sizes."""
+        b, s, h, d = 1, 128, 1, 128
+        drop, seed = 0.25, 42
+        scale = 1.0 / math.sqrt(d)
+        q = _bhsd(_rand((b, s, h, d), 0))
+        k = _bhsd(_rand((b, s, h, d), 1))
+        v = _bhsd(_rand((b, s, h, d), 2))
+        cot = _bhsd(_rand((b, s, h, d), 9))
+
+        @jax.custom_vjp
+        def attn(q_, k_, v_):
+            out, _ = fa._flash_fwd(q_, k_, v_, scale, causal, 128, 128,
+                                   dropout=drop, seed=seed)
+            return out
+
+        def attn_fwd(q_, k_, v_):
+            out, lse = fa._flash_fwd(q_, k_, v_, scale, causal, 128, 128,
+                                     dropout=drop, seed=seed)
+            return out, (q_, k_, v_, out, lse)
+
+        def attn_bwd(res, g_):
+            return fa._flash_bwd_split(res, g_, scale, causal,
+                                       dq_blocks=(128, 128),
+                                       dkv_blocks=(128, 128),
+                                       dropout=drop, seed=seed)
+
+        attn.defvjp(attn_fwd, attn_bwd)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(attn(q_, k_, v_) * cot)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        rng = np.random.RandomState(0)
+        eps = 1e-3
+        for name, x, grad in (("dq", q, dq), ("dk", k, dk), ("dv", v, dv)):
+            for _ in range(5):
+                idx = tuple(rng.randint(0, dim) for dim in x.shape)
+                xp = np.asarray(x).copy()
+                xm = np.asarray(x).copy()
+                xp[idx] += eps
+                xm[idx] -= eps
+                args_p = {"dq": (jnp.asarray(xp), k, v),
+                          "dk": (q, jnp.asarray(xp), v),
+                          "dv": (q, k, jnp.asarray(xp))}[name]
+                args_m = {"dq": (jnp.asarray(xm), k, v),
+                          "dk": (q, jnp.asarray(xm), v),
+                          "dv": (q, k, jnp.asarray(xm))}[name]
+                num = (float(loss(*args_p)) - float(loss(*args_m))) \
+                    / (2 * eps)
+                got = float(np.asarray(grad)[idx])
+                assert abs(num - got) < 5e-2 + 0.05 * abs(num), \
+                    f"{name}[{idx}]: fd={num} vjp={got}"
+
+    def test_dropout_split_matches_fused(self):
+        """Same-mask sanity without finite differences: the split passes
+        at DIFFERENT blocks produce (numerically) the fused pair's grads
+        for the same seed."""
+        b, s, h, d = 1, 256, 2, 128
+        res, g, scale = _make_res(b, s, h, d, True)
+        fused = fa._flash_bwd(res, g, scale, True, 128, 128,
+                              dropout=0.3, seed=7)
+        split = fa._flash_bwd_split(res, g, scale, True,
+                                    dq_blocks=(256, 128),
+                                    dkv_blocks=(128, 256),
+                                    dropout=0.3, seed=7)
+        for name, a, b_ in zip(("dq", "dk", "dv"), split, fused):
+            assert _rel_err(a, b_) <= 1e-3, name
+
+
+class TestSegmentedSplit:
+    def test_varlen_segments_match_xla_vjp(self):
+        """Packed 2-sequence stream: split passes honor the segment mask
+        at asymmetric blocks."""
+        b, s, h, d = 1, 256, 2, 128
+        seg = jnp.concatenate([jnp.zeros((128,), jnp.int32),
+                               jnp.ones((128,), jnp.int32)])
+        seg8 = jnp.broadcast_to(seg[None, None, :], (b, 8, s))
+        q, k, v, g = (_bhsd(_rand((b, s, h, d), i)) for i in range(4))
+        scale = 1.0 / math.sqrt(d)
+        # residuals from the SEGMENTED forward (the xla vjp recomputes a
+        # segmented forward internally; out/lse must agree)
+        out, lse = fa._flash_fwd(q, k, v, scale, False, 128, 128,
+                                 seg_q=seg8, seg_k=seg8, heads=h)
+        res = (q, k, v, out, lse)
+        want = fa._xla_ref_bwd(res, g, scale, False, seg_q=seg8,
+                               seg_k=seg8, heads=h)
+        got = fa._flash_bwd_split(res, g, scale, False,
+                                  dq_blocks=(128, 256),
+                                  dkv_blocks=(256, 128),
+                                  seg_q=seg8, seg_k=seg8, heads=h)
+        for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+            assert _rel_err(a, b_) <= 1e-3, name
+
+
+class TestAutotunedBwdDispatch:
+    def test_tuned_split_routes_through_custom_vjp(self, tmp_path,
+                                                   monkeypatch):
+        """End to end: a fake timer that makes the split strategy win
+        must route jax.grad(flash) through `_flash_bwd_split`, and the
+        grads must still match the XLA vjp."""
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "on")
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune_cache_dir"],
+                            "value", str(tmp_path))
+        at.reset_tuner()
+
+        def timer(fn, args):
+            return 1.0 if getattr(fn, "__name__", "") == "split_bwd" \
+                else 10.0
+
+        at.set_timer(timer)
+        hit = {"split": False}
+        orig = fa._flash_bwd_split
+
+        def spy(*a, **kw):
+            hit["split"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fa, "_flash_bwd_split", spy)
+        try:
+            b, s, h, d = 1, 256, 2, 128
+            q, k, v, g = (_rand((b, s, h, d), i) for i in range(4))
+
+            def loss(q_, k_, v_):
+                out = fa.flash_attention_bshd(q_, k_, v_, causal=True)
+                return jnp.sum(out * g)
+
+            grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            assert hit["split"], "tuned winner must route to split bwd"
+            qt, kt, vt, gt = map(_bhsd, (q, k, v, g))
+            out, lse = fa._flash_fwd(qt, kt, vt, 1.0 / math.sqrt(d),
+                                     True, 128, 128)
+            want = fa._xla_ref_bwd((qt, kt, vt, out, lse), gt,
+                                   1.0 / math.sqrt(d), True)
+            bhsd = [_bhsd(x) for x in grads]
+            for name, a, b_ in zip(("dq", "dk", "dv"), bhsd, want):
+                assert _rel_err(a, b_) <= 1e-3, name
+        finally:
+            at.set_timer(None)
+            at.reset_tuner()
+
+    def test_flag_override_beats_tuned_bwd(self, tmp_path, monkeypatch):
+        """FLAGS_flash_bwd_min_seq set explicitly: the backward ignores
+        any cached winner and follows the flag (XLA below threshold)."""
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "on")
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune_cache_dir"],
+                            "value", str(tmp_path))
+        monkeypatch.setattr(_config._FLAGS["FLAGS_flash_bwd_min_seq"],
+                            "value", 10**9)
+        at.reset_tuner()
+        boom_calls = []
+        at.set_timer(lambda fn, args: boom_calls.append(fn) or 1.0)
+        hit = {"xla": False}
+        orig = fa._xla_ref_bwd
+
+        def spy(*a, **kw):
+            hit["xla"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fa, "_xla_ref_bwd", spy)
+        try:
+            b, s, h, d = 1, 256, 2, 128
+            q, k, v, g = (_rand((b, s, h, d), i) for i in range(4))
+
+            def loss(q_, k_, v_):
+                out = fa.flash_attention_bshd(q_, k_, v_, causal=True)
+                return jnp.sum(out * g)
+
+            jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            assert hit["xla"], "flag must force the XLA backward"
+            assert boom_calls == [], \
+                "explicit flag override must bypass the tuner"
+        finally:
+            at.set_timer(None)
+            at.reset_tuner()

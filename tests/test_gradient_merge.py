@@ -105,12 +105,28 @@ class TestDeadToggles:
                            match="find_unused_parameters"):
             strat.find_unused_parameters = True
 
+    def test_asp_raises(self):
+        # 2:4 sparsity is Ampere sparse-tensor-core hardware; the MXU has
+        # no structured-sparsity mode (COMPONENTS.md §2.2 stance)
+        strat = DistributedStrategy()
+        with pytest.raises(NotImplementedError, match="asp"):
+            strat.asp = True
+
+    def test_fp16_allreduce_raises(self):
+        strat = DistributedStrategy()
+        with pytest.raises(NotImplementedError, match="fp16_allreduce"):
+            strat.fp16_allreduce = True
+
     def test_false_assignment_is_fine(self):
         strat = DistributedStrategy()
         strat.dgc = False
         strat.localsgd = False
         strat.find_unused_parameters = False
+        strat.asp = False
+        strat.fp16_allreduce = False
         assert strat.dgc is False
+        assert strat.asp is False
+        assert strat.fp16_allreduce is False
 
     def test_gradient_merge_with_pipeline_rejected(self):
         import jax

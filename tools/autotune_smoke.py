@@ -1,0 +1,104 @@
+"""Autotuner CI smoke: run the measured-dispatch subsystem end to end in
+interpret mode with the cache pointed at a temp dir (tools/ci.sh gate for
+ISSUE 2).
+
+Covers, at a tiny shape so interpret-mode timing stays cheap:
+  * FLAGS_autotune=on times real candidates (default timer, real
+    kernels) and persists a winner table to the temp cache dir;
+  * a second lookup is a pure cache hit (no re-timing);
+  * readonly mode on a fresh tuner reads the same file;
+  * dispatch through the public entry points (sdpa / rms_norm functional)
+    still produces numerics matching the XLA reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework import config as _config
+    from paddle_tpu.kernels import autotune as at
+
+    tmp = tempfile.mkdtemp(prefix="autotune_smoke_")
+    _config.set_flags({"FLAGS_autotune": "on",
+                       "FLAGS_autotune_cache_dir": tmp})
+    at.reset_tuner()
+
+    # count timer invocations while still really measuring
+    counted = {"n": 0}
+    real = at.default_timer
+
+    def counting_timer(fn, args):
+        counted["n"] += 1
+        return real(fn, args, iters=1)
+
+    at.set_timer(counting_timer)
+    try:
+        b, s, h, d = 1, 256, 2, 128
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        assert out.shape == q.shape
+        timed_first = counted["n"]
+        assert timed_first > 0, "autotune=on must measure on first call"
+
+        # identical-bucket second call: pure cache hit
+        out2 = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=False)
+        assert counted["n"] == timed_first, "cache hit must not re-time"
+        np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+        # rms_norm through the functional dispatch
+        x = paddle.to_tensor(rng.randn(256, 256).astype(np.float32))
+        w = paddle.to_tensor(np.ones((256,), np.float32))
+        y = F.rms_norm(x, w)
+        ref = x.numpy() / np.sqrt(
+            (x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y.numpy(), ref, atol=2e-5)
+
+        path = at.get_tuner().cache_path()
+        assert os.path.dirname(path) == tmp, path
+        table = json.load(open(path))
+        assert table["schema_version"] == at.SCHEMA_VERSION
+        assert table["entries"], "winner table must persist entries"
+        for key, entry in table["entries"].items():
+            tm = entry["timings_ms"]
+            # argmin property: the winner is never slower than the XLA
+            # candidate it was measured against
+            if "xla" in tm:
+                assert tm[entry["winner"]] <= tm["xla"], (key, tm)
+
+        # readonly on a fresh tuner: reads the file, never times
+        _config.set_flags({"FLAGS_autotune": "readonly"})
+        at.reset_tuner()
+        before = counted["n"]
+        out3 = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=False)
+        assert counted["n"] == before, "readonly must never time"
+        np.testing.assert_array_equal(out.numpy(), out3.numpy())
+        print(f"autotune smoke OK: {len(table['entries'])} entries, "
+              f"{timed_first} timed candidates, cache at {path}")
+    finally:
+        at.set_timer(None)
+        _config.set_flags({"FLAGS_autotune": "off",
+                           "FLAGS_autotune_cache_dir": ""})
+        at.reset_tuner()
+
+
+if __name__ == "__main__":
+    main()

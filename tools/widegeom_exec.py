@@ -15,8 +15,11 @@ exercised and EXECUTED.
 The 7B-true-width serving decode (hidden 4096, tp8) executes in
 `__graft_entry__.dryrun_multichip` case `serving_7b_width`.
 
-Writes WIDEGEOM_EXEC.json. Wall-clock on one host core: ~2-5 min
-(dominated by the ~0.5 TFLOP/step serial reference).
+Writes WIDEGEOM_EXEC.json. Wall-clock: ~15 min UNCONTENDED on this host
+(round-5 judge measurement: serial reference 121 s + parallel step 761 s;
+the earlier "~2-5 min" claim was never measured). The rehearsal tier's
+`timeout 3000` in tools/ci.sh gives this a ~3.3x margin — keep that
+headroom in mind before adding work here.
 """
 from __future__ import annotations
 
