@@ -119,12 +119,14 @@ def save_state_dict(state_dict, path, overwrite=True):
     import orbax.checkpoint as ocp
 
     from ..observability import flight_recorder as _flight
+    from ..observability import tracing as _tracing
 
     saves_c, save_h = _checkpoint_metrics()
     t0 = _time.perf_counter()
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _to_arrays(state_dict), force=overwrite)
+    with _tracing.span("checkpoint.save", path=path):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, _to_arrays(state_dict), force=overwrite)
     saves_c.inc()
     save_h.observe(_time.perf_counter() - t0)
     _flight.record_event("checkpoint.save", path=path)
@@ -180,12 +182,16 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         from ..observability import flight_recorder as _flight
+        from ..observability import tracing as _tracing
 
         saves_c, save_h = _checkpoint_metrics()
         t0 = _time.perf_counter()
-        saved = self._mgr.save(
-            int(step), args=ocp.args.StandardSave(_to_arrays(state_dict)),
-            force=force)
+        with _tracing.span("checkpoint.save", step=int(step),
+                           dir=self._dir):
+            saved = self._mgr.save(
+                int(step),
+                args=ocp.args.StandardSave(_to_arrays(state_dict)),
+                force=force)
         if saved:
             saves_c.inc()
             save_h.observe(_time.perf_counter() - t0)

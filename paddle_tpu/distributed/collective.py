@@ -56,9 +56,14 @@ def _make_coll_handles(reg):
     }
 
 
-def _count_collective(op: str, array=None, arrays=None):
+def _count_collective(op: str, array=None, arrays=None,
+                      instant=True) -> float:
     """One call-count increment per API invocation; bytes summed over
-    `array` or every entry of `arrays`."""
+    `array` or every entry of `arrays` (returned so span call sites
+    don't recompute them). With span tracing enabled, drops a
+    `collective.<op>` instant on the timeline — EXCEPT when the caller
+    wraps execution in a real-duration `_coll_span` (instant=False),
+    which would double the event."""
     global _coll_cache
     from ..observability import metrics as _om
 
@@ -70,12 +75,32 @@ def _count_collective(op: str, array=None, arrays=None):
         cell = (h["calls"].labels(op), h["bytes"].labels(op))
         h["children"][op] = cell
     cell[0].inc()
+    nbytes = 0.0
     for a in (arrays if arrays is not None
               else (array,) if array is not None else ()):
         try:  # works for concrete arrays AND tracers (shape/dtype known)
-            cell[1].inc(float(np.prod(a.shape)) * a.dtype.itemsize)
+            nbytes += float(np.prod(a.shape)) * a.dtype.itemsize
         except Exception:
             pass
+    if nbytes:
+        cell[1].inc(nbytes)
+    if instant:
+        from ..observability import tracing as _tracing
+
+        if _tracing.enabled():
+            _tracing.instant(f"collective.{op}", bytes=nbytes)
+    return nbytes
+
+
+def _coll_span(op: str, nbytes: float = 0.0):
+    """Real-duration span around an eagerly-executing collective (the
+    jit-path helpers only emit at trace time — an instant suffices
+    there). No-op singleton when tracing is off."""
+    from ..observability import tracing as _tracing
+
+    if not _tracing.enabled():
+        return _tracing.NOOP_SPAN
+    return _tracing.span(f"collective.{op}", bytes=nbytes)
 
 
 def _axes_for_group(group):
@@ -98,8 +123,10 @@ def _world(axes):
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce (eager identity at world=1; psum under jit)."""
-    _count_collective("all_reduce", as_array(tensor))
-    return _all_reduce_impl(tensor, op, group)
+    nbytes = _count_collective("all_reduce", as_array(tensor),
+                               instant=False)
+    with _coll_span("all_reduce", nbytes):
+        return _all_reduce_impl(tensor, op, group)
 
 
 def _all_reduce_impl(tensor, op, group):
@@ -145,8 +172,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     # counts as "reduce", not "all_reduce": one API call, one increment
-    _count_collective("reduce", as_array(tensor))
-    return _all_reduce_impl(tensor, op, group)
+    nbytes = _count_collective("reduce", as_array(tensor),
+                               instant=False)
+    with _coll_span("reduce", nbytes):
+        return _all_reduce_impl(tensor, op, group)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -223,8 +252,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    _count_collective("barrier")
-    (jax.device_put(0) + 0).block_until_ready()
+    _count_collective("barrier", instant=False)
+    with _coll_span("barrier"):
+        (jax.device_put(0) + 0).block_until_ready()
 
 
 def new_group(ranks=None, backend=None, timeout=None):

@@ -115,6 +115,20 @@ define_flag("FLAGS_autotune_cache_dir", "",
             "Override directory for the autotune cache tables (empty: "
             "~/.cache/paddle_tpu). CI points this at a temp dir so smoke "
             "runs never touch the user cache.")
+define_flag("FLAGS_trace_sample", 0.0,
+            "Span-tracing head-sampling probability "
+            "(observability/tracing.py): 0 (default) disables tracing "
+            "entirely (zero per-step allocations); 1 traces every "
+            "request/step; 0<p<1 keeps a deterministic p fraction of "
+            "traces. Export with observability.write_trace() — Chrome "
+            "trace-event JSON that Perfetto loads directly.",
+            type_=float)
+define_flag("FLAGS_trace_slow_ms", 0.0,
+            "Always-sample-on-slow escape hatch: with tracing enabled, "
+            "a trace whose total latency crosses this many milliseconds "
+            "is committed to the trace ring even when head sampling "
+            "dropped it, and trace_slow_requests_total increments. "
+            "0 disables the escape hatch.", type_=float)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

@@ -23,6 +23,7 @@ from ..autograd import tape as _tape
 from ..kernels import paged_attention as _pa
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _om
+from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
 
 
@@ -103,6 +104,7 @@ class _Slot:
     admit_seq: int = 0    # admission order (preemption picks the youngest)
     needs_first_sample: bool = False  # consume prefill-time sample next step
     _first_token: int = -1
+    trace_id: int = -1    # span-tracing correlation id (-1: not traced)
     # per-request sampling: only the greedy flag lives on the slot (the
     # all-greedy fast path reads it every step); numeric params stay in
     # ServingEngine._req_params — ONE source of truth across preemption
@@ -113,6 +115,10 @@ class FinishedRequest:
     request_id: int
     prompt_ids: np.ndarray
     output_ids: np.ndarray
+    # span-tracing correlation: the request's trace_id (None when tracing
+    # was off at add_request) — grep the Chrome trace / flight-recorder
+    # ring for the same id
+    trace_id: object = None
 
 
 class ServingEngine:
@@ -263,6 +269,12 @@ class ServingEngine:
         self._poisoned = None
         self._n_pages_total = n_pages
         self._m = _EngineMetrics()
+        # span tracing (README.md "Observability"): one Trace per request
+        # while tracing is enabled, keyed by rid. Empty when
+        # FLAGS_trace_sample=0, so every hot-path guard below is one
+        # falsy dict check — the alloc-guard test pins zero span
+        # allocations per decode step with tracing off.
+        self._traces: Dict[int, object] = {}
 
     def _pin_pages(self):
         """Lay the page pools out in the serving sharding (kv heads over
@@ -334,9 +346,19 @@ class ServingEngine:
         # arriving together prefill together in one batched compiled call
         self._pending.append((rid, ids, int(max_new_tokens), []))
         self._m.queue_depth.set(len(self._pending))
+        trace_id = None
+        if _trace.enabled():
+            tr = _trace.start_trace("serving.request", own_track=True,
+                                    rid=rid, prompt_len=len(ids),
+                                    max_new=int(max_new_tokens))
+            if tr.trace_id is not None:
+                self._traces[rid] = tr
+                trace_id = tr.trace_id
+                tr.begin("serving.queue", rid=rid)
         _flight.record_event("serving.add_request", rid=rid,
                              prompt_len=len(ids),
-                             max_new=int(max_new_tokens))
+                             max_new=int(max_new_tokens),
+                             trace_id=trace_id)
         return rid
 
     def _admit(self):
@@ -383,6 +405,13 @@ class ServingEngine:
             self._admit_seq += 1
             s.needs_first_sample = True
             s.active = True
+            if self._traces:
+                tr = self._traces.get(rid)
+                if tr is not None:
+                    # close the queue phase; the prefill span follows in
+                    # _prefill_batch on the same request track
+                    tr.end("serving.queue", slot=slot_idx)
+                    s.trace_id = tr.trace_id
             new.append((slot_idx, ctx))
         self._m.queue_depth.set(len(self._pending))
         if new:
@@ -496,6 +525,7 @@ class ServingEngine:
             self.block_tables[slot_idx, :s.n_pages].tolist())
         s.n_pages = 0
         s.active = False
+        s.trace_id = -1  # don't leak the id into the slot's next tenant
         self._release_gen += 1
 
     def abort(self, request_id: int) -> bool:
@@ -510,6 +540,7 @@ class ServingEngine:
                 self._req_params.pop(request_id, None)
                 self._m.aborts.inc()
                 self._m.queue_depth.set(len(self._pending))
+                self._finish_trace(request_id, aborted="queue")
                 _flight.record_event("serving.abort", rid=request_id,
                                      where="queue")
                 return True
@@ -519,10 +550,29 @@ class ServingEngine:
                 self._prompts.pop(request_id, None)
                 self._req_params.pop(request_id, None)
                 self._m.aborts.inc()
+                self._finish_trace(request_id, aborted="slot")
                 _flight.record_event("serving.abort", rid=request_id,
                                      where="slot")
                 return True
         return False
+
+    def _finish_trace(self, rid, **attrs):
+        """Detach and commit the request's trace (finish/abort); returns
+        its trace_id or None."""
+        tr = self._traces.pop(rid, None)
+        if tr is None:
+            return None
+        if "aborted" in attrs:
+            tr.instant("serving.abort", where=attrs["aborted"])
+        # close the aggregate decode interval on EVERY exit path — a
+        # slow request aborted by a client timeout spent its life in
+        # decode, and that is exactly the span its trace must show
+        d0 = tr.marks.get("decode_t0")
+        if d0 is not None:
+            tr.emit("serving.decode", d0, _time_mod.perf_counter(),
+                    tokens=attrs.get("tokens"))
+        tr.finish(**attrs)
+        return tr.trace_id
 
     def _ensure_pages(self, slot_idx, steps) -> bool:
         """Grow the slot's allocation to cover `steps` successive decode
@@ -549,6 +599,18 @@ class ServingEngine:
                 s.max_new_tokens, list(s.tokens)))
         self._m.preemptions.inc()
         self._m.queue_depth.set(len(self._pending))
+        if self._traces:
+            tr = self._traces.get(s.request_id)
+            if tr is not None:
+                # annotate the eviction and re-open the queue phase; the
+                # aggregate decode span restarts after re-admission
+                tr.instant("serving.preempt",
+                           tokens_so_far=len(s.tokens))
+                d0 = tr.marks.pop("decode_t0", None)
+                if d0 is not None:
+                    tr.emit("serving.decode", d0,
+                            _time_mod.perf_counter(), preempted=True)
+                tr.begin("serving.queue", requeue=True)
         _flight.record_event("serving.preempt", rid=s.request_id,
                              tokens_so_far=len(s.tokens))
 
@@ -602,6 +664,7 @@ class ServingEngine:
         """new: list of (slot_idx, prompt_ids) — ONE compiled forward for
         all admitted prompts + ONE paged scatter per layer."""
         n = len(new)
+        t0_prefill = _time_mod.perf_counter() if self._traces else 0.0
         nb = 1
         while nb < n:
             nb *= 2
@@ -650,6 +713,16 @@ class ServingEngine:
         first_np = np.asarray(first)  # [nb] ints — tiny transfer
         for row, (si, _) in enumerate(new):
             self.slots[si]._first_token = int(first_np[row])
+        if self._traces:
+            # ONE batched compiled prefill served every admitted prompt:
+            # each participating trace gets the shared interval with its
+            # bucket attrs (the span naming scheme's `prefill[bucket]`)
+            t1_prefill = _time_mod.perf_counter()
+            for _row, (si, ids) in enumerate(new):
+                tr = self._traces.get(self.slots[si].request_id)
+                if tr is not None:
+                    tr.emit("serving.prefill", t0_prefill, t1_prefill,
+                            bucket=bucket, nb=nb, prompt_len=len(ids))
 
     # ------------------------------------------------------------------
     # decode step: one jitted forward for all slots
@@ -830,6 +903,7 @@ class ServingEngine:
         engine holds are dead buffers (ADVICE.md round-5)."""
         self._poisoned = why
         self._m.poisoned.set(1.0)
+        _trace.instant("serving.poisoned", why=why)
         _flight.record_event("serving.poisoned", why=why)
 
     def _check_poisoned(self):
@@ -867,6 +941,10 @@ class ServingEngine:
                 # enqueue-to-first-token time, preemption delay included
                 if rp is not None and "t_enq" in rp:
                     self._m.ttft.observe(now - rp.pop("t_enq"))
+                if self._traces:
+                    tr = self._traces.get(s.request_id)
+                    if tr is not None:
+                        tr.instant("serving.first_token")
                 self._stream(s.request_id, s._first_token)
                 eos = self._req_eos(s.request_id)
                 if (eos is not None and s.tokens[-1] == eos) or \
@@ -914,6 +992,13 @@ class ServingEngine:
         params, buffers = self._cached_params()
         t0 = _time_mod.perf_counter()
         tok0 = self._m.tokens.value
+        if self._traces:
+            # the per-request aggregate decode span runs from the first
+            # dispatch that includes the slot to its finish
+            for i in active:
+                tr = self._traces.get(self.slots[i].request_id)
+                if tr is not None and "decode_t0" not in tr.marks:
+                    tr.mark("decode_t0", t0)
         if k_burst > 1:
             fn = self._get_burst_fn(all_greedy, k_burst)
             try:
@@ -985,13 +1070,20 @@ class ServingEngine:
         """Per-step telemetry close-out: ZERO registry allocations —
         handle attribute reads + float ops only (the overhead guard test
         pins this)."""
-        dt = _time_mod.perf_counter() - t0
+        t1 = _time_mod.perf_counter()
+        dt = t1 - t0
         n_tok = self._m.tokens.value - tok0
         self._m.step_lat.observe(dt)
         self._m.token_lat.observe(dt / n_tok if n_tok > 0 else dt)
         self._m.occupancy.set(n_active / self.max_batch)
         self._m.page_util.set(
             1.0 - len(self._free_pages) / self._n_pages_total)
+        if self._traces:
+            # engine-timeline step span (thread track, not per-request):
+            # step granularity for the viewer without duplicating the
+            # interval across every active request's track
+            _trace.emit("serving.decode_step", t0, t1, active=n_active,
+                        tokens=n_tok)
         _flight.record_event("serving.step", active=n_active,
                              tokens=n_tok, seconds=round(dt, 6))
         _flight.beat_all()
@@ -1022,8 +1114,10 @@ class ServingEngine:
         s = self.slots[slot_idx]
         self._release_slot(slot_idx)
         self._m.finished.inc()
+        trace_id = self._finish_trace(s.request_id, tokens=len(s.tokens)) \
+            if self._traces else None
         _flight.record_event("serving.finish", rid=s.request_id,
-                             tokens=len(s.tokens))
+                             tokens=len(s.tokens), trace_id=trace_id)
         self._req_params.pop(s.request_id, None)
         # pop with default: an on_token callback may have abort()ed the
         # request between the decode step and this finish
@@ -1032,7 +1126,8 @@ class ServingEngine:
             request_id=s.request_id,
             prompt_ids=prompt if prompt is not None
             else np.zeros((0,), np.int64),
-            output_ids=np.asarray(s.tokens, np.int64))
+            output_ids=np.asarray(s.tokens, np.int64),
+            trace_id=trace_id)
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.active for s in self.slots)
@@ -1073,6 +1168,12 @@ class ServingEngine:
         n_bursts = min(int(max_bursts), -(-max(rem_of.values()) // k))
         if n_bursts <= 0:
             return [], 0
+        if self._traces:
+            t_disp0 = _time_mod.perf_counter()
+            for i in active:
+                tr = self._traces.get(self.slots[i].request_id)
+                if tr is not None and "decode_t0" not in tr.marks:
+                    tr.mark("decode_t0", t_disp0)
         params, buffers = self._cached_params()
         fn = self._get_burst_fn(st["all_greedy"], k)
         tokens = np.zeros((self.max_batch,), np.int64)

@@ -101,6 +101,16 @@ def _loader_metrics():
     return _dl_cache.get()
 
 
+def _trace_fetch(t0, t1, **attrs):
+    """Span-tracing twin of the fetch histogram: one `dataloader.fetch`
+    span per real batch when tracing is on (the trainer's
+    `train.data_wait` spans line up against these in the viewer)."""
+    from ..observability import tracing as _tracing
+
+    if _tracing.enabled():
+        _tracing.emit("dataloader.fetch", t0, t1, **attrs)
+
+
 def _mp_worker_loop(dataset, batch_lists, ring_name, collate, init_fn,
                     worker_id, num_workers=1):
     """Runs in a forked child: numpy-only; ships pickled batches by shm."""
@@ -220,7 +230,9 @@ class _MultiProcessIter:
             self._next += 1
             # only REAL batches count as fetches: the _END sentinel and
             # error exits above must not skew the latency distribution
-            fetch_h.observe(_time.perf_counter() - t0)
+            t1 = _time.perf_counter()
+            fetch_h.observe(t1 - t0)
+            _trace_fetch(t0, t1, worker=w)
             batches_c.inc()
             return _tensorize(item) if self._wrap else item
 
@@ -275,7 +287,9 @@ class _Iter:
                     return
                 t0 = _time.perf_counter()
                 batch = self._load_batch(indices)
-                fetch_h.observe(_time.perf_counter() - t0)
+                t1 = _time.perf_counter()
+                fetch_h.observe(t1 - t0)
+                _trace_fetch(t0, t1)
                 self._prefetch_q.put(batch)
                 depth_g.set(self._prefetch_q.qsize())
         finally:
@@ -306,7 +320,9 @@ class _Iter:
         t0 = _time.perf_counter()
         indices = next(self._batches)
         out = self._load_batch(indices)
-        fetch_h.observe(_time.perf_counter() - t0)
+        t1 = _time.perf_counter()
+        fetch_h.observe(t1 - t0)
+        _trace_fetch(t0, t1)
         batches_c.inc()
         return out
 

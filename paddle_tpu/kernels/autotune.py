@@ -263,31 +263,48 @@ class Autotuner:
                 candidates: Sequence[Candidate],
                 make_args: Callable[[], tuple]) -> Optional[dict]:
         """Time every candidate on bucket-shaped example inputs, persist
-        and return the entry. Returns None when nothing could be timed."""
+        and return the entry. Returns None when nothing could be timed.
+
+        Every measurement pass is OBSERVABLE in production (a cache-miss
+        re-timing under traffic is exactly the event an operator needs to
+        see): a `autotune.measure` span carries each candidate timing and
+        the winning decision as attributes, a flight-recorder
+        `autotune.decision` breadcrumb lands in the event ring, and
+        `autotune_decisions_total{op,winner}` counts it in the metrics
+        registry — not just in the JSON cache file."""
+        from ..observability import tracing as _tracing
+
         timer = _timer
-        args = make_args()
-        timings: Dict[str, float] = {}
-        for c in candidates:
-            try:
-                timings[c.name] = float(timer(c.fn, args))
-            except Exception:  # noqa: BLE001 — a failing candidate just
-                pass           # drops out of the table
-        if not timings:
-            return None
-        # argmin with XLA-first tie-break: equal times must never flip
-        # dispatch toward an unproven Pallas variant
-        order = {"xla": 0, "pallas": 1}
-        ranked = sorted(
-            timings.items(),
-            key=lambda kv: (kv[1],
-                            order.get(next((c.kind for c in candidates
-                                            if c.name == kv[0]), "pallas"),
-                                      1)))
-        entry = {
-            "winner": ranked[0][0],
-            "timings_ms": {k: round(v, 6) for k, v in timings.items()},
-            "op": op,
-        }
+        with _tracing.span("autotune.measure", op=op, key=key) as sp:
+            args = make_args()
+            timings: Dict[str, float] = {}
+            for c in candidates:
+                try:
+                    timings[c.name] = float(timer(c.fn, args))
+                except Exception:  # noqa: BLE001 — a failing candidate
+                    pass           # just drops out of the table
+            if not timings:
+                sp.set(outcome="nothing_timed")
+                return None
+            # argmin with XLA-first tie-break: equal times must never
+            # flip dispatch toward an unproven Pallas variant
+            order = {"xla": 0, "pallas": 1}
+            ranked = sorted(
+                timings.items(),
+                key=lambda kv: (kv[1],
+                                order.get(
+                                    next((c.kind for c in candidates
+                                          if c.name == kv[0]), "pallas"),
+                                    1)))
+            entry = {
+                "winner": ranked[0][0],
+                "timings_ms": {k: round(v, 6)
+                               for k, v in timings.items()},
+                "op": op,
+            }
+            sp.set(winner=entry["winner"],
+                   timings_ms=entry["timings_ms"])
+        _record_decision(op, key, entry)
         with self._lock:
             self._load()
             self._mem[key] = entry
@@ -331,6 +348,35 @@ class Autotuner:
             if c is not None and ok(c):
                 return c
         return None
+
+
+# decision-observability handles (labeled counter); HandleCache
+# re-resolves after a registry swap/reset — tests included
+_decisions_cache = None
+
+
+def _record_decision(op: str, key: str, entry: dict):
+    """Surface a measurement decision in the metrics registry and the
+    flight-recorder ring (cache-miss re-timings under traffic must be
+    visible in production, not just in the JSON cache file). Never
+    raises — observability must not take a tuning pass down."""
+    global _decisions_cache
+    try:
+        from ..observability import flight_recorder as _flight
+        from ..observability import metrics as _om
+
+        if _decisions_cache is None:
+            _decisions_cache = _om.HandleCache(lambda reg: reg.counter(
+                "autotune_decisions_total",
+                "Autotune measurement passes that picked a winner "
+                "(cache-miss re-timings included), by op and winning "
+                "candidate.", labels=("op", "winner")))
+        _decisions_cache.get().labels(op, entry["winner"]).inc()
+        _flight.record_event("autotune.decision", op=op, key=key,
+                             winner=entry["winner"],
+                             timings_ms=entry["timings_ms"])
+    except Exception:  # noqa: BLE001
+        pass
 
 
 _default_tuner: Optional[Autotuner] = None

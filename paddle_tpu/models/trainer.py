@@ -50,6 +50,7 @@ def _instrument_step(step_fn):
 
     from ..observability import flight_recorder as _flight
     from ..observability import metrics as _om
+    from ..observability import tracing as _trace
 
     if getattr(step_fn, "_observed", False):
         return step_fn
@@ -69,9 +70,14 @@ def _instrument_step(step_fn):
     state = {"last_end": None}
 
     def instrumented(input_ids, labels):
+        # per-step span trace (head-sampled; NOOP_TRACE when
+        # FLAGS_trace_sample=0 — one flag read, zero allocations)
+        trc = _trace.start_trace("train.step") if _trace.enabled() \
+            else _trace.NOOP_TRACE
         t0 = _time.perf_counter()
-        if state["last_end"] is not None:
-            wait_h.observe(t0 - state["last_end"])
+        last_end = state["last_end"]
+        if last_end is not None:
+            wait_h.observe(t0 - last_end)
         out = step_fn(input_ids, labels)
         t1 = _time.perf_counter()
         state["last_end"] = t1
@@ -80,8 +86,17 @@ def _instrument_step(step_fn):
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         n_tok = int(np.prod(x.shape)) if hasattr(x, "shape") else 0
         tokens_c.inc(n_tok)
+        if trc.trace_id is not None:
+            # the two phases an operator budgets a step by: host gap
+            # since the previous step returned (dataloader stalls) and
+            # the compiled dispatch itself
+            if last_end is not None:
+                trc.emit("train.data_wait", last_end, t0)
+            trc.emit("train.step_compute", t0, t1, tokens=n_tok)
+            trc.finish(step=int(steps_c.value), tokens=n_tok)
         _flight.record_event("train.step", tokens=n_tok,
-                             seconds=round(t1 - t0, 6))
+                             seconds=round(t1 - t0, 6),
+                             trace_id=trc.trace_id)
         _flight.beat_all()
         return out
 

@@ -1,10 +1,19 @@
 """Runtime telemetry: metrics registry + exporters + stall flight
-recorder (SURVEY.md §5 "Metrics / logging").
+recorder + span tracer (SURVEY.md §5 "Metrics / logging").
 
 - `metrics` — Counter/Gauge/Histogram cells, labeled families, the
   process-default registry, Prometheus-text and JSONL exporters.
 - `flight_recorder` — bounded event ring + watchdog thread that turns a
   silent hang into a thread-stack dump and a `stalls_total` increment.
+- `tracing` — per-request / per-step span timelines with head-based
+  sampling (`FLAGS_trace_sample`) and Chrome trace-event export that
+  Perfetto loads directly; `tools/trace_report.py` prints TTFT
+  breakdowns and the critical path from the exported JSON.
+
+The three channels correlate: spans and flight-recorder breadcrumbs
+carry the same `rid`/`trace_id` fields, the watchdog stall dump appends
+the in-flight span stack, and slow traces bump
+`trace_slow_requests_total` in the registry.
 
 Exported metric names are documented in README.md ("Observability").
 """
@@ -28,4 +37,15 @@ from .flight_recorder import (  # noqa: F401
     beat_all,
     default_recorder,
     record_event,
+)
+from .tracing import (  # noqa: F401
+    Trace,
+    Tracer,
+    default_tracer,
+    open_spans,
+    set_default_tracer,
+    span,
+    start_trace,
+    to_chrome_trace,
+    write_trace,
 )

@@ -183,6 +183,26 @@ class Watchdog:
             "== python thread stacks ==",
             _format_thread_stacks(),
             "",
+            "== open spans (longest first) ==",
+        ]
+        # the span tracer knows WHERE each thread is stuck semantically
+        # ("41 s inside serving.prefill"), not just which stack frame —
+        # append every in-flight span with its elapsed time
+        try:
+            from . import tracing as _tracing
+
+            opened = _tracing.open_spans()
+            if opened:
+                for thread_name, span_name, elapsed in opened:
+                    lines.append(
+                        f"{thread_name}: {span_name} "
+                        f"({elapsed:.3f}s open)")
+            else:
+                lines.append("(none)")
+        except Exception:  # noqa: BLE001 — a tracer failure must not
+            lines.append("(unavailable)")  # take the stall dump down
+        lines += [
+            "",
             f"== last {self.tail_events} events "
             f"(of {len(self.recorder)} in ring) ==",
         ]

@@ -26,6 +26,7 @@ from __future__ import annotations
 import bisect
 import json
 import math
+import os
 import threading
 import time
 from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
@@ -349,9 +350,47 @@ def to_prometheus(registry: Optional[Registry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+_atomic_seq = 0
+
+
+def atomic_write(path: str, text: str, append: bool = False):
+    """Write `text` via a temp file in the target directory + os.replace:
+    a scraper reading mid-write sees either the old complete file or the
+    new complete file, never a torn one (same discipline as the autotune
+    cache). The temp name is unique per (pid, thread, call) so concurrent
+    writers of the SAME path can't truncate each other's temp file — the
+    last replace wins whole, never torn.
+
+    Append mode folds the existing content into the temp file first, so
+    a reader still only ever sees complete snapshots; that trades
+    kernel-level O_APPEND merging for replace-atomicity, so it assumes
+    ONE appender per path (the snapshot-history use case) — concurrent
+    appenders should write distinct paths."""
+    global _atomic_seq
+    path = os.path.abspath(path)
+    if append:
+        try:
+            with open(path) as f:
+                text = f.read() + text
+        except FileNotFoundError:
+            pass
+    _atomic_seq += 1
+    tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}."
+           f"{_atomic_seq}.tmp")
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def write_prometheus(path: str, registry: Optional[Registry] = None):
-    with open(path, "w") as f:
-        f.write(to_prometheus(registry))
+    atomic_write(path, to_prometheus(registry))
 
 
 def snapshot(registry: Optional[Registry] = None) -> list:
@@ -384,6 +423,6 @@ def write_jsonl(path_or_file, registry: Optional[Registry] = None,
         for r in rows:
             path_or_file.write(json.dumps(r) + "\n")
         return
-    with open(path_or_file, "a" if append else "w") as f:
-        for r in rows:
-            f.write(json.dumps(r) + "\n")
+    atomic_write(path_or_file,
+                 "".join(json.dumps(r) + "\n" for r in rows),
+                 append=append)

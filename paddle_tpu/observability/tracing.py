@@ -1,0 +1,539 @@
+"""Span tracing: per-request / per-step timelines with Chrome-trace
+export (README.md "Observability", third channel).
+
+The metrics registry answers "what are the aggregates" and the flight
+recorder answers "what happened just before the hang" — neither answers
+"*why* was THIS request's TTFT 900 ms" or "which phase of step N ate the
+budget". Spans do: every instrumented hot path (serving request
+lifecycle, train step phases, autotune measurement, checkpoint saves,
+collective calls, dataloader fetches) records bounded, monotonic-clock
+intervals that export directly into the Chrome trace-event JSON format
+Perfetto / chrome://tracing load natively, and that
+`tools/trace_report.py` turns into TTFT breakdowns and a critical path.
+
+Design (dependency-free, thread-safe, zero-overhead when off):
+
+- `span(name, **attrs)` — context manager for synchronous phases;
+  `begin(...)`/`end()` — explicit open spans for async phases that cross
+  call boundaries (a request's queue wait). Timestamps come from
+  `time.perf_counter()` (monotonic — wall-clock steps never produce
+  negative durations).
+- `Trace` — one logical timeline (one serving request, one train step).
+  Spans buffer on the trace and commit into the tracer's bounded ring at
+  `finish()`, subject to HEAD-BASED sampling: the keep/drop decision is
+  taken when the trace starts (`FLAGS_trace_sample` = sampling
+  probability, 0 = tracing off entirely). Escape hatch: when
+  `FLAGS_trace_slow_ms` > 0, an UNsampled trace still buffers and is
+  promoted to the ring if its total latency crosses the threshold — the
+  slow tail is exactly what an operator needs and exactly what head
+  sampling would lose; each promotion (and every sampled-slow trace)
+  bumps `trace_slow_requests_total`.
+- Track assignment: synchronous spans land on a per-thread track (with
+  thread-name metadata); each own-track `Trace` (serving requests) gets
+  its own `req/<trace_id>` track so overlapping requests don't corrupt
+  each other's nesting in the viewer.
+- Storage is a bounded ring (`deque(maxlen=...)`) of plain tuples — one
+  append per committed span, safe on any hot path under the GIL.
+- `FLAGS_trace_sample=0` fast path: `enabled()` is one flag read;
+  `span()`/`start_trace()` return shared no-op singletons and allocate
+  NOTHING (`Tracer.spans_created` counts every span/trace allocation so
+  tests can pin the fast path, same discipline as
+  `Registry.allocations`).
+
+Correlation across the three channels: spans carry the same `rid` /
+`trace_id` fields `flight_recorder.record_event` breadcrumbs carry, the
+watchdog stall dump appends the currently-open spans per thread
+(`open_spans()`), and slow traces surface in the metrics registry via
+`trace_slow_requests_total`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+# span record ring entry: (ph, name, t0, t1, tid, trace_id, attrs)
+#   ph: "X" complete span | "i" instant event
+#   t0/t1: time.perf_counter() seconds (t1 == t0 for instants)
+#   tid: integer track id (thread track or per-trace request track)
+#   trace_id: int or None (freestanding spans)
+#   attrs: dict or None
+_PH_SPAN = "X"
+_PH_INSTANT = "i"
+
+# request/trace tracks live far above thread tracks so the two ranges
+# can never collide in the viewer
+_TRACE_TID_BASE = 1 << 20
+
+_clock = time.perf_counter
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def sample_rate() -> float:
+    try:
+        return float(_flags().get_flag("FLAGS_trace_sample", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def slow_ms() -> float:
+    try:
+        return float(_flags().get_flag("FLAGS_trace_slow_ms", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of tracing when it is off."""
+    return sample_rate() > 0.0
+
+
+# ---------------------------------------------------------------------------
+# no-op singletons (the FLAGS_trace_sample=0 fast path allocates nothing)
+# ---------------------------------------------------------------------------
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def end(self, **attrs):
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _NoopTrace:
+    __slots__ = ()
+    trace_id = None
+    sampled = False
+    marks: dict = {}
+
+    def span(self, name, **attrs):
+        return NOOP_SPAN
+
+    def begin(self, name, **attrs):
+        return NOOP_SPAN
+
+    def end(self, name, **attrs):
+        return None
+
+    def emit(self, name, t0, t1, **attrs):
+        return None
+
+    def instant(self, name, **attrs):
+        return None
+
+    def mark(self, key, value):
+        return None
+
+    def finish(self, **attrs):
+        return None
+
+
+NOOP_TRACE = _NoopTrace()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class _OpenSpan:
+    """An in-flight span: context manager AND explicit-`end()` handle.
+
+    Registered with its tracer while open so the watchdog stall dump can
+    report "hung 41 s inside serving.prefill" (`open_spans()`)."""
+
+    __slots__ = ("_tracer", "_trace", "name", "t0", "attrs", "tid",
+                 "_thread", "_done")
+
+    def __init__(self, tracer, trace, name, tid, attrs):
+        self._tracer = tracer
+        self._trace = trace
+        self.name = name
+        self.t0 = _clock()
+        self.attrs = attrs or None
+        self.tid = tid
+        self._thread = threading.current_thread().name
+        self._done = False
+        tracer._open[id(self)] = self
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span (e.g. the autotune
+        winner)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def end(self, **attrs):
+        if self._done:
+            return
+        self._done = True
+        if attrs:
+            self.set(**attrs)
+        self._tracer._open.pop(id(self), None)
+        rec = (_PH_SPAN, self.name, self.t0, _clock(), self.tid,
+               self._trace.trace_id if self._trace is not None else None,
+               self.attrs)
+        if self._trace is not None:
+            self._trace._spans.append(rec)
+        else:
+            self._tracer._ring.append(rec)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.set(error=repr(exc) if exc is not None
+                     else exc_type.__name__)
+        self.end()
+        return False
+
+
+class Trace:
+    """One logical timeline (request / train step): spans buffer here and
+    commit to the ring at `finish()` if the head-sampling decision said
+    keep — or if the trace turned out slow (`FLAGS_trace_slow_ms`)."""
+
+    __slots__ = ("_tracer", "trace_id", "sampled", "t0", "_spans",
+                 "_tid", "marks", "name", "_finished")
+
+    def __init__(self, tracer, trace_id, sampled, name, own_track, attrs):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.sampled = sampled
+        self.name = name
+        self.t0 = _clock()
+        self._spans: List[tuple] = []
+        self._tid = (_TRACE_TID_BASE + trace_id) if own_track \
+            else tracer._thread_tid()
+        self.marks: Dict[str, float] = {}
+        self._finished = False
+        if attrs:
+            self._spans.append((_PH_INSTANT, name or "trace.start",
+                                self.t0, self.t0, self._tid, trace_id,
+                                dict(attrs)))
+
+    def span(self, name, **attrs):
+        """Synchronous child span (context manager)."""
+        self._tracer.spans_created += 1
+        return _OpenSpan(self._tracer, self, name, self._tid,
+                         attrs or None)
+
+    def begin(self, name, **attrs):
+        """Open an async phase; close with the handle's `.end()` or
+        `trace.end(name)` from another call frame."""
+        return self.span(name, **attrs)
+
+    def end(self, name, **attrs):
+        """Close the most recent still-open span named `name` (async
+        phases whose begin handle wasn't threaded through)."""
+        for sp in reversed(list(self._tracer._open.values())):
+            if sp._trace is self and sp.name == name:
+                sp.end(**attrs)
+                return
+        return None
+
+    def emit(self, name, t0, t1, **attrs):
+        """Record a completed span with explicit endpoints (phases timed
+        by the caller, e.g. one batched prefill shared by N requests)."""
+        self._tracer.spans_created += 1
+        self._spans.append((_PH_SPAN, name, t0, t1, self._tid,
+                            self.trace_id, attrs or None))
+
+    def instant(self, name, **attrs):
+        """Zero-duration annotation (preempt / abort / first-token)."""
+        self._tracer.spans_created += 1
+        now = _clock()
+        self._spans.append((_PH_INSTANT, name, now, now, self._tid,
+                            self.trace_id, attrs or None))
+
+    def mark(self, key, value):
+        """Stash a timestamp/value on the trace (e.g. decode start)."""
+        self.marks[key] = value
+
+    def finish(self, **attrs):
+        """Commit (or drop) the buffered timeline. Returns the total
+        trace duration in seconds."""
+        if self._finished:
+            return None
+        self._finished = True
+        # close any span left open (error paths) so nothing leaks in
+        # the watchdog's open-span registry
+        for sp in list(self._tracer._open.values()):
+            if sp._trace is self:
+                sp.end(unclosed=True)
+        now = _clock()
+        total = now - self.t0
+        threshold = slow_ms()
+        slow = threshold > 0.0 and total * 1e3 >= threshold
+        if slow:
+            self._tracer._slow_counter().inc()
+        if self.sampled or slow:
+            if attrs or slow:
+                a = dict(attrs) if attrs else {}
+                if slow:
+                    a["slow"] = True
+                a["total_s"] = round(total, 6)
+                self._spans.append((_PH_SPAN, self.name or "trace",
+                                    self.t0, now, self._tid,
+                                    self.trace_id, a))
+            for rec in self._spans:
+                self._tracer._ring.append(rec)
+        self._spans = []
+        return total
+
+
+# ---------------------------------------------------------------------------
+# the tracer
+# ---------------------------------------------------------------------------
+
+
+class Tracer:
+    """Bounded ring of committed spans + sampling + Chrome export."""
+
+    def __init__(self, capacity: int = 16384,
+                 registry: Optional[_metrics.Registry] = None):
+        self._ring = deque(maxlen=int(capacity))
+        self._open: Dict[int, _OpenSpan] = {}
+        self._lock = threading.Lock()
+        self._thread_tids: Dict[int, int] = {}
+        self._thread_names: Dict[int, str] = {}
+        self._next_trace_id = 0
+        # deterministic head-sampling accumulator: take a trace whenever
+        # the running sum of the sample rate crosses an integer — exact
+        # at rate 1, rate-accurate (not RNG-flaky) below it
+        self._sample_acc = 0.0
+        # every Span/Trace object minted (the FLAGS_trace_sample=0
+        # alloc-guard asserts this stays flat, like Registry.allocations)
+        self.spans_created = 0
+        self._registry = registry
+        self._slow_cache: Optional[_metrics.HandleCache] = None
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self) -> bool:
+        rate = sample_rate()
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        with self._lock:
+            self._sample_acc += rate
+            if self._sample_acc >= 1.0:
+                self._sample_acc -= 1.0
+                return True
+        return False
+
+    def _slow_counter(self):
+        if self._registry is not None:
+            return self._registry.counter(
+                "trace_slow_requests_total",
+                "Traces whose total latency crossed FLAGS_trace_slow_ms "
+                "(committed to the trace ring even when head sampling "
+                "dropped them).")
+        if self._slow_cache is None:
+            self._slow_cache = _metrics.HandleCache(
+                lambda reg: reg.counter(
+                    "trace_slow_requests_total",
+                    "Traces whose total latency crossed "
+                    "FLAGS_trace_slow_ms (committed to the trace ring "
+                    "even when head sampling dropped them)."))
+        return self._slow_cache.get()
+
+    # -- track bookkeeping -------------------------------------------------
+
+    def _thread_tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._thread_tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._thread_tids.get(ident)
+                if tid is None:
+                    tid = len(self._thread_tids) + 1
+                    self._thread_tids[ident] = tid
+                    self._thread_names[tid] = \
+                        threading.current_thread().name
+        return tid
+
+    # -- recording ---------------------------------------------------------
+
+    def start_trace(self, name: str = "trace", own_track: bool = False,
+                    **attrs):
+        """Begin a logical timeline; head sampling decides retention NOW.
+        Returns NOOP_TRACE (not None — callers never branch) when
+        tracing is off, or when the trace is unsampled and the slow
+        escape hatch is disabled (nothing could ever commit it)."""
+        if not enabled():
+            return NOOP_TRACE
+        sampled = self.sample()
+        if not sampled and slow_ms() <= 0.0:
+            return NOOP_TRACE
+        with self._lock:
+            trace_id = self._next_trace_id
+            self._next_trace_id += 1
+        self.spans_created += 1
+        return Trace(self, trace_id, sampled, name, own_track, attrs)
+
+    def span(self, name, **attrs):
+        """Freestanding synchronous span on the calling thread's track
+        (control-plane phases: autotune measurement, checkpoint saves,
+        collective calls). Committed whenever tracing is enabled — these
+        are low-rate and always worth keeping."""
+        if not enabled():
+            return NOOP_SPAN
+        self.spans_created += 1
+        return _OpenSpan(self, None, name, self._thread_tid(),
+                         attrs or None)
+
+    def emit(self, name, t0, t1, **attrs):
+        """Freestanding completed span with explicit endpoints."""
+        if not enabled():
+            return
+        self.spans_created += 1
+        self._ring.append((_PH_SPAN, name, t0, t1, self._thread_tid(),
+                           None, attrs or None))
+
+    def instant(self, name, **attrs):
+        if not enabled():
+            return
+        self.spans_created += 1
+        now = _clock()
+        self._ring.append((_PH_INSTANT, name, now, now,
+                           self._thread_tid(), None, attrs or None))
+
+    # -- introspection -----------------------------------------------------
+
+    def open_spans(self) -> List[Tuple[str, str, float]]:
+        """(thread_name, span_name, elapsed_s) for every in-flight span,
+        oldest first — the watchdog appends this to its stall dump."""
+        now = _clock()
+        out = [(sp._thread, sp.name, now - sp.t0)
+               for sp in list(self._open.values())]
+        out.sort(key=lambda r: -r[2])
+        return out
+
+    def __len__(self):
+        return len(self._ring)
+
+    def clear(self):
+        self._ring.clear()
+        self._open.clear()
+
+    # -- export ------------------------------------------------------------
+
+    def to_chrome_trace(self) -> List[dict]:
+        """The ring as a Chrome trace-event ARRAY (the JSON Array Format
+        both Perfetto and chrome://tracing load directly). Stable field
+        set per event: name/cat/ph/ts/dur/pid/tid/args ("X"), instants
+        drop dur and add s (scope)."""
+        pid = os.getpid()
+        recs = list(self._ring)
+        events: List[dict] = []
+        seen_tids = set()
+        for ph, name, t0, t1, tid, trace_id, attrs in recs:
+            args = dict(attrs) if attrs else {}
+            if trace_id is not None:
+                args.setdefault("trace_id", trace_id)
+            ev = {
+                "name": name,
+                "cat": name.split(".", 1)[0],
+                "ph": ph,
+                "ts": round(t0 * 1e6, 3),
+                "pid": pid,
+                "tid": int(tid),
+                "args": args,
+            }
+            if ph == _PH_SPAN:
+                ev["dur"] = round(max(t1 - t0, 0.0) * 1e6, 3)
+            else:
+                ev["s"] = "t"
+            events.append(ev)
+            seen_tids.add(int(tid))
+        events.sort(key=lambda e: e["ts"])
+        meta: List[dict] = []
+        for tid in sorted(seen_tids):
+            if tid >= _TRACE_TID_BASE:
+                tname = f"req/{tid - _TRACE_TID_BASE}"
+            else:
+                tname = self._thread_names.get(tid, f"thread-{tid}")
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": tname}})
+        return meta + events
+
+    def write_trace(self, path: str) -> int:
+        """Atomically write the Chrome trace JSON; returns the number of
+        non-metadata events written."""
+        events = self.to_chrome_trace()
+        _metrics.atomic_write(path, json.dumps(events, indent=0))
+        return sum(1 for e in events if e["ph"] != "M")
+
+
+# ---------------------------------------------------------------------------
+# process-global default tracer + module-level convenience API
+# ---------------------------------------------------------------------------
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default
+
+
+def set_default_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process default (tests); returns the previous one."""
+    global _default
+    prev = _default
+    _default = tracer
+    return prev
+
+
+def start_trace(name: str = "trace", own_track: bool = False, **attrs):
+    return _default.start_trace(name, own_track=own_track, **attrs)
+
+
+def span(name, **attrs):
+    return _default.span(name, **attrs)
+
+
+def emit(name, t0, t1, **attrs):
+    return _default.emit(name, t0, t1, **attrs)
+
+
+def instant(name, **attrs):
+    return _default.instant(name, **attrs)
+
+
+def open_spans():
+    return _default.open_spans()
+
+
+def to_chrome_trace():
+    return _default.to_chrome_trace()
+
+
+def write_trace(path: str) -> int:
+    return _default.write_trace(path)

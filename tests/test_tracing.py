@@ -1,0 +1,551 @@
+"""Span tracing (ISSUE 3 tentpole; paddle_tpu/observability/tracing.py).
+
+Covers the acceptance contract: golden Chrome-trace export (stable field
+set, valid JSON, monotonic ts), head sampling on/off plus the
+always-sample-on-slow escape hatch, serving requests carrying
+`FinishedRequest.trace_id` with correctly ordered/nested spans, trainer
+step spans, the FLAGS_trace_sample=0 zero-allocation fast path (same
+discipline as the metrics alloc-guard), atomic exporter writes, the
+autotune decision counter, the watchdog open-span dump, and the
+trace_report critical path.
+"""
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import config as _config
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import tracing as tr
+
+
+@pytest.fixture
+def tracer(monkeypatch):
+    """Fresh default tracer with FLAGS_trace_sample=1; restores both."""
+    monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"], "value", 1.0)
+    monkeypatch.setattr(_config._FLAGS["FLAGS_trace_slow_ms"], "value", 0.0)
+    fresh = tr.Tracer()
+    prev = tr.set_default_tracer(fresh)
+    yield fresh
+    tr.set_default_tracer(prev)
+
+
+@pytest.fixture
+def tracer_off(monkeypatch):
+    monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"], "value", 0.0)
+    monkeypatch.setattr(_config._FLAGS["FLAGS_trace_slow_ms"], "value", 0.0)
+    fresh = tr.Tracer()
+    prev = tr.set_default_tracer(fresh)
+    yield fresh
+    tr.set_default_tracer(prev)
+
+
+def _tiny_engine(**kw):
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, seq=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("page_size", 8)
+    return ServingEngine(m, **kw), cfg
+
+
+def _load_trace_report():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_report.py")
+    spec = importlib.util.spec_from_file_location("trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestChromeExport:
+    def test_golden_event_fields(self, tracer):
+        with tr.span("outer.phase", x=1):
+            with tr.span("outer.child"):
+                pass
+        tracer.instant("outer.marker", note="hi")
+        events = tr.to_chrome_trace()
+        # valid JSON round-trip (what Perfetto actually parses)
+        events2 = json.loads(json.dumps(events))
+        assert events2 == events
+        xs = [e for e in events if e["ph"] == "X"]
+        instants = [e for e in events if e["ph"] == "i"]
+        metas = [e for e in events if e["ph"] == "M"]
+        assert len(xs) == 2 and len(instants) == 1
+        # STABLE field set — the golden contract the report/viewer rely on
+        for e in xs:
+            assert set(e.keys()) == {"name", "cat", "ph", "ts", "dur",
+                                     "pid", "tid", "args"}
+            assert e["dur"] >= 0 and e["ts"] >= 0
+            assert e["pid"] == os.getpid()
+            assert e["cat"] == "outer"
+        for e in instants:
+            assert set(e.keys()) == {"name", "cat", "ph", "ts", "pid",
+                                     "tid", "args", "s"}
+        # thread metadata present for every tid used
+        tids = {e["tid"] for e in xs + instants}
+        assert tids == {m["tid"] for m in metas}
+        assert all(m["name"] == "thread_name" for m in metas)
+        # monotonic: non-meta events sorted by ts
+        ts = [e["ts"] for e in events if e["ph"] != "M"]
+        assert ts == sorted(ts)
+
+    def test_nesting_and_ring_bound(self, tracer):
+        with tr.span("a"):
+            with tr.span("b"):
+                time.sleep(0.001)
+        evs = {e["name"]: e for e in tr.to_chrome_trace()
+               if e["ph"] == "X"}
+        a, b = evs["a"], evs["b"]
+        # child contained in parent (same thread track)
+        assert a["tid"] == b["tid"]
+        assert a["ts"] <= b["ts"]
+        assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+        small = tr.Tracer(capacity=4)
+        prev = tr.set_default_tracer(small)
+        try:
+            for i in range(10):
+                with tr.span(f"s{i}"):
+                    pass
+            assert len(small) == 4  # bounded ring
+        finally:
+            tr.set_default_tracer(prev)
+
+    def test_write_trace_atomic(self, tracer, tmp_path):
+        with tr.span("x"):
+            pass
+        p = tmp_path / "trace.json"
+        n = tr.write_trace(str(p))
+        assert n == 1
+        payload = json.loads(p.read_text())
+        assert isinstance(payload, list)  # the trace-event ARRAY form
+        assert not list(tmp_path.glob("*.tmp"))  # no torn temp left
+
+
+class TestSampling:
+    def test_off_is_noop_singletons(self, tracer_off):
+        assert not tr.enabled()
+        assert tr.span("a") is tr.NOOP_SPAN
+        assert tr.start_trace("t") is tr.NOOP_TRACE
+        tr.emit("e", 0.0, 1.0)
+        tr.instant("i")
+        assert tracer_off.spans_created == 0
+        assert len(tracer_off) == 0
+
+    def test_rate_one_keeps_everything(self, tracer):
+        for _ in range(3):
+            t = tr.start_trace("t")
+            assert t.sampled
+            t.emit("p", 0.0, 1.0)
+            t.finish()
+        assert len(tracer) == 3
+
+    def test_fractional_rate_deterministic(self, tracer, monkeypatch):
+        monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"],
+                            "value", 0.5)
+        kept = sum(1 for _ in range(10) if tracer.sample())
+        assert kept == 5  # accumulator sampling is rate-exact, not flaky
+
+    def test_unsampled_trace_dropped_without_escape_hatch(
+            self, tracer, monkeypatch):
+        monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"],
+                            "value", 0.01)
+        t = tr.start_trace("t")
+        assert t is tr.NOOP_TRACE  # nothing could ever commit it
+        assert len(tracer) == 0
+
+    def test_slow_escape_hatch_promotes_and_counts(self, monkeypatch):
+        monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"],
+                            "value", 0.01)
+        monkeypatch.setattr(_config._FLAGS["FLAGS_trace_slow_ms"],
+                            "value", 1.0)
+        reg = om.Registry()
+        tracer = tr.Tracer(registry=reg)
+        prev = tr.set_default_tracer(tracer)
+        try:
+            t = tr.start_trace("slow.req")
+            assert t is not tr.NOOP_TRACE and not t.sampled
+            with t.span("slow.phase"):
+                time.sleep(0.005)  # >> 1 ms threshold
+            t.finish()
+            assert len(tracer) >= 2  # phase + slow summary committed
+            assert reg.value("trace_slow_requests_total") == 1
+            names = [e["name"] for e in tr.to_chrome_trace()
+                     if e["ph"] == "X"]
+            assert "slow.phase" in names and "slow.req" in names
+            summary = [e for e in tr.to_chrome_trace()
+                       if e["name"] == "slow.req"][0]
+            assert summary["args"]["slow"] is True
+            # a FAST unsampled trace still drops
+            t2 = tr.start_trace("fast.req")
+            t2.emit("fast.phase", 0.0, 0.0001)
+            t2.finish()
+            assert "fast.phase" not in [
+                e["name"] for e in tr.to_chrome_trace()]
+            assert reg.value("trace_slow_requests_total") == 1
+        finally:
+            tr.set_default_tracer(prev)
+
+
+class TestServingTracing:
+    def test_finished_request_trace_id_and_span_order(self, tracer):
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(0)
+        rids = [eng.add_request(rng.randint(0, 97, (6,)),
+                                max_new_tokens=4) for _ in range(2)]
+        finished = eng.run()
+        assert len(finished) == 2
+        by_rid = {f.request_id: f for f in finished}
+        assert all(by_rid[r].trace_id is not None for r in rids)
+        assert by_rid[rids[0]].trace_id != by_rid[rids[1]].trace_id
+        events = tr.to_chrome_trace()
+        for f in finished:
+            mine = [e for e in events
+                    if e.get("args", {}).get("trace_id") == f.trace_id]
+            spans = {e["name"]: e for e in mine if e["ph"] == "X"}
+            # the per-request phase timeline is complete…
+            for name in ("serving.queue", "serving.prefill",
+                         "serving.decode", "serving.request"):
+                assert name in spans, (f.trace_id, sorted(spans))
+            # …ordered queue -> prefill -> decode…
+            q, p, d = (spans["serving.queue"], spans["serving.prefill"],
+                       spans["serving.decode"])
+            assert q["ts"] <= p["ts"] <= d["ts"]
+            assert q["ts"] + q["dur"] <= p["ts"] + 1.0  # µs slack
+            # …and NESTED inside the request envelope on its own track
+            env = spans["serving.request"]
+            for s in (q, p, d):
+                assert s["tid"] == env["tid"]
+                assert env["ts"] <= s["ts"] + 1.0
+                assert s["ts"] + s["dur"] <= env["ts"] + env["dur"] + 1.0
+            assert env["args"]["tokens"] == len(f.output_ids)
+            assert spans["serving.prefill"]["args"]["bucket"] == 8
+            # first-token instant present (TTFT anchor)
+            assert any(e["name"] == "serving.first_token"
+                       for e in mine if e["ph"] == "i")
+        # engine-timeline decode steps recorded on a thread track
+        assert any(e["name"] == "serving.decode_step" for e in events)
+
+    def test_trace_id_on_flight_recorder_events(self, tracer):
+        rec = fr.default_recorder()
+        rec.clear()
+        eng, cfg = _tiny_engine()
+        rid = eng.add_request(np.arange(4), max_new_tokens=2)
+        finished = eng.run()
+        tid = finished[0].trace_id
+        assert tid is not None
+        evs = {kind: fields for _, kind, fields in rec.tail()}
+        assert evs["serving.add_request"]["trace_id"] == tid
+        assert evs["serving.add_request"]["rid"] == rid
+        assert evs["serving.finish"]["trace_id"] == tid
+
+    def test_preempt_annotated_and_requeued(self, tracer):
+        eng, cfg = _tiny_engine()
+        rid = eng.add_request(np.arange(6), max_new_tokens=6)
+        eng.step()
+        eng._preempt(0)
+        out = eng.run()
+        assert len(out) == 1 and out[0].request_id == rid
+        mine = [e for e in tr.to_chrome_trace()
+                if e.get("args", {}).get("trace_id") == out[0].trace_id]
+        assert any(e["name"] == "serving.preempt" for e in mine)
+        # the queue phase reopened on requeue: two queue spans total
+        queues = [e for e in mine if e["name"] == "serving.queue"]
+        assert len(queues) == 2
+        assert any(e["args"].get("requeue") for e in queues)
+        # trace_report sums repeated phases — a preempted request's
+        # queue/decode columns must cover BOTH segments
+        rep = _load_trace_report()
+        row = [r for r in rep.serving_rows(tr.to_chrome_trace())
+               if r["trace_id"] == out[0].trace_id][0]
+        assert row["queue_us"] == pytest.approx(
+            sum(q["dur"] for q in queues))
+        decodes = [e for e in mine if e["name"] == "serving.decode"]
+        assert len(decodes) == 2  # pre-preemption segment + final
+        assert row["decode_us"] == pytest.approx(
+            sum(d["dur"] for d in decodes))
+
+    def test_abort_finishes_trace(self, tracer):
+        eng, cfg = _tiny_engine()
+        rid = eng.add_request(np.arange(4), max_new_tokens=4)
+        assert eng.abort(rid)
+        assert rid not in eng._traces  # no leak
+        assert any(e["name"] == "serving.abort"
+                   for e in tr.to_chrome_trace())
+
+    def test_abort_mid_decode_keeps_decode_span(self, tracer):
+        # a slow request aborted by a client timeout spent its life in
+        # decode — its trace must show that interval, not decode=0
+        eng, cfg = _tiny_engine()
+        rid = eng.add_request(np.arange(4), max_new_tokens=8)
+        eng.step()  # admit + first token
+        eng.step()  # at least one real decode dispatch
+        assert eng.abort(rid)
+        mine = [e for e in tr.to_chrome_trace() if e["ph"] == "X"]
+        decode = [e for e in mine if e["name"] == "serving.decode"]
+        assert len(decode) == 1 and decode[0]["dur"] > 0
+        # slot doesn't leak the trace id to its next tenant
+        assert all(s.trace_id == -1 for s in eng.slots)
+
+    def test_zero_alloc_fast_path_when_off(self, tracer_off):
+        # the acceptance guard: with FLAGS_trace_sample=0 a warm decode
+        # loop creates ZERO span/trace objects (same discipline as the
+        # metrics registry alloc-guard)
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(2)
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=6)
+        eng.run()  # warm
+        eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=6)
+        c0 = tracer_off.spans_created
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        assert steps >= 2
+        assert tracer_off.spans_created - c0 == 0
+        assert len(tracer_off) == 0
+        assert eng._traces == {}
+
+
+class TestTrainTracing:
+    def test_step_spans_recorded(self, tracer):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       build_train_step)
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               seq=32)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=m.parameters())
+        step = build_train_step(m, opt)
+        b, s = 2, 16
+        x = paddle.to_tensor(np.random.randint(0, 97, (b, s)))
+        y = paddle.to_tensor(np.random.randint(0, 97, (b, s)))
+        n_steps = 3
+        for _ in range(n_steps):
+            step(x, y)
+        xs = [e for e in tr.to_chrome_trace() if e["ph"] == "X"]
+        names = [e["name"] for e in xs]
+        assert names.count("train.step_compute") == n_steps
+        # data-wait spans cover the gaps BETWEEN steps: n-1 of them
+        assert names.count("train.data_wait") == n_steps - 1
+        assert names.count("train.step") == n_steps
+        comp = [e for e in xs if e["name"] == "train.step_compute"]
+        assert all(e["args"]["tokens"] == b * s for e in comp)
+        # distinct trace ids, one per step
+        ids = {e["args"]["trace_id"] for e in comp}
+        assert len(ids) == n_steps
+
+    def test_off_adds_no_spans(self, tracer_off):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       build_train_step)
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               seq=32)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=m.parameters())
+        step = build_train_step(m, opt)
+        x = paddle.to_tensor(np.random.randint(0, 97, (2, 16)))
+        y = paddle.to_tensor(np.random.randint(0, 97, (2, 16)))
+        step(x, y)  # warm/compile
+        c0 = tracer_off.spans_created
+        step(x, y)
+        assert tracer_off.spans_created == c0
+        assert len(tracer_off) == 0
+
+
+class TestCorrelationChannels:
+    def test_watchdog_dump_includes_open_spans(self, tracer, tmp_path):
+        reg = om.Registry()
+        wd = fr.Watchdog(deadline=60.0, dump_dir=str(tmp_path),
+                         registry=reg, name="spans")
+        sp = tr.span("serving.prefill", bucket=512)
+        sp.__enter__()
+        time.sleep(0.01)
+        try:
+            path = wd.dump()
+            txt = open(path).read()
+            assert "open spans" in txt
+            # "hung somewhere" becomes "inside serving.prefill, N s open"
+            assert "serving.prefill" in txt
+            assert "s open)" in txt
+        finally:
+            sp.end()
+        # after end() the span leaves the open registry
+        assert tr.open_spans() == []
+        txt2 = open(wd.dump()).read()
+        assert "(none)" in txt2
+
+    def test_autotune_decision_counter_and_event(self, tmp_path,
+                                                 monkeypatch):
+        from paddle_tpu.kernels import autotune as at
+
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "on")
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune_cache_dir"],
+                            "value", str(tmp_path))
+        at.reset_tuner()
+        rec = fr.default_recorder()
+        rec.clear()
+        reg = om.default_registry()
+
+        def fake_timer(fn, args):
+            return {"xla": 2.0, "pallas:a": 1.0}[fn.__autotune_name__]
+
+        at.set_timer(fake_timer)
+        try:
+            cands = []
+            for name, kind in (("xla", "xla"), ("pallas:a", "pallas")):
+                def fn(*a):
+                    return None
+
+                fn.__autotune_name__ = name
+                cands.append(at.Candidate(name, kind, fn, {"name": name}))
+            before = reg.value("autotune_decisions_total",
+                               op="flash_fwd", winner="pallas:a") \
+                if reg.get("autotune_decisions_total") else 0.0
+            win = at.get_tuner().pick(
+                "flash_fwd", (("sq", 128), ("dt", "float32")), cands,
+                lambda: (None,))
+            assert win.name == "pallas:a"
+            assert reg.value("autotune_decisions_total", op="flash_fwd",
+                             winner="pallas:a") == before + 1
+            evs = [(k, f) for _, k, f in rec.tail()
+                   if k == "autotune.decision"]
+            assert len(evs) == 1
+            assert evs[0][1]["winner"] == "pallas:a"
+            assert evs[0][1]["op"] == "flash_fwd"
+            assert evs[0][1]["timings_ms"] == {"xla": 2.0,
+                                               "pallas:a": 1.0}
+        finally:
+            at.set_timer(None)
+            at.reset_tuner()
+
+    def test_autotune_measure_records_span(self, tracer, tmp_path,
+                                           monkeypatch):
+        from paddle_tpu.kernels import autotune as at
+
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune"], "value",
+                            "on")
+        monkeypatch.setattr(_config._FLAGS["FLAGS_autotune_cache_dir"],
+                            "value", str(tmp_path))
+        at.reset_tuner()
+        at.set_timer(lambda fn, args: 1.5)
+        try:
+            def fn(*a):
+                return None
+
+            fn.__autotune_name__ = "xla"
+            at.get_tuner().pick(
+                "rms_norm", (("rows", 128),),
+                [at.Candidate("xla", "xla", fn, {})], lambda: (None,))
+            spans = [e for e in tr.to_chrome_trace()
+                     if e["name"] == "autotune.measure"]
+            assert len(spans) == 1
+            # candidate timings + winner ride the span attributes
+            assert spans[0]["args"]["winner"] == "xla"
+            assert spans[0]["args"]["timings_ms"] == {"xla": 1.5}
+            assert spans[0]["args"]["op"] == "rms_norm"
+        finally:
+            at.set_timer(None)
+            at.reset_tuner()
+
+
+class TestCollectiveTracing:
+    def test_eager_all_reduce_single_span_no_duplicate(self, tracer):
+        import paddle_tpu.distributed.collective as coll
+
+        t = paddle.to_tensor(np.ones((8, 4), np.float32))
+        coll.all_reduce(t)
+        evs = [e for e in tr.to_chrome_trace()
+               if e["name"] == "collective.all_reduce"]
+        # ONE real-duration span, not a span + a same-named instant
+        assert len(evs) == 1 and evs[0]["ph"] == "X"
+        assert evs[0]["args"]["bytes"] == 8 * 4 * 4
+
+    def test_jit_helper_emits_instant(self, tracer):
+        # jit-path helpers (psum & co) funnel through _count_collective
+        # with instant=True — a trace-time emission marker, no duration
+        import paddle_tpu.distributed.collective as coll
+
+        coll._count_collective("psum", np.ones((4,), np.float32))
+        evs = [e for e in tr.to_chrome_trace()
+               if e["name"] == "collective.psum"]
+        assert len(evs) == 1 and evs[0]["ph"] == "i"
+        assert evs[0]["args"]["bytes"] == 16.0
+
+
+class TestAtomicExporters:
+    def test_write_prometheus_atomic(self, tmp_path):
+        reg = om.Registry()
+        reg.counter("c_total", "h").inc(3)
+        p = tmp_path / "m.prom"
+        om.write_prometheus(str(p), reg)
+        assert "c_total 3" in p.read_text()
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_write_jsonl_append_atomic(self, tmp_path):
+        reg = om.Registry()
+        reg.counter("c_total", "h").inc()
+        p = tmp_path / "m.jsonl"
+        om.write_jsonl(str(p), reg)
+        om.write_jsonl(str(p), reg)  # append preserved across replaces
+        rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+        assert len(rows) == 2
+        om.write_jsonl(str(p), reg, append=False)  # truncate mode
+        assert len(p.read_text().splitlines()) == 1
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_atomic_write_never_leaves_temp_on_error(self, tmp_path):
+        bad = tmp_path / "missing_dir" / "f.txt"
+        with pytest.raises(OSError):
+            om.atomic_write(str(bad), "x")
+        assert not list(tmp_path.glob("**/*.tmp"))
+
+
+class TestTraceReport:
+    def test_report_on_serving_trace(self, tracer, tmp_path):
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(3)
+        for _ in range(2):
+            eng.add_request(rng.randint(0, 97, (6,)), max_new_tokens=3)
+        finished = eng.run()
+        assert len(finished) == 2
+        p = tmp_path / "trace.json"
+        tr.write_trace(str(p))
+        rep = _load_trace_report()
+        events = rep.load_events(str(p))
+        text, ok = rep.build_report(events)
+        assert ok
+        assert "critical path" in text
+        assert "serving.prefill" in text and "serving.decode" in text
+        assert "ttft_ms" in text
+        # per-request rows: one line per traced request
+        assert text.count("\n") > 8
+
+    def test_report_rejects_empty_trace(self, tmp_path):
+        p = tmp_path / "empty.json"
+        p.write_text("[]")
+        rep = _load_trace_report()
+        text, ok = rep.build_report(rep.load_events(str(p)))
+        assert not ok
+        assert rep.main([str(p)]) == 2
+
+    def test_report_object_form_accepted(self, tmp_path):
+        p = tmp_path / "obj.json"
+        p.write_text(json.dumps({"traceEvents": []}))
+        rep = _load_trace_report()
+        assert rep.load_events(str(p)) == []

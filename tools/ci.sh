@@ -61,6 +61,23 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# span-tracing gate: the serving smoke with FLAGS_trace_sample=1 must
+# produce a Perfetto-loadable Chrome trace (valid trace-event array,
+# FinishedRequest.trace_id populated — checked inside the snapshot
+# tool) AND trace_report.py must parse it and print a non-empty
+# critical path (it exits 2 when the trace yields none)
+if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_trace_sample=1 \
+    python tools/serving_metrics_snapshot.py \
+      --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json; then
+  echo "CI: traced serving smoke FAILED" >&2
+  rc=1
+elif ! timeout 120 env JAX_PLATFORMS=cpu \
+    python tools/trace_report.py /tmp/ci_trace.json; then
+  echo "CI: trace_report on /tmp/ci_trace.json FAILED (empty critical" \
+       "path or unparseable trace)" >&2
+  rc=1
+fi
+
 # driver-parseability gate (VERDICT round-5 Weak #1 regression guard):
 # the LAST stdout line of a bench.py smoke run must parse as JSON — the
 # driver artifact tails stdout, so anything after (or inlined into) the
@@ -97,6 +114,7 @@ fi
 if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
-  echo "CI GREEN (mode=$MODE) — metrics artifact: /tmp/ci_metrics.prom"
+  echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
+       "/tmp/ci_trace.json"
 fi
 exit $rc

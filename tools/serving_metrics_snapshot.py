@@ -23,6 +23,10 @@ def main():
     ap.add_argument("--out", default="/tmp/ci_metrics.prom")
     ap.add_argument("--jsonl", default=None,
                     help="also append a JSONL snapshot here")
+    ap.add_argument("--trace", default=None,
+                    help="also write the span-trace Chrome JSON here "
+                         "(run with FLAGS_trace_sample=1 to populate; "
+                         "feed to tools/trace_report.py / Perfetto)")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -68,10 +72,30 @@ def main():
     om.write_prometheus(args.out, reg)
     if args.jsonl:
         om.write_jsonl(args.jsonl, reg)
+    trace_note = ""
+    if args.trace:
+        from paddle_tpu.observability import tracing
+
+        n_events = tracing.write_trace(args.trace)
+        if tracing.enabled():
+            if n_events == 0:
+                print("trace snapshot FAILED: tracing enabled but the "
+                      "serving smoke produced no span events",
+                      file=sys.stderr)
+                return 1
+            # every-request guarantee only holds at rate >= 1 — below
+            # that, head sampling drops trace_ids BY DESIGN
+            if tracing.sample_rate() >= 1.0 and \
+                    any(f.trace_id is None for f in finished):
+                print("trace snapshot FAILED: a finished request carries "
+                      "no trace_id with FLAGS_trace_sample=1",
+                      file=sys.stderr)
+                return 1
+        trace_note = f"; {n_events} trace events -> {args.trace}"
     n_lines = sum(1 for _ in open(args.out))
     print(f"serving smoke OK: {n_req} requests, "
           f"{int(checks['serving_tokens_total'])} tokens; "
-          f"{n_lines} exposition lines -> {args.out}")
+          f"{n_lines} exposition lines -> {args.out}{trace_note}")
     return 0
 
 

@@ -1,0 +1,291 @@
+"""Trace report: the artifact an operator actually reads.
+
+Loads a Chrome trace-event JSON produced by
+`paddle_tpu.observability.write_trace()` (or any tool emitting the same
+format) and prints:
+
+- per-request serving breakdowns: TTFT split into queue / prefill, the
+  aggregate decode time, totals and token counts;
+- span duration statistics (count / p50 / p95 / max) by span name;
+- the CRITICAL PATH of the slowest request (or, in a training trace,
+  the slowest train step): its phases in time order with durations,
+  percentages, and any unattributed gap.
+
+    python tools/trace_report.py /tmp/ci_trace.json
+
+Exit codes: 0 = report printed, 2 = empty/unusable trace (CI gates on
+this — a trace that yields no critical path is a red run).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    """Accept both the JSON Array Format and the {"traceEvents": [...]}
+    object form; returns the event list."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict):
+        payload = payload.get("traceEvents", [])
+    if not isinstance(payload, list):
+        raise ValueError("not a Chrome trace: expected an event array")
+    return [e for e in payload if isinstance(e, dict)]
+
+
+def _spans(events):
+    """Complete ("X") spans only, ts/dur normalized to float µs."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or "ts" not in e:
+            continue
+        out.append({
+            "name": str(e.get("name", "?")),
+            "ts": float(e["ts"]),
+            "dur": float(e.get("dur", 0.0)),
+            "tid": e.get("tid"),
+            "args": e.get("args") or {},
+        })
+    out.sort(key=lambda s: s["ts"])
+    return out
+
+
+def _instants(events):
+    return [e for e in events if e.get("ph") == "i"]
+
+
+def _pct(values, q):
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _ms(us: float) -> str:
+    return f"{us / 1e3:.3f}"
+
+
+def _traces_by_id(spans, prefix: str) -> Dict[object, List[dict]]:
+    groups = defaultdict(list)
+    for s in spans:
+        tid = s["args"].get("trace_id")
+        if tid is not None and s["name"].startswith(prefix):
+            groups[tid].append(s)
+    return groups
+
+
+def _phase(trace_spans, name) -> Optional[dict]:
+    for s in trace_spans:
+        if s["name"] == name:
+            return s
+    return None
+
+
+def _phase_total_us(trace_spans, name) -> float:
+    """Sum of ALL spans with this name in the trace — a preempted
+    request legitimately has two queue spans (initial + requeue) and
+    two decode segments; first-match would under-report exactly the
+    slow request being diagnosed."""
+    return sum(s["dur"] for s in trace_spans if s["name"] == name)
+
+
+def _trace_bounds(trace_spans):
+    t0 = min(s["ts"] for s in trace_spans)
+    t1 = max(s["ts"] + s["dur"] for s in trace_spans)
+    return t0, t1
+
+
+def serving_rows(events) -> List[dict]:
+    """One row per traced request: queue/prefill/decode durations, TTFT
+    (first-token instant when present, else prefill end), total."""
+    spans = _spans(events)
+    first_tokens = {}
+    for e in _instants(events):
+        if e.get("name") == "serving.first_token":
+            tid = (e.get("args") or {}).get("trace_id")
+            if tid is not None and tid not in first_tokens:
+                first_tokens[tid] = float(e["ts"])
+    rows = []
+    for trace_id, tspans in sorted(_traces_by_id(spans,
+                                                 "serving.").items()):
+        t0, t1 = _trace_bounds(tspans)
+        queue = _phase(tspans, "serving.queue")
+        prefill = _phase(tspans, "serving.prefill")
+        summary = _phase(tspans, "serving.request")
+        start = queue["ts"] if queue is not None else t0
+        ft = first_tokens.get(trace_id)
+        if ft is None and prefill is not None:
+            ft = prefill["ts"] + prefill["dur"]
+        rid = None
+        tokens = None
+        for s in tspans:
+            rid = s["args"].get("rid", rid)
+            tokens = s["args"].get("tokens", tokens)
+        rows.append({
+            "trace_id": trace_id,
+            "rid": rid,
+            "queue_us": _phase_total_us(tspans, "serving.queue"),
+            "prefill_us": _phase_total_us(tspans, "serving.prefill"),
+            "decode_us": _phase_total_us(tspans, "serving.decode"),
+            "ttft_us": (ft - start) if ft is not None else None,
+            "total_us": (t1 - t0) if summary is None
+            else summary["dur"],
+            "tokens": tokens,
+            "spans": tspans,
+            "slow": bool((summary or {"args": {}})["args"].get("slow")),
+        })
+    return rows
+
+
+def train_rows(events) -> List[dict]:
+    spans = _spans(events)
+    rows = []
+    for trace_id, tspans in sorted(_traces_by_id(spans,
+                                                 "train.").items()):
+        t0, t1 = _trace_bounds(tspans)
+        step = None
+        for s in tspans:
+            step = s["args"].get("step", step)
+        rows.append({
+            "trace_id": trace_id,
+            "step": step,
+            "data_wait_us": _phase_total_us(tspans, "train.data_wait"),
+            "compute_us": _phase_total_us(tspans, "train.step_compute"),
+            "total_us": t1 - t0,
+            "spans": tspans,
+        })
+    return rows
+
+
+def span_stats(events) -> List[tuple]:
+    by_name = defaultdict(list)
+    for s in _spans(events):
+        by_name[s["name"]].append(s["dur"])
+    out = []
+    for name, durs in sorted(by_name.items()):
+        out.append((name, len(durs), _pct(durs, 0.50), _pct(durs, 0.95),
+                    max(durs)))
+    return out
+
+
+def critical_path(trace_spans, total_us) -> List[tuple]:
+    """The slowest trace's phases in time order. Returns (name, dur_us,
+    pct, attrs) tuples, closing with an unattributed-gap entry when the
+    phases don't cover the whole timeline. Trace-summary spans (the
+    `serving.request` / `train.step` envelope) are excluded — they ARE
+    the timeline, not a phase of it."""
+    phases = [s for s in sorted(trace_spans, key=lambda s: s["ts"])
+              if s["name"] not in ("serving.request", "train.step")]
+    if not phases or total_us <= 0:
+        return []
+    covered = 0.0
+    last_end = None
+    out = []
+    for s in phases:
+        end = s["ts"] + s["dur"]
+        if last_end is None:
+            covered += s["dur"]
+        else:
+            covered += max(0.0, end - max(s["ts"], last_end))
+        last_end = end if last_end is None else max(last_end, end)
+        attrs = {k: v for k, v in s["args"].items()
+                 if k not in ("trace_id", "rid") and v is not None}
+        out.append((s["name"], s["dur"],
+                    100.0 * s["dur"] / total_us, attrs))
+    gap = total_us - min(covered, total_us)
+    if gap > 0.005 * total_us:
+        out.append(("(unattributed)", gap, 100.0 * gap / total_us, {}))
+    return out
+
+
+def build_report(events) -> tuple:
+    """Returns (text, ok). ok=False means no usable spans were found."""
+    lines = []
+    srows = serving_rows(events)
+    trows = train_rows(events)
+    stats = span_stats(events)
+    if srows:
+        lines.append(f"== serving requests ({len(srows)} traced) ==")
+        lines.append(f"{'rid':>6} {'trace':>6} {'ttft_ms':>9} "
+                     f"{'queue_ms':>9} {'prefill_ms':>11} "
+                     f"{'decode_ms':>10} {'total_ms':>9} {'tokens':>7}")
+        for r in srows:
+            ttft = _ms(r["ttft_us"]) if r["ttft_us"] is not None else "-"
+            toks = r["tokens"] if r["tokens"] is not None else "-"
+            flag = " SLOW" if r["slow"] else ""
+            lines.append(
+                f"{str(r['rid']):>6} {str(r['trace_id']):>6} {ttft:>9} "
+                f"{_ms(r['queue_us']):>9} {_ms(r['prefill_us']):>11} "
+                f"{_ms(r['decode_us']):>10} {_ms(r['total_us']):>9} "
+                f"{str(toks):>7}{flag}")
+        lines.append("")
+    if trows:
+        lines.append(f"== train steps ({len(trows)} traced) ==")
+        lines.append(f"{'step':>6} {'trace':>6} {'data_wait_ms':>13} "
+                     f"{'compute_ms':>11} {'total_ms':>9}")
+        for r in trows:
+            lines.append(
+                f"{str(r['step']):>6} {str(r['trace_id']):>6} "
+                f"{_ms(r['data_wait_us']):>13} "
+                f"{_ms(r['compute_us']):>11} {_ms(r['total_us']):>9}")
+        lines.append("")
+    if stats:
+        lines.append("== span durations by name ==")
+        lines.append(f"{'name':<28} {'count':>6} {'p50_ms':>9} "
+                     f"{'p95_ms':>9} {'max_ms':>9}")
+        for name, n, p50, p95, mx in stats:
+            lines.append(f"{name:<28} {n:>6} {_ms(p50):>9} "
+                         f"{_ms(p95):>9} {_ms(mx):>9}")
+        lines.append("")
+    # critical path of the slowest request (serving) or step (training)
+    path = []
+    if srows:
+        worst = max(srows, key=lambda r: r["total_us"])
+        label = (f"slowest request rid={worst['rid']} "
+                 f"trace_id={worst['trace_id']} "
+                 f"total {_ms(worst['total_us'])} ms")
+        path = critical_path(worst["spans"], worst["total_us"])
+    elif trows:
+        worst = max(trows, key=lambda r: r["total_us"])
+        label = (f"slowest train step step={worst['step']} "
+                 f"trace_id={worst['trace_id']} "
+                 f"total {_ms(worst['total_us'])} ms")
+        path = critical_path(worst["spans"], worst["total_us"])
+    if path:
+        lines.append(f"== critical path ({label}) ==")
+        for name, dur, pct, attrs in path:
+            extra = "  " + " ".join(f"{k}={v}"
+                                    for k, v in sorted(attrs.items())) \
+                if attrs else ""
+            lines.append(f"  {name:<24} {_ms(dur):>9} ms  "
+                         f"{pct:5.1f}%{extra}")
+        lines.append("")
+    ok = bool(path)
+    if not ok:
+        lines.append("no serving/train trace spans found — nothing to "
+                     "report (was FLAGS_trace_sample set?)")
+    return "\n".join(lines) + "\n", ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace JSON (write_trace())")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_report: cannot load {args.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    text, ok = build_report(events)
+    sys.stdout.write(text)
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
