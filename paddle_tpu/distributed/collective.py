@@ -14,6 +14,7 @@ in the common single-process case (world=1) every collective is an identity
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Optional
 
 import jax
@@ -61,9 +62,10 @@ def _count_collective(op: str, array=None, arrays=None,
     """One call-count increment per API invocation; bytes summed over
     `array` or every entry of `arrays` (returned so span call sites
     don't recompute them). With span tracing enabled, drops a
-    `collective.<op>` instant on the timeline — EXCEPT when the caller
-    wraps execution in a real-duration `_coll_span` (instant=False),
-    which would double the event."""
+    `collective.<op>` instant on the timeline, and with the fleet layer
+    on (FLAGS_telemetry_dir) a zero-duration sequence record — EXCEPT
+    when the caller wraps execution in a real-duration `_coll_exec`
+    (instant=False), which would double both."""
     global _coll_cache
     from ..observability import metrics as _om
 
@@ -85,22 +87,70 @@ def _count_collective(op: str, array=None, arrays=None,
     if nbytes:
         cell[1].inc(nbytes)
     if instant:
+        from ..observability import fleet as _fleet
         from ..observability import tracing as _tracing
 
         if _tracing.enabled():
             _tracing.instant(f"collective.{op}", bytes=nbytes)
+        if _fleet.enabled():
+            # instantaneous/jit-trace-time calls still advance the per-op
+            # sequence counter: every rank compiles/invokes in the same
+            # program order, so these align fleet-wide too
+            _fleet.record_collective(op, _time.time(), 0.0, nbytes)
     return nbytes
 
 
-def _coll_span(op: str, nbytes: float = 0.0):
-    """Real-duration span around an eagerly-executing collective (the
-    jit-path helpers only emit at trace time — an instant suffices
-    there). No-op singleton when tracing is off."""
+class _CollExec:
+    """Wraps ONE eagerly-executing collective with the enabled channels:
+    a real-duration tracing span and/or a fleet sequence record carrying
+    (enter-time, duration). Allocated only when at least one channel is
+    on — `_coll_exec` returns the shared no-op singleton otherwise, so
+    the disabled path allocates nothing."""
+
+    __slots__ = ("_op", "_nbytes", "_span", "_fleet", "_w0", "_t0")
+
+    def __init__(self, op, nbytes, span, fleet_on):
+        self._op = op
+        self._nbytes = nbytes
+        self._span = span
+        self._fleet = fleet_on
+        self._w0 = 0.0
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if self._span is not None:
+            self._span.__enter__()
+        if self._fleet:
+            self._w0 = _time.time()        # wall: cross-rank alignment
+            self._t0 = _time.perf_counter()  # monotonic: duration
+        return self
+
+    def __exit__(self, *exc):
+        if self._fleet:
+            from ..observability import fleet as _fleet
+
+            _fleet.record_collective(
+                self._op, self._w0, _time.perf_counter() - self._t0,
+                self._nbytes)
+        if self._span is not None:
+            return self._span.__exit__(*exc)
+        return False
+
+
+def _coll_exec(op: str, nbytes: float = 0.0):
+    """Execution context for an eagerly-executing collective: tracing
+    span (real duration) + fleet sequence record (the jit-path helpers
+    only emit at trace time — an instant/zero-duration record suffices
+    there). No-op singleton when both channels are off."""
+    from ..observability import fleet as _fleet
     from ..observability import tracing as _tracing
 
-    if not _tracing.enabled():
+    fleet_on = _fleet.enabled()
+    span = _tracing.span(f"collective.{op}", bytes=nbytes) \
+        if _tracing.enabled() else None
+    if span is None and not fleet_on:
         return _tracing.NOOP_SPAN
-    return _tracing.span(f"collective.{op}", bytes=nbytes)
+    return _CollExec(op, nbytes, span, fleet_on)
 
 
 def _axes_for_group(group):
@@ -125,7 +175,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce (eager identity at world=1; psum under jit)."""
     nbytes = _count_collective("all_reduce", as_array(tensor),
                                instant=False)
-    with _coll_span("all_reduce", nbytes):
+    with _coll_exec("all_reduce", nbytes):
         return _all_reduce_impl(tensor, op, group)
 
 
@@ -174,7 +224,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     # counts as "reduce", not "all_reduce": one API call, one increment
     nbytes = _count_collective("reduce", as_array(tensor),
                                instant=False)
-    with _coll_span("reduce", nbytes):
+    with _coll_exec("reduce", nbytes):
         return _all_reduce_impl(tensor, op, group)
 
 
@@ -253,7 +303,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def barrier(group=None):
     _count_collective("barrier", instant=False)
-    with _coll_span("barrier"):
+    with _coll_exec("barrier"):
         (jax.device_put(0) + 0).block_until_ready()
 
 

@@ -34,6 +34,9 @@ class JobContext:
     devices: Optional[str] = None
     job_id: str = "default"
     max_restarts: int = 0  # >0 enables elastic restart-from-failure
+    # fleet telemetry root: each rank writes <dir>/rank_<i>/ shards
+    # (observability/fleet.py); the controller merges them at job end
+    telemetry_dir: Optional[str] = None
     envs: dict = field(default_factory=dict)
 
     def __post_init__(self):
@@ -108,6 +111,13 @@ def parse_args(argv=None) -> JobContext:
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
                                               "0")))
+    p.add_argument("--telemetry_dir", type=str,
+                   default=os.environ.get("FLAGS_telemetry_dir") or None,
+                   help="fleet telemetry root: every rank exports "
+                        "rank_<i>/ shards here and the launcher merges "
+                        "them into fleet.prom / fleet_trace.json / "
+                        "fleet_report.txt at job end "
+                        "(tools/fleet_report.py re-runs the analysis)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -117,7 +127,8 @@ def parse_args(argv=None) -> JobContext:
         script=a.script, script_args=a.script_args, nnodes=a.nnodes,
         node_rank=a.node_rank, nproc_per_node=a.nproc_per_node,
         master=a.master, log_dir=a.log_dir, devices=a.devices,
-        job_id=a.job_id, max_restarts=a.max_restarts)
+        job_id=a.job_id, max_restarts=a.max_restarts,
+        telemetry_dir=a.telemetry_dir)
 
 
 def rank_env(ctx: JobContext, local_rank: int) -> dict:
@@ -142,6 +153,10 @@ def rank_env(ctx: JobContext, local_rank: int) -> dict:
     # so workers skip the gather instead of stalling in connect retries
     env.setdefault("PADDLE_STORE_ENDPOINT",
                    f"{master.split(':')[0]}:{ctx.store_port()}")
+    if ctx.telemetry_dir:
+        # activates the rank-sharded fleet exporter in every worker
+        # (observability/fleet.py reads the flag at first telemetry hit)
+        env["FLAGS_telemetry_dir"] = ctx.telemetry_dir
     if ctx.devices is not None:
         devs = ctx.devices.split(",")
         env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]

@@ -28,18 +28,52 @@ class Container:
     env: dict
     log_path: str
     proc: Optional[subprocess.Popen] = None
+    _interrupted: bool = False
 
     def start(self):
         os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
         logf = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
             self.cmd, env=self.env, stdout=logf, stderr=subprocess.STDOUT)
+        self._interrupted = False
 
     def poll(self) -> Optional[int]:
         return self.proc.poll() if self.proc else None
 
-    def terminate(self, grace: float = 5.0):
+    def interrupt(self):
+        """Send SIGINT without waiting — _teardown broadcasts this to
+        the whole pod first so every rank's grace window overlaps
+        instead of serializing (a pod of hung ranks would otherwise pay
+        one full escalation each, back to back)."""
         if self.proc and self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGINT)
+                self._interrupted = True
+            except OSError:
+                pass
+
+    def terminate(self, grace: float = 5.0):
+        """SIGINT -> SIGTERM -> SIGKILL escalation. SIGINT first is
+        deliberate: Python's default SIGTERM disposition skips atexit,
+        which would drop the fleet exporter's FINAL telemetry flush in
+        every surviving rank — losing the last flush-interval of
+        collectives/heartbeats, the most diagnostic window of a failure
+        teardown. KeyboardInterrupt unwinds through atexit; a hung rank
+        that ignores it meets SIGTERM/SIGKILL on the same grace. Sends
+        no second SIGINT when interrupt() already delivered one (a rank
+        unwinding its atexit flush must not be re-interrupted mid-write)."""
+        if self.proc and self.proc.poll() is None:
+            if not self._interrupted:
+                try:
+                    self.proc.send_signal(signal.SIGINT)
+                    self._interrupted = True
+                except OSError:
+                    pass
+            try:
+                self.proc.wait(grace)
+                return
+            except subprocess.TimeoutExpired:
+                pass
             self.proc.terminate()
             try:
                 self.proc.wait(grace)
@@ -95,12 +129,14 @@ class CollectiveController:
             return self._watch(poll_interval)
         except KeyboardInterrupt:
             self._teardown()
+            self._aggregate_telemetry()
             return 130
 
     def _watch(self, poll_interval: float) -> int:
         while True:
             statuses = [c.poll() for c in self.pod]
             if all(s == 0 for s in statuses):
+                self._aggregate_telemetry()
                 return 0
             failed = next((s for s in statuses if s not in (None, 0)), None)
             if failed is not None:
@@ -122,9 +158,62 @@ class CollectiveController:
                           f"(logs: {self.ctx.log_dir}/workerlog.*)",
                           file=sys.stderr)
                     self._teardown()
+                    # failure is exactly when the merged view matters:
+                    # the report names the dead rank / straggler
+                    self._aggregate_telemetry()
                     return failed
             time.sleep(poll_interval)
 
     def _teardown(self):
+        # broadcast SIGINT first (overlapping grace windows), then the
+        # serial wait/escalate pass
+        for c in self.pod:
+            c.interrupt()
         for c in self.pod:
             c.terminate()
+
+    def _aggregate_telemetry(self):
+        """Merge the rank telemetry shards at job end (success, final
+        failure, or interrupt): fleet.prom + fleet_trace.json +
+        fleet_report.txt land next to the shards, and dead-rank /
+        straggler findings go to stderr. Best-effort — a telemetry
+        failure must never change the job's exit code."""
+        tdir = self.ctx.telemetry_dir
+        if not tdir:
+            return
+        try:
+            from ...observability import fleet as _fleet
+
+            report = _fleet.aggregate(tdir)
+            if not report["shards"]:
+                print(f"[launch] fleet telemetry: no rank shards under "
+                      f"{tdir}", file=sys.stderr)
+                return
+            text = _fleet.format_report(report)
+            path = os.path.join(tdir, "fleet_report.txt")
+            with open(path, "w") as f:
+                f.write(text)
+            art = report["artifacts"]
+            print(f"[launch] fleet telemetry: merged "
+                  f"{len(report['shards'])} shards -> {art['prom']}, "
+                  f"{art['trace']}; report: {path}", file=sys.stderr)
+            for r in report["missing"]:
+                print(f"[launch] MISSING RANK: rank {r} wrote no "
+                      f"telemetry shard", file=sys.stderr)
+            for d in report["dead"]:
+                if d.get("never_beat"):
+                    print(f"[launch] DEAD RANK: rank {d['rank']} never "
+                          f"beat (hung before its first step?)",
+                          file=sys.stderr)
+                else:
+                    print(f"[launch] DEAD RANK: rank {d['rank']} "
+                          f"stopped beating at step {d['step']} "
+                          f"({d['age_s']:.1f} s behind the fleet)",
+                          file=sys.stderr)
+            for r in report["stragglers"][:3]:
+                print(f"[launch] STRAGGLER: rank {r['last_rank']} was "
+                      f"last into {r['op']} #{r['seq']} by "
+                      f"{r['skew_s'] * 1e3:.1f} ms", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — best-effort reporting
+            print(f"[launch] fleet telemetry aggregation failed: {e}",
+                  file=sys.stderr)

@@ -129,6 +129,23 @@ define_flag("FLAGS_trace_slow_ms", 0.0,
             "is committed to the trace ring even when head sampling "
             "dropped it, and trace_slow_requests_total increments. "
             "0 disables the escape hatch.", type_=float)
+define_flag("FLAGS_telemetry_dir", "",
+            "Rank-sharded fleet telemetry export root "
+            "(observability/fleet.py): when set, a background flusher "
+            "writes this rank's shard <dir>/rank_<i>/{metrics.prom,"
+            "events.jsonl,trace.json,heartbeat.json,collectives.jsonl} "
+            "every FLAGS_telemetry_flush_s seconds and once more at "
+            "exit, and eager collectives record (op, seq, enter-time, "
+            "duration, bytes) into a bounded ring for cross-rank "
+            "straggler alignment (tools/fleet_report.py). Empty "
+            "(default) = the fleet layer is fully off: zero "
+            "per-collective-call allocations, pinned by "
+            "tests/test_fleet_telemetry.py.")
+define_flag("FLAGS_telemetry_flush_s", 5.0,
+            "Fleet telemetry shard flush interval in seconds "
+            "(FLAGS_telemetry_dir). The dead-rank detector treats a "
+            "heartbeat more than ~3x this behind the fleet's newest "
+            "beat as a stopped rank.", type_=float)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

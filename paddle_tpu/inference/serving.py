@@ -21,6 +21,7 @@ import numpy as np
 
 from ..autograd import tape as _tape
 from ..kernels import paged_attention as _pa
+from ..observability import fleet as _fleet
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _om
 from ..observability import tracing as _trace
@@ -1087,6 +1088,8 @@ class ServingEngine:
         _flight.record_event("serving.step", active=n_active,
                              tokens=n_tok, seconds=round(dt, 6))
         _flight.beat_all()
+        # fleet heartbeat (rank shard liveness): one flag read when off
+        _fleet.heartbeat()
 
     def _replay_burst(self, toks, emits, active):
         """Token-by-token host replay of one harvested burst: identical

@@ -48,6 +48,7 @@ def _instrument_step(step_fn):
     source of truth."""
     import time as _time
 
+    from ..observability import fleet as _fleet
     from ..observability import flight_recorder as _flight
     from ..observability import metrics as _om
     from ..observability import tracing as _trace
@@ -98,6 +99,8 @@ def _instrument_step(step_fn):
                              seconds=round(t1 - t0, 6),
                              trace_id=trc.trace_id)
         _flight.beat_all()
+        # fleet heartbeat (rank shard liveness): one flag read when off
+        _fleet.heartbeat(step=int(steps_c.value))
         return out
 
     for k, v in step_fn.__dict__.items():

@@ -9,6 +9,12 @@ recorder + span tracer (SURVEY.md §5 "Metrics / logging").
   sampling (`FLAGS_trace_sample`) and Chrome trace-event export that
   Perfetto loads directly; `tools/trace_report.py` prints TTFT
   breakdowns and the critical path from the exported JSON.
+- `fleet` — rank-sharded export of all three channels
+  (`FLAGS_telemetry_dir` → `rank_<i>/` shards on a background flusher),
+  a per-op collective sequence log, and the cross-rank aggregator:
+  merged fleet exposition + multi-rank Chrome trace, dead-rank
+  detection, and the collective straggler report
+  (`tools/fleet_report.py`).
 
 The three channels correlate: spans and flight-recorder breadcrumbs
 carry the same `rid`/`trace_id` fields, the watchdog stall dump appends
@@ -25,12 +31,15 @@ from .metrics import (  # noqa: F401
     Histogram,
     Registry,
     default_registry,
+    fleet_labels,
+    rank_world,
     set_default_registry,
     snapshot,
     to_prometheus,
     write_jsonl,
     write_prometheus,
 )
+from . import fleet  # noqa: F401  (rank-sharded export + aggregation)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     Watchdog,

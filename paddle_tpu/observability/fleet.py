@@ -1,0 +1,826 @@
+"""Fleet telemetry: rank-sharded export + cross-rank aggregation
+(README.md "Fleet observability").
+
+The three single-process channels (metrics registry, flight recorder,
+span tracer) make ONE rank legible; a HybridParallel job is N of them.
+Without a merged view the canonical distributed questions — "which rank
+is the straggler holding every allreduce hostage?", "did rank 2 die or
+is it just slow?" — are unanswerable. Following the per-rank trace-shard
++ merged-timeline design of MegaScale (PAPERS.md) and the collective
+flight-recorder direction of PyTorch Distributed's NCCL trace buffer,
+this module adds:
+
+- **Rank-sharded export** (`FleetExporter`): when `FLAGS_telemetry_dir`
+  is set, a background flusher thread (+ one final atexit flush) writes
+  this rank's shard every `FLAGS_telemetry_flush_s` seconds:
+
+      <dir>/rank_<i>/metrics.prom       # rank/world_size const labels
+      <dir>/rank_<i>/events.jsonl       # flight-recorder ring
+      <dir>/rank_<i>/trace.json         # Chrome trace, pid = rank
+      <dir>/rank_<i>/collectives.jsonl  # (op, seq, enter, dur, bytes)
+      <dir>/rank_<i>/heartbeat.json     # last beat time + step
+
+  All files go through the PR 3 atomic writers (temp + os.replace): an
+  aggregator scraping mid-flush sees complete old or complete new files,
+  never torn ones. Chrome-trace `pid` is the RANK, so the merged trace
+  renders one Perfetto process lane per rank.
+
+- **Collective sequence log** (`CollectiveLog`): `distributed/
+  collective.py` records every executed collective as
+  `(op, seq, t_enter, dur, nbytes)` into a bounded ring and bumps the
+  online `collective_wait_seconds_total{op}` counter. `seq` is a per-op
+  monotonic counter; collectives execute in program order on every rank,
+  so `(op, seq)` names the SAME logical collective fleet-wide — the
+  alignment key of the straggler report. Enter times are wall-clock
+  (`time.time()`): perf_counter epochs are per-process and cannot be
+  compared across ranks; same-host ranks (the launcher default) agree to
+  well under a millisecond, cross-host to NTP sync.
+
+- **Aggregation** (`aggregate` / `tools/fleet_report.py`): merges all
+  shards into a fleet Prometheus exposition + a merged multi-rank Chrome
+  trace, prints a per-rank step/TTFT table, flags dead ranks (a
+  heartbeat stale RELATIVE to the fleet's newest beat — after a job ends
+  every beat is old, a dead rank is old relative to its peers), and
+  aligns collective sequence numbers across ranks into a top-N skew
+  table ("rank 3 was last into all_reduce #1842 by 180.0 ms").
+
+Zero-overhead contract: with `FLAGS_telemetry_dir` unset, `enabled()`
+is one flag read, no exporter thread ever starts, and the collective
+hot path performs zero fleet-layer allocations (`CollectiveLog.records`
+stays flat — pinned by tests/test_fleet_telemetry.py, same discipline
+as `Registry.allocations` / `Tracer.spans_created`).
+"""
+from __future__ import annotations
+
+import atexit
+import glob
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+SHARD_FILES = ("metrics.prom", "events.jsonl", "trace.json",
+               "collectives.jsonl", "heartbeat.json")
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def telemetry_dir() -> str:
+    return str(_flags().get_flag("FLAGS_telemetry_dir", "") or "")
+
+
+def flush_interval() -> float:
+    try:
+        v = float(_flags().get_flag("FLAGS_telemetry_flush_s", 5.0))
+        return v if v > 0 else 5.0
+    except (TypeError, ValueError):
+        return 5.0
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the fleet layer when it is
+    off."""
+    return bool(telemetry_dir())
+
+
+# ---------------------------------------------------------------------------
+# collective sequence log (fed by distributed/collective.py)
+# ---------------------------------------------------------------------------
+
+
+class CollectiveLog:
+    """Bounded ring of (op, seq, t_enter_wall, dur_s, nbytes) records,
+    one per executed collective. `seq` is per-op monotonic — the
+    cross-rank alignment key (see module docstring). One deque append +
+    one dict update per record, GIL-safe on the eager path."""
+
+    def __init__(self, capacity: int = 4096):
+        self._ring = deque(maxlen=int(capacity))
+        self._seq: Dict[str, int] = {}
+        # every ring append ever — the disabled-path overhead guard
+        # asserts this stays flat (Registry.allocations discipline)
+        self.records = 0
+
+    def record(self, op: str, t_enter: float, dur: float,
+               nbytes: float) -> int:
+        seq = self._seq.get(op, 0)
+        self._seq[op] = seq + 1
+        self._ring.append((op, seq, t_enter, dur, nbytes))
+        self.records += 1
+        return seq
+
+    def tail(self) -> List[tuple]:
+        return list(self._ring)
+
+    def clear(self):
+        self._ring.clear()
+        self._seq.clear()
+
+    def __len__(self):
+        return len(self._ring)
+
+
+_log = CollectiveLog()
+_wait_cache: Optional[_metrics.HandleCache] = None
+
+
+def collective_log() -> CollectiveLog:
+    return _log
+
+
+def records_created() -> int:
+    return _log.records
+
+
+def _make_wait_handles(reg):
+    return {
+        "fam": reg.counter(
+            "collective_wait_seconds_total",
+            "Wall time spent inside eagerly-executed collectives, by op "
+            "(populated when FLAGS_telemetry_dir is set). A rank whose "
+            "total grows faster than its peers' is WAITING on them — "
+            "i.e. the others are the stragglers.", labels=("op",)),
+        "children": {},
+    }
+
+
+def record_collective(op: str, t_enter: float, dur: float,
+                      nbytes: float = 0.0) -> int:
+    """One executed collective: ring record + online wait counter.
+    Call sites guard on `enabled()` — this function assumes the fleet
+    layer is on."""
+    global _wait_cache
+    seq = _log.record(op, t_enter, dur, nbytes)
+    if _wait_cache is None:
+        _wait_cache = _metrics.HandleCache(_make_wait_handles)
+    h = _wait_cache.get()
+    cell = h["children"].get(op)
+    if cell is None:
+        cell = h["fam"].labels(op)
+        h["children"][op] = cell
+    cell.inc(dur if dur > 0.0 else 0.0)
+    ensure_exporter()
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# heartbeat (fed by serving/_step_metrics and trainer step close-out)
+# ---------------------------------------------------------------------------
+
+_hb = {"step": -1, "beats": 0, "ts": 0.0}
+
+
+def heartbeat(step: Optional[int] = None):
+    """One liveness beat per completed serving/train step. The flusher
+    persists the LAST beat's wall time + step into heartbeat.json; a
+    rank whose beat goes stale relative to its peers is dead — "rank 2
+    stopped beating at step 1840". No-op (one flag read) when the fleet
+    layer is off."""
+    if not enabled():
+        return
+    if step is None:
+        _hb["step"] += 1
+    else:
+        _hb["step"] = int(step)
+    _hb["beats"] += 1
+    _hb["ts"] = time.time()
+    ensure_exporter()
+
+
+# ---------------------------------------------------------------------------
+# the rank-shard exporter
+# ---------------------------------------------------------------------------
+
+
+class FleetExporter:
+    """Background flusher for ONE rank's telemetry shard.
+
+    Sources default to the process-default registry / tracer / flight
+    recorder / collective log; tests inject fresh ones. `flush()` is
+    also safe to call synchronously (final flush, tools)."""
+
+    def __init__(self, root: str, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 interval: Optional[float] = None,
+                 registry=None, tracer=None, recorder=None, log=None):
+        env_rank, env_world = _metrics.rank_world()
+        self.rank = env_rank if rank is None else int(rank)
+        self.world_size = env_world if world_size is None else int(world_size)
+        self.root = root
+        self.shard_dir = os.path.join(root, f"rank_{self.rank}")
+        self.interval = flush_interval() if interval is None \
+            else float(interval)
+        self._registry = registry
+        self._tracer = tracer
+        self._recorder = recorder
+        self._log = log if log is not None else _log
+        self.flushes = 0
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"fleet-exporter-r{self.rank}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True):
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval + 5.0)
+        if final_flush:
+            try:
+                self.flush()
+            except BaseException:  # noqa: BLE001 — teardown must never
+                # take the process down, and this path runs at atexit
+                # where a SECOND Ctrl-C / controller SIGINT raises
+                # KeyboardInterrupt (not Exception) mid-flush; the
+                # atomic writers guarantee the aborted flush leaves
+                # whole old files, never torn ones
+                pass
+
+    def _loop(self):
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — a flush failure (full
+                pass           # disk, dir removed) must not kill the job
+
+    # -- the shard ---------------------------------------------------------
+
+    def flush(self):
+        """Write the whole shard atomically, heartbeat LAST: a reader
+        that sees a beat knows the rest of the shard is at least as
+        fresh."""
+        os.makedirs(self.shard_dir, exist_ok=True)
+        const = {"rank": str(self.rank),
+                 "world_size": str(self.world_size)}
+        reg = self._registry or _metrics.default_registry()
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "metrics.prom"),
+            _metrics.to_prometheus(reg, const_labels=const))
+
+        from . import flight_recorder as _fr
+
+        rec = self._recorder or _fr.default_recorder()
+        rows = [json.dumps({"ts": round(ts, 6), "kind": kind, **fields},
+                           default=repr)
+                for ts, kind, fields in rec.tail()]
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "events.jsonl"),
+            "".join(r + "\n" for r in rows))
+
+        from . import tracing as _tracing
+
+        tracer = self._tracer or _tracing.default_tracer()
+        events = tracer.to_chrome_trace(pid=self.rank)
+        # process metadata so the merged trace names + orders rank lanes
+        events[:0] = [
+            {"name": "process_name", "ph": "M", "pid": self.rank,
+             "tid": 0, "args": {"name": f"rank {self.rank}"}},
+            {"name": "process_sort_index", "ph": "M", "pid": self.rank,
+             "tid": 0, "args": {"sort_index": self.rank}},
+        ]
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "trace.json"),
+            json.dumps(events, indent=0))
+
+        rows = [json.dumps({"op": op, "seq": seq, "t": round(t, 6),
+                            "dur": round(dur, 6), "nbytes": nb})
+                for op, seq, t, dur, nb in self._log.tail()]
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "collectives.jsonl"),
+            "".join(r + "\n" for r in rows))
+
+        self.flushes += 1
+        hb = {
+            "rank": self.rank,
+            "world_size": self.world_size,
+            "pid": os.getpid(),
+            "step": _hb["step"],
+            "beats": _hb["beats"],
+            "beat_time": round(_hb["ts"], 6) if _hb["beats"] else None,
+            "write_time": round(time.time(), 6),
+            "flushes": self.flushes,
+            "flush_interval_s": self.interval,
+            # perf<->wall anchor, sampled back-to-back: span ts are
+            # perf_counter (per-process epoch), so the trace merger
+            # rebases each rank's lane to wall-clock µs with
+            # offset = wall_s - perf_s — without this, lanes from
+            # different processes/hosts sit arbitrary boot-time offsets
+            # apart on the merged timeline
+            "clock": {"perf_s": round(time.perf_counter(), 6),
+                      "wall_s": round(time.time(), 6)},
+        }
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "heartbeat.json"),
+            json.dumps(hb, indent=1))
+
+
+_exporter: Optional[FleetExporter] = None
+_exporter_lock = threading.Lock()
+
+
+def exporter() -> Optional[FleetExporter]:
+    return _exporter
+
+
+def ensure_exporter() -> Optional[FleetExporter]:
+    """Start the process exporter on first telemetry activity (lazy so
+    `paddle.set_flags({"FLAGS_telemetry_dir": ...})` after import works
+    too). Returns None when the fleet layer is off."""
+    global _exporter
+    exp = _exporter
+    if exp is not None:
+        return exp
+    if not enabled():
+        return None
+    with _exporter_lock:
+        if _exporter is None:
+            exp = FleetExporter(telemetry_dir())
+            exp.start()
+            atexit.register(_shutdown)
+            _exporter = exp
+    return _exporter
+
+
+def _shutdown():
+    exp = _exporter
+    if exp is not None:
+        try:
+            exp.stop(final_flush=True)
+        except BaseException:  # noqa: BLE001 — a KeyboardInterrupt
+            pass               # during atexit must not mask exit
+
+
+def flush_now():
+    """Synchronous shard flush (end-of-job, tests)."""
+    exp = ensure_exporter()
+    if exp is not None:
+        exp.flush()
+
+
+def _reset_for_tests():
+    """Stop the exporter and zero the module state (tests only)."""
+    global _exporter, _wait_cache
+    exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop(final_flush=False)
+    _log.clear()
+    _log.records = 0
+    _hb.update({"step": -1, "beats": 0, "ts": 0.0})
+    _wait_cache = None
+
+
+# ---------------------------------------------------------------------------
+# aggregation: shards -> fleet view
+# ---------------------------------------------------------------------------
+
+
+def discover_shards(root: str) -> Dict[int, str]:
+    """rank -> shard directory for every `rank_<i>/` under `root`."""
+    out: Dict[int, str] = {}
+    for p in glob.glob(os.path.join(root, "rank_*")):
+        if not os.path.isdir(p):
+            continue
+        try:
+            rank = int(os.path.basename(p).split("_", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        out[rank] = p
+    return dict(sorted(out.items()))
+
+
+def _read_json(path, default=None):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default
+
+
+def _read_jsonl(path) -> List[dict]:
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def load_heartbeats(shards: Dict[int, str]) -> Dict[int, dict]:
+    out = {}
+    for rank, path in shards.items():
+        hb = _read_json(os.path.join(path, "heartbeat.json"))
+        if isinstance(hb, dict):
+            out[rank] = hb
+    return out
+
+
+def load_collectives(shards: Dict[int, str]) -> Dict[int, List[dict]]:
+    return {rank: _read_jsonl(os.path.join(path, "collectives.jsonl"))
+            for rank, path in shards.items()}
+
+
+def merge_prometheus(shards: Dict[int, str]) -> str:
+    """One fleet exposition from the per-rank shards: HELP/TYPE emitted
+    once per family (first shard wins), every rank's sample lines
+    appended — the per-sample `rank=` labels keep them distinct."""
+    fams: Dict[str, dict] = {}
+    order: List[str] = []
+
+    def _fam(name):
+        f = fams.get(name)
+        if f is None:
+            f = fams[name] = {"help": None, "type": None, "samples": []}
+            order.append(name)
+        return f
+
+    for rank in sorted(shards):
+        try:
+            with open(os.path.join(shards[rank], "metrics.prom")) as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        current = None
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                name = line.split(" ", 3)[2]
+                f = _fam(name)
+                if f["help"] is None:
+                    f["help"] = line
+            elif line.startswith("# TYPE "):
+                name = line.split(" ", 3)[2]
+                f = _fam(name)
+                if f["type"] is None:
+                    f["type"] = line
+                current = name
+            elif line.strip():
+                # sample lines belong to the family of the last # TYPE;
+                # _bucket/_sum/_count suffixes stay grouped with it
+                if current is None:
+                    current = line.split("{", 1)[0].split(" ", 1)[0]
+                    _fam(current)
+                fams[current]["samples"].append(line)
+        # next shard restarts family tracking
+    lines = []
+    for name in order:
+        f = fams[name]
+        if f["help"]:
+            lines.append(f["help"])
+        if f["type"]:
+            lines.append(f["type"])
+        lines.extend(f["samples"])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_traces(shards: Dict[int, str]) -> List[dict]:
+    """Concatenate the per-rank Chrome traces (each already carries
+    pid = rank + process_name metadata) into one multi-lane timeline.
+
+    Span `ts` values are per-process perf_counter µs, whose epochs are
+    NOT comparable across processes/hosts; each rank's heartbeat
+    carries a perf<->wall clock anchor, and its events are rebased to
+    wall-clock µs (`ts += (wall_s - perf_s) * 1e6`) so the lanes line
+    up — exactly on one host, to NTP sync across hosts. Shards without
+    an anchor (older/partial) merge unshifted."""
+    merged: List[dict] = []
+    for rank in sorted(shards):
+        events = _read_json(os.path.join(shards[rank], "trace.json"))
+        if not isinstance(events, list):
+            continue
+        hb = _read_json(os.path.join(shards[rank], "heartbeat.json"))
+        offset_us = 0.0
+        if isinstance(hb, dict):
+            clock = hb.get("clock") or {}
+            try:
+                offset_us = (float(clock["wall_s"])
+                             - float(clock["perf_s"])) * 1e6
+            except (KeyError, TypeError, ValueError):
+                offset_us = 0.0
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            if offset_us and "ts" in e:
+                try:
+                    e = {**e, "ts": round(float(e["ts"]) + offset_us, 3)}
+                except (TypeError, ValueError):
+                    pass
+            merged.append(e)
+    return merged
+
+
+def _beat_time(hb: dict) -> float:
+    """The rank's last STEP beat — never the flusher's write_time: a
+    hung rank's daemon flusher keeps rewriting heartbeat.json, so a
+    write_time fallback would make the hung rank look like the
+    freshest and flag its healthy peers dead (the exact inversion).
+    0.0 = this rank never completed a step."""
+    v = hb.get("beat_time")
+    try:
+        return float(v) if v else 0.0
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def dead_ranks(heartbeats: Dict[int, dict],
+               stale_s: Optional[float] = None) -> List[dict]:
+    """Ranks whose last beat is > stale_s behind the fleet's NEWEST
+    beat. Relative on purpose: after a job ends every beat is old in
+    absolute terms; a dead rank is old relative to its peers. Default
+    threshold: 3x the largest declared flush interval (floor 5 s).
+    A rank that NEVER beat (hung before its first step) is reported
+    with `never_beat: True` and `age_s: None` — but only when at least
+    one OTHER rank did beat: a job whose workload never touches the
+    heartbeat call sites at all (pure eager collectives, no serving /
+    train steps) has no liveness baseline, and flagging every rank
+    would turn every healthy such run into a false alarm."""
+    if not heartbeats:
+        return []
+    beats = {rank: _beat_time(hb) for rank, hb in heartbeats.items()}
+    alive = [t for t in beats.values() if t > 0.0]
+    if not alive:
+        return []  # nobody beats: no baseline, not N dead ranks
+    newest = max(alive)
+    if stale_s is None:
+        iv = max((float(hb.get("flush_interval_s") or 0.0)
+                  for hb in heartbeats.values()), default=0.0)
+        stale_s = max(3.0 * iv, 5.0)
+    out = []
+    for rank, hb in sorted(heartbeats.items()):
+        t = beats[rank]
+        if t <= 0.0:
+            out.append({"rank": rank, "step": hb.get("step"),
+                        "age_s": None, "beats": hb.get("beats") or 0,
+                        "never_beat": True})
+            continue
+        age = newest - t
+        if age > stale_s:
+            out.append({"rank": rank, "step": hb.get("step"),
+                        "age_s": round(age, 3),
+                        "beats": hb.get("beats"),
+                        "never_beat": False})
+    return out
+
+
+def missing_ranks(shards: Dict[int, str],
+                  heartbeats: Dict[int, dict]) -> List[int]:
+    """Ranks the job declared (world_size) but that never wrote a shard
+    — crashed before the first flush, or never launched."""
+    world = max((int(hb.get("world_size") or 0)
+                 for hb in heartbeats.values()), default=0)
+    return [r for r in range(world) if r not in shards]
+
+
+def straggler_table(collectives: Dict[int, List[dict]]) -> List[dict]:
+    """Align collective records across ranks on (op, seq); every aligned
+    op seen by >= 2 ranks yields one row with the enter-time spread
+    (last rank in minus first rank in). Sorted by skew, largest first —
+    the head of this table IS the straggler report."""
+    by_key: Dict[Tuple[str, int], Dict[int, float]] = {}
+    for rank, rows in collectives.items():
+        for r in rows:
+            try:
+                key = (str(r["op"]), int(r["seq"]))
+                by_key.setdefault(key, {})[rank] = float(r["t"])
+            except (KeyError, TypeError, ValueError):
+                continue
+    out = []
+    for (op, seq), enters in by_key.items():
+        if len(enters) < 2:
+            continue
+        first = min(enters, key=enters.get)
+        last = max(enters, key=enters.get)
+        out.append({"op": op, "seq": seq,
+                    "skew_s": round(enters[last] - enters[first], 6),
+                    "last_rank": last, "first_rank": first,
+                    "n_ranks": len(enters)})
+    out.sort(key=lambda r: (-r["skew_s"], r["op"], r["seq"]))
+    return out
+
+
+def straggler_summary(rows: List[dict]) -> List[dict]:
+    """Per (rank, op): how often that rank was LAST into the collective
+    and the worst/mean skew it caused — the one-line answer to "who is
+    holding the fleet hostage". Computed over ALL aligned rows, not the
+    top-N slice."""
+    acc: Dict[Tuple[int, str], dict] = {}
+    for r in rows:
+        key = (r["last_rank"], r["op"])
+        a = acc.get(key)
+        if a is None:
+            a = acc[key] = {"rank": r["last_rank"], "op": r["op"],
+                            "times_last": 0, "max_skew_s": 0.0,
+                            "sum_skew_s": 0.0}
+        a["times_last"] += 1
+        a["max_skew_s"] = max(a["max_skew_s"], r["skew_s"])
+        a["sum_skew_s"] += r["skew_s"]
+    out = []
+    for a in acc.values():
+        a["mean_skew_s"] = round(a["sum_skew_s"] / a["times_last"], 6)
+        del a["sum_skew_s"]
+        out.append(a)
+    out.sort(key=lambda a: (-a["times_last"], -a["max_skew_s"]))
+    return out
+
+
+def _parse_prom_samples(text: str) -> Dict[str, List[Tuple[dict, float]]]:
+    """Minimal exposition parser: name -> [(labels, value)]. Enough for
+    the per-rank table (histogram _sum/_count extraction)."""
+    import re
+
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    pat = re.compile(
+        r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})? (\S+)$')
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = pat.match(line)
+        if m is None:
+            continue
+        name, labels, val = m.groups()
+        lab = dict(re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels or ""))
+        try:
+            v = float(val.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        out.setdefault(name, []).append((lab, v))
+    return out
+
+
+def _hist_mean_ms(samples, name) -> Optional[float]:
+    s = sum(v for _, v in samples.get(name + "_sum", []))
+    c = sum(v for _, v in samples.get(name + "_count", []))
+    return (s / c) * 1e3 if c else None
+
+
+def _total(samples, name) -> Optional[float]:
+    rows = samples.get(name)
+    return sum(v for _, v in rows) if rows else None
+
+
+def rank_table(shards: Dict[int, str],
+               heartbeats: Dict[int, dict]) -> List[dict]:
+    """One row per rank: steps, mean train-step / decode-step / TTFT
+    latency, total collective wait, and heartbeat age relative to the
+    fleet's newest beat."""
+    newest = max((t for t in (_beat_time(hb)
+                              for hb in heartbeats.values())
+                  if t > 0.0), default=0.0)
+    out = []
+    for rank, path in sorted(shards.items()):
+        try:
+            with open(os.path.join(path, "metrics.prom")) as fh:
+                samples = _parse_prom_samples(fh.read())
+        except OSError:
+            samples = {}
+        hb = heartbeats.get(rank, {})
+        out.append({
+            "rank": rank,
+            "step": hb.get("step"),
+            "beat_age_s": round(newest - _beat_time(hb), 3)
+            if hb and _beat_time(hb) > 0.0 else None,
+            "train_step_ms": _hist_mean_ms(samples, "train_step_seconds"),
+            "decode_step_ms": _hist_mean_ms(
+                samples, "serving_decode_step_seconds"),
+            "ttft_ms": _hist_mean_ms(samples, "serving_ttft_seconds"),
+            "collective_wait_s": _total(
+                samples, "collective_wait_seconds_total"),
+        })
+    return out
+
+
+def aggregate(root: str, out_dir: Optional[str] = None,
+              stale_s: Optional[float] = None, top: int = 10) -> dict:
+    """Merge every rank shard under `root` into the fleet view: writes
+    `fleet.prom` + `fleet_trace.json` into `out_dir` (default: root) and
+    returns the full report structure (shards, per-rank table, dead /
+    missing ranks, straggler rows + summary, artifact paths)."""
+    shards = discover_shards(root)
+    report: dict = {"root": root, "shards": shards, "ranks": [],
+                    "dead": [], "missing": [], "stragglers": [],
+                    "straggler_summary": [], "artifacts": {}}
+    if not shards:
+        return report
+    heartbeats = load_heartbeats(shards)
+    rows = straggler_table(load_collectives(shards))
+    merged_trace = merge_traces(shards)
+    out_dir = out_dir or root
+    os.makedirs(out_dir, exist_ok=True)
+    prom_path = os.path.join(out_dir, "fleet.prom")
+    trace_path = os.path.join(out_dir, "fleet_trace.json")
+    _metrics.atomic_write(prom_path, merge_prometheus(shards))
+    _metrics.atomic_write(trace_path, json.dumps(merged_trace, indent=0))
+    report.update({
+        "heartbeats": heartbeats,
+        "ranks": rank_table(shards, heartbeats),
+        "dead": dead_ranks(heartbeats, stale_s=stale_s),
+        "missing": missing_ranks(shards, heartbeats),
+        "stragglers": rows[:top] if top else rows,
+        "straggler_summary": straggler_summary(rows),
+        "artifacts": {
+            "prom": prom_path,
+            "trace": trace_path,
+            "n_trace_events": sum(
+                1 for e in merged_trace if e.get("ph") != "M"),
+            "trace_pids": sorted({e.get("pid") for e in merged_trace
+                                  if "pid" in e}),
+        },
+    })
+    return report
+
+
+def _fmt_opt_ms(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def format_report(report: dict) -> str:
+    """The operator-facing fleet report text (tools/fleet_report.py)."""
+    lines = []
+    shards = report["shards"]
+    lines.append(f"== fleet shards ({len(shards)} ranks under "
+                 f"{report['root']}) ==")
+    for rank, path in shards.items():
+        present = [f for f in SHARD_FILES
+                   if os.path.exists(os.path.join(path, f))]
+        lines.append(f"  rank {rank}: {path} ({len(present)}/"
+                     f"{len(SHARD_FILES)} files)")
+    lines.append("")
+    if report["ranks"]:
+        lines.append("== per-rank summary ==")
+        lines.append(f"{'rank':>5} {'step':>8} {'beat_age_s':>11} "
+                     f"{'train_step_ms':>14} {'decode_step_ms':>15} "
+                     f"{'ttft_ms':>9} {'coll_wait_s':>12}")
+        for r in report["ranks"]:
+            lines.append(
+                f"{r['rank']:>5} {str(r['step']):>8} "
+                f"{_fmt_opt_ms(r['beat_age_s']):>11} "
+                f"{_fmt_opt_ms(r['train_step_ms']):>14} "
+                f"{_fmt_opt_ms(r['decode_step_ms']):>15} "
+                f"{_fmt_opt_ms(r['ttft_ms']):>9} "
+                f"{_fmt_opt_ms(r['collective_wait_s']):>12}")
+        lines.append("")
+    for r in report["missing"]:
+        lines.append(f"MISSING RANK: rank {r} declared by the job but "
+                     f"wrote no shard (crashed before first flush?)")
+    for d in report["dead"]:
+        if d.get("never_beat"):
+            lines.append(f"DEAD RANK: rank {d['rank']} never beat — "
+                         f"hung before completing its first step?")
+        else:
+            lines.append(f"DEAD RANK: rank {d['rank']} stopped beating "
+                         f"at step {d['step']} ({d['age_s']:.1f} s "
+                         f"behind the fleet's newest beat)")
+    if report["missing"] or report["dead"]:
+        lines.append("")
+    if report["stragglers"]:
+        lines.append("== top collective skews (last-in minus first-in, "
+                     "aligned on (op, seq)) ==")
+        for r in report["stragglers"]:
+            lines.append(
+                f"  rank {r['last_rank']} was last into {r['op']} "
+                f"#{r['seq']} by {r['skew_s'] * 1e3:.1f} ms "
+                f"(first: rank {r['first_rank']}, "
+                f"{r['n_ranks']} ranks aligned)")
+        lines.append("")
+        lines.append("== straggler summary (times last, by rank and "
+                     "op) ==")
+        for a in report["straggler_summary"]:
+            lines.append(
+                f"  rank {a['rank']} · {a['op']}: last in "
+                f"{a['times_last']}x, max skew "
+                f"{a['max_skew_s'] * 1e3:.1f} ms, mean "
+                f"{a['mean_skew_s'] * 1e3:.1f} ms")
+        lines.append("")
+    else:
+        lines.append("no aligned collective sequences across ranks — "
+                     "skew table empty (single shard, or collectives "
+                     "never ran)")
+    art = report["artifacts"]
+    if art:
+        lines.append(f"artifacts: {art['prom']} ; {art['trace']} "
+                     f"({art['n_trace_events']} events, pid lanes "
+                     f"{art['trace_pids']})")
+    return "\n".join(lines) + "\n"

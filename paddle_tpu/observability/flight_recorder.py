@@ -167,14 +167,25 @@ class Watchdog:
                 self._stalls.inc()
 
     def dump(self, stall_age: Optional[float] = None) -> str:
-        """Write the stall artifact; returns its path."""
+        """Write the stall artifact; returns its path.
+
+        The filename carries rank (when the launch env declares one) and
+        pid: concurrent ranks of one job share a dump_dir, and without
+        the disambiguation they would overwrite each other's dumps."""
         os.makedirs(self.dump_dir, exist_ok=True)
+        rank, world = _metrics.rank_world()
+        rank_known = world > 1 or "PADDLE_TRAINER_ID" in os.environ
+        rank_tag = f"_r{rank}" if rank_known else ""
         path = os.path.join(
             self.dump_dir,
-            f"stall_{self.name}_{os.getpid()}_{len(self.dumps)}.txt")
+            f"stall_{self.name}{rank_tag}_{os.getpid()}_"
+            f"{len(self.dumps)}.txt")
         lines = [
             f"paddle_tpu stall flight-recorder dump",
             f"name: {self.name}",
+            f"rank: {rank}",
+            f"world_size: {world}",
+            f"pid: {os.getpid()}",
             f"time: {time.strftime('%Y-%m-%dT%H:%M:%S%z')}",
             f"deadline_s: {self.deadline}",
             f"stall_age_s: "

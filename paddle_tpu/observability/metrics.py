@@ -251,6 +251,34 @@ class Registry:
             self.generation += 1
 
 
+def rank_world() -> Tuple[int, int]:
+    """This process's (rank, world_size) from the launch env contract
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, distributed/launch).
+
+    Env-only ON PURPOSE: telemetry must never be the thing that
+    initializes the XLA backend (jax.process_index() would, and a later
+    jax.distributed.initialize would then be impossible). Single-process
+    jobs report (0, 1)."""
+    try:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        rank = 0
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        world = 1
+    return rank, world
+
+
+def fleet_labels() -> Dict[str, str]:
+    """The constant labels stamped onto every exposition sample so shards
+    from different ranks merge without collisions (fleet.py aggregator);
+    single-rank exports carry rank="0"/world_size="1" and are therefore
+    fleet-merge-ready too."""
+    rank, world = rank_world()
+    return {"rank": str(rank), "world_size": str(world)}
+
+
 def registry_key(registry: Optional["Registry"] = None) -> tuple:
     """Cache key for library-internal metric handles: changes whenever
     the default registry is swapped OR reset, so lazy module-level
@@ -328,14 +356,24 @@ def _fmt_float(v: float) -> str:
     return repr(float(v))
 
 
-def to_prometheus(registry: Optional[Registry] = None) -> str:
-    """Prometheus text exposition format 0.0.4 of the whole registry."""
+def to_prometheus(registry: Optional[Registry] = None,
+                  const_labels: Optional[Dict[str, str]] = None) -> str:
+    """Prometheus text exposition format 0.0.4 of the whole registry.
+
+    `const_labels` are stamped onto EVERY sample; the default is
+    `fleet_labels()` (rank/world_size from the launch env) so any
+    export — including a single-rank one — can be merged into a fleet
+    exposition without sample collisions. Pass `{}` to suppress."""
     registry = registry or default_registry()
+    if const_labels is None:
+        const_labels = fleet_labels()
     lines = []
     for fam in registry.families():
         lines.append(f"# HELP {fam.name} {fam.help}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
         for labels, cell in fam.samples():
+            if const_labels:
+                labels = {**labels, **const_labels}
             if fam.kind == "histogram":
                 for ub, c in cell.bucket_counts().items():
                     le = _fmt_labels(labels, f'le="{_fmt_float(ub)}"')
@@ -397,11 +435,12 @@ def snapshot(registry: Optional[Registry] = None) -> list:
     """One dict per sample: {"name", "kind", "labels", value fields}."""
     registry = registry or default_registry()
     ts = time.time()
+    rank, world = rank_world()
     out = []
     for fam in registry.families():
         for labels, cell in fam.samples():
-            row = {"ts": round(ts, 3), "name": fam.name, "kind": fam.kind,
-                   "labels": labels}
+            row = {"ts": round(ts, 3), "rank": rank, "world_size": world,
+                   "name": fam.name, "kind": fam.kind, "labels": labels}
             if fam.kind == "histogram":
                 row["count"] = cell.count
                 row["sum"] = cell.sum

@@ -445,12 +445,16 @@ class Tracer:
 
     # -- export ------------------------------------------------------------
 
-    def to_chrome_trace(self) -> List[dict]:
+    def to_chrome_trace(self, pid: Optional[int] = None) -> List[dict]:
         """The ring as a Chrome trace-event ARRAY (the JSON Array Format
         both Perfetto and chrome://tracing load directly). Stable field
         set per event: name/cat/ph/ts/dur/pid/tid/args ("X"), instants
-        drop dur and add s (scope)."""
-        pid = os.getpid()
+        drop dur and add s (scope).
+
+        `pid` defaults to the OS pid; the fleet exporter passes the RANK
+        instead, so merged multi-rank traces render one process lane per
+        rank in the viewer (fleet.py)."""
+        pid = os.getpid() if pid is None else int(pid)
         recs = list(self._ring)
         events: List[dict] = []
         seen_tids = set()
@@ -484,10 +488,10 @@ class Tracer:
                          "tid": tid, "args": {"name": tname}})
         return meta + events
 
-    def write_trace(self, path: str) -> int:
+    def write_trace(self, path: str, pid: Optional[int] = None) -> int:
         """Atomically write the Chrome trace JSON; returns the number of
         non-metadata events written."""
-        events = self.to_chrome_trace()
+        events = self.to_chrome_trace(pid=pid)
         _metrics.atomic_write(path, json.dumps(events, indent=0))
         return sum(1 for e in events if e["ph"] != "M")
 
@@ -531,9 +535,9 @@ def open_spans():
     return _default.open_spans()
 
 
-def to_chrome_trace():
-    return _default.to_chrome_trace()
+def to_chrome_trace(pid: Optional[int] = None):
+    return _default.to_chrome_trace(pid=pid)
 
 
-def write_trace(path: str) -> int:
-    return _default.write_trace(path)
+def write_trace(path: str, pid: Optional[int] = None) -> int:
+    return _default.write_trace(path, pid=pid)

@@ -18,10 +18,12 @@ from paddle_tpu.observability import flight_recorder as fr
 from paddle_tpu.observability import metrics as om
 
 
-def _parse_prom(text):
+def _parse_prom(text, keep_const=False):
     """Tiny Prometheus text parser: {(name, sorted-label-items): value}.
     Raises on any malformed sample line — the golden test doubles as a
-    format validator."""
+    format validator. The fleet-merge constant labels (rank /
+    world_size, stamped on every sample since ISSUE 4) are stripped
+    unless keep_const so per-metric assertions stay label-exact."""
     out = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -30,9 +32,11 @@ def _parse_prom(text):
             r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})? (\S+)$', line)
         assert m is not None, f"unparseable exposition line: {line!r}"
         name, labels, val = m.groups()
-        lab = tuple(sorted(
-            (k, v) for k, v in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"',
-                                          labels or "")))
+        pairs = re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels or "")
+        if not keep_const:
+            pairs = [(k, v) for k, v in pairs
+                     if k not in ("rank", "world_size")]
+        lab = tuple(sorted(pairs))
         out[(name, lab)] = float(val.replace("+Inf", "inf"))
     return out
 
@@ -132,6 +136,30 @@ class TestExporters:
         assert s[("lat_seconds_count", ())] == 3
         assert s[("calls_total", (("op", "psum"),))] == 2
         assert s[("calls_total", (("op", "ppermute"),))] == 1
+        # fleet-merge constant labels: EVERY sample (labeled or not)
+        # carries rank/world_size so single-rank exports merge cleanly
+        # into a fleet exposition (observability/fleet.py)
+        const = (("rank", "0"), ("world_size", "1"))
+        sc = _parse_prom(text, keep_const=True)
+        assert sc[("requests_total", const)] == 3
+        assert sc[("calls_total",
+                   tuple(sorted((("op", "psum"),) + const)))] == 2
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert 'rank="0"' in line and 'world_size="1"' in line, \
+                    f"sample line missing const labels: {line!r}"
+
+    def test_prometheus_const_label_override(self):
+        reg = self._driven_registry()
+        # explicit const labels (the fleet exporter stamps its rank)
+        text = om.to_prometheus(reg, const_labels={"rank": "3",
+                                                   "world_size": "8"})
+        s = _parse_prom(text, keep_const=True)
+        assert s[("depth", (("rank", "3"), ("world_size", "8")))] == 2.5
+        # {} suppresses them entirely (pre-fleet shape)
+        bare = om.to_prometheus(reg, const_labels={})
+        assert 'rank="' not in bare
+        assert _parse_prom(bare)[("requests_total", ())] == 3
 
     def test_jsonl_snapshot(self, tmp_path):
         reg = self._driven_registry()

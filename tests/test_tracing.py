@@ -494,7 +494,8 @@ class TestAtomicExporters:
         reg.counter("c_total", "h").inc(3)
         p = tmp_path / "m.prom"
         om.write_prometheus(str(p), reg)
-        assert "c_total 3" in p.read_text()
+        # samples carry the fleet-merge const labels (rank/world_size)
+        assert 'c_total{rank="0",world_size="1"} 3' in p.read_text()
         assert not list(tmp_path.glob("*.tmp"))
 
     def test_write_jsonl_append_atomic(self, tmp_path):

@@ -111,10 +111,26 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# fleet telemetry smoke: 2 ranks export rank shards with staggered
+# synthetic collectives; the smoke asserts shard layout + that the
+# aggregator names the injected straggler + merged-trace pid lanes,
+# then fleet_report.py --require-skew re-runs the analysis as the
+# user-facing gate (exit 2 on no shards / empty skew table)
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/fleet_smoke.py --dir /tmp/ci_fleet; then
+  echo "CI: fleet telemetry smoke FAILED" >&2
+  rc=1
+elif ! timeout 120 env JAX_PLATFORMS=cpu \
+    python tools/fleet_report.py /tmp/ci_fleet --require-skew; then
+  echo "CI: fleet_report on /tmp/ci_fleet FAILED (no shards or empty" \
+       "skew table)" >&2
+  rc=1
+fi
+
 if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
-       "/tmp/ci_trace.json"
+       "/tmp/ci_trace.json, /tmp/ci_fleet/"
 fi
 exit $rc

@@ -1,0 +1,70 @@
+"""Fleet telemetry report: the merged view of a multi-rank job.
+
+Loads every `rank_<i>/` shard under a `FLAGS_telemetry_dir` root
+(written by paddle_tpu.observability.fleet), merges them, and prints:
+
+- shard inventory + per-rank summary table (step, heartbeat age, mean
+  train-step / decode-step / TTFT latency, total collective wait);
+- dead ranks (heartbeat stale relative to the fleet's newest beat:
+  "rank 2 stopped beating at step 1840") and missing ranks;
+- the collective straggler report: sequence numbers aligned across
+  ranks, top-N enter-time skews by rank and op ("rank 3 was last into
+  all_reduce #1842 by 180.0 ms") + a per-(rank, op) summary.
+
+Artifacts written next to the shards (or --out-dir): `fleet.prom` (one
+Prometheus exposition, every sample rank-labeled) and
+`fleet_trace.json` (merged Chrome trace, one `pid` lane per rank —
+load in Perfetto directly).
+
+    python tools/fleet_report.py /tmp/ci_fleet
+    python tools/fleet_report.py /tmp/ci_fleet --require-skew  # CI gate
+
+Exit codes: 0 = report printed, 2 = no shards found (or, with
+--require-skew, an empty skew table — CI treats both as red).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="FLAGS_telemetry_dir root holding "
+                                 "rank_<i>/ shards")
+    ap.add_argument("--out-dir", default=None,
+                    help="where fleet.prom / fleet_trace.json land "
+                         "(default: the shard root)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the skew table (default 10)")
+    ap.add_argument("--stale-s", type=float, default=None,
+                    help="dead-rank heartbeat threshold in seconds "
+                         "(default: 3x the declared flush interval)")
+    ap.add_argument("--require-skew", action="store_true",
+                    help="exit 2 when no cross-rank collective "
+                         "sequences aligned (CI gate)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import fleet
+
+    report = fleet.aggregate(args.root, out_dir=args.out_dir,
+                             stale_s=args.stale_s, top=args.top)
+    if not report["shards"]:
+        print(f"fleet_report: no rank_<i>/ shards under {args.root} "
+              f"(was FLAGS_telemetry_dir set on the job?)",
+              file=sys.stderr)
+        return 2
+    sys.stdout.write(fleet.format_report(report))
+    if args.require_skew and not report["stragglers"]:
+        print("fleet_report: --require-skew and the skew table is "
+              "empty", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

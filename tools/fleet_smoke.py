@@ -1,0 +1,173 @@
+"""2-rank fleet telemetry CI smoke (tools/ci.sh).
+
+Parent mode: wipes --dir, spawns one subprocess per rank (this script
+with --worker N and the PADDLE_* / FLAGS_telemetry_dir env), waits,
+then aggregates and sanity-checks the merged view:
+
+- every rank wrote a complete shard (all 5 files);
+- the skew table is non-empty and names the injected straggler
+  (rank 1 sleeps before every collective, the others after — same
+  per-step period, so only the collective ENTER times drift);
+- the merged trace is a valid Chrome trace-event array with one pid
+  lane per rank.
+
+tools/ci.sh then re-runs the analysis through tools/fleet_report.py
+--require-skew as the user-facing gate. Artifacts stay under --dir
+(default /tmp/ci_fleet).
+
+    python tools/fleet_smoke.py --dir /tmp/ci_fleet
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STRAGGLER_RANK = 1
+STEP_S = 0.1
+N_STEPS = 5
+
+
+def _ready_barrier(rank: int, world: int, tdir: str,
+                   timeout: float = 120.0):
+    """Align rank start times via ready-files: per-process interpreter +
+    jax import variance can exceed STEP_S on a loaded CI box, and an
+    unsynchronized start would let startup lag — not the injected sleep
+    — decide who is 'last in'."""
+    open(os.path.join(tdir, f".ready_{rank}"), "w").close()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(os.path.exists(os.path.join(tdir, f".ready_{r}"))
+               for r in range(world)):
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"rank {rank}: peers never became ready")
+
+
+def worker(rank: int, world: int, tdir: str) -> int:
+    """One synthetic rank: staggered collectives + heartbeats."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import collective as coll
+    from paddle_tpu.observability import fleet
+
+    x = paddle.to_tensor(np.ones((1024,), np.float32))
+    _ready_barrier(rank, world, tdir)
+    for step in range(N_STEPS):
+        if rank == STRAGGLER_RANK:
+            time.sleep(STEP_S)  # late INTO the collective every step
+        coll.all_reduce(x)
+        fleet.heartbeat(step)
+        if rank != STRAGGLER_RANK:
+            time.sleep(STEP_S)  # same period, on-time into the next op
+    fleet.flush_now()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="/tmp/ci_fleet")
+    ap.add_argument("--ranks", type=int, default=2)
+    ap.add_argument("--worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker is not None:
+        return worker(args.worker, args.ranks, args.dir)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    procs = []
+    for rank in range(args.ranks):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(args.ranks),
+            "FLAGS_telemetry_dir": args.dir,
+            "FLAGS_telemetry_flush_s": "0.5",
+            "FLAGS_trace_sample": "1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(rank), "--ranks", str(args.ranks),
+             "--dir", args.dir], env=env))
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=300))
+        except subprocess.TimeoutExpired:
+            rcs.append("timeout")
+    if any(rcs):
+        # kill stragglers so a wedged worker can't orphan past the gate
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        print(f"fleet smoke FAILED: worker exit codes {rcs}",
+              file=sys.stderr)
+        return 1
+
+    from paddle_tpu.observability import fleet
+
+    report = fleet.aggregate(args.dir)
+    shards = report["shards"]
+    if len(shards) != args.ranks:
+        print(f"fleet smoke FAILED: {len(shards)}/{args.ranks} shards",
+              file=sys.stderr)
+        return 1
+    for rank, path in shards.items():
+        missing = [f for f in fleet.SHARD_FILES
+                   if not os.path.exists(os.path.join(path, f))]
+        if missing:
+            print(f"fleet smoke FAILED: rank {rank} shard missing "
+                  f"{missing}", file=sys.stderr)
+            return 1
+    rows = report["stragglers"]
+    if not rows:
+        print("fleet smoke FAILED: empty skew table", file=sys.stderr)
+        return 1
+    if rows[0]["last_rank"] != STRAGGLER_RANK:
+        print(f"fleet smoke FAILED: top skew names rank "
+              f"{rows[0]['last_rank']}, injected straggler is rank "
+              f"{STRAGGLER_RANK}: {rows[:3]}", file=sys.stderr)
+        return 1
+    # merged trace: valid event array, one pid lane per rank
+    with open(report["artifacts"]["trace"]) as f:
+        events = json.load(f)
+    if not (isinstance(events, list)
+            and all(isinstance(e, dict) for e in events)):
+        print("fleet smoke FAILED: merged trace is not an event array",
+              file=sys.stderr)
+        return 1
+    pids = sorted({e.get("pid") for e in events})
+    if pids != list(range(args.ranks)):
+        print(f"fleet smoke FAILED: trace pid lanes {pids}, want "
+              f"{list(range(args.ranks))}", file=sys.stderr)
+        return 1
+    # merged exposition: every rank's samples present under its label
+    with open(report["artifacts"]["prom"]) as f:
+        prom = f.read()
+    for rank in range(args.ranks):
+        if f'rank="{rank}"' not in prom:
+            print(f"fleet smoke FAILED: merged exposition has no "
+                  f'rank="{rank}" samples', file=sys.stderr)
+            return 1
+    print(f"fleet smoke OK: {args.ranks} shards, top skew "
+          f"{rows[0]['skew_s'] * 1e3:.1f} ms on {rows[0]['op']} "
+          f"#{rows[0]['seq']} (rank {rows[0]['last_rank']}), "
+          f"{report['artifacts']['n_trace_events']} merged trace "
+          f"events -> {args.dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

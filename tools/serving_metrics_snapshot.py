@@ -27,7 +27,28 @@ def main():
                     help="also write the span-trace Chrome JSON here "
                          "(run with FLAGS_trace_sample=1 to populate; "
                          "feed to tools/trace_report.py / Perfetto)")
+    ap.add_argument("--merge", default=None, metavar="TELEMETRY_DIR",
+                    help="skip the smoke: merge the rank_<i>/ shards "
+                         "under this fleet telemetry dir "
+                         "(FLAGS_telemetry_dir) into --out — composes "
+                         "this tool with fleet output")
     args = ap.parse_args()
+
+    if args.merge:
+        from paddle_tpu.observability import fleet
+        from paddle_tpu.observability import metrics as om
+
+        shards = fleet.discover_shards(args.merge)
+        if not shards:
+            print(f"merge FAILED: no rank_<i>/ shards under "
+                  f"{args.merge}", file=sys.stderr)
+            return 2
+        text = fleet.merge_prometheus(shards)
+        om.atomic_write(args.out, text)
+        print(f"fleet merge OK: {len(shards)} shards, "
+              f"{len(text.splitlines())} exposition lines -> "
+              f"{args.out}")
+        return 0
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax

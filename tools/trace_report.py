@@ -19,7 +19,9 @@ this — a trace that yields no critical path is a red run).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -27,7 +29,30 @@ from typing import Dict, List, Optional
 
 def load_events(path: str) -> List[dict]:
     """Accept both the JSON Array Format and the {"traceEvents": [...]}
-    object form; returns the event list."""
+    object form; returns the event list.
+
+    Also accepts a DIRECTORY — fleet-telemetry composition
+    (observability/fleet.py): a `FLAGS_telemetry_dir` root (every
+    `rank_<i>/trace.json` shard merged, one pid lane per rank), a
+    single rank shard dir, or any dir holding a `fleet_trace.json` /
+    `trace.json`."""
+    if os.path.isdir(path):
+        for cand in ("fleet_trace.json", "trace.json"):
+            p = os.path.join(path, cand)
+            if os.path.exists(p):
+                path = p
+                break
+        else:
+            shards = sorted(
+                glob.glob(os.path.join(path, "rank_*", "trace.json")))
+            if not shards:
+                raise ValueError(
+                    f"{path}: no fleet_trace.json / trace.json / "
+                    f"rank_*/trace.json inside")
+            events: List[dict] = []
+            for p in shards:
+                events.extend(load_events(p))
+            return events
     with open(path) as f:
         payload = json.load(f)
     if isinstance(payload, dict):
@@ -274,7 +299,10 @@ def build_report(events) -> tuple:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace JSON (write_trace())")
+    ap.add_argument("trace",
+                    help="Chrome trace JSON (write_trace()), or a "
+                         "fleet telemetry dir / rank shard dir "
+                         "(rank_*/trace.json merged)")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.trace)
