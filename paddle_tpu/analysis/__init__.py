@@ -1,0 +1,37 @@
+"""tpu-lint: dependency-free AST static analysis for JAX/TPU hazards.
+
+Every rule encodes a bug this repo actually shipped (CHANGES.md):
+
+  jax-compat               jax APIs absent on the pinned jax 0.4.37
+                           (the PR 2 dead-kernel-library class)
+  weak-float-in-kernel     bare float literals lowering f64 inside
+                           Pallas kernel bodies under global x64
+  rank-divergent-collective  collectives under `if rank == ...` —
+                           fleet-wide deadlock, statically visible
+  side-effect-under-jit    metrics/tracing record calls that run at
+                           trace time instead of per step
+  donated-arg-reuse        reads of buffers already donated to XLA
+  flag-hygiene             FLAGS_* declared/used cross-check, both
+                           directions
+
+CLI: `python tools/tpu_lint.py [paths...]` — exits non-zero on any
+finding not in the committed baseline (tools/tpu_lint_baseline.json).
+Per-line suppression: `# tpu-lint: disable=<rule>`. Docs: README.md
+"Static analysis".
+
+This package imports neither jax nor the rest of paddle_tpu, so the
+CLI loads it directly off sys.path and lint failures surface in
+seconds.
+"""
+from .core import (  # noqa: F401
+    FileContext,
+    Finding,
+    ImportMap,
+    RULES,
+    Rule,
+    iter_py_files,
+    register,
+    repo_root,
+    run,
+)
+from . import baseline, flagsdoc, reporters, rules  # noqa: F401

@@ -1,0 +1,65 @@
+"""tpu-lint baseline: grandfathered findings, keyed by
+(rule, path, source-line text) with a count per key so line drift does
+not invalidate the file but a SECOND identical hazard on the same line
+text still fails the gate.
+
+The committed baseline (`tools/tpu_lint_baseline.json`) ships empty:
+every true positive found while building the linter was fixed, not
+baselined. The machinery exists so the gate can be adopted mid-flight
+on a future dirty subtree and ratcheted down finding by finding.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+VERSION = 1
+
+
+def load(path: str) -> Dict[str, int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path} (expected {VERSION})")
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save(path: str, findings: Sequence[Finding]) -> int:
+    counts: Dict[str, int] = collections.Counter(
+        f.key() for f in findings)
+    data = {
+        "version": VERSION,
+        "note": ("grandfathered tpu-lint findings; regenerate with "
+                 "`python tools/tpu_lint.py --write-baseline`. An empty "
+                 "table means the tree is clean — keep it that way."),
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return len(counts)
+
+
+def split(findings: Sequence[Finding], baseline: Dict[str, int]
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined). The first `count` occurrences of a baselined
+    key are grandfathered; any beyond that are new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

@@ -1,0 +1,120 @@
+"""tpu-lint command line (wrapped by tools/tpu_lint.py).
+
+Exit codes: 0 clean (or baselined-only), 1 new findings, 2 usage /
+internal error — ci.sh treats anything non-zero as red.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as _baseline
+from . import flagsdoc as _flagsdoc
+from . import reporters as _reporters
+from .core import RULES, repo_root, run
+
+DEFAULT_BASELINE = os.path.join("tools", "tpu_lint_baseline.json")
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_lint",
+        description=("AST static analysis for JAX/TPU hazards; see "
+                     "paddle_tpu/analysis/ and README.md 'Static "
+                     "analysis'."))
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: paddle_tpu/, "
+                        "tools/, bench.py)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        f"under the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding "
+                        "as new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "and exit 0 (the ratchet: adopt, then shrink)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule names to run "
+                        "(default: all)")
+    p.add_argument("--disable", default=None,
+                   help="comma-separated rule names to skip")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--emit-flags-doc", nargs="?", const="-",
+                   metavar="PATH", default=None,
+                   help="generate the FLAGS_* reference table "
+                        "(markdown) to PATH (or stdout) and exit; "
+                        "docs/FLAGS.md is the committed output")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    root = repo_root()
+
+    from . import rules as _rules  # noqa: F401  (register plug-ins)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:28s} {RULES[name].description}")
+        return 0
+
+    if args.emit_flags_doc is not None:
+        config = os.path.join(root, _flagsdoc.CONFIG_RELPATH)
+        md = _flagsdoc.to_markdown(
+            _flagsdoc.parse_flag_declarations(config))
+        if args.emit_flags_doc == "-":
+            sys.stdout.write(md)
+        else:
+            out = args.emit_flags_doc
+            os.makedirs(os.path.dirname(os.path.abspath(out)),
+                        exist_ok=True)
+            with open(out, "w", encoding="utf-8") as f:
+                f.write(md)
+            print(f"wrote {out}")
+        return 0
+
+    select = ({s.strip() for s in args.select.split(",") if s.strip()}
+              if args.select else None)
+    disable = ({s.strip() for s in args.disable.split(",") if s.strip()}
+               if args.disable else None)
+    for names in (select or ()), (disable or ()):
+        unknown = set(names) - set(RULES)
+        if unknown:
+            print(f"tpu-lint: unknown rule(s): "
+                  f"{', '.join(sorted(unknown))} "
+                  f"(--list-rules shows the registry)",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or [
+        os.path.join(root, "paddle_tpu"),
+        os.path.join(root, "tools"),
+        os.path.join(root, "bench.py"),
+    ]
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        print("tpu-lint: no input paths exist", file=sys.stderr)
+        return 2
+
+    findings = run(paths, select=select, disable=disable, root=root)
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  DEFAULT_BASELINE)
+    if args.write_baseline:
+        n = _baseline.save(baseline_path, findings)
+        print(f"tpu-lint: baselined {len(findings)} finding(s) "
+              f"({n} unique keys) -> {baseline_path}")
+        return 0
+
+    base = {} if args.no_baseline else _baseline.load(baseline_path)
+    new, old = _baseline.split(findings, base)
+
+    out = (_reporters.to_json(new, old) if args.format == "json"
+           else _reporters.to_text(new, old))
+    sys.stdout.write(out)
+    return 1 if new else 0

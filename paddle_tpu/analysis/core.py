@@ -1,0 +1,319 @@
+"""tpu-lint core: AST file contexts, import/alias resolution, rule
+registry, per-line suppressions, and the runner.
+
+Dependency-free on purpose (stdlib only, no jax / no paddle_tpu
+imports): `tools/tpu_lint.py` loads this package directly off
+`sys.path` so a lint run never pays the jax import tax — lint failures
+must surface in seconds, before any test tier spins up.
+
+Suppression syntax (checked on the finding's physical line):
+
+    something_hazardous()  # tpu-lint: disable=rule-name
+    another()              # tpu-lint: disable=rule-a,rule-b
+    third()                # tpu-lint: disable          (all rules)
+
+Baseline workflow: `tools/tpu_lint_baseline.json` holds grandfathered
+finding keys (rule + path + source text); the CLI exits non-zero only
+on findings NOT in the baseline, so the gate can be adopted on a dirty
+tree and ratcheted down. Regenerate with `--write-baseline`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint hit. `snippet` (the stripped source line) — not the line
+    number — feeds the baseline key, so baselines survive unrelated
+    edits shifting code up or down a file."""
+
+    rule: str
+    path: str  # repo-relative, "/"-separated
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.snippet}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+
+def dotted_parts(node) -> Optional[List[str]]:
+    """['jax', 'experimental', 'pallas'] for a Name/Attribute chain;
+    None when the chain roots in anything else (call, subscript, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _module_package(relpath: str) -> List[str]:
+    """Package path of a module file, for relative-import resolution:
+    'paddle_tpu/distributed/collective.py' -> ['paddle_tpu',
+    'distributed']."""
+    parts = relpath.replace(os.sep, "/").split("/")
+    parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    else:
+        parts = parts[:-1]
+    return [p for p in parts if p]
+
+
+class ImportMap:
+    """Local name -> fully dotted origin, from imports plus simple
+    `alias = module.attr` assignments (e.g. `_pc = pl.pallas_call`).
+    Assignments inside a try/except-AttributeError guard are NOT
+    aliased: that is the feature-detection idiom the jax-compat rule
+    deliberately leaves alone."""
+
+    def __init__(self, tree: ast.AST, relpath: str,
+                 guarded: Sequence[Tuple[int, int]] = ()):
+        self.alias: Dict[str, str] = {}
+        pkg = _module_package(relpath)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.alias.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                base: List[str] = []
+                if node.level:
+                    base = pkg[: len(pkg) - (node.level - 1)] \
+                        if node.level <= len(pkg) + 1 else []
+                if node.module:
+                    base = base + node.module.split(".")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.alias[a.asname or a.name] = \
+                        ".".join(base + [a.name])
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, (ast.Attribute, ast.Name))
+                    and not any(a <= node.lineno <= b for a, b in guarded)):
+                target = node.targets[0].id
+                origin = self.expand(node.value)
+                if origin and origin != target:
+                    self.alias.setdefault(target, origin)
+
+    def expand(self, node) -> Optional[str]:
+        parts = dotted_parts(node)
+        if not parts:
+            return None
+        root = self.alias.get(parts[0])
+        if root:
+            parts = root.split(".") + parts[1:]
+        return ".".join(parts)
+
+
+def _attr_guarded_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """Line spans of `try:` bodies whose handlers name AttributeError
+    or ImportError — the feature-detection idiom shims use. Extra
+    SPECIFIC types alongside the probe exception are fine
+    (`except (AttributeError, TypeError)` probes jax.typeof across
+    jax versions AND non-tracer inputs).
+
+    Deliberately excluded: `except Exception:` / bare `except:`. A
+    catch-everything handler around a jax-compat lookup is precisely
+    the PR 2 silent-fallback bug (kernel entry raises AttributeError,
+    dispatch quietly takes the XLA path) — exempting it would make the
+    rule blind to the very pattern it exists to catch."""
+    probe = {"AttributeError", "ImportError", "ModuleNotFoundError"}
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if h.type is None:
+                continue  # bare except: silent fallback, not a probe
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            names: Set[str] = set()
+            for t in types:
+                parts = dotted_parts(t)
+                if parts:
+                    names.add(parts[-1])
+            if (names & probe) and not (names & {"Exception",
+                                                 "BaseException"}):
+                last = node.body[-1]
+                spans.append((node.body[0].lineno,
+                              getattr(last, "end_lineno", last.lineno)))
+                break
+    return spans
+
+
+class FileContext:
+    """Parsed view of one source file handed to every rule."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.attr_guarded = _attr_guarded_spans(self.tree)
+        self.imports = ImportMap(self.tree, self.relpath,
+                                 self.attr_guarded)
+        self._suppress: Dict[int, Optional[Set[str]]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = m.group(1)
+                self._suppress[i] = (
+                    {r.strip() for r in rules.split(",") if r.strip()}
+                    if rules else None)  # None = all rules
+
+    def in_attr_guard(self, lineno: int) -> bool:
+        return any(a <= lineno <= b for a, b in self.attr_guarded)
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        if lineno not in self._suppress:
+            return False
+        rules = self._suppress[lineno]
+        return rules is None or rule in rules
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, snippet=self.snippet(line))
+
+
+class Rule:
+    """Plug-in base. Per-file rules implement `check(ctx)`;
+    whole-program rules set `project_rule = True` and implement
+    `check_project(ctxs, repo_root)` (run once, after every file is
+    parsed — the flag-hygiene cross-check needs the full use set)."""
+
+    name = ""
+    description = ""
+    project_rule = False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      repo_root: str) -> Iterable[Finding]:
+        return ()
+
+
+RULES: Dict[str, type] = {}
+
+
+def register(cls):
+    assert cls.name and cls.name not in RULES, cls
+    RULES[cls.name] = cls
+    return cls
+
+
+def repo_root() -> str:
+    """<repo>/paddle_tpu/analysis/core.py -> <repo>."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hg", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for base, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(base, f))
+    seen: Set[str] = set()
+    uniq = []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+def load_contexts(files: Sequence[str], root: str
+                  ) -> Tuple[List[FileContext], List[Finding]]:
+    ctxs: List[FileContext] = []
+    errors: List[Finding] = []
+    for f in files:
+        rel = os.path.relpath(f, root)
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            ctxs.append(FileContext(f, rel, src))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding(
+                rule="syntax-error", path=rel.replace(os.sep, "/"),
+                line=line, col=0,
+                message=f"file does not parse: {e}", snippet=""))
+    return ctxs, errors
+
+
+def run(paths: Sequence[str], select: Optional[Set[str]] = None,
+        disable: Optional[Set[str]] = None,
+        root: Optional[str] = None) -> List[Finding]:
+    """Run the registered rules over `paths`; returns findings with
+    per-line suppressions already applied (baseline filtering is the
+    CLI's job — tests want the raw list)."""
+    from . import rules as _rules  # noqa: F401  (registers plug-ins)
+
+    root = root or repo_root()
+    active = [cls() for name, cls in sorted(RULES.items())
+              if (select is None or name in select)
+              and (disable is None or name not in disable)]
+    ctxs, findings = load_contexts(iter_py_files(paths), root)
+    for rule in active:
+        if rule.project_rule:
+            findings.extend(rule.check_project(ctxs, root))
+        else:
+            for ctx in ctxs:
+                findings.extend(rule.check(ctx))
+    by_path = {c.relpath: c for c in ctxs}
+    kept = []
+    seen: Set[Tuple[str, str, int, int, str]] = set()
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and ctx.suppressed(f.line, f.rule):
+            continue
+        dedupe = (f.rule, f.path, f.line, f.col, f.message)
+        if dedupe in seen:
+            continue  # nested nodes can re-report one hazard
+        seen.add(dedupe)
+        kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    return kept

@@ -1,0 +1,58 @@
+"""tpu-lint reporters: human text and machine JSON.
+
+JSON schema (version 1, pinned by tests/test_tpu_lint.py):
+
+    {"version": 1, "tool": "tpu-lint",
+     "counts": {"new": N, "baselined": M, "total": N+M},
+     "findings": [{"rule", "path", "line", "col", "message",
+                   "snippet", "key", "baselined"} ...]}
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+from .core import Finding
+
+JSON_VERSION = 1
+
+
+def to_text(new: Sequence[Finding], baselined: Sequence[Finding] = ()
+            ) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] "
+                     f"{f.message}")
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    n, m = len(new), len(baselined)
+    if n:
+        lines.append("")
+    tail = f"tpu-lint: {n} new finding{'s' if n != 1 else ''}"
+    if m:
+        tail += f" ({m} baselined, not shown)"
+    lines.append(tail if (n or m) else "tpu-lint: clean")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(new: Sequence[Finding], baselined: Sequence[Finding] = ()
+            ) -> str:
+    def one(f: Finding, is_baselined: bool) -> dict:
+        return {
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "snippet": f.snippet,
+            "key": f.key(), "baselined": is_baselined,
+        }
+
+    entries = ([one(f, False) for f in new]
+               + [one(f, True) for f in baselined])
+    entries.sort(key=lambda d: (d["path"], d["line"], d["col"],
+                                d["rule"]))
+    doc = {
+        "version": JSON_VERSION,
+        "tool": "tpu-lint",
+        "counts": {"new": len(new), "baselined": len(baselined),
+                   "total": len(new) + len(baselined)},
+        "findings": entries,
+    }
+    return json.dumps(doc, indent=2) + "\n"

@@ -1,0 +1,17 @@
+"""tpu-lint rule plug-ins. Importing this package registers every rule
+with `analysis.core.RULES`; a new rule is a module here with a
+`@register`-decorated `Rule` subclass — nothing else to wire."""
+from . import (  # noqa: F401
+    collectives,
+    donated,
+    flags,
+    jax_compat,
+    jit_side_effects,
+    weak_float,
+)
+
+from ..core import RULES
+
+
+def all_rules():
+    return dict(RULES)

@@ -1,0 +1,113 @@
+"""Rule: rank-divergent-collective — a collective call lexically under
+a conditional that tests the process's rank.
+
+`if rank == 0: all_reduce(x)` hangs the whole fleet: ranks 1..N-1
+enter the collective, rank 0 never does, and every participant blocks
+until the job is killed. The PR 4 fleet aggregator can only *diagnose*
+this after the reservation is burned ("rank 0 never entered
+all_reduce #1842"); the pattern itself is visible in the AST at CI
+time. Either branch of a rank-test is flagged — divergence is about
+SOME ranks skipping the call, not about which arm it sits in.
+
+Names that are unambiguous collectives (all_reduce, psum,
+reduce_scatter, ...) are flagged wherever they resolve from; short
+generic names (reduce, gather, send, ...) are only flagged when their
+import/attribute chain points into a distributed/collective module —
+`functools.reduce` under a rank test is not a deadlock.
+
+Legitimate rank-conditional collectives (e.g. a broadcast everyone
+reaches through different code paths) document themselves with
+`# tpu-lint: disable=rank-divergent-collective`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_parts, register
+
+UNAMBIGUOUS = {
+    "all_reduce", "allreduce", "all_gather", "allgather",
+    "all_gather_jit", "all_gather_object", "all_gather_into_tensor",
+    "reduce_scatter", "reducescatter", "psum", "psum_scatter",
+    "pmean", "pmax", "pmin", "alltoall", "alltoall_single",
+    "all_to_all", "all_to_all_jit", "all_to_all_single", "ppermute",
+    "barrier", "gloo_barrier", "broadcast_object_list",
+    "scatter_object_list", "batch_isend_irecv", "isend", "irecv",
+}
+AMBIGUOUS = {"reduce", "gather", "scatter", "send", "recv",
+             "broadcast", "wait"}
+_COLLECTIVE_MODULE_HINTS = ("distributed", "collective",
+                            "communication", "dist")
+
+RANK_NAMES = {"rank", "local_rank", "node_rank", "world_rank",
+              "global_rank", "trainer_id", "process_index",
+              "proc_rank"}
+RANK_CALLS = {"get_rank", "get_local_rank", "process_index",
+              "local_rank", "node_rank", "get_world_rank"}
+
+
+def _mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name):
+            if node.id in RANK_NAMES or node.id.endswith("_rank"):
+                return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in RANK_NAMES or node.attr.endswith("_rank"):
+                return True
+        elif isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if parts and parts[-1] in RANK_CALLS:
+                return True
+    return False
+
+
+def _module_hint(path: str) -> bool:
+    parts = path.lower().split(".")
+    return any(h in parts for h in _COLLECTIVE_MODULE_HINTS)
+
+
+@register
+class RankDivergentCollectiveRule(Rule):
+    name = "rank-divergent-collective"
+    description = ("collective call under an `if rank == ...` style "
+                   "conditional — only some ranks enter it; the rest "
+                   "of the fleet blocks forever (deadlock)")
+
+    def _is_collective(self, ctx, call: ast.Call) -> bool:
+        parts = dotted_parts(call.func)
+        if not parts:
+            return False
+        leaf = parts[-1]
+        if leaf not in UNAMBIGUOUS and leaf not in AMBIGUOUS:
+            return False
+        path = ctx.imports.expand(call.func) or leaf
+        prefix = path.rsplit(".", 1)[0] if "." in path else ""
+        if prefix.split(".")[0] in {"functools", "itertools",
+                                    "operator", "os", "shutil",
+                                    "signal", "socket"}:
+            return False
+        if leaf in UNAMBIGUOUS:
+            return True
+        # short generic names need collective-ish provenance
+        return _module_hint(path)
+
+    def check(self, ctx):
+        yield from self._walk(ctx, ctx.tree, rank_if=None)
+
+    def _walk(self, ctx, node, rank_if):
+        if isinstance(node, (ast.If, ast.IfExp, ast.While)) \
+                and _mentions_rank(node.test):
+            rank_if = node
+        elif isinstance(node, ast.Call) and rank_if is not None \
+                and self._is_collective(ctx, node):
+            leaf = dotted_parts(node.func)[-1]
+            yield ctx.finding(
+                self.name, node,
+                f"collective `{leaf}` under a rank-conditional "
+                f"(line {rank_if.test.lineno}) — ranks that skip this "
+                f"branch never enter it and the rest of the fleet "
+                f"blocks forever; hoist the collective out of the "
+                f"rank test (all ranks must execute collectives in "
+                f"the same order)")
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(ctx, child, rank_if)

@@ -1,0 +1,179 @@
+"""Rule: donated-arg-reuse — reading a variable after it was passed in
+a donated position of a jitted call.
+
+`jax.jit(f, donate_argnums=(0,))` hands the argument's buffer to XLA;
+after `out = jitted(x)` the array behind `x` is deleted, and the next
+read raises `RuntimeError: Array has been deleted` — or on some paths
+silently aliases freshly-written memory. The serving engine's poisoned
+fail-fast (PR 1) exists because this bug class corrupted KV pages at
+runtime; the read-after-donate is visible statically.
+
+Scope and honesty about limits: the analysis is per-function and
+flow-insensitive across iterations — it tracks, in source order,
+`f = jax.jit(fn, donate_argnums=(literal ints...))` assignments, then
+marks the Name/attribute-path arguments at the donated positions of
+each later `f(...)` call, and flags subsequent Loads of a marked path
+until it is reassigned. Non-literal donate_argnums (`(0, 2) if donate
+else ()`) are skipped — unknowable statically. `x = f(x)` (the
+donate-and-rebind idiom) is correct and not flagged: the call
+evaluates before the rebind clears the mark.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Rule, dotted_parts, register
+
+
+def _donate_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a jit(...) call, else None."""
+    fn = dotted_parts(call.func)
+    if not fn or fn[-1] != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, int) for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return None  # IfExp / computed: statically unknowable
+    return None
+
+
+def _path_of(node) -> Optional[str]:
+    """Trackable lvalue-ish path: bare name or dotted attribute chain
+    (`kv`, `self._kv_pages`). Anything else (subscripts, calls) is
+    untracked."""
+    parts = dotted_parts(node)
+    return ".".join(parts) if parts else None
+
+
+@register
+class DonatedArgReuseRule(Rule):
+    name = "donated-arg-reuse"
+    description = ("variable read after being passed in a donated "
+                   "position of a jitted call — the buffer was handed "
+                   "to XLA and deleted; reads raise or alias reused "
+                   "memory")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+                yield from self._scan_scope(ctx, node)
+
+    def _scan_scope(self, ctx, scope):
+        jitted: Dict[str, Tuple[int, ...]] = {}
+        donated: Dict[str, int] = {}  # path -> donation line
+        body = scope.body
+        findings: List = []
+        self._run_block(ctx, body, jitted, donated, findings,
+                        top=scope)
+        yield from findings
+
+    def _run_block(self, ctx, stmts, jitted, donated, findings, top):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes analyzed on their own
+            if isinstance(stmt, ast.If):
+                self._scan_expr(ctx, stmt.test, jitted, donated,
+                                findings)
+                snap_j, snap_d = dict(jitted), dict(donated)
+                self._run_block(ctx, stmt.body, jitted, donated,
+                                findings, top)
+                else_j, else_d = dict(snap_j), dict(snap_d)
+                self._run_block(ctx, stmt.orelse, else_j, else_d,
+                                findings, top)
+                jitted.update(else_j)
+                donated.update(else_d)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While,
+                                 ast.With, ast.AsyncWith, ast.Try)):
+                for field in ("iter", "test"):
+                    expr = getattr(stmt, field, None)
+                    if expr is not None:
+                        self._scan_expr(ctx, expr, jitted, donated,
+                                        findings)
+                for item in getattr(stmt, "items", []) or []:
+                    self._scan_expr(ctx, item.context_expr, jitted,
+                                    donated, findings)
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    self._clear_targets(stmt.target, donated)
+                for block in ("body", "orelse", "finalbody"):
+                    self._run_block(ctx, getattr(stmt, block, []) or [],
+                                    jitted, donated, findings, top)
+                for h in getattr(stmt, "handlers", []) or []:
+                    self._run_block(ctx, h.body, jitted, donated,
+                                    findings, top)
+                continue
+            self._scan_stmt(ctx, stmt, jitted, donated, findings)
+
+    def _scan_expr(self, ctx, expr, jitted, donated, findings):
+        """Header expression of a compound statement: reads + donating
+        calls, no assignment handling."""
+        wrapper = ast.Expr(value=expr)
+        ast.copy_location(wrapper, expr)
+        self._scan_stmt(ctx, wrapper, jitted, donated, findings)
+
+    def _clear_targets(self, target, donated):
+        elts = target.elts if isinstance(target, (ast.Tuple, ast.List)) \
+            else [target]
+        for e in elts:
+            path = _path_of(e)
+            if path:
+                donated.pop(path, None)
+
+    def _scan_stmt(self, ctx, stmt, jitted, donated, findings):
+        # 1. flag reads of already-donated paths (skip Store contexts)
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                path = _path_of(node)
+                if path in donated:
+                    # the donating call's own arg node is this same
+                    # statement's Load — only flag LATER statements
+                    if node.lineno > donated[path]:
+                        findings.append(ctx.finding(
+                            self.name, node,
+                            f"`{path}` read after being donated to a "
+                            f"jitted call on line {donated[path]} — "
+                            f"its buffer was handed to XLA and "
+                            f"deleted; reload it from the call's "
+                            f"outputs or drop donation for this "
+                            f"argument"))
+                        donated.pop(path, None)  # one report per donation
+        # 2. record jit(...) assignments + mark donated args of calls
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            idx = _donate_indices(node)
+            if idx is not None:
+                continue  # the jit() wrapper itself; handled at Assign
+            fn = dotted_parts(node.func)
+            if fn and len(fn) == 1 and fn[0] in jitted:
+                for i in jitted[fn[0]]:
+                    if i < len(node.args):
+                        path = _path_of(node.args[i])
+                        if path:
+                            donated[path] = node.lineno
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call):
+            idx = _donate_indices(stmt.value)
+            if idx is not None:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = idx
+        # 3. reassignment clears the donated mark
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            self._clear_targets(t, donated)

@@ -1,0 +1,147 @@
+"""Rule: flag-hygiene — cross-check `FLAGS_*` declarations against use
+sites, both directions.
+
+The registry (`framework/config.py:define_flag`) and the readers
+(`get_flag("FLAGS_x")`, env dicts, shell `FLAGS_x=1` prefixes) are
+string-coupled: a typo'd or undeclared flag silently evaluates to the
+call-site default forever, and a declared flag nobody reads is dead
+configuration surface that documents behavior the code does not have.
+Both were live bugs when this rule landed: `FLAGS_cp_ring_balance` was
+read but never declared, `FLAGS_eager_delete_tensor_gb` declared but
+never read.
+
+Direction 1 (undeclared-use): any exact `FLAGS_\\w+` string constant or
+identifier in the SCANNED files that is not declared → finding at the
+use site. Prose mentions inside help strings don't count — only
+whole-string matches.
+
+Direction 2 (declared-unread): only when config.py itself is in the
+scan set (so linting one stray file never fires it). Uses are counted
+over the whole repo universe (paddle_tpu/, tools/ incl. *.sh, tests/,
+bench.py — minus tests/data fixtures), not just the scanned paths:
+a flag read only by a CI tool is read.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..core import FileContext, Rule, register
+from ..flagsdoc import CONFIG_RELPATH, parse_flag_declarations
+
+_FLAG_EXACT = re.compile(r"^FLAGS_\w+$")
+_FLAG_TOKEN = re.compile(r"FLAGS_\w+")
+
+
+def _uses_in_tree(tree: ast.AST) -> List[Tuple[str, int, int]]:
+    """(flag, line, col) for every exact-match use in a Python AST:
+    string constants (get_flag args, env/set_flags dict keys, environ
+    subscripts) and FLAGS_* identifiers. Declaration sites
+    (define_flag's first argument) are excluded by the caller."""
+    uses: List[Tuple[str, int, int]] = []
+    decl_nodes = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "define_flag" and node.args):
+            decl_nodes.add(id(node.args[0]))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, str):
+            if _FLAG_EXACT.match(node.value) \
+                    and id(node) not in decl_nodes:
+                uses.append((node.value, node.lineno,
+                             node.col_offset))
+        elif isinstance(node, ast.Name) and _FLAG_EXACT.match(node.id):
+            uses.append((node.id, node.lineno, node.col_offset))
+        elif isinstance(node, ast.Attribute) \
+                and _FLAG_EXACT.match(node.attr):
+            uses.append((node.attr, node.lineno, node.col_offset))
+    return uses
+
+
+def _universe_uses(repo_root: str) -> Set[str]:
+    """Flag names used anywhere in the repo's code universe (Python
+    exact-match uses + shell-script tokens)."""
+    used: Set[str] = set()
+    roots = [os.path.join(repo_root, d)
+             for d in ("paddle_tpu", "tools", "tests")]
+    files: List[str] = []
+    bench = os.path.join(repo_root, "bench.py")
+    if os.path.exists(bench):
+        files.append(bench)
+    for root in roots:
+        for base, dirs, names in os.walk(root):
+            dirs[:] = [d for d in dirs if d not in
+                       {"__pycache__", ".git", "data"}]
+            for n in sorted(names):
+                if n.endswith((".py", ".sh")):
+                    files.append(os.path.join(base, n))
+    for f in files:
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        if f.endswith(".sh"):
+            used.update(_FLAG_TOKEN.findall(src))
+            continue
+        try:
+            used.update(u for u, _, _ in _uses_in_tree(ast.parse(src)))
+        except SyntaxError:
+            continue
+    return used
+
+
+@register
+class FlagHygieneRule(Rule):
+    name = "flag-hygiene"
+    description = ("FLAGS_* read but not declared in framework/"
+                   "config.py (typo -> silent default), or declared "
+                   "but never read anywhere (dead flag)")
+    project_rule = True
+
+    def check_project(self, ctxs, repo_root):
+        config_path = os.path.join(repo_root, CONFIG_RELPATH)
+        if not os.path.exists(config_path):
+            return
+        declared: Dict[str, int] = {
+            d.name: d.lineno
+            for d in parse_flag_declarations(config_path)}
+        config_rel = CONFIG_RELPATH.replace(os.sep, "/")
+        config_ctx = None
+
+        for ctx in ctxs:
+            if ctx.relpath == config_rel:
+                config_ctx = ctx
+            for flag, line, col in _uses_in_tree(ctx.tree):
+                if flag not in declared:
+                    node = _Pos(line, col)
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{flag}` used here but never declared via "
+                        f"define_flag in {config_rel} — a typo or a "
+                        f"missing declaration reads as the call-site "
+                        f"default forever; declare it (with help "
+                        f"text) or fix the name")
+
+        if config_ctx is None:
+            return  # partial scan: skip the declared-unread direction
+        used = _universe_uses(repo_root)
+        for flag, lineno in sorted(declared.items()):
+            if flag not in used:
+                node = _Pos(lineno, 0)
+                yield config_ctx.finding(
+                    self.name, node,
+                    f"`{flag}` declared but never read anywhere in "
+                    f"the repo (paddle_tpu/, tools/, tests/, "
+                    f"bench.py) — dead flag; delete the declaration "
+                    f"or wire up the reader it documents")
+
+
+class _Pos:
+    def __init__(self, lineno, col_offset):
+        self.lineno = lineno
+        self.col_offset = col_offset

@@ -1,0 +1,91 @@
+"""Rule: side-effect-under-jit — observability record calls inside a
+function compiled by `@jax.jit`.
+
+A metrics/tracing/flight-recorder call in a jitted body runs at TRACE
+time only: it fires once per compilation (then never again, however
+many steps execute), or per retrace — both produce numbers that look
+plausible and are wrong. The repo's convention (PR 3/4): jit-path code
+records through trace-time-safe *instant* helpers only
+(`tracing.instant`, the collective seq helpers), and everything with a
+duration or a counter lives in the eager host wrapper around the
+compiled call.
+
+Flagged inside a jit-decorated function (including nested defs — the
+whole subtree traces):
+  * any call resolving into `paddle_tpu.observability.*` whose leaf is
+    not in the trace-time-safe allowlist;
+  * `.inc(` / `.dec(` / `.observe(` method calls (metric handles reach
+    jitted code through closures, where the module chain is invisible
+    to the AST).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_parts, register
+
+# Trace-time-safe: read-only probes and the documented instant helpers.
+SAFE_LEAVES = {"instant", "enabled", "sample_rate", "slow_ms",
+               "rank_world", "fleet_labels", "registry_key",
+               "open_spans", "tracing"}
+MUTATOR_METHODS = {"inc", "dec", "observe"}
+
+
+def _is_jit_decorator(dec, imports) -> bool:
+    if isinstance(dec, ast.Call):
+        fn = imports.expand(dec.func) or ""
+        if fn == "jit" or fn.endswith(".jit"):
+            return True  # @jax.jit(static_argnums=...)
+        if fn.endswith("partial") and dec.args:
+            inner = imports.expand(dec.args[0]) or ""
+            return inner == "jit" or inner.endswith(".jit")
+        return False
+    path = imports.expand(dec) or ""
+    return path == "jit" or path.endswith(".jit")
+
+
+@register
+class SideEffectUnderJitRule(Rule):
+    name = "side-effect-under-jit"
+    description = ("metrics/tracing/flight-recorder record call inside "
+                   "an @jax.jit function — runs once at trace time, "
+                   "not per step; record from the eager wrapper or use "
+                   "a trace-time-safe instant helper")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d, ctx.imports)
+                       for d in node.decorator_list):
+                continue
+            for stmt in node.body:
+                for call in ast.walk(stmt):
+                    if isinstance(call, ast.Call):
+                        yield from self._check_call(ctx, node, call)
+
+    def _check_call(self, ctx, jit_fn, call):
+        parts = dotted_parts(call.func)
+        if not parts:
+            return
+        leaf = parts[-1]
+        path = ctx.imports.expand(call.func) or ""
+        if ("observability." in path or path.endswith("observability")) \
+                and leaf not in SAFE_LEAVES:
+            yield ctx.finding(
+                self.name, call,
+                f"`{path}` called inside jitted `{jit_fn.name}` — "
+                f"executes at trace time only (once per compile/"
+                f"retrace, never per step); move it to the eager "
+                f"wrapper or use a trace-time-safe helper "
+                f"({', '.join(sorted(SAFE_LEAVES))})")
+        elif isinstance(call.func, ast.Attribute) \
+                and leaf in MUTATOR_METHODS and len(parts) > 1 \
+                and "observability." not in path:
+            yield ctx.finding(
+                self.name, call,
+                f"metric-style `.{leaf}()` inside jitted "
+                f"`{jit_fn.name}` — if this is a metrics handle it "
+                f"records at trace time only; record outside the "
+                f"compiled region")

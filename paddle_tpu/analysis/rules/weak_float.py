@@ -1,0 +1,133 @@
+"""Rule: weak-float-in-kernel — bare Python float literals in
+arithmetic inside Pallas kernel bodies.
+
+PR 2's second silent bug: the package enables jax x64 globally (paddle
+int64 semantics), so a weakly-typed Python float literal that reaches
+kernel arithmetic lowers as f64 — interpret-mode kernels then produce
+f64 intermediates (or Mosaic rejects the op on real TPU). The fix is
+always the same: wrap the literal, `np.float32(1.0 / (1.0 - p))`.
+
+Kernel bodies are found two ways: any function whose name ends in
+`_kernel`, and any function reaching a `pallas_call` first argument —
+directly, through `functools.partial`, through the
+`_pc = pl.pallas_call` alias, or through the repo's dict-dispatch
+idiom (`kern_fn = {...: _fwd_kernel_seg}[key]` then
+`partial(kern_fn, ...)`): every Name in such a dict literal counts.
+The name heuristic is anchored (endswith, not substring) so a host
+helper like `pick_kernel_config` doing ordinary float math never
+trips the rule.
+
+Only FLOAT literals in arithmetic BinOps are flagged. Int literals are
+int32-safe under the kernels' x64_off() regions (`i == 0`,
+`n_blocks - 1` grid math is idiomatic and harmless), and comparisons
+never produce a weak result dtype — flagging either would bury the
+real hazard in noise.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Rule, dotted_parts, register
+
+# Explicit scalar-cast constructors: a literal inside these is typed at
+# trace time, which is exactly the demanded fix.
+CAST_NAMES = {"float32", "float16", "bfloat16", "float64", "int8",
+              "int16", "int32", "int64", "uint8", "uint16", "uint32",
+              "uint64"}
+
+_ARITH = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+          ast.Pow, ast.MatMult)
+
+
+def _is_bare_float(node) -> bool:
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return isinstance(node, ast.Constant) and isinstance(
+        node.value, float)
+
+
+def _kernel_names(ctx) -> Set[str]:
+    """Function names passed as a pallas_call kernel, resolving one
+    level of `kernel = functools.partial(kern_fn, ...)` and the
+    dict-dispatch idiom `kern_fn = {...: _fwd_kernel_seg}[key]` (every
+    Name value in the dict counts as reachable)."""
+    partial_of: Dict[str, str] = {}
+    dict_alias: Dict[str, Set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if isinstance(node.value, ast.Call):
+            fn = ctx.imports.expand(node.value.func) or ""
+            if fn.endswith("partial") and node.value.args and isinstance(
+                    node.value.args[0], ast.Name):
+                partial_of[target] = node.value.args[0].id
+        elif isinstance(node.value, ast.Subscript) and isinstance(
+                node.value.value, ast.Dict):
+            vals = {v.id for v in node.value.value.values
+                    if isinstance(v, ast.Name)}
+            if vals:
+                dict_alias[target] = vals
+
+    def resolve(name: str) -> Set[str]:
+        name = partial_of.get(name, name)
+        return dict_alias.get(name, {name})
+
+    names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = ctx.imports.expand(node.func) or ""
+        if not fn.endswith("pallas_call"):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            names |= resolve(arg.id)
+        elif isinstance(arg, ast.Call):
+            inner = ctx.imports.expand(arg.func) or ""
+            if inner.endswith("partial") and arg.args and isinstance(
+                    arg.args[0], ast.Name):
+                names |= resolve(arg.args[0].id)
+    return names
+
+
+@register
+class WeakFloatInKernelRule(Rule):
+    name = "weak-float-in-kernel"
+    description = ("bare Python float literal in arithmetic inside a "
+                   "Pallas kernel body — lowers as f64 under the "
+                   "package's global x64 mode; wrap it: np.float32(...)")
+
+    def check(self, ctx):
+        called = _kernel_names(ctx)
+        kernels: List[ast.FunctionDef] = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and (node.name.endswith("_kernel") or node.name in called)]
+        for fn in kernels:
+            for stmt in fn.body:
+                yield from self._scan(ctx, stmt, exempt=False)
+
+    def _scan(self, ctx, node, exempt):
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if parts and parts[-1] in CAST_NAMES:
+                exempt = True
+        if (not exempt and isinstance(node, ast.BinOp)
+                and isinstance(node.op, _ARITH)
+                and (_is_bare_float(node.left)
+                     or _is_bare_float(node.right))):
+            yield ctx.finding(
+                self.name, node,
+                "bare float literal in kernel arithmetic — weak-typed "
+                "Python floats lower as f64 under global x64; wrap the "
+                "literal (np.float32(...)) or hoist it to a typed "
+                "constant")
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan(ctx, child, exempt)

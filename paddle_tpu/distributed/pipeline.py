@@ -52,8 +52,10 @@ def _pcast_varying(x, axes):
     if isinstance(axes, str):
         axes = (axes,)
     try:
+        # AttributeError: no jax.typeof on this jax (0.4.37);
+        # TypeError: non-tracer values have no aval on newer jax
         cur = getattr(jax.typeof(x), "vma", frozenset())
-    except Exception:  # noqa: BLE001 — non-tracer values have no aval
+    except (AttributeError, TypeError):
         cur = frozenset()
     need = tuple(a for a in axes if a not in cur)
     if not need:

@@ -72,8 +72,15 @@ define_flag("FLAGS_static_strict_placeholders", False,
             "Raise (instead of warn) when a static-graph placeholder is "
             "coerced to a Python scalar during program capture.")
 define_flag("FLAGS_benchmark", False, "Per-op timing dumps.")
-define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "No-op on TPU (XLA manages memory).")
 define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fused kernels where available.")
+define_flag("FLAGS_cp_ring_balance", "",
+            "Context-parallel ring-attention load balancing for the "
+            "contiguous-layout path (models/llama.py): 'zigzag' opts "
+            "into per-call relayout so every rank does equal causal "
+            "work per ring tick (~2x kernel wall-clock at large cp); "
+            "empty (default) keeps the contiguous ring — the relayout "
+            "gather cost is not chip-measured yet. Streams already in "
+            "zigzag layout ignore this flag.")
 define_flag("FLAGS_paged_grouped_kernel", False,
             "Route long-context float paged decode to the grouped-fetch "
             "kernel (8 pages per grid step via HBM DMA). Opt-in until the "

@@ -793,6 +793,13 @@ def format_report(report: dict) -> str:
             lines.append(f"DEAD RANK: rank {d['rank']} stopped beating "
                          f"at step {d['step']} ({d['age_s']:.1f} s "
                          f"behind the fleet's newest beat)")
+    if report["dead"]:
+        lines.append("hint: ranks that die together inside a "
+                     "collective usually mean only SOME ranks entered "
+                     "it (`if rank == 0: all_reduce(...)`) — the "
+                     "tpu-lint rule `rank-divergent-collective` finds "
+                     "that statically: `python tools/tpu_lint.py "
+                     "--select rank-divergent-collective paddle_tpu/`")
     if report["missing"] or report["dead"]:
         lines.append("")
     if report["stragglers"]:

@@ -219,6 +219,14 @@ class Watchdog:
         ]
         for ts, kind, fields in self.recorder.tail(self.tail_events):
             lines.append(f"{ts:.6f} {kind} {fields}")
+        lines += [
+            "",
+            "hint: a stall with threads parked inside a collective is "
+            "often a rank-divergent collective (`if rank == 0: "
+            "all_reduce(...)`) — statically detectable BEFORE the run: "
+            "`python tools/tpu_lint.py --select "
+            "rank-divergent-collective paddle_tpu/`",
+        ]
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         self.dumps.append(path)

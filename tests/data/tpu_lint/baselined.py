@@ -1,0 +1,7 @@
+"""Fixture: a real finding that tests grandfather through a baseline
+file (written by the test, not committed)."""
+import jax
+
+
+def old_code(x):
+    return jax.enable_x64  # known finding, baselined in the test

@@ -1,0 +1,33 @@
+"""Fixture: jax-compat positive — the exact PR 2 regression, in all
+three spellings the rule must catch. Not a test module; linted by
+tests/test_tpu_lint.py."""
+import jax
+
+
+def kernel_entry(x):
+    with jax.enable_x64(False):  # absent on jax 0.4.37
+        return x
+
+
+def silent_fallback(x, pallas, xla):
+    # the PR 2 bug verbatim: a catch-everything handler is NOT a
+    # feature-detection probe — the kernel library dies silently
+    try:
+        with jax.enable_x64(False):
+            return pallas(x)
+    except Exception:
+        return xla(x)
+
+
+def probe(x):
+    # this IS the feature-detection idiom: exempt
+    try:
+        ctx = jax.enable_x64
+    except AttributeError:
+        from jax.experimental import enable_x64 as ctx
+    return ctx
+
+
+def from_import_spelling():
+    from jax import enable_x64  # same absent API, ImportError spelling
+    return enable_x64

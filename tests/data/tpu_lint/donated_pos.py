@@ -1,0 +1,26 @@
+"""Fixture: donated-arg-reuse positive — `kv` is donated to the jitted
+step, then read again; the buffer behind it no longer exists."""
+import jax
+
+
+def decode(params, kv, tok):
+    step = jax.jit(_step, donate_argnums=(1,))
+    out, new_kv = step(params, kv)
+    print(kv.shape)  # read after donation: deleted buffer
+    return out, new_kv
+
+
+def decode_rebind(params, kv, tok):
+    step = jax.jit(_step, donate_argnums=(1,))
+    out, kv = step(params, kv)  # donate-and-rebind: fine
+    return out, kv.shape
+
+
+def decode_dynamic(params, kv, donate):
+    step = jax.jit(_step, donate_argnums=(1,) if donate else ())
+    out, new_kv = step(params, kv)
+    return out, kv.shape  # donation unknowable statically: not flagged
+
+
+def _step(params, kv):
+    return kv.sum(), kv

@@ -1,0 +1,8 @@
+"""Fixture: flag-hygiene positive — reads a flag nobody declared."""
+from paddle_tpu.framework import config
+
+
+def readers():
+    a = config.get_flag("FLAGS_zz_never_declared", False)
+    b = config.get_flag("FLAGS_use_pallas_kernels", True)  # declared: fine
+    return a, b

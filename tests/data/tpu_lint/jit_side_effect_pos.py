@@ -1,0 +1,23 @@
+"""Fixture: side-effect-under-jit positive — a counter bump and a span
+inside a jitted function record at trace time, not per step."""
+import jax
+
+from paddle_tpu.observability import metrics, tracing
+
+
+@jax.jit
+def step(x, counter):
+    tracing.span("step")  # trace-time only: wrong
+    counter.inc()  # metric handle mutator under jit: wrong
+    return x * x
+
+
+@jax.jit
+def safe_step(x):
+    tracing.instant("step_traced")  # documented trace-time-safe helper
+    return x + x
+
+
+def eager_step(x, counter):
+    counter.inc()  # not jitted: fine
+    return x

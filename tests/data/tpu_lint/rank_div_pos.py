@@ -1,0 +1,25 @@
+"""Fixture: rank-divergent-collective positive — the canonical fleet
+deadlock: only rank 0 enters the collective, every other rank blocks
+forever."""
+from paddle_tpu.distributed.collective import all_reduce, broadcast
+
+
+def log_and_sync(x, rank):
+    if rank == 0:
+        all_reduce(x)  # ranks 1..N-1 never enter: deadlock
+    return x
+
+
+def provenance_required(x, rank, dist):
+    if rank == 0:
+        dist.broadcast(x, src=0)  # attribute chain into a dist module
+    return x
+
+
+def fine_paths(x, rank, items):
+    import functools
+
+    if rank == 0:
+        total = functools.reduce(lambda a, b: a + b, items)  # not a collective
+    all_reduce(x)  # outside the rank test: fine
+    return x

@@ -1,0 +1,37 @@
+"""Fixture: weak-float-in-kernel positive — bare float literals in a
+Pallas kernel body (the PR 2 f64-under-x64 regression), both via the
+`*_kernel` name convention and via a pallas_call first argument."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    o_ref[:] = x * 2.0 + 0.5  # weak floats lower as f64
+
+
+def body(x_ref, o_ref):
+    o_ref[:] = x_ref[:] / 3.0  # weak float, kernel found via pallas_call
+
+
+def run(x):
+    return pl.pallas_call(body, out_shape=x)(x)
+
+
+def dispatch_seg(x, seg):
+    import functools
+
+    kern_fn = {False: body, True: _seg_variant}[seg]
+    return pl.pallas_call(functools.partial(kern_fn), out_shape=x)(x)
+
+
+def _seg_variant(x_ref, o_ref):
+    o_ref[:] = x_ref[:] - 0.25  # reached only via the dict dispatch
+
+
+def host_math(x):
+    return x * 2.0  # NOT a kernel: must not be flagged
+
+
+def pick_kernel_config(p):
+    return p * 0.5  # host helper with 'kernel' in the name: not flagged

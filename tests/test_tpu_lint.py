@@ -1,0 +1,220 @@
+"""tpu-lint (paddle_tpu/analysis/): fixture-driven rule tests, the
+repo-is-clean self-check, baseline + suppression workflows, reporter
+schema, and the FLAGS.md freshness gate."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.analysis import baseline as lint_baseline
+from paddle_tpu.analysis import flagsdoc, reporters
+from paddle_tpu.analysis import run as lint_run
+from paddle_tpu.analysis.cli import main as lint_main
+from paddle_tpu.analysis.core import RULES, repo_root
+
+REPO = repo_root()
+FIXTURES = os.path.join(REPO, "tests", "data", "tpu_lint")
+
+
+def lint_fixture(name, **kw):
+    return lint_run([os.path.join(FIXTURES, name)], **kw)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: each positive file triggers EXACTLY its rule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fixture,rule,expect_lines", [
+    # compat: attribute use, silent-fallback broad except (NOT exempt),
+    # and the from-import spelling; the try/except-AttributeError probe
+    # between them must stay exempt
+    ("compat_pos.py", "jax-compat", [8, 16, 32]),
+    # weak float: named kernel, pallas_call arg, dict-dispatch variant;
+    # host helpers (incl. one with 'kernel' in the name) stay clean
+    ("weak_float_pos.py", "weak-float-in-kernel", [10, 14, 29]),
+    ("rank_div_pos.py", "rank-divergent-collective", [9, 15]),
+    ("jit_side_effect_pos.py", "side-effect-under-jit", [10, 11]),
+    ("donated_pos.py", "donated-arg-reuse", [9]),
+    ("flags_pos.py", "flag-hygiene", [6]),
+])
+def test_fixture_triggers_exactly_its_rule(fixture, rule, expect_lines):
+    findings = lint_fixture(fixture)
+    assert findings, f"{fixture}: expected findings"
+    assert {f.rule for f in findings} == {rule}, findings
+    assert sorted({f.line for f in findings}) == expect_lines, findings
+
+
+def test_registry_ships_all_six_rules():
+    assert set(RULES) >= {
+        "jax-compat", "weak-float-in-kernel",
+        "rank-divergent-collective", "side-effect-under-jit",
+        "donated-arg-reuse", "flag-hygiene"}
+    for cls in RULES.values():
+        assert cls.description
+
+
+def test_select_and_disable_narrow_the_rule_set():
+    none = lint_fixture("compat_pos.py", disable={"jax-compat"})
+    assert none == []
+    only = lint_fixture("compat_pos.py", select={"jax-compat"})
+    assert {f.rule for f in only} == {"jax-compat"}
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+def test_suppressed_fixture_is_clean():
+    assert lint_fixture("suppressed.py") == []
+
+
+def test_unsuppressed_twin_of_suppressed_fixture_fires():
+    # the suppressed fixture holds real hazards: strip the pragmas and
+    # the same source must fire, proving the pragmas did the silencing
+    findings = lint_fixture("compat_pos.py") \
+        + lint_fixture("rank_div_pos.py")
+    assert findings
+
+
+def test_baseline_grandfathers_then_ratchets(tmp_path):
+    findings = lint_fixture("baselined.py")
+    assert [f.rule for f in findings] == ["jax-compat"]
+    path = str(tmp_path / "baseline.json")
+    lint_baseline.save(path, findings)
+    new, old = lint_baseline.split(findings, lint_baseline.load(path))
+    assert new == [] and len(old) == 1
+    # a second identical hazard would NOT be covered by the count of 1
+    new2, old2 = lint_baseline.split(findings + findings,
+                                     lint_baseline.load(path))
+    assert len(new2) == 1 and len(old2) == 1
+
+
+def test_committed_baseline_is_empty():
+    path = os.path.join(REPO, "tools", "tpu_lint_baseline.json")
+    assert lint_baseline.load(path) == {}, \
+        "the committed baseline must stay empty: fix findings, don't " \
+        "grandfather them"
+
+
+# ---------------------------------------------------------------------------
+# repo is clean (the acceptance gate, in-process)
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    paths = [os.path.join(REPO, "paddle_tpu"),
+             os.path.join(REPO, "tools"),
+             os.path.join(REPO, "bench.py")]
+    findings = lint_run(paths)
+    assert findings == [], "\n" + reporters.to_text(findings)
+
+
+def test_cli_exit_codes(capsys):
+    fixture = os.path.join(FIXTURES, "compat_pos.py")
+    assert lint_main([fixture, "--no-baseline"]) == 1
+    capsys.readouterr()
+    assert lint_main([os.path.join(REPO, "paddle_tpu", "analysis")]) == 0
+    capsys.readouterr()
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "rank-divergent-collective" in out
+    assert lint_main(["--select", "no-such-rule", fixture]) == 2
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+def test_json_reporter_schema():
+    findings = lint_fixture("compat_pos.py")
+    doc = json.loads(reporters.to_json(findings[:1], findings[1:]))
+    assert doc["version"] == reporters.JSON_VERSION
+    assert doc["tool"] == "tpu-lint"
+    assert set(doc["counts"]) == {"new", "baselined", "total"}
+    assert doc["counts"]["total"] == len(findings)
+    for entry in doc["findings"]:
+        assert set(entry) == {"rule", "path", "line", "col", "message",
+                              "snippet", "key", "baselined"}
+        assert isinstance(entry["line"], int)
+        assert entry["key"].startswith(entry["rule"] + "::")
+
+
+def test_text_reporter_mentions_rule_and_location():
+    findings = lint_fixture("compat_pos.py")
+    text = reporters.to_text(findings)
+    assert "compat_pos.py:8:" in text
+    assert "[jax-compat]" in text
+    assert f"{len(findings)} new finding" in text
+
+
+# ---------------------------------------------------------------------------
+# flag-hygiene: declared-unread direction + FLAGS.md freshness
+# ---------------------------------------------------------------------------
+
+def test_dead_flag_direction(tmp_path):
+    fw = tmp_path / "paddle_tpu" / "framework"
+    fw.mkdir(parents=True)
+    (fw / "config.py").write_text(
+        'def define_flag(*a, **k):\n    pass\n\n'
+        'define_flag("FLAGS_dead_one", False, "never read anywhere")\n'
+        'define_flag("FLAGS_live_one", 0, "read by reader.py")\n')
+    (tmp_path / "paddle_tpu" / "reader.py").write_text(
+        'from .framework.config import get_flag\n'
+        'v = get_flag("FLAGS_live_one", 0)\n')
+    findings = lint_run([str(tmp_path / "paddle_tpu")],
+                        select={"flag-hygiene"}, root=str(tmp_path))
+    assert len(findings) == 1, findings
+    assert "FLAGS_dead_one" in findings[0].message
+    assert findings[0].path.endswith("config.py")
+
+
+def test_flags_doc_is_fresh():
+    decls = flagsdoc.parse_flag_declarations(
+        os.path.join(REPO, flagsdoc.CONFIG_RELPATH))
+    assert len(decls) >= 16
+    expected = flagsdoc.to_markdown(decls)
+    committed = open(os.path.join(REPO, "docs", "FLAGS.md"),
+                     encoding="utf-8").read()
+    assert committed == expected, \
+        "docs/FLAGS.md is stale — regenerate: python tools/tpu_lint.py " \
+        "--emit-flags-doc docs/FLAGS.md"
+    for d in decls:
+        assert f"`{d.name}`" in committed
+
+
+def test_emit_flags_doc_cli(tmp_path, capsys):
+    out = str(tmp_path / "FLAGS.md")
+    assert lint_main(["--emit-flags-doc", out]) == 0
+    text = open(out, encoding="utf-8").read()
+    assert "FLAGS_use_pallas_kernels" in text
+    assert text.startswith("# `FLAGS_*` reference")
+
+
+# ---------------------------------------------------------------------------
+# runtime-symptom -> static-cause hints (satellite: close the loop)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_dump_mentions_lint_rule(tmp_path):
+    from paddle_tpu.observability import flight_recorder as fr
+
+    wd = fr.Watchdog(deadline=60.0, dump_dir=str(tmp_path),
+                     name="linttest")
+    path = wd.dump(stall_age=1.0)
+    text = open(path, encoding="utf-8").read()
+    assert "rank-divergent-collective" in text
+    assert "tools/tpu_lint.py" in text
+
+
+def test_fleet_report_dead_rank_mentions_lint_rule():
+    from paddle_tpu.observability import fleet
+
+    report = {
+        "root": "/tmp/x", "shards": {}, "ranks": [], "world_size": 2,
+        "dead": [{"rank": 1, "step": 7, "age_s": 99.0,
+                  "never_beat": False}],
+        "missing": [], "stragglers": [], "straggler_summary": [],
+        "artifacts": {},
+    }
+    text = fleet.format_report(report)
+    assert "DEAD RANK" in text
+    assert "rank-divergent-collective" in text
+    assert "tools/tpu_lint.py" in text

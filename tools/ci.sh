@@ -44,6 +44,19 @@ if [ "$MODE" = "rehearsal" ]; then
   exit $rc
 fi
 
+# tpu-lint gate FIRST: static analysis over the source tree (jax-compat
+# APIs, weak floats in Pallas kernels, rank-divergent collectives, jit
+# side effects, donated-arg reuse, FLAGS_* hygiene). Dependency-free and
+# sub-10s, so a lint-detectable hazard fails CI in seconds instead of
+# after a full test tier (or a burned TPU reservation). Fails on any
+# finding not in tools/tpu_lint_baseline.json.
+if ! timeout 120 python tools/tpu_lint.py; then
+  echo "CI: tpu_lint FAILED — new static-analysis finding(s) above;" \
+       "fix them (preferred) or, for a deliberate exception, add a" \
+       "'# tpu-lint: disable=<rule>' line comment" >&2
+  exit 1
+fi
+
 ARGS=(-q -p no:cacheprovider)
 if [ "$MODE" = "fast" ]; then
   ARGS+=(-m "not slow")

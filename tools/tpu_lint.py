@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""tpu-lint CLI — AST static analysis for JAX/TPU hazards.
+
+    python tools/tpu_lint.py                  # lint paddle_tpu/ tools/ bench.py
+    python tools/tpu_lint.py paddle_tpu/      # lint a subtree
+    python tools/tpu_lint.py --list-rules
+    python tools/tpu_lint.py --format json path/to/file.py
+    python tools/tpu_lint.py --emit-flags-doc docs/FLAGS.md
+
+Implementation lives in paddle_tpu/analysis/. Loaded via importlib
+spec ON PURPOSE: importing `paddle_tpu.analysis` through the package
+__init__ would pull jax (~seconds) — the lint gate runs before the
+test tiers and must fail in well under that — and putting
+paddle_tpu/ itself on sys.path would shadow stdlib modules the
+package re-exports (signal, io, jit, static).
+"""
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "paddle_tpu", "analysis")
+
+
+def _load_analysis():
+    spec = importlib.util.spec_from_file_location(
+        "analysis", os.path.join(_PKG, "__init__.py"),
+        submodule_search_locations=[_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    analysis = _load_analysis()
+    from analysis.cli import main
+
+    sys.exit(main(sys.argv[1:]))
