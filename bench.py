@@ -35,6 +35,32 @@ def model_flops_per_token(cfg, seq_len, causal=True):
 
 PROBE_DIAG = {"attempts": []}
 
+
+def _enable_observability(paddle):
+    """Turn the memwatch/compilewatch channels on for the bench run so
+    every row carries peak_hbm_bytes + compiles columns — BENCH_*.json
+    trajectories then catch memory and recompile regressions, not just
+    latency ones."""
+    try:
+        paddle.set_flags({"FLAGS_memwatch": True,
+                          "FLAGS_compilewatch": True})
+    except Exception as e:  # noqa: BLE001 — observability must never
+        print(f"bench observability disabled: {e}", file=sys.stderr)
+
+
+def _observability_columns():
+    """The memory/compile columns for a bench row: the run's peak device
+    bytes (allocator high-water mark; live-sweep max on CPU) and total
+    XLA compiles attributed to watched callables."""
+    try:
+        from paddle_tpu.observability import compilewatch, memwatch
+
+        return {"peak_hbm_bytes": int(memwatch.peak_hbm_bytes()),
+                "compiles": int(compilewatch.total_compiles())}
+    except Exception as e:  # noqa: BLE001
+        return {"peak_hbm_bytes": 0, "compiles": 0,
+                "observability_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ---------------------------------------------------------------------------
 # Last-known-good on-chip capture bank (round-4 verdict item 2): every
 # successful on-TPU bench run banks its result row here, keyed by config;
@@ -241,6 +267,7 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_train_step
 
+    _enable_observability(paddle)
     n_dev = len(jax.devices())
     on_tpu = probe is not None
 
@@ -360,6 +387,7 @@ def main():
             "loss_last": round(final, 4),
         },
     }
+    result["extra"].update(_observability_columns())
     if on_tpu:
         _bank_tpu_result(f"llama:{size}{_env_override_tag()}", result)
     else:
@@ -404,6 +432,7 @@ def bench_resnet(paddle, jax, on_tpu, n_dev):
                   "devices": n_dev, "backend": jax.default_backend(),
                   "loss_first": round(loss0, 4),
                   "loss_last": round(final, 4)}}
+    result["extra"].update(_observability_columns())
     if on_tpu:
         _bank_tpu_result("resnet", result)
     else:
@@ -504,6 +533,16 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers,
                   "params_b": params_b}}
+    result["extra"].update(_observability_columns())
+    # serving rows additionally carry the steady-state check the CI
+    # smoke gates on: decode recompiles after engine.warmup() must be 0
+    try:
+        from paddle_tpu.observability import compilewatch as _cwatch
+
+        result["extra"]["decode_recompiles"] = int(
+            _cwatch.recompiles("serving.decode"))
+    except Exception:  # noqa: BLE001
+        pass
     if on_tpu:
         tags = [t for t in (f"quant={quant}" if quant else "",
                             f"kv={kv_quant}" if kv_quant else "",

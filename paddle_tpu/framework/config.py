@@ -153,6 +153,41 @@ define_flag("FLAGS_telemetry_flush_s", 5.0,
             "(FLAGS_telemetry_dir). The dead-rank detector treats a "
             "heartbeat more than ~3x this behind the fleet's newest "
             "beat as a stopped rank.", type_=float)
+define_flag("FLAGS_memwatch", False,
+            "Memory observability channel (observability/memwatch.py): "
+            "per-step HBM watermark gauges from device memory_stats "
+            "(live-buffer-sweep fallback on backends without allocator "
+            "stats), KV page-pool occupancy + fragmentation histograms "
+            "in serving, and static breakdown gauges "
+            "(params/optimizer/kv_pages). Off (default) costs one flag "
+            "read per step (pinned by tests/test_memwatch.py). OOM "
+            "forensic dumps are ALWAYS on — catching a "
+            "RESOURCE_EXHAUSTED costs nothing until it fires, and that "
+            "is exactly when the data is needed.")
+define_flag("FLAGS_memwatch_dump_dir", "",
+            "Directory for OOM forensic dumps "
+            "(oom_<name>_r<rank>_<pid>_<n>.txt, written through the "
+            "atomic writers); empty = current directory, the same "
+            "default as the watchdog stall dumps.")
+define_flag("FLAGS_memwatch_top", 10,
+            "Rows in the ranked live-buffer table of memory reports "
+            "and OOM forensic dumps.", type_=int)
+define_flag("FLAGS_compilewatch", False,
+            "Compile observability channel "
+            "(observability/compilewatch.py): counts XLA backend "
+            "compiles per watched callable (jit entry points, serving "
+            "prefill/decode programs, autotune candidates) with "
+            "compile-time spans on the tracer, and detects recompile "
+            "storms — a callable compiling for more than "
+            "FLAGS_compilewatch_storm_shapes distinct argument-shape "
+            "signatures after its warmup mark. Off (default) costs one "
+            "flag read per wrapped call (pinned by "
+            "tests/test_compilewatch.py).")
+define_flag("FLAGS_compilewatch_storm_shapes", 4,
+            "Distinct post-warmup shape signatures per callable that "
+            "trigger a recompile-storm report citing the offending "
+            "shapes (shape churn belongs in the autotuner's pow2 "
+            "buckets, not the jit executable cache).", type_=int)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

@@ -21,8 +21,10 @@ import numpy as np
 
 from ..autograd import tape as _tape
 from ..kernels import paged_attention as _pa
+from ..observability import compilewatch as _cw
 from ..observability import fleet as _fleet
 from ..observability import flight_recorder as _flight
+from ..observability import memwatch as _memwatch
 from ..observability import metrics as _om
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
@@ -38,7 +40,8 @@ class _EngineMetrics:
     __slots__ = ("ttft", "step_lat", "token_lat", "queue_depth",
                  "queue_wait", "occupancy", "page_util", "prefill_hits",
                  "prefill_misses", "preemptions", "aborts", "tokens",
-                 "finished", "poisoned")
+                 "finished", "poisoned", "kv_occupancy", "kv_frag",
+                 "kv_free")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -91,6 +94,25 @@ class _EngineMetrics:
             "1 once a compiled decode call raised after donating the KV "
             "page pools (engine must be recreated; step()/run() fail "
             "fast).")
+        # memwatch channel (README.md "Memory & compile observability"):
+        # per-step KV page-pool distributions, observed only when
+        # FLAGS_memwatch is on — handles still resolve here so the on
+        # path allocates nothing per step
+        self.kv_occupancy = reg.histogram(
+            "serving_kv_pool_occupancy",
+            "Per-step fraction of KV pages allocated (distribution of "
+            "serving_page_pool_utilization over steps; FLAGS_memwatch).",
+            buckets=_memwatch.RATIO_BUCKETS)
+        self.kv_frag = reg.histogram(
+            "serving_kv_fragmentation",
+            "Per-step internal fragmentation of allocated KV pages: "
+            "1 - cached tokens / (allocated pages * page_size). High "
+            "values mean page_size is too coarse for the traffic's "
+            "context lengths (FLAGS_memwatch).",
+            buckets=_memwatch.RATIO_BUCKETS)
+        self.kv_free = reg.gauge(
+            "serving_kv_pages_free",
+            "KV pages currently free in the pool (FLAGS_memwatch).")
 
 
 @dataclass
@@ -270,6 +292,13 @@ class ServingEngine:
         self._poisoned = None
         self._n_pages_total = n_pages
         self._m = _EngineMetrics()
+        # OOM graceful degradation (memwatch channel): a decode-time
+        # RESOURCE_EXHAUSTED gets ONE preemption round (shed the
+        # youngest slot, retry) before the engine poisons — see
+        # _handle_decode_oom
+        self._oom_retried = False
+        if _memwatch.enabled():
+            self._record_static_breakdown()
         # span tracing (README.md "Observability"): one Trace per request
         # while tracing is enabled, keyed by rid. Empty when
         # FLAGS_trace_sample=0, so every hot-path guard below is one
@@ -472,6 +501,10 @@ class ServingEngine:
                                  max_new_tokens=mx,
                                  decode_strategy=strategy, eos_token_id=-1)
                 self.run()
+        # compile observability: from here on, any serving program
+        # compile is an IN-TRAFFIC recompile (compilewatch counts them;
+        # tools/ci.sh gates the smoke on zero decode recompiles)
+        _cw.mark_warmup_done("serving.")
         return _time.perf_counter() - t0
 
     def _autotune_decode_bucket(self):
@@ -658,7 +691,8 @@ class ServingEngine:
             return first, ks, vs  # ks: [L, nb, bucket, kvh, hd]
 
         fn = self._prefill_fns[(nb, bucket, all_greedy)] = \
-            jax.jit(pure_prefill)
+            _cw.watch_jit("serving.prefill", jax.jit(pure_prefill),
+                          tag=(nb, bucket, all_greedy))
         return fn
 
     def _prefill_batch(self, new):
@@ -782,8 +816,10 @@ class ServingEngine:
                     lens, active, key, greedy, temp, tk, tp)
             return nxt, nk, nv, nks, nvs
 
-        fn = self._decode_fns[all_greedy] = jax.jit(
-            pure_decode, donate_argnums=(2, 3, 4, 5))
+        fn = self._decode_fns[all_greedy] = _cw.watch_jit(
+            "serving.decode",
+            jax.jit(pure_decode, donate_argnums=(2, 3, 4, 5)),
+            tag=("greedy" if all_greedy else "mixed",))
         return fn
 
     def _get_burst_fn(self, all_greedy, n_steps):
@@ -833,8 +869,10 @@ class ServingEngine:
             return (toks, emits, nk, nv, nks, nvs,
                     tok_f, ln_f, act_f, rm_f, jax.random.key_data(key_f))
 
-        fn = self._burst_fns[(all_greedy, n_steps)] = jax.jit(
-            pure_burst, donate_argnums=(2, 3, 4, 5))
+        fn = self._burst_fns[(all_greedy, n_steps)] = _cw.watch_jit(
+            "serving.decode_burst",
+            jax.jit(pure_burst, donate_argnums=(2, 3, 4, 5)),
+            tag=("greedy" if all_greedy else "mixed", n_steps))
         return fn
 
     def _rem_of(self, active):
@@ -915,6 +953,99 @@ class ServingEngine:
                 f"pools, so the engine holds deleted buffers. Recreate "
                 f"the engine; in-flight requests must be re-submitted.")
 
+    # ------------------------------------------------------------------
+    # memory observability (memwatch channel)
+    # ------------------------------------------------------------------
+    def _record_static_breakdown(self):
+        """Publish this engine's static memory budget: param bytes + KV
+        page-pool bytes (pages + quant scales) into the
+        memwatch_breakdown_bytes gauges. Never raises."""
+        try:
+            params = sum(int(p._data.nbytes)
+                         for p in self.model.parameters())
+            kv = sum(int(p.nbytes) for p in self.k_pages + self.v_pages)
+            if self.k_scales is not None:
+                kv += sum(int(p.nbytes)
+                          for p in self.k_scales + self.v_scales)
+            _memwatch.record_breakdown(params=params, kv_pages=kv)
+        except Exception:  # noqa: BLE001 — telemetry must never take
+            pass           # engine construction down
+
+    def _observe_memory(self):
+        """Per-step memwatch close-out (FLAGS_memwatch on): KV pool
+        occupancy + internal-fragmentation histograms, free-page gauge,
+        and one HBM watermark sample. Handles were resolved at engine
+        build — zero registry allocations per step."""
+        free = len(self._free_pages)
+        self._m.kv_free.set(free)
+        self._m.kv_occupancy.observe(1.0 - free / self._n_pages_total)
+        alloc_tokens = 0
+        used_tokens = 0
+        for s in self.slots:
+            if s.active:
+                alloc_tokens += s.n_pages * self.page_size
+                used_tokens += s.context_len
+        self._m.kv_frag.observe(
+            1.0 - used_tokens / alloc_tokens if alloc_tokens else 0.0)
+        _memwatch.sample()
+
+    def _page_table_report(self) -> str:
+        """The page-table half of an OOM forensic dump: per-slot page
+        allocation + context, pool state, and internal fragmentation."""
+        lines = [
+            "== kv page table ==",
+            f"pool: {self._n_pages_total} pages x {self.page_size} "
+            f"tokens, {len(self._free_pages)} free, dtype "
+            f"{jnp.dtype(self.kv_dtype).name}"
+            + (", quant int8" if self.kv_cache_quant else ""),
+        ]
+        for i, s in enumerate(self.slots):
+            if not s.active:
+                lines.append(f"  slot {i}: (idle)")
+                continue
+            pages = self.block_tables[i, :s.n_pages].tolist()
+            waste = s.n_pages * self.page_size - s.context_len
+            lines.append(
+                f"  slot {i}: rid {s.request_id}, ctx {s.context_len}, "
+                f"{s.n_pages} pages (waste {waste} tok), "
+                f"admit_seq {s.admit_seq}, tokens {len(s.tokens)}/"
+                f"{s.max_new_tokens}, pages {pages}")
+        lines.append(f"pending queue: {len(self._pending)} request(s)")
+        return "\n".join(lines)
+
+    def _handle_decode_oom(self, exc, where: str) -> bool:
+        """RESOURCE_EXHAUSTED in a compiled decode call: write the
+        forensic dump (ranked live buffers + the page-table report),
+        then degrade gracefully ONCE — preempt the lowest-priority
+        (youngest-admitted) slot and tell the caller to retry the
+        dispatch. A second OOM, or one that already consumed the
+        donated pools, poisons the engine instead (fail fast, never a
+        silent crash). Returns True when the caller should retry."""
+        path = _memwatch.dump_oom(f"serving_{where}", exc=exc,
+                                  extra=self._page_table_report())
+        _flight.record_event("serving.oom", where=where, dump=path)
+        if any(pages and self._buffers_deleted(pages)
+               for pages in (self.k_pages, self.v_pages)):
+            self._poison(f"{where} raised RESOURCE_EXHAUSTED after "
+                         f"donating the KV pages (forensics: {path})")
+            return False
+        if self._oom_retried:
+            self._poison(f"{where} OOM persisted after a preemption "
+                         f"round (forensics: {path})")
+            return False
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        if not active:
+            self._poison(f"{where} OOM with no active slots "
+                         f"(forensics: {path})")
+            return False
+        victim = max(active, key=lambda i: self.slots[i].admit_seq)
+        self._oom_retried = True
+        _flight.record_event("serving.oom_preempt",
+                             rid=self.slots[victim].request_id,
+                             slot=victim)
+        self._preempt(victim)
+        return True
+
     def step(self) -> List[FinishedRequest]:
         """Run one decode step for all active slots; returns requests that
         finished this step."""
@@ -967,81 +1098,109 @@ class ServingEngine:
         # burst is correct, just not free; it only occurs while the queue
         # drains. max rem == 1 (every row on its last token) drops to the
         # single-step program.
-        rem_of = self._rem_of(active)
-        k_burst = self.decode_burst if (
-            self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
-        # on-demand page growth for the positions this step writes (one per
-        # single step, up to min(burst, remaining) for a burst); pool
-        # exhaustion preempts the youngest slot (recompute policy) and
-        # retries, so the oldest slots always make progress
+        #
+        # The dispatch runs inside a retry loop: a RESOURCE_EXHAUSTED
+        # from the compiled call gets one graceful-degradation round
+        # (_handle_decode_oom dumps forensics and preempts the youngest
+        # slot) before the engine poisons — the launch state is rebuilt
+        # from the surviving slots and the dispatch retried.
         while True:
-            stalled = [i for i in active
-                       if not self._ensure_pages(i, min(k_burst, rem_of[i]))]
-            if not stalled:
-                break
-            victim = max(stalled, key=lambda i: self.slots[i].admit_seq)
-            self._preempt(victim)
-            active = [j for j in active if j != victim]
-            if not active:
-                return finished_early
-        st = self._decode_launch_state(active)
-        all_greedy = st["all_greedy"]
-        lens, act_mask = st["lens"], st["act_mask"]
-        greedy, temp, tk, tp_arr = (st["greedy"], st["temp"], st["tk"],
-                                    st["tp"])
-        self._key, sk = jax.random.split(self._key)
-        params, buffers = self._cached_params()
-        t0 = _time_mod.perf_counter()
-        tok0 = self._m.tokens.value
-        if self._traces:
-            # the per-request aggregate decode span runs from the first
-            # dispatch that includes the slot to its finish
-            for i in active:
-                tr = self._traces.get(self.slots[i].request_id)
-                if tr is not None and "decode_t0" not in tr.marks:
-                    tr.mark("decode_t0", t0)
-        if k_burst > 1:
-            fn = self._get_burst_fn(all_greedy, k_burst)
+            rem_of = self._rem_of(active)
+            k_burst = self.decode_burst if (
+                self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
+            # on-demand page growth for the positions this step writes
+            # (one per single step, up to min(burst, remaining) for a
+            # burst); pool exhaustion preempts the youngest slot
+            # (recompute policy) and retries, so the oldest slots always
+            # make progress
+            while True:
+                stalled = [i for i in active if not self._ensure_pages(
+                    i, min(k_burst, rem_of[i]))]
+                if not stalled:
+                    break
+                victim = max(stalled,
+                             key=lambda i: self.slots[i].admit_seq)
+                self._preempt(victim)
+                active = [j for j in active if j != victim]
+                if not active:
+                    return finished_early
+            st = self._decode_launch_state(active)
+            all_greedy = st["all_greedy"]
+            lens, act_mask = st["lens"], st["act_mask"]
+            greedy, temp, tk, tp_arr = (st["greedy"], st["temp"],
+                                        st["tk"], st["tp"])
+            self._key, sk = jax.random.split(self._key)
+            params, buffers = self._cached_params()
+            t0 = _time_mod.perf_counter()
+            tok0 = self._m.tokens.value
+            if self._traces:
+                # the per-request aggregate decode span runs from the
+                # first dispatch that includes the slot to its finish
+                for i in active:
+                    tr = self._traces.get(self.slots[i].request_id)
+                    if tr is not None and "decode_t0" not in tr.marks:
+                        tr.mark("decode_t0", t0)
+            if k_burst > 1:
+                fn = self._get_burst_fn(all_greedy, k_burst)
+                try:
+                    (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
+                        params, buffers, tuple(self.k_pages),
+                        tuple(self.v_pages),
+                        tuple(self.k_scales or ()),
+                        tuple(self.v_scales or ()),
+                        jnp.asarray(tokens),
+                        jnp.asarray(self.block_tables),
+                        jnp.asarray(lens), jnp.asarray(act_mask),
+                        jnp.asarray(st["rem"]), jnp.asarray(st["eos"]),
+                        jax.random.key_data(sk),
+                        jnp.asarray(greedy), jnp.asarray(temp),
+                        jnp.asarray(tk), jnp.asarray(tp_arr))
+                except BaseException as e:
+                    if _memwatch.is_oom(e) and \
+                            self._handle_decode_oom(e, "burst_decode"):
+                        active = [i for i in active
+                                  if self.slots[i].active]
+                        if not active:
+                            return finished_early
+                        continue
+                    self._poison_if_donated(
+                        "burst decode fn raised after donating the KV "
+                        "pages", self.k_pages, self.v_pages)
+                    raise
+                self.k_pages, self.v_pages = list(nk), list(nv)
+                if self.k_scales is not None:
+                    self.k_scales, self.v_scales = list(nks), list(nvs)
+                finished = finished_early
+                finished.extend(self._replay_burst(
+                    np.asarray(toks), np.asarray(emits), active))
+                self._step_metrics(t0, len(active), tok0)
+                if finished:
+                    self._admit()
+                return finished
+            fn = self._get_decode_fn(all_greedy)
             try:
-                (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
+                nxt, nk, nv, nks, nvs = fn(
                     params, buffers, tuple(self.k_pages),
                     tuple(self.v_pages),
-                    tuple(self.k_scales or ()), tuple(self.v_scales or ()),
+                    tuple(self.k_scales or ()),
+                    tuple(self.v_scales or ()),
                     jnp.asarray(tokens), jnp.asarray(self.block_tables),
                     jnp.asarray(lens), jnp.asarray(act_mask),
-                    jnp.asarray(st["rem"]), jnp.asarray(st["eos"]),
-                    jax.random.key_data(sk),
-                    jnp.asarray(greedy), jnp.asarray(temp),
-                    jnp.asarray(tk), jnp.asarray(tp_arr))
-            except BaseException:
+                    jax.random.key_data(sk), jnp.asarray(greedy),
+                    jnp.asarray(temp), jnp.asarray(tk),
+                    jnp.asarray(tp_arr))
+            except BaseException as e:
+                if _memwatch.is_oom(e) and \
+                        self._handle_decode_oom(e, "decode"):
+                    active = [i for i in active if self.slots[i].active]
+                    if not active:
+                        return finished_early
+                    continue
                 self._poison_if_donated(
-                    "burst decode fn raised after donating the KV pages",
+                    "decode fn raised after donating the KV pages",
                     self.k_pages, self.v_pages)
                 raise
-            self.k_pages, self.v_pages = list(nk), list(nv)
-            if self.k_scales is not None:
-                self.k_scales, self.v_scales = list(nks), list(nvs)
-            finished = finished_early
-            finished.extend(self._replay_burst(
-                np.asarray(toks), np.asarray(emits), active))
-            self._step_metrics(t0, len(active), tok0)
-            if finished:
-                self._admit()
-            return finished
-        fn = self._get_decode_fn(all_greedy)
-        try:
-            nxt, nk, nv, nks, nvs = fn(
-                params, buffers, tuple(self.k_pages), tuple(self.v_pages),
-                tuple(self.k_scales or ()), tuple(self.v_scales or ()),
-                jnp.asarray(tokens), jnp.asarray(self.block_tables),
-                jnp.asarray(lens), jnp.asarray(act_mask),
-                jax.random.key_data(sk), jnp.asarray(greedy),
-                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp_arr))
-        except BaseException:
-            self._poison_if_donated(
-                "decode fn raised after donating the KV pages",
-                self.k_pages, self.v_pages)
-            raise
+            break
         self.k_pages, self.v_pages = list(nk), list(nv)
         if self.k_scales is not None:
             self.k_scales, self.v_scales = list(nks), list(nvs)
@@ -1088,6 +1247,10 @@ class ServingEngine:
         _flight.record_event("serving.step", active=n_active,
                              tokens=n_tok, seconds=round(dt, 6))
         _flight.beat_all()
+        # memwatch channel (one flag read when off): KV pool occupancy/
+        # fragmentation histograms + an HBM watermark sample
+        if _memwatch.enabled():
+            self._observe_memory()
         # fleet heartbeat (rank shard liveness): one flag read when off
         _fleet.heartbeat()
 
@@ -1233,12 +1396,23 @@ class ServingEngine:
                                 jnp.asarray(self.block_tables), carry[1],
                                 carry[2], carry[3], eos_arr, carry[4],
                                 greedy, temp, tk, tp_arr)
-                        except BaseException:
+                        except BaseException as e:
                             # on a post-donation failure `pages` names
                             # deleted buffers and the finally below
                             # re-points the engine at them — poison so
                             # step()/run() fail fast (ADVICE.md round-5);
-                            # pre-donation failures keep the engine live
+                            # pre-donation failures keep the engine live.
+                            # An OOM still gets its forensic dump here;
+                            # the graceful preemption round belongs to
+                            # the classic step() the caller falls back
+                            # to.
+                            if _memwatch.is_oom(e):
+                                path = _memwatch.dump_oom(
+                                    "serving_async_decode", exc=e,
+                                    extra=self._page_table_report())
+                                _flight.record_event(
+                                    "serving.oom", where="async_decode",
+                                    dump=path)
                             self._poison_if_donated(
                                 "async burst decode fn raised after "
                                 "donating the KV pages",

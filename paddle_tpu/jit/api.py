@@ -34,6 +34,7 @@ import numpy as np
 
 from ..framework import random as _random
 from ..nn.layer_base import Layer
+from ..observability import compilewatch as _cw
 from ..tensor import Tensor, as_array
 
 _tls = threading.local()
@@ -167,6 +168,11 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        # compilewatch attribution name: which @to_static program is
+        # compiling (README.md "Memory & compile observability")
+        owner = f"{type(layer).__name__}." if layer is not None else ""
+        self._cw_name = \
+            f"to_static.{owner}{getattr(fn, '__name__', 'fn')}"
         # out-tree PER input structure: alternating call signatures hit
         # the jit cache without retracing, so one global field would go
         # stale and decode with the wrong tree
@@ -248,8 +254,15 @@ class StaticFunction:
                 # a 1-tuple would break the vjp structure
                 return outs[0] if len(outs) == 1 else outs
 
-            results = _apply_op(prog_fn, *param_tensors, *leaves,
-                                _name="run_program")
+            # compile attribution: any backend compile triggered by the
+            # program dispatch below bills to this StaticFunction (the
+            # structure is the static half of the jit cache key, the
+            # leaf shapes the dynamic half)
+            with _cw.call(self._cw_name,
+                          _cw.signature(leaves, tag=("st", structure))
+                          if _cw.enabled() else None):
+                results = _apply_op(prog_fn, *param_tensors, *leaves,
+                                    _name="run_program")
             if not isinstance(results, tuple):
                 results = (results,)
             n_out = n_out_holder["n"]
@@ -262,9 +275,12 @@ class StaticFunction:
                                  self._out_structures[structure],
                                  wrap=False)
 
-        out_leaves, new_buffers = self._compiled(
-            params, buffers, seed, leaves, structure
-        )
+        with _cw.call(self._cw_name,
+                      _cw.signature(leaves, tag=("st", structure))
+                      if _cw.enabled() else None):
+            out_leaves, new_buffers = self._compiled(
+                params, buffers, seed, leaves, structure
+            )
         if layer is not None and new_buffers:
             layer.load_pytree(new_buffers)
         return unflatten_out(out_leaves, self._out_structures[structure])
@@ -454,6 +470,10 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
             static_argnames=("structure",),
             donate_argnums=(0, 2) if donate else (),
         )
+    # compilewatch: attribute the (rare, expensive) train-step compiles;
+    # a post-warmup recompile here means the input pipeline is shape-
+    # churning (bucket/pad the batch, not the jit cache)
+    jitted = _cw.watch_jit("jit.train_step", jitted)
     merge_holder = {"accum": None, "count": None}
 
     def step(*args, **kwargs):

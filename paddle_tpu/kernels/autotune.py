@@ -272,10 +272,15 @@ class Autotuner:
         `autotune.decision` breadcrumb lands in the event ring, and
         `autotune_decisions_total{op,winner}` counts it in the metrics
         registry — not just in the JSON cache file."""
+        from ..observability import compilewatch as _cw
         from ..observability import tracing as _tracing
 
         timer = _timer
-        with _tracing.span("autotune.measure", op=op, key=key) as sp:
+        # compile attribution: candidate timing compiles every variant —
+        # compilewatch bills those to autotune.<op>, not to whatever
+        # serving/train callable happened to trigger the measurement
+        with _tracing.span("autotune.measure", op=op, key=key) as sp, \
+                _cw.call(f"autotune.{op}"):
             args = make_args()
             timings: Dict[str, float] = {}
             for c in candidates:

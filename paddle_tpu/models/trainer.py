@@ -33,7 +33,7 @@ from ..nn.layer_base import Layer
 from ..tensor import Tensor
 
 
-def _instrument_step(step_fn):
+def _instrument_step(step_fn, model=None):
     """Wrap a compiled step(input_ids, labels) with runtime telemetry
     (README.md "Observability"): `train_steps_total`,
     `train_step_seconds` (dispatch wall time of the compiled call),
@@ -41,6 +41,14 @@ def _instrument_step(step_fn):
     — dataloader stalls show up here), `train_tokens_total`, and a
     watchdog beat + flight-recorder breadcrumb per step. Handles resolve
     ONCE at build time; the per-step cost is a few float ops.
+
+    Memwatch channel (README.md "Memory & compile observability"): when
+    `FLAGS_memwatch` is on, each step also takes an HBM watermark
+    sample, and the first completed step records the params/optimizer
+    static breakdown (the opt state exists only after init). A
+    RESOURCE_EXHAUSTED from the compiled call writes an OOM forensic
+    dump (ranked live buffers) before re-raising — always on, it costs
+    nothing until it fires.
 
     The compiled call dispatches asynchronously, so step_seconds is
     dispatch+trace time unless the caller blocks on the loss; the
@@ -50,6 +58,7 @@ def _instrument_step(step_fn):
 
     from ..observability import fleet as _fleet
     from ..observability import flight_recorder as _flight
+    from ..observability import memwatch as _memwatch
     from ..observability import metrics as _om
     from ..observability import tracing as _trace
 
@@ -68,7 +77,36 @@ def _instrument_step(step_fn):
         "called — dataloader/input stalls.")
     tokens_c = reg.counter("train_tokens_total",
                            "Input tokens fed to the train step.")
-    state = {"last_end": None}
+    state = {"last_end": None, "breakdown_done": False}
+
+    def _record_train_breakdown():
+        """Params + optimizer-state bytes into the breakdown gauges —
+        once, after the first step (opt state is lazily initialized).
+        Never raises."""
+        try:
+            comp = {}
+            if model is not None:
+                comp["params"] = sum(
+                    int(p._data.nbytes) for _, p in
+                    model.named_parameters())
+            # the opt state lives in a holder whose home differs by
+            # path: plain step -> _opt_state_holder["state"]; sharded
+            # step -> the same holder on ._inner; pipeline step ->
+            # _holder["opt_state"]
+            holder = getattr(step_fn, "_opt_state_holder", None) or \
+                getattr(getattr(step_fn, "_inner", None),
+                        "_opt_state_holder", None)
+            state = holder.get("state") if holder else None
+            if state is None:
+                ph = getattr(step_fn, "_holder", None)
+                if isinstance(ph, dict):
+                    state = ph.get("opt_state")
+            if state is not None:
+                comp["optimizer"] = _memwatch.tree_nbytes(state)
+            if comp:
+                _memwatch.record_breakdown(**comp)
+        except Exception:  # noqa: BLE001 — telemetry must never take
+            pass           # the train loop down
 
     def instrumented(input_ids, labels):
         # per-step span trace (head-sampled; NOOP_TRACE when
@@ -79,7 +117,15 @@ def _instrument_step(step_fn):
         last_end = state["last_end"]
         if last_end is not None:
             wait_h.observe(t0 - last_end)
-        out = step_fn(input_ids, labels)
+        try:
+            out = step_fn(input_ids, labels)
+        except BaseException as e:
+            # OOM forensics (always on): the ranked live-buffer dump is
+            # the post-mortem; the step still fails — training has no
+            # slot to shed, unlike serving's preempt-before-poison
+            if _memwatch.is_oom(e):
+                _memwatch.dump_oom("train_step", exc=e)
+            raise
         t1 = _time.perf_counter()
         state["last_end"] = t1
         step_h.observe(t1 - t0)
@@ -99,6 +145,13 @@ def _instrument_step(step_fn):
                              seconds=round(t1 - t0, 6),
                              trace_id=trc.trace_id)
         _flight.beat_all()
+        # memwatch channel (one flag read when off): HBM watermark per
+        # step + the one-shot params/optimizer breakdown
+        if _memwatch.enabled():
+            if not state["breakdown_done"]:
+                state["breakdown_done"] = True
+                _record_train_breakdown()
+            _memwatch.sample()
         # fleet heartbeat (rank shard liveness): one flag read when off
         _fleet.heartbeat(step=int(steps_c.value))
         return out
@@ -509,7 +562,7 @@ def build_pipeline_train_step(model: Layer, optimizer,
     step._jitted = jitted          # AOT lowering (tools/scale_rehearsal.py)
     step._flat_specs = flat_specs
     step._data_put = _data_put
-    return _instrument_step(step)
+    return _instrument_step(step, model=model)
 
 
 def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
@@ -581,7 +634,7 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
                            gradient_merge_avg=merge_avg)
 
     if mesh is None:
-        return _instrument_step(step)
+        return _instrument_step(step, model=model)
 
     # lay params out ONCE in their between-steps (stored) layout: the
     # zero-sharded spec at stage 3, the compute spec otherwise
@@ -611,4 +664,4 @@ def build_train_step(model: Layer, optimizer, criterion: Optional[Callable]
         return step(Tensor(_data_put(x)), Tensor(_data_put(y)))
 
     sharded_step._inner = step
-    return _instrument_step(sharded_step)
+    return _instrument_step(sharded_step, model=model)

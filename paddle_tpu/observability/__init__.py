@@ -9,17 +9,30 @@ recorder + span tracer (SURVEY.md §5 "Metrics / logging").
   sampling (`FLAGS_trace_sample`) and Chrome trace-event export that
   Perfetto loads directly; `tools/trace_report.py` prints TTFT
   breakdowns and the critical path from the exported JSON.
-- `fleet` — rank-sharded export of all three channels
+- `fleet` — rank-sharded export of all channels
   (`FLAGS_telemetry_dir` → `rank_<i>/` shards on a background flusher),
   a per-op collective sequence log, and the cross-rank aggregator:
   merged fleet exposition + multi-rank Chrome trace, dead-rank
-  detection, and the collective straggler report
+  detection, the collective straggler report, and the HBM-skew table
   (`tools/fleet_report.py`).
+- `memwatch` — live HBM accounting (fourth channel): per-step
+  watermark gauges from `device.memory_stats()` / live-buffer sweeps,
+  static breakdown gauges (params / optimizer / KV pages / XLA
+  `memory_analysis()` splits), and the always-on OOM forensics handler
+  (`is_oom` / `dump_oom` — ranked live-buffer report through the
+  atomic writers; the serving engine preempts one slot before
+  poisoning).
+- `compilewatch` — compile accounting (fifth channel): every wrapped
+  jit entry point (StaticFunction, train_step, serving programs,
+  autotune candidates) gets per-callable compile counts + compile-time
+  spans, and recompile storms after warmup are detected and reported
+  with the offending argument shapes.
 
-The three channels correlate: spans and flight-recorder breadcrumbs
-carry the same `rid`/`trace_id` fields, the watchdog stall dump appends
-the in-flight span stack, and slow traces bump
-`trace_slow_requests_total` in the registry.
+The channels correlate: spans and flight-recorder breadcrumbs carry
+the same `rid`/`trace_id` fields, the watchdog stall dump appends the
+in-flight span stack AND the current memory report, slow traces bump
+`trace_slow_requests_total`, and compiles land as `compile.<name>`
+spans on the same timeline as the steps they stall.
 
 Exported metric names are documented in README.md ("Observability").
 """
@@ -39,7 +52,9 @@ from .metrics import (  # noqa: F401
     write_jsonl,
     write_prometheus,
 )
+from . import compilewatch  # noqa: F401  (compile counts + storm detect)
 from . import fleet  # noqa: F401  (rank-sharded export + aggregation)
+from . import memwatch  # noqa: F401  (HBM accounting + OOM forensics)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     Watchdog,

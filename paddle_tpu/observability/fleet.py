@@ -63,8 +63,8 @@ from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
 
-SHARD_FILES = ("metrics.prom", "events.jsonl", "trace.json",
-               "collectives.jsonl", "heartbeat.json")
+SHARD_FILES = ("metrics.prom", "memory.prom", "events.jsonl",
+               "trace.json", "collectives.jsonl", "heartbeat.json")
 
 
 def _flags():
@@ -273,6 +273,15 @@ class FleetExporter:
         _metrics.atomic_write(
             os.path.join(self.shard_dir, "metrics.prom"),
             _metrics.to_prometheus(reg, const_labels=const))
+
+        from . import memwatch as _memwatch
+
+        # the memory/compile channel families alone (hbm_*, memwatch_*,
+        # compilewatch_*, serving_kv_*): the HBM-skew aggregation reads
+        # this small file instead of the full exposition
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "memory.prom"),
+            _memwatch.memory_exposition(reg, const_labels=const))
 
         from . import flight_recorder as _fr
 
@@ -712,6 +721,83 @@ def rank_table(shards: Dict[int, str],
     return out
 
 
+def hbm_table(shards: Dict[int, str]) -> List[dict]:
+    """One row per rank from its memory.prom shard (metrics.prom
+    fallback for shards written before the memwatch channel): peak /
+    in-use / limit bytes and the peak-utilization fraction. Fractions
+    come from `hbm_utilization_peak` when the backend reported a
+    limit, else peak/limit, else None (live-sweep-only shards compare
+    on bytes)."""
+    out = []
+    for rank, path in sorted(shards.items()):
+        samples = {}
+        for fname in ("memory.prom", "metrics.prom"):
+            try:
+                with open(os.path.join(path, fname)) as fh:
+                    samples = _parse_prom_samples(fh.read())
+            except OSError:
+                continue
+            if samples:
+                break
+
+        def _g(name):
+            rows = samples.get(name)
+            return rows[0][1] if rows else None
+
+        peak = _g("hbm_peak_bytes")
+        limit = _g("hbm_bytes_limit")
+        frac = _g("hbm_utilization_peak")
+        if not limit:
+            # stat-less backend (live-sweep shard): the utilization
+            # gauge exists in the family but was never fed — a 0.0%
+            # "fraction" would be noise; compare such ranks on bytes
+            frac = None
+        elif frac is None and peak:
+            frac = peak / limit
+        out.append({"rank": rank, "peak_bytes": peak,
+                    "in_use_bytes": _g("hbm_bytes_in_use"),
+                    "limit_bytes": limit,
+                    "peak_frac": round(frac, 4)
+                    if frac is not None else None})
+    return out
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def hbm_skew(rows: List[dict], frac_margin: float = 0.10,
+             bytes_ratio: float = 1.25) -> dict:
+    """The cross-rank HBM comparison: fleet median peak + the ranks
+    meaningfully above it ("rank 3 peak 92% vs fleet median 71%").
+    Skew by utilization fraction when limits are known (> frac_margin
+    above the median), by peak bytes otherwise (> bytes_ratio x the
+    median) — an imbalanced rank is the one that OOMs first."""
+    fracs = [r["peak_frac"] for r in rows if r["peak_frac"] is not None]
+    med_frac = _median(fracs)
+    peaks = [r["peak_bytes"] for r in rows
+             if r.get("peak_bytes") is not None]
+    med_bytes = _median(peaks)
+    skewed = []
+    for r in rows:
+        if med_frac is not None and r["peak_frac"] is not None:
+            if r["peak_frac"] - med_frac > frac_margin:
+                skewed.append({**r, "median_frac": round(med_frac, 4)})
+        elif med_bytes and r.get("peak_bytes"):
+            if r["peak_bytes"] > bytes_ratio * med_bytes:
+                skewed.append({**r, "median_bytes": med_bytes})
+    skewed.sort(key=lambda r: -(r.get("peak_frac")
+                                or r.get("peak_bytes") or 0))
+    return {"ranks": rows,
+            "median_frac": round(med_frac, 4)
+            if med_frac is not None else None,
+            "median_bytes": med_bytes, "skewed": skewed}
+
+
 def aggregate(root: str, out_dir: Optional[str] = None,
               stale_s: Optional[float] = None, top: int = 10) -> dict:
     """Merge every rank shard under `root` into the fleet view: writes
@@ -721,7 +807,10 @@ def aggregate(root: str, out_dir: Optional[str] = None,
     shards = discover_shards(root)
     report: dict = {"root": root, "shards": shards, "ranks": [],
                     "dead": [], "missing": [], "stragglers": [],
-                    "straggler_summary": [], "artifacts": {}}
+                    "straggler_summary": [],
+                    "hbm": {"ranks": [], "median_frac": None,
+                            "median_bytes": None, "skewed": []},
+                    "artifacts": {}}
     if not shards:
         return report
     heartbeats = load_heartbeats(shards)
@@ -740,6 +829,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "missing": missing_ranks(shards, heartbeats),
         "stragglers": rows[:top] if top else rows,
         "straggler_summary": straggler_summary(rows),
+        "hbm": hbm_skew(hbm_table(shards)),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -754,6 +844,12 @@ def aggregate(root: str, out_dir: Optional[str] = None,
 
 def _fmt_opt_ms(v) -> str:
     return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def _fmt_opt_bytes(v) -> str:
+    from .memwatch import format_bytes  # one byte-ladder repo-wide
+
+    return format_bytes(v)
 
 
 def format_report(report: dict) -> str:
@@ -825,6 +921,42 @@ def format_report(report: dict) -> str:
         lines.append("no aligned collective sequences across ranks — "
                      "skew table empty (single shard, or collectives "
                      "never ran)")
+    hbm = report.get("hbm") or {}
+    hbm_rows = [r for r in hbm.get("ranks", [])
+                if r.get("peak_frac") is not None
+                or r.get("peak_bytes") is not None]
+    if hbm_rows:
+        lines.append("")
+        lines.append("== HBM peak per rank (memwatch; fleet median "
+                     + (f"{hbm['median_frac'] * 100.0:.1f}%"
+                        if hbm.get("median_frac") is not None
+                        else _fmt_opt_bytes(hbm.get("median_bytes")))
+                     + ") ==")
+        for r in hbm_rows:
+            if r.get("peak_frac") is not None:
+                lines.append(f"  rank {r['rank']}: peak "
+                             f"{r['peak_frac'] * 100.0:.1f}% "
+                             f"({_fmt_opt_bytes(r.get('peak_bytes'))} of "
+                             f"{_fmt_opt_bytes(r.get('limit_bytes'))})")
+            else:
+                lines.append(f"  rank {r['rank']}: peak "
+                             f"{_fmt_opt_bytes(r.get('peak_bytes'))} "
+                             f"(no device limit reported)")
+        for r in hbm.get("skewed", []):
+            if r.get("peak_frac") is not None:
+                lines.append(
+                    f"HBM SKEW: rank {r['rank']} peak "
+                    f"{r['peak_frac'] * 100.0:.1f}% vs fleet median "
+                    f"{r['median_frac'] * 100.0:.1f}% — this rank OOMs "
+                    f"first; check its resident buffers "
+                    f"(rank_{r['rank']}/memory.prom, "
+                    f"memwatch_breakdown_bytes)")
+            else:
+                lines.append(
+                    f"HBM SKEW: rank {r['rank']} peak "
+                    f"{_fmt_opt_bytes(r.get('peak_bytes'))} vs fleet "
+                    f"median {_fmt_opt_bytes(r.get('median_bytes'))}")
+        lines.append("")
     art = report["artifacts"]
     if art:
         lines.append(f"artifacts: {art['prom']} ; {art['trace']} "

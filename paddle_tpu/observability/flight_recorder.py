@@ -212,6 +212,16 @@ class Watchdog:
                 lines.append("(none)")
         except Exception:  # noqa: BLE001 — a tracer failure must not
             lines.append("(unavailable)")  # take the stall dump down
+        # a stalled step is often an OOM-retry loop: append the current
+        # memory report (device watermarks + ranked live buffers) so the
+        # dump answers "was it memory?" without a second incident
+        lines += ["", "== memory report =="]
+        try:
+            from . import memwatch as _memwatch
+
+            lines.append(_memwatch.report_text().rstrip())
+        except Exception:  # noqa: BLE001 — memwatch failure must not
+            lines.append("(unavailable)")  # take the stall dump down
         lines += [
             "",
             f"== last {self.tail_events} events "

@@ -1,0 +1,454 @@
+"""Memory observability: live HBM accounting + OOM forensics
+(README.md "Memory & compile observability", fourth telemetry channel).
+
+Device memory is the resource that gates every scale move — serving
+batch growth, longer contexts, bigger models — and until now the stack
+answered "how much HBM headroom is left?" with a one-off
+`memory_analysis()` call in the rehearsal tools, and answered
+"why did we OOM?" with a crash. This module turns both into artifacts:
+
+- **Per-step watermarks** (`sample()`): `device.memory_stats()` gauges
+  (`hbm_bytes_in_use` / `hbm_peak_bytes` / `hbm_bytes_limit` and the
+  derived utilization fractions). Backends without allocator stats (the
+  CPU test backend returns None) fall back to a `jax.live_arrays()`
+  sweep — the in-use/peak gauges then track live-buffer bytes, limit
+  stays 0, and the utilization gauges are not set. Serving and trainer
+  steps call `sample()` when `FLAGS_memwatch` is on; off is one flag
+  read (pinned by tests/test_memwatch.py, the tracing alloc-guard
+  discipline).
+
+- **Static breakdown** (`record_breakdown()` /
+  `breakdown_from_memory_analysis()`): where a device's bytes WOULD go —
+  params / optimizer state / KV pages from the live pytrees, argument/
+  output/temp/code splits from a compiled program's XLA
+  `memory_analysis()` — exported as `memwatch_breakdown_bytes{component}`
+  gauges. The serving engine records params+KV at construction; the
+  trainer records params+optimizer after its first step (when the opt
+  state exists).
+
+- **OOM forensics** (`is_oom()` / `dump_oom()`): when a compiled call
+  raises RESOURCE_EXHAUSTED, the handler writes a ranked live-buffer
+  report (plus caller-provided context — the serving engine appends its
+  page-table report) through the atomic writers, rank-tagged like the
+  watchdog stall dumps (`oom_<name>_r<rank>_<pid>_<n>.txt`). Forensics
+  are ALWAYS on — catching an exception costs nothing until it fires,
+  and an OOM is exactly when an operator needs data most; only the
+  per-step sampling is gated by `FLAGS_memwatch`.
+
+Exports ride the PR 4 fleet flusher as `rank_<i>/memory.prom`
+(`memory_exposition()` — the memory/compile families only), and
+`tools/fleet_report.py` turns the per-rank peaks into an HBM-skew table
+("rank 3 peak 92% vs fleet median 71%") next to the straggler table.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+
+# fraction-valued histograms (pool occupancy, fragmentation) share one
+# 0..1 ladder so serving dashboards are cross-comparable
+RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0)
+
+# exposition families that belong to the memory/compile channels — the
+# filter behind memory_exposition() and the fleet flusher's memory.prom
+MEM_FAMILY_PREFIXES = ("hbm_", "live_buffer_", "memwatch_",
+                       "compilewatch_", "serving_kv_")
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def enabled() -> bool:
+    """One flag read — the whole per-step cost of memwatch when off."""
+    return bool(_flags().get_flag("FLAGS_memwatch", False))
+
+
+def dump_dir() -> str:
+    return str(_flags().get_flag("FLAGS_memwatch_dump_dir", "") or ".")
+
+
+def top_n() -> int:
+    try:
+        v = int(_flags().get_flag("FLAGS_memwatch_top", 10))
+        return v if v > 0 else 10
+    except (TypeError, ValueError):
+        return 10
+
+
+# every sample()/report allocation — the off-path guard asserts this
+# stays flat (Registry.allocations / Tracer.spans_created discipline)
+_samples = {"taken": 0, "oom_dumps": 0}
+
+
+def samples_taken() -> int:
+    return _samples["taken"]
+
+
+# ---------------------------------------------------------------------------
+# raw collectors
+# ---------------------------------------------------------------------------
+
+
+def device_memory_stats(device=None) -> Dict[str, float]:
+    """The device allocator's stats dict ({} when the backend exposes
+    none — the CPU test backend returns None). Keys follow the TPU/GPU
+    allocator convention: bytes_in_use, peak_bytes_in_use, bytes_limit,
+    largest_alloc_size, num_allocs, ..."""
+    try:
+        import jax
+
+        d = device if device is not None else jax.devices()[0]
+        stats = d.memory_stats()
+        return dict(stats) if stats else {}
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return {}
+
+
+def live_buffer_stats(top: Optional[int] = None) -> dict:
+    """Sweep `jax.live_arrays()`: total live bytes/count and the top-N
+    largest buffers (nbytes, dtype, shape, device) ranked descending —
+    the table an OOM post-mortem starts from."""
+    try:
+        import jax
+
+        arrs = jax.live_arrays()
+    except Exception:  # noqa: BLE001
+        return {"count": 0, "bytes": 0, "top": []}
+    n = top_n() if top is None else int(top)
+    rows = []
+    total = 0
+    for a in arrs:
+        try:
+            nb = int(a.nbytes)
+            total += nb
+            rows.append((nb, str(a.dtype), tuple(a.shape),
+                         str(getattr(a, "device", ""))))
+        except Exception:  # noqa: BLE001 — a deleted buffer mid-sweep
+            continue
+    rows.sort(key=lambda r: -r[0])
+    return {
+        "count": len(rows),
+        "bytes": total,
+        "top": [{"nbytes": nb, "dtype": dt, "shape": list(shape),
+                 "device": dev} for nb, dt, shape, dev in rows[:n]],
+    }
+
+
+def tree_nbytes(tree) -> int:
+    """Total nbytes of every array-like leaf in a pytree (params,
+    optimizer state, KV pools) — the static-breakdown input."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:  # noqa: BLE001
+        leaves = tree if isinstance(tree, (list, tuple)) else [tree]
+    total = 0
+    for leaf in leaves:
+        data = getattr(leaf, "_data", leaf)  # Tensor or raw array
+        nb = getattr(data, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def breakdown_from_memory_analysis(compiled) -> Dict[str, int]:
+    """A compiled program's XLA per-device memory analysis as plain
+    bytes (the tools/_rehearsal_common.py field set): arguments /
+    outputs / temps (the activation working set) / generated_code.
+    Missing fields read 0 on backends that don't report them."""
+    mem = compiled.memory_analysis()
+    return {
+        "arguments": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "outputs": int(getattr(mem, "output_size_in_bytes", 0)),
+        "temps": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "generated_code": int(getattr(
+            mem, "generated_code_size_in_bytes", 0)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# gauges
+# ---------------------------------------------------------------------------
+
+
+def _make_handles(reg):
+    return {
+        "in_use": reg.gauge(
+            "hbm_bytes_in_use",
+            "Device allocator bytes in use at the last memwatch sample "
+            "(live-buffer bytes on backends without allocator stats)."),
+        "peak": reg.gauge(
+            "hbm_peak_bytes",
+            "Device allocator peak bytes in use (high-water mark; "
+            "max-of-samples on backends without allocator stats)."),
+        "limit": reg.gauge(
+            "hbm_bytes_limit",
+            "Device memory capacity reported by the allocator (0 when "
+            "the backend reports none)."),
+        "util": reg.gauge(
+            "hbm_utilization",
+            "hbm_bytes_in_use / hbm_bytes_limit (only set when the "
+            "backend reports a limit)."),
+        "util_peak": reg.gauge(
+            "hbm_utilization_peak",
+            "hbm_peak_bytes / hbm_bytes_limit — the fleet HBM-skew "
+            "table compares this across ranks (only set when the "
+            "backend reports a limit)."),
+        "lb_bytes": reg.gauge(
+            "live_buffer_bytes",
+            "Total bytes of live jax arrays at the last sweep."),
+        "lb_count": reg.gauge(
+            "live_buffer_count",
+            "Number of live jax arrays at the last sweep."),
+        "breakdown": reg.gauge(
+            "memwatch_breakdown_bytes",
+            "Static device-memory breakdown estimate by component "
+            "(params / optimizer / kv_pages / arguments / outputs / "
+            "temps / generated_code — whichever the workload recorded).",
+            labels=("component",)),
+        "oom_dumps": reg.counter(
+            "memwatch_oom_dumps_total",
+            "OOM forensic dumps written (RESOURCE_EXHAUSTED caught in "
+            "a serving decode or trainer step)."),
+    }
+
+
+_handles: Optional[_metrics.HandleCache] = None
+
+
+def _h():
+    global _handles
+    if _handles is None:
+        _handles = _metrics.HandleCache(_make_handles)
+    return _handles.get()
+
+
+def sample(registry=None) -> dict:
+    """One watermark sample into the gauges. Called per serving/train
+    step when `FLAGS_memwatch` is on; also safe to call ad hoc. Returns
+    the raw numbers it published."""
+    _samples["taken"] += 1
+    h = _make_handles(registry) if registry is not None else _h()
+    stats = device_memory_stats()
+    out: dict = {}
+    if stats:
+        in_use = float(stats.get("bytes_in_use", 0))
+        peak = float(stats.get("peak_bytes_in_use", in_use))
+        limit = float(stats.get("bytes_limit", 0))
+        h["in_use"].set(in_use)
+        h["peak"].set(peak)
+        h["limit"].set(limit)
+        if limit > 0:
+            h["util"].set(in_use / limit)
+            h["util_peak"].set(peak / limit)
+        out.update(in_use=in_use, peak=peak, limit=limit, source="device")
+    else:
+        lb = live_buffer_stats(top=0)
+        in_use = float(lb["bytes"])
+        h["in_use"].set(in_use)
+        # no allocator high-water mark: track max-of-samples ourselves
+        h["peak"].set(max(h["peak"].value, in_use))
+        h["lb_bytes"].set(in_use)
+        h["lb_count"].set(lb["count"])
+        out.update(in_use=in_use, peak=h["peak"].value, limit=0.0,
+                   source="live_sweep")
+    return out
+
+
+def peak_hbm_bytes() -> int:
+    """Best-available peak device bytes for bench rows: the allocator
+    high-water mark, else the max-of-samples live-sweep gauge, else a
+    fresh sweep."""
+    stats = device_memory_stats()
+    if stats.get("peak_bytes_in_use"):
+        return int(stats["peak_bytes_in_use"])
+    try:
+        peak = _h()["peak"].value
+    except Exception:  # noqa: BLE001
+        peak = 0.0
+    if peak > 0:
+        return int(peak)
+    return int(live_buffer_stats(top=0)["bytes"])
+
+
+def record_breakdown(registry=None, **components) -> Dict[str, int]:
+    """Publish a static breakdown estimate: component -> bytes gauges
+    (`memwatch_breakdown_bytes{component=...}`). Components are
+    workload-defined; the conventional keys are params / optimizer /
+    kv_pages plus the XLA analysis fields from
+    breakdown_from_memory_analysis()."""
+    h = _make_handles(registry) if registry is not None else _h()
+    out = {}
+    for name, nbytes in components.items():
+        if nbytes is None:
+            continue
+        out[name] = int(nbytes)
+        h["breakdown"].labels(str(name)).set(int(nbytes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exposition + reports
+# ---------------------------------------------------------------------------
+
+
+def _is_mem_family(name: str) -> bool:
+    return name.startswith(MEM_FAMILY_PREFIXES)
+
+
+def memory_exposition(registry=None, const_labels=None) -> str:
+    """Prometheus text of the memory/compile families ONLY (the
+    `rank_<i>/memory.prom` fleet shard + `--mem` snapshot artifact) —
+    the full registry keeps exporting everything via metrics.prom."""
+    return _metrics.to_prometheus(
+        registry or _metrics.default_registry(),
+        const_labels=const_labels,
+        family_filter=_is_mem_family)
+
+
+def format_bytes(n) -> str:
+    """Human byte string ("-" for non-numeric) — the ONE B/KiB/../TiB
+    ladder shared by memory reports and the fleet HBM table."""
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def report_text(top: Optional[int] = None) -> str:
+    """The human memory report: device watermarks, the ranked live-
+    buffer table, and any recorded breakdown — appended to watchdog
+    stall dumps and OOM forensic dumps, printed by the snapshot tool."""
+    lines: List[str] = []
+    stats = device_memory_stats()
+    if stats:
+        in_use = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        limit = stats.get("bytes_limit", 0)
+        line = (f"device: in_use {format_bytes(in_use)}, "
+                f"peak {format_bytes(peak)}, limit {format_bytes(limit)}")
+        if limit:
+            line += (f" (in_use {100.0 * in_use / limit:.1f}%, "
+                     f"peak {100.0 * peak / limit:.1f}%)")
+        lines.append(line)
+    else:
+        lines.append("device: no allocator stats on this backend "
+                     "(live-buffer sweep below is the watermark)")
+    lb = live_buffer_stats(top=top)
+    lines.append(f"live buffers: {lb['count']} arrays, "
+                 f"{format_bytes(lb['bytes'])} total")
+    if lb["top"]:
+        lines.append(f"top {len(lb['top'])} live buffers "
+                     f"(largest first):")
+        for i, row in enumerate(lb["top"]):
+            shape = "x".join(str(s) for s in row["shape"]) or "scalar"
+            lines.append(
+                f"  #{i:<2} {format_bytes(row['nbytes']):>12}  "
+                f"{row['dtype']}[{shape}]  {row['device']}")
+    try:
+        reg = _metrics.default_registry()
+        fam = reg.get("memwatch_breakdown_bytes")
+        if fam is not None:
+            rows = [(labels.get("component", "?"), cell.value)
+                    for labels, cell in fam.samples()]
+            if rows:
+                lines.append("static breakdown estimate:")
+                for comp, v in sorted(rows, key=lambda r: -r[1]):
+                    lines.append(f"  {comp:<16} {format_bytes(v):>12}")
+    except Exception:  # noqa: BLE001
+        pass
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "ResourceExhausted",
+                "Out of memory", "out of memory")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True when an exception is an XLA RESOURCE_EXHAUSTED / allocator
+    OOM (matched on type name + message: jaxlib raises XlaRuntimeError
+    with the status code in the text)."""
+    if exc is None:
+        return False
+    name = type(exc).__name__
+    if "ResourceExhausted" in name:
+        return True
+    try:
+        msg = str(exc)
+    except Exception:  # noqa: BLE001
+        return False
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def dump_oom(name: str, exc: Optional[BaseException] = None,
+             extra: str = "") -> str:
+    """Write the OOM forensic dump and return its path. Filename carries
+    rank + pid (the watchdog stall-dump convention — concurrent ranks of
+    one job share a dump dir). Never raises: forensics must not mask the
+    original OOM."""
+    _samples["oom_dumps"] += 1
+    d = dump_dir()
+    os.makedirs(d, exist_ok=True)
+    rank, world = _metrics.rank_world()
+    rank_known = world > 1 or "PADDLE_TRAINER_ID" in os.environ
+    rank_tag = f"_r{rank}" if rank_known else ""
+    path = os.path.join(
+        d, f"oom_{name}{rank_tag}_{os.getpid()}_"
+           f"{_samples['oom_dumps']}.txt")
+    lines = [
+        "paddle_tpu OOM forensic dump",
+        f"name: {name}",
+        f"rank: {rank}",
+        f"world_size: {world}",
+        f"pid: {os.getpid()}",
+        f"time: {time.strftime('%Y-%m-%dT%H:%M:%S%z')}",
+        f"exception: {type(exc).__name__}: {exc}" if exc is not None
+        else "exception: (not provided)",
+        "",
+        "== memory report ==",
+        report_text().rstrip(),
+    ]
+    if extra:
+        lines += ["", extra.rstrip()]
+    lines += [
+        "",
+        "hint: the static breakdown gauges "
+        "(memwatch_breakdown_bytes) say where the budget went by "
+        "design; the live-buffer table above says where it went in "
+        "fact. For serving, shrink max_batch / max_seq_len or enable "
+        "kv_cache_quant='int8'; for training, raise "
+        "gradient_merge_steps or enable recompute.",
+    ]
+    h = _h()
+    try:
+        _metrics.atomic_write(path, "\n".join(lines) + "\n")
+        h["oom_dumps"].inc()
+        from . import flight_recorder as _flight
+
+        _flight.record_event("memwatch.oom_dump", name=name, path=path)
+    except Exception:  # noqa: BLE001 — never mask the OOM itself
+        return path
+    return path
+
+
+def _reset_for_tests():
+    global _handles
+    _handles = None
+    _samples["taken"] = 0
+    _samples["oom_dumps"] = 0

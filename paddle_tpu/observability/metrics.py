@@ -357,18 +357,26 @@ def _fmt_float(v: float) -> str:
 
 
 def to_prometheus(registry: Optional[Registry] = None,
-                  const_labels: Optional[Dict[str, str]] = None) -> str:
+                  const_labels: Optional[Dict[str, str]] = None,
+                  family_filter: Optional[Callable[[str], bool]]
+                  = None) -> str:
     """Prometheus text exposition format 0.0.4 of the whole registry.
 
     `const_labels` are stamped onto EVERY sample; the default is
     `fleet_labels()` (rank/world_size from the launch env) so any
     export — including a single-rank one — can be merged into a fleet
-    exposition without sample collisions. Pass `{}` to suppress."""
+    exposition without sample collisions. Pass `{}` to suppress.
+
+    `family_filter(name) -> bool` restricts the exposition to matching
+    families (the memwatch channel's `memory.prom` shard carries only
+    the memory/compile families)."""
     registry = registry or default_registry()
     if const_labels is None:
         const_labels = fleet_labels()
     lines = []
     for fam in registry.families():
+        if family_filter is not None and not family_filter(fam.name):
+            continue
         lines.append(f"# HELP {fam.name} {fam.help}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
         for labels, cell in fam.samples():

@@ -74,15 +74,24 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
-# span-tracing gate: the serving smoke with FLAGS_trace_sample=1 must
-# produce a Perfetto-loadable Chrome trace (valid trace-event array,
-# FinishedRequest.trace_id populated — checked inside the snapshot
-# tool) AND trace_report.py must parse it and print a non-empty
-# critical path (it exits 2 when the trace yields none)
+# span-tracing + steady-state gate: the serving smoke with
+# FLAGS_trace_sample=1 must produce a Perfetto-loadable Chrome trace
+# (valid trace-event array, FinishedRequest.trace_id populated —
+# checked inside the snapshot tool) AND trace_report.py must parse it
+# and print a non-empty critical path (it exits 2 when the trace
+# yields none). With FLAGS_memwatch/FLAGS_compilewatch on, the tool
+# additionally enforces the memory & compile observability gate
+# (ISSUE 6): the smoke warms up, then must show ZERO serving decode
+# recompiles after warmup (fails loudly with the compilewatch storm
+# report) and a non-empty memory exposition (/tmp/ci_memory.prom)
 if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_trace_sample=1 \
+    FLAGS_memwatch=1 FLAGS_compilewatch=1 \
     python tools/serving_metrics_snapshot.py \
-      --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json; then
-  echo "CI: traced serving smoke FAILED" >&2
+      --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json \
+      --mem /tmp/ci_memory.prom; then
+  echo "CI: traced serving smoke FAILED (workload, zero-decode-" \
+       "recompiles-after-warmup gate, or empty memory exposition —" \
+       "see the compilewatch report above)" >&2
   rc=1
 elif ! timeout 120 env JAX_PLATFORMS=cpu \
     python tools/trace_report.py /tmp/ci_trace.json; then
@@ -144,6 +153,6 @@ if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
-       "/tmp/ci_trace.json, /tmp/ci_fleet/"
+       "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/"
 fi
 exit $rc

@@ -9,7 +9,12 @@ Loads every `rank_<i>/` shard under a `FLAGS_telemetry_dir` root
   "rank 2 stopped beating at step 1840") and missing ranks;
 - the collective straggler report: sequence numbers aligned across
   ranks, top-N enter-time skews by rank and op ("rank 3 was last into
-  all_reduce #1842 by 180.0 ms") + a per-(rank, op) summary.
+  all_reduce #1842 by 180.0 ms") + a per-(rank, op) summary;
+- the HBM-skew table (memwatch channel, read from rank_<i>/memory.prom):
+  per-rank peak device-memory utilization vs the fleet median ("rank 3
+  peak 92.0% vs fleet median 71.0%") — the skewed rank is the one that
+  OOMs first, and expert/sequence imbalance shows up here before it
+  shows up as a crash.
 
 Artifacts written next to the shards (or --out-dir): `fleet.prom` (one
 Prometheus exposition, every sample rank-labeled) and

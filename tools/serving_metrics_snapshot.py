@@ -7,6 +7,17 @@ serving path both works AND reports. Exits non-zero if the workload or
 the exposition sanity checks fail.
 
     python tools/serving_metrics_snapshot.py --out /tmp/ci_metrics.prom
+
+`--mem PATH` additionally turns the memwatch channel on, writes the
+memory exposition (hbm_*/memwatch_*/compilewatch_*/serving_kv_*
+families) to PATH, and prints the ranked top-10 live-buffer table — the
+"non-empty memory exposition" half of the CI steady-state gate.
+
+When `FLAGS_compilewatch=1`, the smoke runs `engine.warmup()` first and
+then FAILS (exit 1, storm report on stderr) if any serving decode
+program recompiled after warmup — the zero-decode-recompiles half of
+the gate: in-traffic decode compiles are exactly the latency cliff
+warmup exists to prepay.
 """
 from __future__ import annotations
 
@@ -27,6 +38,10 @@ def main():
                     help="also write the span-trace Chrome JSON here "
                          "(run with FLAGS_trace_sample=1 to populate; "
                          "feed to tools/trace_report.py / Perfetto)")
+    ap.add_argument("--mem", default=None, metavar="PATH",
+                    help="enable FLAGS_memwatch, write the memory "
+                         "exposition here, and print the top-10 "
+                         "live-buffer table (CI memory-gate artifact)")
     ap.add_argument("--merge", default=None, metavar="TELEMETRY_DIR",
                     help="skip the smoke: merge the rank_<i>/ shards "
                          "under this fleet telemetry dir "
@@ -62,11 +77,26 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import metrics as om
 
+    from paddle_tpu.observability import compilewatch
+
+    if args.mem:
+        paddle.set_flags({"FLAGS_memwatch": True})
+
     paddle.seed(0)
     cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, seq=64)
     model = LlamaForCausalLM(cfg)
     model.eval()
     engine = ServingEngine(model, max_batch=2, max_seq_len=32, page_size=8)
+    if compilewatch.enabled():
+        # prepay the decode programs and mark warmup done — every
+        # serving compile after this point is an in-traffic recompile,
+        # and the steady-state gate below requires ZERO on decode
+        engine.warmup()
+    reg = om.default_registry()
+    # delta-based: warmup (when compilewatch is on) ran its own
+    # throwaway request through these counters already
+    base = {n: reg.value(n) for n in (
+        "serving_requests_finished_total", "serving_tokens_total")}
     rng = np.random.RandomState(0)
     n_req, max_new = 2, 5
     for _ in range(n_req):
@@ -78,15 +108,28 @@ def main():
               file=sys.stderr)
         return 1
 
-    reg = om.default_registry()
     checks = {
         "serving_requests_finished_total": n_req,
         "serving_tokens_total": sum(len(f.output_ids) for f in finished),
     }
     for name, want in checks.items():
-        got = reg.value(name)
+        got = reg.value(name) - base[name]
         if got != want:
-            print(f"metrics snapshot FAILED: {name}={got}, want {want}",
+            print(f"metrics snapshot FAILED: {name}=+{got}, want {want}",
+                  file=sys.stderr)
+            return 1
+
+    # steady-state compile gate (FLAGS_compilewatch=1): zero decode
+    # recompiles after warmup — an in-traffic decode compile is a
+    # latency cliff warmup was supposed to prepay; fail loudly with the
+    # named storm/recompile report
+    if compilewatch.enabled():
+        n_rc = compilewatch.recompiles("serving.decode")
+        if n_rc:
+            print(f"steady-state gate FAILED: {n_rc} serving decode "
+                  f"recompile(s) after warmup", file=sys.stderr)
+            report = compilewatch.storm_report()
+            print(report or str(compilewatch.snapshot()),
                   file=sys.stderr)
             return 1
 
@@ -113,10 +156,27 @@ def main():
                       file=sys.stderr)
                 return 1
         trace_note = f"; {n_events} trace events -> {args.trace}"
+    mem_note = ""
+    if args.mem:
+        from paddle_tpu.observability import memwatch
+
+        text = memwatch.memory_exposition(reg)
+        om.atomic_write(args.mem, text)
+        n_mem = sum(1 for ln in text.splitlines()
+                    if ln and not ln.startswith("#"))
+        if n_mem == 0:
+            print("memory snapshot FAILED: FLAGS_memwatch on but the "
+                  "memory exposition is empty", file=sys.stderr)
+            return 1
+        # the ranked live-buffer table: the OOM post-mortem view, here
+        # as a liveness artifact
+        print(memwatch.report_text(top=10), end="")
+        mem_note = f"; {n_mem} memory samples -> {args.mem}"
     n_lines = sum(1 for _ in open(args.out))
     print(f"serving smoke OK: {n_req} requests, "
           f"{int(checks['serving_tokens_total'])} tokens; "
-          f"{n_lines} exposition lines -> {args.out}{trace_note}")
+          f"{n_lines} exposition lines -> {args.out}{trace_note}"
+          f"{mem_note}")
     return 0
 
 
