@@ -188,6 +188,23 @@ define_flag("FLAGS_compilewatch_storm_shapes", 4,
             "trigger a recompile-storm report citing the offending "
             "shapes (shape churn belongs in the autotuner's pow2 "
             "buckets, not the jit executable cache).", type_=int)
+define_flag("FLAGS_stepledger", False,
+            "Step-time ledger channel (observability/stepledger.py): "
+            "reconcile every train/decode step's wall time into named "
+            "buckets (device compute via block_until_ready windows, "
+            "collective wait, data wait, compile, host dispatch, "
+            "residual), exported as stepledger_* families and per rank "
+            "via the fleet flusher (rank_<i>/ledger.prom); "
+            "tools/step_ledger.py prints the waterfall + per-op "
+            "roofline + top optimization targets. Blocking on step "
+            "outputs serializes async dispatch — a measurement mode, "
+            "not a production default. Off (default) costs one flag "
+            "read per step (pinned by tests/test_stepledger.py).")
+define_flag("FLAGS_stepledger_block_every", 1,
+            "With FLAGS_stepledger on, block_until_ready on the step "
+            "outputs every N-th step (1 = every step) so the measured "
+            "dispatch window includes the true device tail; unblocked "
+            "steps attribute only the host-visible window.", type_=int)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

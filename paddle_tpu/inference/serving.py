@@ -26,6 +26,7 @@ from ..observability import fleet as _fleet
 from ..observability import flight_recorder as _flight
 from ..observability import memwatch as _memwatch
 from ..observability import metrics as _om
+from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
 
@@ -1140,10 +1141,17 @@ class ServingEngine:
                     tr = self._traces.get(self.slots[i].request_id)
                     if tr is not None and "decode_t0" not in tr.marks:
                         tr.mark("decode_t0", t0)
+            # step-time ledger (one flag read when off): open the
+            # measured dispatch window for this decode step
+            led = _stepledger.begin()
             if k_burst > 1:
                 fn = self._get_burst_fn(all_greedy, k_burst)
                 try:
-                    (toks, emits, nk, nv, nks, nvs, *_carry) = fn(
+                    # arg prep stays INSIDE the try: the host->device
+                    # transfers can themselves raise RESOURCE_EXHAUSTED
+                    # near the HBM ceiling, and that must reach the
+                    # same forensics + preempt-retry path as the call
+                    burst_args = (
                         params, buffers, tuple(self.k_pages),
                         tuple(self.v_pages),
                         tuple(self.k_scales or ()),
@@ -1155,6 +1163,8 @@ class ServingEngine:
                         jax.random.key_data(sk),
                         jnp.asarray(greedy), jnp.asarray(temp),
                         jnp.asarray(tk), jnp.asarray(tp_arr))
+                    (toks, emits, nk, nv, nks, nvs, *_carry) = \
+                        fn(*burst_args)
                 except BaseException as e:
                     if _memwatch.is_oom(e) and \
                             self._handle_decode_oom(e, "burst_decode"):
@@ -1167,6 +1177,15 @@ class ServingEngine:
                         "burst decode fn raised after donating the KV "
                         "pages", self.k_pages, self.v_pages)
                     raise
+                if led is not None:
+                    # blocked window + bucket attribution; cost
+                    # registration lowers on ShapeDtypeStructs (safe
+                    # post-donation), once per process under the flag
+                    _stepledger.end(led, "serving.decode_burst",
+                                    _time_mod.perf_counter(),
+                                    out=(nk, nv, toks))
+                    _stepledger.register_from_lowered(
+                        "serving.decode_burst", fn, burst_args)
                 self.k_pages, self.v_pages = list(nk), list(nv)
                 if self.k_scales is not None:
                     self.k_scales, self.v_scales = list(nks), list(nvs)
@@ -1179,7 +1198,10 @@ class ServingEngine:
                 return finished
             fn = self._get_decode_fn(all_greedy)
             try:
-                nxt, nk, nv, nks, nvs = fn(
+                # arg prep inside the try for the same reason as the
+                # burst path: transfer-time OOM must hit the
+                # forensics + preempt-retry handler, not escape it
+                decode_args = (
                     params, buffers, tuple(self.k_pages),
                     tuple(self.v_pages),
                     tuple(self.k_scales or ()),
@@ -1189,6 +1211,7 @@ class ServingEngine:
                     jax.random.key_data(sk), jnp.asarray(greedy),
                     jnp.asarray(temp), jnp.asarray(tk),
                     jnp.asarray(tp_arr))
+                nxt, nk, nv, nks, nvs = fn(*decode_args)
             except BaseException as e:
                 if _memwatch.is_oom(e) and \
                         self._handle_decode_oom(e, "decode"):
@@ -1200,6 +1223,12 @@ class ServingEngine:
                     "decode fn raised after donating the KV pages",
                     self.k_pages, self.v_pages)
                 raise
+            if led is not None:
+                _stepledger.end(led, "serving.decode_step",
+                                _time_mod.perf_counter(),
+                                out=(nk, nv, nxt))
+                _stepledger.register_from_lowered(
+                    "serving.decode_step", fn, decode_args)
             break
         self.k_pages, self.v_pages = list(nk), list(nv)
         if self.k_scales is not None:
@@ -1315,6 +1344,12 @@ class ServingEngine:
 
     def _decode_async(self, max_bursts):
         """Dispatch up to `async_depth` bursts ahead of the harvest point.
+
+        Deliberately NOT instrumented by the step-time ledger: its
+        whole point is keeping multiple bursts in flight, and the
+        ledger's block_until_ready window would serialize exactly that
+        pipeline. Measure decode attribution on the sync paths
+        (async_depth=0) — the compiled programs are identical.
 
         The compiled burst returns its scalar carry (token/lens/active/
         budget/key) as device arrays; each next dispatch consumes them as

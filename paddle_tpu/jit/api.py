@@ -484,6 +484,7 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
         lr = jnp.asarray(optimizer.get_lr(), dtype=jnp.float32)
         seed = jax.random.key_data(_random.next_key())
         leaves, structure = flatten_call(args, kwargs)
+        ost = opt_state_holder["state"]
         if k_merge > 1:
             if merge_holder["accum"] is None:
                 # accumulators live in the grad layout (zero-sharded at
@@ -509,6 +510,23 @@ def train_step(model: Layer, criterion: Callable, optimizer, donate=True,
                 structure,
             )
         opt_state_holder["state"] = new_opt
+        # step-time ledger roofline (one dict lookup + flag read when
+        # off/registered): AOT-lower the step on ShapeDtypeStructs —
+        # shape/dtype only, safe after donation consumed the real
+        # buffers — and read the compiled program's cost_analysis
+        # FLOPs/bytes. Once per process, only under FLAGS_stepledger.
+        from ..observability import stepledger as _sl
+
+        if _sl.enabled() and not _sl.has_cost("train.step"):
+            if k_merge > 1:
+                _sl.register_from_lowered(
+                    "train.step", jitted,
+                    (params, buffers, ost, merge_holder["accum"],
+                     merge_holder["count"], lr, seed, leaves, structure))
+            else:
+                _sl.register_from_lowered(
+                    "train.step", jitted,
+                    (params, buffers, ost, lr, seed, leaves, structure))
         model.load_pytree(new_params)
         model.load_pytree(new_buffers)
         optimizer._step_count += 1

@@ -60,6 +60,7 @@ def _instrument_step(step_fn, model=None):
     from ..observability import flight_recorder as _flight
     from ..observability import memwatch as _memwatch
     from ..observability import metrics as _om
+    from ..observability import stepledger as _stepledger
     from ..observability import tracing as _trace
 
     if getattr(step_fn, "_observed", False):
@@ -117,6 +118,10 @@ def _instrument_step(step_fn, model=None):
         last_end = state["last_end"]
         if last_end is not None:
             wait_h.observe(t0 - last_end)
+        # step-time ledger (one flag read when off): snapshot the
+        # compile/collective counters so the step window can be
+        # reconciled into named buckets after the dispatch
+        led = _stepledger.begin()
         try:
             out = step_fn(input_ids, labels)
         except BaseException as e:
@@ -133,6 +138,15 @@ def _instrument_step(step_fn, model=None):
         x = input_ids._data if isinstance(input_ids, Tensor) else input_ids
         n_tok = int(np.prod(x.shape)) if hasattr(x, "shape") else 0
         tokens_c.inc(n_tok)
+        if led is not None:
+            # end() blocks on the loss (the FLAGS_stepledger measured
+            # dispatch window) and returns the post-block timestamp —
+            # re-anchor last_end so the block does not show up AGAIN as
+            # the next step's data wait
+            state["last_end"] = _stepledger.end(
+                led, "train.step", t1, out=out,
+                data_wait=(t0 - last_end) if last_end is not None
+                else 0.0, tokens=n_tok)
         if trc.trace_id is not None:
             # the two phases an operator budgets a step by: host gap
             # since the previous step returned (dataloader stalls) and

@@ -27,6 +27,17 @@ recorder + span tracer (SURVEY.md §5 "Metrics / logging").
   autotune candidates) gets per-callable compile counts + compile-time
   spans, and recompile storms after warmup are detected and reported
   with the offending argument shapes.
+- `stepledger` — step-time ledger (sixth channel): each train/decode
+  step's wall time reconciled into named buckets (device compute via
+  `block_until_ready` windows under `FLAGS_stepledger`, collective
+  wait, data wait, compile, host dispatch, residual), plus a per-
+  executable roofline classification and MFU from
+  `compiled.cost_analysis()` against the shared `device_peaks` table;
+  `tools/step_ledger.py` prints the waterfall and the top
+  optimization targets.
+- `device_peaks` — the ONE per-chip bf16-peak-FLOPs / HBM-bandwidth
+  table shared by PerfMeter's MFU gauge, bench.py, tools/mfu_sweep.py,
+  and the stepledger roofline.
 
 The channels correlate: spans and flight-recorder breadcrumbs carry
 the same `rid`/`trace_id` fields, the watchdog stall dump appends the
@@ -53,8 +64,10 @@ from .metrics import (  # noqa: F401
     write_prometheus,
 )
 from . import compilewatch  # noqa: F401  (compile counts + storm detect)
+from . import device_peaks  # noqa: F401  (the shared per-chip peak table)
 from . import fleet  # noqa: F401  (rank-sharded export + aggregation)
 from . import memwatch  # noqa: F401  (HBM accounting + OOM forensics)
+from . import stepledger  # noqa: F401  (step-time ledger + roofline)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     Watchdog,

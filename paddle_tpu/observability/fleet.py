@@ -63,8 +63,9 @@ from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
 
-SHARD_FILES = ("metrics.prom", "memory.prom", "events.jsonl",
-               "trace.json", "collectives.jsonl", "heartbeat.json")
+SHARD_FILES = ("metrics.prom", "memory.prom", "ledger.prom",
+               "events.jsonl", "trace.json", "collectives.jsonl",
+               "heartbeat.json")
 
 
 def _flags():
@@ -282,6 +283,15 @@ class FleetExporter:
         _metrics.atomic_write(
             os.path.join(self.shard_dir, "memory.prom"),
             _memwatch.memory_exposition(reg, const_labels=const))
+
+        from . import stepledger as _stepledger
+
+        # the step-time ledger families alone (stepledger_*): the
+        # per-rank ledger table reads this small file instead of the
+        # full exposition
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "ledger.prom"),
+            _stepledger.ledger_exposition(reg, const_labels=const))
 
         from . import flight_recorder as _fr
 
@@ -762,6 +772,50 @@ def hbm_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def ledger_table(shards: Dict[int, str]) -> List[dict]:
+    """One row per rank from its ledger.prom shard (metrics.prom
+    fallback): total ledgered steps/wall seconds summed over entry
+    points, per-bucket seconds, and the residual fraction — the
+    stepledger waterfall compared ACROSS ranks (a rank whose
+    collective bucket dwarfs its peers' is the one waiting on the
+    straggler the skew table names). Ranks that never ran with
+    FLAGS_stepledger are omitted."""
+    from . import stepledger as _stepledger
+
+    out = []
+    for rank, path in sorted(shards.items()):
+        samples = {}
+        for fname in ("ledger.prom", "metrics.prom"):
+            try:
+                with open(os.path.join(path, fname)) as fh:
+                    samples = _parse_prom_samples(fh.read())
+            except OSError:
+                continue
+            if samples.get("stepledger_steps_total"):
+                break
+        agg = _stepledger.aggregate_from_samples(samples)
+        steps = sum(a["steps"] for a in agg.values())
+        if steps <= 0:
+            continue
+        wall = sum(a["wall"] for a in agg.values())
+        buckets = {b: sum(a["buckets"][b] for a in agg.values())
+                   for b in _stepledger.BUCKETS}
+        # same integrity recompute as stepledger.waterfall(): bucket
+        # samples lost from a shard surface as residual, not as a
+        # silently smaller waterfall
+        named = sum(v for b, v in buckets.items() if b != "residual")
+        buckets["residual"] = max(buckets["residual"], wall - named)
+        out.append({
+            "rank": rank,
+            "steps": steps,
+            "wall_s": round(wall, 6),
+            "buckets": {b: round(v, 6) for b, v in buckets.items()},
+            "residual_frac": round(buckets["residual"] / wall, 4)
+            if wall > 0 else 0.0,
+        })
+    return out
+
+
 def _median(vals: List[float]) -> Optional[float]:
     if not vals:
         return None
@@ -810,6 +864,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "straggler_summary": [],
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
+                    "ledger": [],
                     "artifacts": {}}
     if not shards:
         return report
@@ -830,6 +885,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "stragglers": rows[:top] if top else rows,
         "straggler_summary": straggler_summary(rows),
         "hbm": hbm_skew(hbm_table(shards)),
+        "ledger": ledger_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -956,6 +1012,25 @@ def format_report(report: dict) -> str:
                     f"HBM SKEW: rank {r['rank']} peak "
                     f"{_fmt_opt_bytes(r.get('peak_bytes'))} vs fleet "
                     f"median {_fmt_opt_bytes(r.get('median_bytes'))}")
+        lines.append("")
+    ledger = report.get("ledger") or []
+    if ledger:
+        from . import stepledger as _stepledger
+
+        lines.append("")
+        lines.append("== step-time ledger per rank (stepledger; "
+                     "bucket share of wall) ==")
+        named = [b for b in _stepledger.BUCKETS if b != "residual"]
+        hdr = " ".join(f"{b + '%':>10}" for b in named)
+        lines.append(f"{'rank':>5} {'steps':>6} {'wall_s':>9} {hdr} "
+                     f"{'resid%':>7}")
+        for r in ledger:
+            w = r["wall_s"] or 1.0
+            cells = " ".join(
+                f"{100.0 * r['buckets'][b] / w:>10.1f}" for b in named)
+            lines.append(
+                f"{r['rank']:>5} {r['steps']:>6} {r['wall_s']:>9.3f} "
+                f"{cells} {100.0 * r['residual_frac']:>7.1f}")
         lines.append("")
     art = report["artifacts"]
     if art:

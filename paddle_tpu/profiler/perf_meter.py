@@ -17,35 +17,14 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-# bf16 peak FLOPs per chip by generation (public TPU specs; note v5e's
-# headline 394 TOPS is INT8 — bf16 is half that)
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
-def detect_peak_flops(default=None):
-    """Best-effort bf16 peak from the device kind string. Returns None for
-    unrecognized devices (CPU/GPU dev boxes) so MFU is omitted rather than
-    computed against a meaningless peak."""
-    try:
-        import jax
-
-        kind = jax.devices()[0].device_kind.lower()
-        if "v5 lite" in kind or "v5e" in kind:
-            return PEAK_FLOPS["v5e"]
-        if "v5p" in kind or "v5" in kind:
-            return PEAK_FLOPS["v5p"]
-        if "v4" in kind:
-            return PEAK_FLOPS["v4"]
-        if "v6" in kind:
-            return PEAK_FLOPS["v6e"]
-    except Exception:
-        pass
-    return default
+# the per-chip bf16 peak table + detection moved to the shared
+# observability/device_peaks.py (single source of truth with bench.py,
+# tools/mfu_sweep.py, and the stepledger roofline — pinned by
+# tests/test_stepledger.py); the historical names stay importable here
+from ..observability.device_peaks import (  # noqa: F401
+    PEAK_FLOPS_BF16 as PEAK_FLOPS,
+    detect_peak_flops,
+)
 
 
 def transformer_flops_per_token(n_params: int, seq_len: int,

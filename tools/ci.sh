@@ -85,7 +85,7 @@ fi
 # recompiles after warmup (fails loudly with the compilewatch storm
 # report) and a non-empty memory exposition (/tmp/ci_memory.prom)
 if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_trace_sample=1 \
-    FLAGS_memwatch=1 FLAGS_compilewatch=1 \
+    FLAGS_memwatch=1 FLAGS_compilewatch=1 FLAGS_stepledger=1 \
     python tools/serving_metrics_snapshot.py \
       --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json \
       --mem /tmp/ci_memory.prom; then
@@ -97,6 +97,17 @@ elif ! timeout 120 env JAX_PLATFORMS=cpu \
     python tools/trace_report.py /tmp/ci_trace.json; then
   echo "CI: trace_report on /tmp/ci_trace.json FAILED (empty critical" \
        "path or unparseable trace)" >&2
+  rc=1
+# step-time ledger gate (ISSUE 7): the traced smoke ran with
+# FLAGS_stepledger=1, so its metrics exposition must yield a NON-EMPTY
+# waterfall whose named buckets + residual reconcile to the measured
+# step wall time — residual (the "unexplained" fraction) must stay
+# under 25%, and the report names the top optimization targets
+elif ! timeout 120 env JAX_PLATFORMS=cpu \
+    python tools/step_ledger.py /tmp/ci_metrics_traced.prom \
+      --max-residual 0.25; then
+  echo "CI: step_ledger on /tmp/ci_metrics_traced.prom FAILED (empty" \
+       "waterfall or residual bucket >= 25% of step wall time)" >&2
   rc=1
 fi
 
@@ -117,10 +128,25 @@ assert "metric" in parsed and "value" in parsed, parsed
 # 0 by design (driver contract) — the CI gate must still go red on it
 assert "error" not in parsed, parsed["error"]
 assert r.returncode == 0, r.returncode
+with open("/tmp/ci_bench_smoke.json", "w") as f:
+    f.write(lines[-1] + "\n")  # the fresh row for the regression gate
 print(f"bench --smoke last line parses: metric={parsed['metric']}")
 PYEOF
 then
   echo "CI: bench.py --smoke stdout-parseability FAILED" >&2
+  rc=1
+# bench regression gate (ISSUE 7): the fresh smoke row vs the most
+# recent comparable baseline (BENCH_HISTORY.jsonl trajectory, plus the
+# committed smoke anchor in BENCH_TPU_CACHE.json). Tolerance 0.35 HERE
+# because CPU smoke throughput is load-noisy on a shared CI box; the
+# tool's default (10%) is the gate for banked on-chip rows, and
+# tests/test_bench_compare.py pins that an injected >10% regression
+# fails at that default. Exit 2 (no comparable baseline) is red too —
+# the committed anchor row must keep the gate armed.
+elif ! timeout 120 python tools/bench_compare.py \
+    --fresh /tmp/ci_bench_smoke.json --tolerance 0.35; then
+  echo "CI: bench_compare regression gate FAILED (>35% off the" \
+       "baseline row, or no comparable baseline — see table above)" >&2
   rc=1
 fi
 
@@ -153,6 +179,8 @@ if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
-       "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/"
+       "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
+       "/tmp/ci_bench_smoke.json (ledger waterfall:" \
+       "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi
 exit $rc

@@ -31,6 +31,32 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
+_PEAKS = None
+
+
+def load_device_peaks():
+    """The shared per-chip peak table
+    (paddle_tpu/observability/device_peaks.py), loaded by file path so
+    this subprocess driver never pays the framework/jax import. ONE
+    table for bench.py, PerfMeter, the stepledger roofline, and this
+    sweep — tests/test_stepledger.py pins that they agree."""
+    import importlib.util
+
+    path = os.path.join(REPO, "paddle_tpu", "observability",
+                        "device_peaks.py")
+    spec = importlib.util.spec_from_file_location(
+        "_mfu_sweep_device_peaks", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _peaks():
+    global _PEAKS
+    if _PEAKS is None:
+        _PEAKS = load_device_peaks()
+    return _PEAKS
+
 # sweep grids per model size: batch up => more arithmetic per dispatch;
 # seq up => attention flops grow but so does the causal discount; remat
 # trades flops for HBM headroom at the big points; scan_layers shrinks the
@@ -110,6 +136,19 @@ def run_combo(model, batch, seq, recompute, scan, fused_ce, timeout):
         return row
     row.update(tok_per_sec_chip=res["value"], mfu=extra.get("mfu"),
                loss_last=extra.get("loss_last"))
+    # bench reports the per-chip peak it used (the shared device_peaks
+    # table); annotate the achieved TFLOPs and flag any drift between
+    # the measurement codepath and the table this sweep was built on
+    peak = extra.get("peak_flops_per_chip")
+    if peak:
+        row["peak_tflops_bf16"] = round(peak / 1e12, 1)
+        table = _peaks()
+        if peak not in table.PEAK_FLOPS_BF16.values() and \
+                peak != table.CPU_FALLBACK_PEAK_FLOPS:
+            row["peak_table_mismatch"] = True
+        if row.get("mfu"):
+            row["achieved_tflops_per_chip"] = round(
+                row["mfu"] * peak / 1e12, 2)
     return row
 
 

@@ -228,8 +228,62 @@ def critical_path(trace_spans, total_us) -> List[tuple]:
     return out
 
 
-def build_report(events) -> tuple:
-    """Returns (text, ok). ok=False means no usable spans were found."""
+def find_ledger(trace_path: str) -> Optional[List[str]]:
+    """Stepledger expositions sitting alongside the trace: a
+    `ledger.prom` in the same directory (a fleet rank shard carries one
+    per rank), plus — for a telemetry-dir input whose traces live in
+    rank subdirs — every `rank_*/ledger.prom` under it (summed, the
+    same shard layout load_events merges trace.json from). None when
+    absent — the report then prints exactly as before."""
+    base = trace_path if os.path.isdir(trace_path) \
+        else os.path.dirname(os.path.abspath(trace_path))
+    cands = []
+    p = os.path.join(base, "ledger.prom")
+    if os.path.exists(p):
+        cands.append(p)
+    cands.extend(sorted(
+        glob.glob(os.path.join(base, "rank_*", "ledger.prom"))))
+    return cands or None
+
+
+def load_ledger(paths) -> Optional[dict]:
+    """Bucket map + fleet-wide bucket shares from one or more
+    stepledger Prometheus exports (rank shards summed; lazy paddle_tpu
+    import — the tool stays dependency-free when no ledger is
+    present)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    shown = paths[0] if len(paths) == 1 else \
+        f"{len(paths)} ledger shards under " \
+        f"{os.path.dirname(os.path.dirname(paths[0])) or '.'}"
+    try:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from paddle_tpu.observability import stepledger
+
+        samples = stepledger.samples_from_prom_files(paths)
+    except Exception as e:  # noqa: BLE001 — ledger is optional garnish
+        print(f"trace_report: ledger {shown} unusable ({e}); "
+              f"reporting without bucket attribution", file=sys.stderr)
+        return None
+    agg = stepledger.aggregate_from_samples(samples)
+    rows = stepledger.waterfall(agg)
+    if not rows:
+        return None
+    total = sum(r["wall_s"] for r in rows)
+    shares = {b: sum(r["buckets"][b]["seconds"] for r in rows) / total
+              for b in stepledger.BUCKETS} if total else {}
+    return {"bucket_of": stepledger.bucket_of_span, "shares": shares,
+            "path": shown}
+
+
+def build_report(events, ledger: Optional[dict] = None) -> tuple:
+    """Returns (text, ok). ok=False means no usable spans were found.
+
+    `ledger` (load_ledger) adds a bucket column to the critical path —
+    each phase tagged with its step-time-ledger bucket, and the
+    fleet-wide bucket shares printed under it, so one report answers
+    both "what was slow" and "why"."""
     lines = []
     srows = serving_rows(events)
     trows = train_rows(events)
@@ -287,8 +341,17 @@ def build_report(events) -> tuple:
             extra = "  " + " ".join(f"{k}={v}"
                                     for k, v in sorted(attrs.items())) \
                 if attrs else ""
+            bucket = ledger["bucket_of"](name) if ledger else None
+            bcol = f" [{bucket}]" if bucket else \
+                ("" if ledger is None else " [-]")
             lines.append(f"  {name:<24} {_ms(dur):>9} ms  "
-                         f"{pct:5.1f}%{extra}")
+                         f"{pct:5.1f}%{bcol}{extra}")
+        if ledger and ledger["shares"]:
+            shares = " ".join(
+                f"{b} {frac * 100.0:.1f}%"
+                for b, frac in ledger["shares"].items() if frac > 0)
+            lines.append(f"  ledger bucket shares "
+                         f"({ledger['path']}): {shares}")
         lines.append("")
     ok = bool(path)
     if not ok:
@@ -303,6 +366,11 @@ def main(argv=None) -> int:
                     help="Chrome trace JSON (write_trace()), or a "
                          "fleet telemetry dir / rank shard dir "
                          "(rank_*/trace.json merged)")
+    ap.add_argument("--ledger", default=None, metavar="PROM",
+                    help="stepledger Prometheus export to attribute "
+                         "critical-path phases to ledger buckets "
+                         "(default: a ledger.prom alongside the "
+                         "trace, when present)")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.trace)
@@ -310,7 +378,10 @@ def main(argv=None) -> int:
         print(f"trace_report: cannot load {args.trace}: {e}",
               file=sys.stderr)
         return 2
-    text, ok = build_report(events)
+    ledger_paths = [args.ledger] if args.ledger \
+        else find_ledger(args.trace)
+    ledger = load_ledger(ledger_paths) if ledger_paths else None
+    text, ok = build_report(events, ledger=ledger)
     sys.stdout.write(text)
     return 0 if ok else 2
 
