@@ -37,6 +37,9 @@ class JobContext:
     # fleet telemetry root: each rank writes <dir>/rank_<i>/ shards
     # (observability/fleet.py); the controller merges them at job end
     telemetry_dir: Optional[str] = None
+    # live telemetry plane base port: rank i serves /metrics,/healthz,
+    # /readyz,/statusz on base+i (observability/httpd.py); 0 = off
+    telemetry_port: int = 0
     envs: dict = field(default_factory=dict)
 
     def __post_init__(self):
@@ -118,6 +121,14 @@ def parse_args(argv=None) -> JobContext:
                         "them into fleet.prom / fleet_trace.json / "
                         "fleet_report.txt at job end "
                         "(tools/fleet_report.py re-runs the analysis)")
+    p.add_argument("--telemetry_port", type=int,
+                   default=int(os.environ.get("FLAGS_telemetry_port")
+                               or 0),
+                   help="live telemetry plane base port: worker rank i "
+                        "serves /metrics /healthz /readyz /statusz on "
+                        "base+rank (observability/httpd.py; heartbeats "
+                        "advertise the address for tools/"
+                        "fleet_report.py --scrape). 0 = off")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -128,7 +139,8 @@ def parse_args(argv=None) -> JobContext:
         node_rank=a.node_rank, nproc_per_node=a.nproc_per_node,
         master=a.master, log_dir=a.log_dir, devices=a.devices,
         job_id=a.job_id, max_restarts=a.max_restarts,
-        telemetry_dir=a.telemetry_dir)
+        telemetry_dir=a.telemetry_dir,
+        telemetry_port=a.telemetry_port)
 
 
 def rank_env(ctx: JobContext, local_rank: int) -> dict:
@@ -157,6 +169,10 @@ def rank_env(ctx: JobContext, local_rank: int) -> dict:
         # activates the rank-sharded fleet exporter in every worker
         # (observability/fleet.py reads the flag at first telemetry hit)
         env["FLAGS_telemetry_dir"] = ctx.telemetry_dir
+    if ctx.telemetry_port:
+        # one live HTTP plane per rank at base+rank — distinct ports
+        # even with multiple workers on one host (observability/httpd)
+        env["FLAGS_telemetry_port"] = str(ctx.telemetry_port + rank)
     if ctx.devices is not None:
         devs = ctx.devices.split(",")
         env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]

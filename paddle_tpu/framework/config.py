@@ -205,6 +205,48 @@ define_flag("FLAGS_stepledger_block_every", 1,
             "outputs every N-th step (1 = every step) so the measured "
             "dispatch window includes the true device tail; unblocked "
             "steps attribute only the host-visible window.", type_=int)
+define_flag("FLAGS_telemetry_port", 0,
+            "Live telemetry plane (observability/httpd.py): when > 0, a "
+            "per-rank daemon-thread HTTP server (stdlib http.server, "
+            "zero new deps) binds this port and serves /metrics "
+            "(Prometheus text), /healthz (liveness: watchdog stall, "
+            "engine poison, heartbeat freshness), /readyz (warmup done "
+            "+ KV pool non-exhausted), /statusz (JSON status), "
+            "/debug/stacks and /debug/trace?secs=N. 0 (default) = off: "
+            "one flag read per step, zero registry/span allocations "
+            "(pinned by tests/test_telemetry_httpd.py). Launcher "
+            "--telemetry_port assigns base+rank per worker.", type_=int)
+define_flag("FLAGS_healthz_stale_s", 0.0,
+            "/healthz heartbeat-freshness threshold in seconds: when "
+            "> 0 and the last serving/train step heartbeat is older "
+            "than this, /healthz reports unhealthy (503). 0 (default) "
+            "= report the age but never fail on it — an idle serving "
+            "engine between requests is healthy, not dead.",
+            type_=float)
+define_flag("FLAGS_slo_window_s", 300.0,
+            "Base SLO evaluation window in seconds (observability/"
+            "slo.py). Burn-rate alert policies derive their window "
+            "pairs from it: fast_burn = (1x, 12x) at burn >= 14.4, "
+            "slow_burn = (6x, 72x) at burn >= 6 — the SRE multi-window "
+            "multi-burn-rate pattern. The default 300 reproduces the "
+            "classic 5m/1h + 30m/6h ladder.", type_=float)
+define_flag("FLAGS_slo_ttft_p95_ms", 1000.0,
+            "TTFT SLO threshold in milliseconds: the ttft_p95 "
+            "objective requires 95% of requests to see their first "
+            "token within this budget (evaluated from the "
+            "serving_ttft_seconds histogram; thresholds snap to the "
+            "shared latency bucket ladder).", type_=float)
+define_flag("FLAGS_slo_decode_p50_ms", 250.0,
+            "Per-token decode SLO threshold in milliseconds: the "
+            "decode_p50 objective requires 50% of decode steps to "
+            "commit each token within this budget (evaluated from the "
+            "serving_token_decode_seconds histogram).", type_=float)
+define_flag("FLAGS_slo_error_budget", 0.01,
+            "Error-budget fraction for the error_rate SLO objective: "
+            "serving failure events (decode OOMs, engine poisons; "
+            "serving_errors_total) may be at most this fraction of "
+            "outcomes (errors + finished requests) before the budget "
+            "burns.", type_=float)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

@@ -24,8 +24,10 @@ from ..kernels import paged_attention as _pa
 from ..observability import compilewatch as _cw
 from ..observability import fleet as _fleet
 from ..observability import flight_recorder as _flight
+from ..observability import httpd as _httpd
 from ..observability import memwatch as _memwatch
 from ..observability import metrics as _om
+from ..observability import slo as _slo
 from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
@@ -41,8 +43,8 @@ class _EngineMetrics:
     __slots__ = ("ttft", "step_lat", "token_lat", "queue_depth",
                  "queue_wait", "occupancy", "page_util", "prefill_hits",
                  "prefill_misses", "preemptions", "aborts", "tokens",
-                 "finished", "poisoned", "kv_occupancy", "kv_frag",
-                 "kv_free")
+                 "finished", "poisoned", "errors", "kv_occupancy",
+                 "kv_frag", "kv_free")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -95,6 +97,13 @@ class _EngineMetrics:
             "1 once a compiled decode call raised after donating the KV "
             "page pools (engine must be recreated; step()/run() fail "
             "fast).")
+        self.errors = reg.counter(
+            "serving_errors_total",
+            "Serving failure events: decode-dispatch OOMs and engine "
+            "poisons. The error_rate SLO objective (observability/"
+            "slo.py) burns its budget on these, against "
+            "serving_requests_finished_total as the good-event "
+            "counter.")
         # memwatch channel (README.md "Memory & compile observability"):
         # per-step KV page-pool distributions, observed only when
         # FLAGS_memwatch is on — handles still resolve here so the on
@@ -298,6 +307,12 @@ class ServingEngine:
         # youngest slot, retry) before the engine poisons — see
         # _handle_decode_oom
         self._oom_retried = False
+        # live telemetry plane (README.md "Live telemetry plane"):
+        # /readyz is 503 until warmup() completes and while the KV pool
+        # is exhausted; tracking is a weakref append — the engine never
+        # holds a server handle
+        self._warmup_done = False
+        _httpd.track_engine(self)
         if _memwatch.enabled():
             self._record_static_breakdown()
         # span tracing (README.md "Observability"): one Trace per request
@@ -506,6 +521,10 @@ class ServingEngine:
         # compile is an IN-TRAFFIC recompile (compilewatch counts them;
         # tools/ci.sh gates the smoke on zero decode recompiles)
         _cw.mark_warmup_done("serving.")
+        # readiness gate: /readyz flips to 200 only now — a router that
+        # admitted traffic earlier would eat the compile cliff warmup
+        # exists to prepay
+        self._warmup_done = True
         return _time.perf_counter() - t0
 
     def _autotune_decode_bucket(self):
@@ -943,6 +962,7 @@ class ServingEngine:
         engine holds are dead buffers (ADVICE.md round-5)."""
         self._poisoned = why
         self._m.poisoned.set(1.0)
+        self._m.errors.inc()  # the error_rate SLO burns on poisons
         _trace.instant("serving.poisoned", why=why)
         _flight.record_event("serving.poisoned", why=why)
 
@@ -1025,6 +1045,7 @@ class ServingEngine:
         path = _memwatch.dump_oom(f"serving_{where}", exc=exc,
                                   extra=self._page_table_report())
         _flight.record_event("serving.oom", where=where, dump=path)
+        self._m.errors.inc()  # the error_rate SLO burns on decode OOMs
         if any(pages and self._buffers_deleted(pages)
                for pages in (self.k_pages, self.v_pages)):
             self._poison(f"{where} raised RESOURCE_EXHAUSTED after "
@@ -1280,8 +1301,13 @@ class ServingEngine:
         # fragmentation histograms + an HBM watermark sample
         if _memwatch.enabled():
             self._observe_memory()
-        # fleet heartbeat (rank shard liveness): one flag read when off
+        # fleet heartbeat (rank shard liveness; also lazily boots the
+        # live HTTP plane — fleet.heartbeat is the ONE ensure_server
+        # call site) + SLO window snapshot: flag reads only when
+        # FLAGS_telemetry_port/_dir are unset (the off-path alloc
+        # guard pins zero allocations per step)
         _fleet.heartbeat()
+        _slo.tick()
 
     def _replay_burst(self, toks, emits, active):
         """Token-by-token host replay of one harvested burst: identical

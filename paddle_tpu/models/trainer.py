@@ -60,6 +60,7 @@ def _instrument_step(step_fn, model=None):
     from ..observability import flight_recorder as _flight
     from ..observability import memwatch as _memwatch
     from ..observability import metrics as _om
+    from ..observability import slo as _slo
     from ..observability import stepledger as _stepledger
     from ..observability import tracing as _trace
 
@@ -166,8 +167,11 @@ def _instrument_step(step_fn, model=None):
                 state["breakdown_done"] = True
                 _record_train_breakdown()
             _memwatch.sample()
-        # fleet heartbeat (rank shard liveness): one flag read when off
+        # fleet heartbeat (rank shard liveness; also lazily boots the
+        # live HTTP plane — fleet.heartbeat is the ONE ensure_server
+        # call site) + SLO window snapshot: flag reads only when off
         _fleet.heartbeat(step=int(steps_c.value))
+        _slo.tick()
         return out
 
     for k, v in step_fn.__dict__.items():

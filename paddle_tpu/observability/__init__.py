@@ -27,6 +27,18 @@ recorder + span tracer (SURVEY.md §5 "Metrics / logging").
   autotune candidates) gets per-callable compile counts + compile-time
   spans, and recompile storms after warmup are detected and reported
   with the offending argument shapes.
+- `httpd` — the live telemetry plane (seventh channel, the first
+  pull-based one): a per-rank stdlib HTTP server
+  (`FLAGS_telemetry_port`) serving `/metrics` (registry-locked
+  Prometheus exposition), `/healthz` (poison/stall/heartbeat
+  liveness), `/readyz` (warmup + KV-pool admission gate), `/statusz`
+  (JSON status), `/debug/stacks`, `/debug/trace?secs=N`; fleet
+  heartbeats advertise the endpoint for `fleet_report --scrape`.
+- `slo` — declarative SLO engine: objectives as data (ttft_p95 /
+  decode_p50 / error_rate / availability), sliding-window compliance
+  from histogram snapshots, SRE multi-window burn-rate alerts
+  (`slo_compliance` / `slo_burn_rate` / `slo_alert` gauges) and the
+  composite `serving_load_score` admission signal.
 - `stepledger` — step-time ledger (sixth channel): each train/decode
   step's wall time reconciled into named buckets (device compute via
   `block_until_ready` windows under `FLAGS_stepledger`, collective
@@ -66,7 +78,9 @@ from .metrics import (  # noqa: F401
 from . import compilewatch  # noqa: F401  (compile counts + storm detect)
 from . import device_peaks  # noqa: F401  (the shared per-chip peak table)
 from . import fleet  # noqa: F401  (rank-sharded export + aggregation)
+from . import httpd  # noqa: F401  (per-rank HTTP exposition plane)
 from . import memwatch  # noqa: F401  (HBM accounting + OOM forensics)
+from . import slo  # noqa: F401  (SLO objectives + burn-rate alerts)
 from . import stepledger  # noqa: F401  (step-time ledger + roofline)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,

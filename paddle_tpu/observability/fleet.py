@@ -183,8 +183,15 @@ def heartbeat(step: Optional[int] = None):
     """One liveness beat per completed serving/train step. The flusher
     persists the LAST beat's wall time + step into heartbeat.json; a
     rank whose beat goes stale relative to its peers is dead — "rank 2
-    stopped beating at step 1840". No-op (one flag read) when the fleet
-    layer is off."""
+    stopped beating at step 1840". No-op (one flag read each for the
+    fleet layer and the HTTP plane) when both are off."""
+    # the live HTTP plane rides the same liveness signal: any workload
+    # that beats (serving, trainer, synthetic collectives) boots its
+    # per-rank server lazily — FLAGS_telemetry_port can be on without
+    # FLAGS_telemetry_dir, so this runs before the fleet gate
+    from . import httpd as _httpd
+
+    _httpd.ensure_server()
     if not enabled():
         return
     if step is None:
@@ -194,6 +201,12 @@ def heartbeat(step: Optional[int] = None):
     _hb["beats"] += 1
     _hb["ts"] = time.time()
     ensure_exporter()
+
+
+def last_beat() -> dict:
+    """The rank's own last heartbeat (step, beats, wall ts) — the
+    /healthz freshness source (observability/httpd.py)."""
+    return {"step": _hb["step"], "beats": _hb["beats"], "ts": _hb["ts"]}
 
 
 # ---------------------------------------------------------------------------
@@ -271,6 +284,19 @@ class FleetExporter:
         const = {"rank": str(self.rank),
                  "world_size": str(self.world_size)}
         reg = self._registry or _metrics.default_registry()
+
+        from . import slo as _slo
+
+        # refresh the slo_* / serving_load_score gauges so every shard
+        # exposition carries a current SLO verdict (the per-rank SLO
+        # table in tools/fleet_report.py reads them back). Collect only
+        # when flushing the process-default registry: a test-injected
+        # registry must not have default-registry gauges mixed in.
+        if self._registry is None:
+            try:
+                _slo.collect()
+            except Exception:  # noqa: BLE001 — telemetry never takes
+                pass           # the flusher down
         _metrics.atomic_write(
             os.path.join(self.shard_dir, "metrics.prom"),
             _metrics.to_prometheus(reg, const_labels=const))
@@ -345,6 +371,15 @@ class FleetExporter:
             "clock": {"perf_s": round(time.perf_counter(), 6),
                       "wall_s": round(time.time(), 6)},
         }
+        # the live telemetry plane's scrape address rides the
+        # heartbeat: fleet_report --scrape discovers rank endpoints
+        # from the shards it already reads
+        try:
+            from . import httpd as _httpd
+
+            hb["endpoint"] = _httpd.advertised_address()
+        except Exception:  # noqa: BLE001
+            hb["endpoint"] = None
         _metrics.atomic_write(
             os.path.join(self.shard_dir, "heartbeat.json"),
             json.dumps(hb, indent=1))
@@ -816,6 +851,171 @@ def ledger_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def slo_table(shards: Dict[int, str]) -> List[dict]:
+    """One row per (rank, objective) from the slo_* samples in the
+    rank's metrics.prom — compliance, the worst burn rate with its
+    window, firing alert policies, and the rank's load score. Ranks
+    whose shards predate the SLO engine are omitted (empty list when
+    no rank evaluated an objective)."""
+    out = []
+    for rank, path in sorted(shards.items()):
+        try:
+            with open(os.path.join(path, "metrics.prom")) as fh:
+                samples = _parse_prom_samples(fh.read())
+        except OSError:
+            continue
+        comp = {}
+        for labels, v in samples.get("slo_compliance", []):
+            obj = labels.get("objective")
+            if obj:
+                comp[obj] = v
+        burns: Dict[str, Dict[str, float]] = {}
+        for labels, v in samples.get("slo_burn_rate", []):
+            obj, win = labels.get("objective"), labels.get("window")
+            if obj and win:
+                burns.setdefault(obj, {})[win] = v
+        alerts: Dict[str, List[str]] = {}
+        for labels, v in samples.get("slo_alert", []):
+            obj, pol = labels.get("objective"), labels.get("policy")
+            if obj and pol and v >= 1.0:
+                alerts.setdefault(obj, []).append(pol)
+        load_rows = samples.get("serving_load_score", [])
+        load = load_rows[0][1] if load_rows else None
+        for obj in sorted(comp):
+            b = burns.get(obj, {})
+            worst_win = max(b, key=b.get) if b else None
+            out.append({
+                "rank": rank,
+                "objective": obj,
+                "compliance": comp[obj],
+                "burn": b,
+                "worst_burn": b[worst_win] if worst_win else 0.0,
+                "worst_window": worst_win,
+                "alerts": sorted(alerts.get(obj, [])),
+                "load_score": load,
+            })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live-endpoint scraping (the pull half of the telemetry plane)
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url: str, timeout: float = 5.0) -> Tuple[int, bytes]:
+    """(status_code, body) — 503s still carry their JSON payload (the
+    /healthz and /readyz failure bodies are the interesting ones)."""
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except HTTPError as e:
+        return e.code, e.read()
+
+
+def normalize_endpoint(ep: str) -> str:
+    """'host:port' (the heartbeat/--scrape form) -> a base URL."""
+    ep = ep.strip().rstrip("/")
+    if not ep.startswith(("http://", "https://")):
+        ep = "http://" + ep
+    return ep
+
+
+def endpoints_from_heartbeats(root: str) -> List[str]:
+    """Live scrape addresses advertised by the rank shards under
+    `root` (heartbeat.json `endpoint` field) — lets `--scrape auto`
+    discover the fleet from the dir it already reads."""
+    eps = []
+    for _rank, path in discover_shards(root).items():
+        hb = _read_json(os.path.join(path, "heartbeat.json"))
+        ep = hb.get("endpoint") if isinstance(hb, dict) else None
+        if ep:
+            eps.append(str(ep))
+    return eps
+
+
+def scrape_to_shards(endpoints: List[str], out_root: str,
+                     timeout: float = 5.0) -> Dict[int, dict]:
+    """Pull /metrics (+ /healthz, /readyz, /statusz best-effort) from
+    every live endpoint and lay the results out as `rank_<i>/` shards
+    under `out_root`, so the whole aggregation/report stack runs
+    unchanged on LIVE data. The rank comes from the scraped samples'
+    own `rank` const labels (endpoint order is the fallback);
+    heartbeat.json is synthesized from /statusz so the per-rank table
+    and dead-rank logic keep working. Returns
+    {rank: {"endpoint", "shard", "error"?}} — unreachable endpoints
+    are reported, not fatal."""
+    os.makedirs(out_root, exist_ok=True)
+    results: Dict[int, dict] = {}
+    for pos, ep in enumerate(endpoints):
+        base = normalize_endpoint(ep)
+        try:
+            code, body = _http_get(base + "/metrics", timeout=timeout)
+            if code != 200:
+                raise OSError(f"/metrics returned {code}")
+            text = body.decode("utf-8", "replace")
+        except Exception as e:  # noqa: BLE001 — one dead endpoint
+            # must not kill the fleet scrape
+            results[-(pos + 1)] = {"endpoint": ep, "error": repr(e)}
+            continue
+        samples = _parse_prom_samples(text)
+        rank = pos
+        for rows in samples.values():
+            found = False
+            for lab, _v in rows:
+                if "rank" in lab:
+                    try:
+                        rank = int(lab["rank"])
+                        found = True
+                        break
+                    except (TypeError, ValueError):
+                        pass
+            if found:
+                break
+        if rank in results:
+            # two replicas claiming the same rank label (e.g. both
+            # started by hand without PADDLE_TRAINER_ID, so both stamp
+            # rank="0"): fall back to the first free slot instead of
+            # silently overwriting the earlier shard
+            rank = pos
+            while rank in results:
+                rank += 1
+        shard = os.path.join(out_root, f"rank_{rank}")
+        os.makedirs(shard, exist_ok=True)
+        _metrics.atomic_write(os.path.join(shard, "metrics.prom"), text)
+        statusz = None
+        for name in ("healthz", "readyz", "statusz"):
+            try:
+                code, body = _http_get(f"{base}/{name}",
+                                       timeout=timeout)
+                payload = json.loads(body.decode("utf-8", "replace"))
+                if name == "statusz":
+                    statusz = payload
+                _metrics.atomic_write(
+                    os.path.join(shard, f"{name}.json"),
+                    json.dumps({"code": code, **payload}, indent=1))
+            except Exception:  # noqa: BLE001 — optional extras
+                continue
+        hb = {
+            "rank": rank,
+            "world_size": (statusz or {}).get("world_size", 0),
+            "pid": (statusz or {}).get("pid"),
+            "endpoint": ep,
+            "scraped": True,
+            "write_time": round(time.time(), 6),
+        }
+        shb = (statusz or {}).get("heartbeat") or {}
+        hb["step"] = shb.get("step", -1)
+        hb["beats"] = shb.get("beats", 0)
+        hb["beat_time"] = shb.get("ts") or None
+        _metrics.atomic_write(os.path.join(shard, "heartbeat.json"),
+                              json.dumps(hb, indent=1))
+        results[rank] = {"endpoint": ep, "shard": shard}
+    return results
+
+
 def _median(vals: List[float]) -> Optional[float]:
     if not vals:
         return None
@@ -864,7 +1064,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "straggler_summary": [],
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
-                    "ledger": [],
+                    "ledger": [], "slo": [],
                     "artifacts": {}}
     if not shards:
         return report
@@ -886,6 +1086,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "straggler_summary": straggler_summary(rows),
         "hbm": hbm_skew(hbm_table(shards)),
         "ledger": ledger_table(shards),
+        "slo": slo_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -1031,6 +1232,34 @@ def format_report(report: dict) -> str:
             lines.append(
                 f"{r['rank']:>5} {r['steps']:>6} {r['wall_s']:>9.3f} "
                 f"{cells} {100.0 * r['residual_frac']:>7.1f}")
+        lines.append("")
+    slo_rows = report.get("slo") or []
+    if slo_rows:
+        lines.append("")
+        lines.append("== SLO compliance per rank (slo_* gauges; burn = "
+                     "error-budget multiple) ==")
+        lines.append(f"{'rank':>5} {'objective':<14} {'compliance':>11} "
+                     f"{'worst burn':>11} {'window':>8} {'load':>6} "
+                     f"alerts")
+        for r in slo_rows:
+            alerts = ",".join(r["alerts"]) if r["alerts"] else "-"
+            load = f"{r['load_score']:.2f}" \
+                if r.get("load_score") is not None else "-"
+            lines.append(
+                f"{r['rank']:>5} {r['objective']:<14} "
+                f"{r['compliance'] * 100.0:>10.2f}% "
+                f"{r['worst_burn']:>11.2f} "
+                f"{str(r['worst_window'] or '-'):>8} {load:>6} "
+                f"{alerts}")
+        for r in slo_rows:
+            if r["alerts"]:
+                lines.append(
+                    f"SLO ALERT: rank {r['rank']} {r['objective']} "
+                    f"{','.join(r['alerts'])} firing (burn "
+                    f"{r['worst_burn']:.1f} over {r['worst_window']}) "
+                    f"— this rank is burning its error budget; route "
+                    f"traffic elsewhere (serving_load_score) and check "
+                    f"its ledger/straggler rows above")
         lines.append("")
     art = report["artifacts"]
     if art:

@@ -74,6 +74,19 @@ def beat_all():
         w.beat()
 
 
+def any_stalled() -> bool:
+    """True while any started watchdog is in the stalled state (missed
+    deadline, no beat since) — the liveness half of /healthz
+    (observability/httpd.py). Re-arms to False at the next beat."""
+    return any(w._stalled for w in list(_watchdogs))
+
+
+def format_thread_stacks() -> str:
+    """All Python thread stacks as text (the /debug/stacks payload and
+    the stall-dump section share this)."""
+    return _format_thread_stacks()
+
+
 def _format_thread_stacks() -> str:
     names = {t.ident: t.name for t in threading.enumerate()}
     parts = []

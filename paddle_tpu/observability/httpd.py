@@ -1,0 +1,510 @@
+"""Live telemetry plane: the per-rank HTTP exposition server
+(README.md "Live telemetry plane").
+
+Six telemetry channels export files into `rank_<i>/` shards read
+post-mortem; nothing could ask a RUNNING engine how it is doing. This
+module is the seventh channel and the first pull-based one: a
+stdlib-only (`http.server` + one daemon thread, zero new deps) server
+per rank, serving:
+
+- `/metrics`  — Prometheus text exposition of the process registry,
+  taken under the registry lock (cross-family-consistent scrape; the
+  histogram cells are additionally torn-read-proof via
+  `Histogram.state()`). A scrape forces an SLO collect first, so
+  `slo_*` and `serving_load_score` samples are always fresh.
+- `/healthz`  — liveness: 503 when a serving engine is poisoned or a
+  watchdog is in the stalled state; heartbeat age is reported (and
+  gates when `FLAGS_healthz_stale_s` > 0); firing SLO burn alerts
+  degrade the status (200 + "degraded" — load balancers route on
+  /readyz, pagers on burn alerts).
+- `/readyz`   — readiness: 503 until every tracked serving engine has
+  completed `warmup()` and while any is poisoned or its KV page pool
+  is exhausted — the admission gate a multi-replica router checks
+  before sending traffic.
+- `/statusz`  — JSON: per-engine slot/KV state, the stepledger
+  waterfall, the SLO report, heartbeat, flags, build info.
+- `/debug/stacks`       — on-demand thread dump + open spans + the
+  trailing flight-recorder ring (a stall dump without the stall).
+- `/debug/trace?secs=N` — window capture of the span ring as a
+  Chrome-trace download (Perfetto-loadable; requires tracing on).
+
+Activation: `FLAGS_telemetry_port` > 0 starts the server lazily on
+first step telemetry (`ensure_server()`, the fleet-exporter pattern);
+the launcher's `--telemetry_port` assigns base+rank per worker, and
+the fleet heartbeat carries the advertised endpoint so
+`tools/fleet_report.py --scrape` can discover live ranks. Tools and
+tests call `start_server(port=0)` for an ephemeral port.
+
+Zero-overhead contract: port 0 (default) means `ensure_server()` is
+one flag read, no thread, no socket, and zero registry/span/snapshot
+allocations per step — pinned by tests/test_telemetry_httpd.py.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+from . import slo as _slo
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def port_flag() -> int:
+    try:
+        return int(_flags().get_flag("FLAGS_telemetry_port", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the plane when it is off."""
+    return port_flag() > 0
+
+
+def stale_s() -> float:
+    try:
+        return float(_flags().get_flag("FLAGS_healthz_stale_s", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# engine tracking (readiness + load score)
+# ---------------------------------------------------------------------------
+
+_engines: List[weakref.ref] = []
+_engines_lock = threading.Lock()
+
+
+def track_engine(engine):
+    """Register a ServingEngine for /readyz and the load score — a
+    weakref append at construction; the engine never needs a handle
+    back."""
+    with _engines_lock:
+        _engines.append(weakref.ref(engine))
+
+
+def tracked_engines() -> list:
+    """Live tracked engines (dead weakrefs pruned)."""
+    out = []
+    with _engines_lock:
+        alive = []
+        for ref in _engines:
+            e = ref()
+            if e is not None:
+                alive.append(ref)
+                out.append(e)
+        _engines[:] = alive
+    return out
+
+
+# ---------------------------------------------------------------------------
+# probe payloads (pure functions — the handlers and tests share them)
+# ---------------------------------------------------------------------------
+
+
+def health_payload(registry: Optional[_metrics.Registry] = None
+                   ) -> Tuple[int, dict]:
+    """(status_code, payload). 503 on the HARD checks — engine
+    poisoned (the gauge flips inside _poison(), so a poison is visible
+    to the very next request) or a stalled watchdog; heartbeat age 503s
+    only when FLAGS_healthz_stale_s opts in. Firing SLO burn alerts
+    degrade the status without failing liveness."""
+    reg = registry or _metrics.default_registry()
+    hard = _slo.hard_health(reg)
+    # engines the registry may not have seen yet (fresh registry in
+    # tests): ask the tracked objects directly too
+    eng_poisoned = any(getattr(e, "_poisoned", None)
+                       for e in tracked_engines())
+    poisoned = bool(hard["poisoned"] or eng_poisoned)
+    checks = {
+        "poisoned": {"ok": not poisoned},
+        "watchdog": {"ok": not hard["stalled"],
+                     "stalled": hard["stalled"]},
+    }
+    from . import fleet as _fleet
+
+    hb = _fleet.last_beat()
+    age = round(time.time() - hb["ts"], 3) if hb["beats"] else None
+    threshold = stale_s()
+    hb_ok = not (threshold > 0 and age is not None and age > threshold)
+    checks["heartbeat"] = {"ok": hb_ok, "age_s": age,
+                           "step": hb["step"], "beats": hb["beats"],
+                           "stale_after_s": threshold or None}
+    degraded = _slo.firing()
+    ok = all(c["ok"] for c in checks.values())
+    status = "unhealthy" if not ok else (
+        "degraded" if degraded else "ok")
+    return (200 if ok else 503), {
+        "status": status, "checks": checks,
+        "slo_alerts_firing": degraded}
+
+
+def ready_payload() -> Tuple[int, dict]:
+    """(status_code, payload). Ready iff every tracked serving engine
+    finished warmup(), none is poisoned, and each KV page pool has at
+    least one free page (an exhausted pool cannot admit work — the
+    router should drain elsewhere until preemption/finishes free
+    pages). A process with no serving engine (a trainer rank) is
+    trivially ready."""
+    engines = tracked_engines()
+    rows = []
+    ok = True
+    for i, e in enumerate(engines):
+        warmed = bool(getattr(e, "_warmup_done", False))
+        poisoned = getattr(e, "_poisoned", None)
+        kv_free = len(e._free_pages)
+        row_ok = warmed and not poisoned and kv_free > 0
+        ok = ok and row_ok
+        rows.append({"engine": i, "ok": row_ok, "warmed": warmed,
+                     "poisoned": bool(poisoned),
+                     "kv_pages_free": kv_free,
+                     "kv_pages_total": e._n_pages_total})
+    payload = {"status": "ready" if ok else "unready",
+               "engines": rows}
+    if not engines:
+        payload["note"] = "no serving engine tracked"
+    return (200 if ok else 503), payload
+
+
+def statusz_payload(registry: Optional[_metrics.Registry] = None
+                    ) -> dict:
+    """The one-stop JSON status: identity, build, flags, per-engine
+    serving state, the stepledger waterfall, the SLO report, health +
+    readiness verdicts."""
+    reg = registry or _metrics.default_registry()
+    rank, world = _metrics.rank_world()
+    jax_mod = sys.modules.get("jax")
+    serving = []
+    for i, e in enumerate(tracked_engines()):
+        slots = [{"slot": si, "rid": s.request_id,
+                  "ctx": s.context_len, "pages": s.n_pages,
+                  "tokens": len(s.tokens), "max_new": s.max_new_tokens}
+                 for si, s in enumerate(e.slots) if s.active]
+        alloc_tokens = sum(s.n_pages * e.page_size
+                           for s in e.slots if s.active)
+        used_tokens = sum(s.context_len for s in e.slots if s.active)
+        serving.append({
+            "engine": i,
+            "max_batch": e.max_batch,
+            "max_seq_len": e.max_seq_len,
+            "page_size": e.page_size,
+            "active_slots": len(slots),
+            "queue_depth": len(e._pending),
+            "warmed": bool(getattr(e, "_warmup_done", False)),
+            "poisoned": getattr(e, "_poisoned", None),
+            "kv": {
+                "pages_total": e._n_pages_total,
+                "pages_free": len(e._free_pages),
+                "occupancy": round(
+                    1.0 - len(e._free_pages) / e._n_pages_total, 4),
+                "fragmentation": round(
+                    1.0 - used_tokens / alloc_tokens, 4)
+                if alloc_tokens else 0.0,
+            },
+            "slots": slots,
+        })
+    from . import fleet as _fleet
+    from . import stepledger as _stepledger
+
+    health_code, health = health_payload(reg)
+    ready_code, ready = ready_payload()
+    cfg = _flags()
+    return {
+        "rank": rank,
+        "world_size": world,
+        "pid": os.getpid(),
+        "time": round(time.time(), 3),
+        "endpoint": advertised_address(),
+        "build": {
+            "python": sys.version.split()[0],
+            "jax": getattr(jax_mod, "__version__", None),
+            "argv": sys.argv[:3],
+        },
+        "health": {"code": health_code, **health},
+        "ready": {"code": ready_code, **ready},
+        "serving": serving,
+        "load_score": _slo.load_score(registry=reg),
+        "slo": _slo.default_engine().last_report,
+        "ledger": _stepledger.waterfall(),
+        "heartbeat": _fleet.last_beat(),
+        "flags": {name: cfg.get_flag(name)
+                  for name in sorted(cfg._FLAGS)},
+    }
+
+
+def stacks_payload() -> str:
+    """Thread stacks + open spans + the trailing flight-recorder ring:
+    the watchdog stall dump's content, on demand and without a
+    stall."""
+    from . import tracing as _tracing
+
+    lines = [
+        "paddle_tpu /debug/stacks",
+        f"pid: {os.getpid()}",
+        f"time: {time.strftime('%Y-%m-%dT%H:%M:%S%z')}",
+        "",
+        "== python thread stacks ==",
+        _flight.format_thread_stacks(),
+        "",
+        "== open spans (longest first) ==",
+    ]
+    opened = _tracing.open_spans()
+    if opened:
+        lines += [f"{tn}: {sn} ({el:.3f}s open)"
+                  for tn, sn, el in opened]
+    else:
+        lines.append("(none)")
+    rec = _flight.default_recorder()
+    lines += ["", f"== last 64 events (of {len(rec)} in ring) =="]
+    for ts, kind, fields in rec.tail(64):
+        lines.append(f"{ts:.6f} {kind} {fields}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-telemetry/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # requests must not spam stderr; scrape activity is a metric
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra: Optional[dict] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        try:
+            url = urlparse(self.path)
+            code, body, ctype, extra = self._route(
+                url.path.rstrip("/") or "/", parse_qs(url.query))
+        except BrokenPipeError:
+            return
+        except Exception as e:  # noqa: BLE001 — a handler bug must
+            # answer 500, never kill the server thread
+            code, ctype, extra = 500, "text/plain; charset=utf-8", None
+            body = f"internal error: {e!r}\n".encode()
+        try:
+            self._send(code, body, ctype, extra)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _route(self, path: str, query: dict):
+        reg = _metrics.default_registry()
+        try:
+            reg.counter(
+                "telemetry_scrapes_total",
+                "HTTP telemetry-plane requests served, by endpoint "
+                "(observability/httpd.py).",
+                labels=("endpoint",)).labels(path).inc()
+        except Exception:  # noqa: BLE001 — accounting never 500s
+            pass
+        if path == "/metrics":
+            # fresh slo_*/load gauges ride every scrape; the exposition
+            # itself is taken under the registry lock (cross-family
+            # consistency — see Registry.lock)
+            try:
+                _slo.collect()
+            except Exception:  # noqa: BLE001
+                pass
+            with reg.lock:
+                text = _metrics.to_prometheus(reg)
+            return (200, text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", None)
+        if path == "/healthz":
+            code, payload = health_payload(reg)
+            return (code, (json.dumps(payload, indent=1) + "\n")
+                    .encode(), "application/json", None)
+        if path == "/readyz":
+            code, payload = ready_payload()
+            return (code, (json.dumps(payload, indent=1) + "\n")
+                    .encode(), "application/json", None)
+        if path == "/statusz":
+            try:
+                _slo.collect()
+            except Exception:  # noqa: BLE001
+                pass
+            payload = statusz_payload(reg)
+            return (200, (json.dumps(payload, indent=1, default=repr)
+                          + "\n").encode(), "application/json", None)
+        if path == "/debug/stacks":
+            return (200, stacks_payload().encode(),
+                    "text/plain; charset=utf-8", None)
+        if path == "/debug/trace":
+            from . import tracing as _tracing
+
+            try:
+                secs = float(query.get("secs", ["60"])[0])
+            except (TypeError, ValueError):
+                secs = 60.0
+            events = _tracing.to_chrome_trace(since_s=secs)
+            return (200, json.dumps(events, indent=0).encode(),
+                    "application/json",
+                    {"Content-Disposition":
+                     f'attachment; filename="trace_last_'
+                     f'{int(secs)}s.json"'})
+        if path == "/":
+            index = ("paddle-tpu telemetry plane\n"
+                     "endpoints: /metrics /healthz /readyz /statusz "
+                     "/debug/stacks /debug/trace?secs=N\n")
+            return (200, index.encode(),
+                    "text/plain; charset=utf-8", None)
+        return (404, b"not found\n", "text/plain; charset=utf-8", None)
+
+
+class TelemetryServer:
+    """One rank's HTTP plane: a ThreadingHTTPServer on a daemon thread
+    (scrapes run concurrently with steps and never block them)."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self.httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self.httpd.serve_forever,
+                name=f"telemetry-httpd:{self.port}", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            self.httpd.shutdown()
+            t.join(timeout=5.0)
+        self.httpd.server_close()
+
+    def address(self) -> str:
+        """host:port as a peer can reach it: the concrete bind host
+        when one was given, else this host's name (best effort)."""
+        host = self.host
+        if host in ("", "0.0.0.0", "::"):
+            try:
+                host = socket.gethostname() or "127.0.0.1"
+            except OSError:
+                host = "127.0.0.1"
+        return f"{host}:{self.port}"
+
+
+_server: Optional[TelemetryServer] = None
+_server_lock = threading.Lock()
+_start_failed = False
+
+
+def server() -> Optional[TelemetryServer]:
+    return _server
+
+
+def advertised_address() -> Optional[str]:
+    """The live endpoint as host:port (fleet heartbeats carry this so
+    --scrape can discover ranks); None when the plane is off."""
+    srv = _server
+    return srv.address() if srv is not None else None
+
+
+def start_server(port: Optional[int] = None,
+                 host: str = "0.0.0.0") -> TelemetryServer:
+    """Explicit start (tools/tests): port 0 binds an ephemeral port —
+    read it back from the returned server's .port. Replaces any
+    previously started server."""
+    global _server, _start_failed
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+        srv = TelemetryServer(
+            port=port_flag() if port is None else int(port), host=host)
+        srv.start()
+        _server = srv
+        _start_failed = False
+        atexit.register(_shutdown)
+    _flight.record_event("telemetry.httpd_start", addr=srv.address())
+    return srv
+
+
+def ensure_server() -> Optional[TelemetryServer]:
+    """Lazy flag-driven start on first step telemetry (the
+    fleet-exporter pattern): one flag read when FLAGS_telemetry_port
+    is 0. A bind failure (port taken) records one flight event and
+    stands down — it must not retry every step or take the step loop
+    down."""
+    global _server, _start_failed
+    srv = _server
+    if srv is not None:
+        return srv
+    if _start_failed or not enabled():
+        return None
+    created = None
+    with _server_lock:
+        if _server is None and not _start_failed:
+            try:
+                created = TelemetryServer(port=port_flag())
+                created.start()
+                _server = created
+                atexit.register(_shutdown)
+            except OSError as e:
+                _start_failed = True
+                _flight.record_event("telemetry.httpd_bind_failed",
+                                     port=port_flag(), error=repr(e))
+                return None
+        # a racing thread may have lost to a bind failure (or to the
+        # winner): report whatever the lock-held state says — never
+        # dereference the global after release (a concurrent
+        # stop_server() could null it)
+        srv = _server
+    if created is not None:
+        _flight.record_event("telemetry.httpd_start",
+                             addr=created.address())
+    return srv
+
+
+def _shutdown():
+    global _server
+    srv, _server = _server, None
+    if srv is not None:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001 — teardown never raises
+            pass
+
+
+def stop_server():
+    _shutdown()
+
+
+def _reset_for_tests():
+    global _start_failed
+    _shutdown()
+    _start_failed = False
+    with _engines_lock:
+        _engines.clear()

@@ -122,15 +122,30 @@ class Histogram:
     def count(self) -> int:
         return self._count
 
+    def state(self) -> Tuple[list, float, int]:
+        """One consistent read for exporters: (per-bucket counts incl.
+        the trailing +Inf slot, sum, count) with count DERIVED from the
+        counts copy — a scrape concurrent with observe() can therefore
+        never expose `_bucket{+Inf}` != `_count` (the torn read that
+        makes strict exposition parsers reject a histogram). `observe`
+        bumps the bucket slot before `_sum`/`_count`, so the copy is
+        either fully pre- or post-increment per observation; `sum` may
+        lag the counts by at most the in-flight observation — a float
+        sample, not an invariant."""
+        counts = list(self._counts)
+        return counts, self._sum, sum(counts)
+
     def bucket_counts(self) -> Dict[float, int]:
         """CUMULATIVE counts keyed by upper bound (math.inf last) — the
-        Prometheus exposition shape."""
+        Prometheus exposition shape. Built from ONE `state()` copy so
+        the cumulative series is monotone even mid-observe."""
+        counts, _sum_, total = self.state()
         out = {}
         acc = 0
-        for ub, c in zip(self.buckets, self._counts):
+        for ub, c in zip(self.buckets, counts):
             acc += c
             out[ub] = acc
-        out[math.inf] = acc + self._counts[-1]
+        out[math.inf] = total
         return out
 
 
@@ -192,6 +207,16 @@ class Registry:
         # bumped by reset(): library-internal handle caches key on
         # (id(registry), generation) to notice both swaps and resets
         self.generation = 0
+
+    @property
+    def lock(self):
+        """The registry's creation RLock, exposed so a scrape can take
+        the WHOLE exposition under it (observability/httpd.py /metrics):
+        per-family locking already guarantees each family is internally
+        consistent; holding the lock across families additionally pins
+        cross-family consistency for the scrape's duration (an RLock, so
+        same-thread family iteration inside stays reentrant)."""
+        return self._lock
 
     def _get_or_create(self, name, help_, kind, labels, **kwargs):
         with self._lock:
@@ -383,13 +408,21 @@ def to_prometheus(registry: Optional[Registry] = None,
             if const_labels:
                 labels = {**labels, **const_labels}
             if fam.kind == "histogram":
-                for ub, c in cell.bucket_counts().items():
+                # ONE state() copy per cell: _bucket/_sum/_count come
+                # from the same snapshot, so a concurrent observe()
+                # cannot tear the invariant _bucket{+Inf} == _count
+                counts, hsum, total = cell.state()
+                acc = 0
+                for ub, c in zip(cell.buckets, counts):
+                    acc += c
                     le = _fmt_labels(labels, f'le="{_fmt_float(ub)}"')
-                    lines.append(f"{fam.name}_bucket{le} {c}")
+                    lines.append(f"{fam.name}_bucket{le} {acc}")
+                le = _fmt_labels(labels, 'le="+Inf"')
+                lines.append(f"{fam.name}_bucket{le} {total}")
                 ls = _fmt_labels(labels)
                 lines.append(
-                    f"{fam.name}_sum{ls} {_fmt_float(cell.sum)}")
-                lines.append(f"{fam.name}_count{ls} {cell.count}")
+                    f"{fam.name}_sum{ls} {_fmt_float(hsum)}")
+                lines.append(f"{fam.name}_count{ls} {total}")
             else:
                 lines.append(f"{fam.name}{_fmt_labels(labels)} "
                              f"{_fmt_float(cell.value)}")
@@ -450,11 +483,17 @@ def snapshot(registry: Optional[Registry] = None) -> list:
             row = {"ts": round(ts, 3), "rank": rank, "world_size": world,
                    "name": fam.name, "kind": fam.kind, "labels": labels}
             if fam.kind == "histogram":
-                row["count"] = cell.count
-                row["sum"] = cell.sum
-                row["buckets"] = {
-                    _fmt_float(ub): c
-                    for ub, c in cell.bucket_counts().items()}
+                # same single-copy discipline as to_prometheus
+                counts, hsum, total = cell.state()
+                row["count"] = total
+                row["sum"] = hsum
+                buckets = {}
+                acc = 0
+                for ub, c in zip(cell.buckets, counts):
+                    acc += c
+                    buckets[_fmt_float(ub)] = acc
+                buckets["+Inf"] = total
+                row["buckets"] = buckets
             else:
                 row["value"] = cell.value
             out.append(row)

@@ -1,0 +1,569 @@
+"""Declarative SLO engine: objectives as data, sliding-window
+compliance from histogram snapshots, SRE multi-window burn-rate alerts
+(README.md "Live telemetry plane").
+
+The metrics registry holds CUMULATIVE series — "p95 TTFT since boot" is
+useless to a router deciding where the NEXT request should go. This
+module turns the cumulative registry into windowed service-level
+answers:
+
+- **Objectives are data** (`Objective`): a latency objective names a
+  histogram family + a threshold + a target quantile ("95% of requests
+  see their first token within FLAGS_slo_ttft_p95_ms"); a ratio
+  objective names a bad-event counter and a good-event counter
+  ("serving failure events stay under FLAGS_slo_error_budget of
+  outcomes"); a health objective counts healthy evaluation ticks
+  (poison / watchdog-stall free). `default_objectives()` declares the
+  serving four: ttft_p95, decode_p50, error_rate, availability.
+- **Sliding windows from snapshots**: `tick()` appends a timestamped
+  copy of the referenced histogram bucket counts / counter values into
+  a bounded ring; window evaluation is the DELTA between now and the
+  newest snapshot at least the window old (clamped to available
+  history — `actual_s` reports the truth). Compliance over a window =
+  good / total of the delta; thresholds snap to the shared latency
+  bucket ladder (metrics.LATENCY_BUCKETS), which is why the defaults
+  (1 s, 250 ms) sit exactly on ladder rungs.
+- **Burn rate** = bad_fraction / error_budget: 1.0 burns the budget
+  exactly at the objective's horizon, 14.4 burns a 30-day budget in
+  2 days. Alert policies are the SRE multi-window pairs — `fast_burn`
+  fires when BOTH the 1x and 12x `FLAGS_slo_window_s` windows burn at
+  >= 14.4, `slow_burn` when both 6x and 72x burn at >= 6 — so a blip
+  that already recovered cannot page (the short window clears first)
+  and a slow leak still does.
+- **Export**: `collect()` evaluates and publishes
+  `slo_compliance{objective}` (over the fast-burn long window),
+  `slo_burn_rate{objective,window}`, `slo_alert{objective,policy}` and
+  the composite `serving_load_score` gauge (busy slots + queue depth +
+  KV pool pressure — the admission signal a multi-replica router
+  ranks replicas by). The gauges ride every exposition: the /metrics
+  scrape (httpd.py forces a collect), the fleet rank shard
+  (FleetExporter.flush does too), and tools/fleet_report.py renders
+  the per-rank SLO section from them.
+
+Zero-overhead contract: with the telemetry plane off (no
+FLAGS_telemetry_port, no FLAGS_telemetry_dir), `tick()` is two flag
+reads and takes NO snapshot — `snapshots_taken()` stays flat, pinned
+by tests/test_telemetry_httpd.py.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+
+# (policy, short window multiple, long window multiple, burn threshold)
+# of FLAGS_slo_window_s — at the default base 300 s this is the classic
+# SRE ladder: page on 5m+1h burning >= 14.4, ticket on 30m+6h >= 6.
+BURN_POLICIES: Tuple[Tuple[str, float, float, float], ...] = (
+    ("fast_burn", 1.0, 12.0, 14.4),
+    ("slow_burn", 6.0, 72.0, 6.0),
+)
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def base_window_s() -> float:
+    try:
+        v = float(_flags().get_flag("FLAGS_slo_window_s", 300.0))
+        return v if v > 0 else 300.0
+    except (TypeError, ValueError):
+        return 300.0
+
+
+def enabled() -> bool:
+    """The SLO engine runs whenever ANY live export path exists: the
+    HTTP plane (FLAGS_telemetry_port) or the fleet shard flusher
+    (FLAGS_telemetry_dir). Two flag reads when off."""
+    try:
+        if int(_flags().get_flag("FLAGS_telemetry_port", 0) or 0) > 0:
+            return True
+    except (TypeError, ValueError):
+        pass
+    return bool(_flags().get_flag("FLAGS_telemetry_dir", "") or "")
+
+
+# ---------------------------------------------------------------------------
+# objectives
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One service-level objective, declared as data.
+
+    kind="latency": `family` is a histogram; compliance over a window
+    is the fraction of observations <= `threshold_s`, and the target
+    compliance IS the quantile ("p95 <= 1 s" == "95% under 1 s", so
+    budget = 1 - quantile).
+
+    kind="ratio": `bad` / `good` are counter families; compliance =
+    good / (good + bad) deltas over the window; target is explicit.
+
+    kind="health": compliance = healthy ticks / total ticks recorded by
+    the engine's health callback (poison + watchdog-stall free)."""
+
+    name: str
+    kind: str                 # "latency" | "ratio" | "health"
+    family: str = ""          # latency: histogram family
+    threshold_s: float = 0.0  # latency: the budgeted latency
+    quantile: float = 0.95    # latency: target quantile (= target)
+    bad: str = ""             # ratio: bad-event counter family
+    good: str = ""            # ratio: good-event counter family
+    target: float = 0.99      # ratio/health compliance target
+
+    @property
+    def compliance_target(self) -> float:
+        return self.quantile if self.kind == "latency" else self.target
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.compliance_target, 1e-9)
+
+
+def default_objectives() -> Tuple[Objective, ...]:
+    """The serving SLOs (thresholds from the FLAGS_slo_* knobs):
+    ttft_p95 + decode_p50 latency, error_rate over failure events,
+    availability over health ticks."""
+    cfg = _flags()
+
+    def _ms(name, dflt):
+        try:
+            return float(cfg.get_flag(name, dflt)) / 1e3
+        except (TypeError, ValueError):
+            return dflt / 1e3
+
+    try:
+        budget = float(cfg.get_flag("FLAGS_slo_error_budget", 0.01))
+    except (TypeError, ValueError):
+        budget = 0.01
+    budget = min(max(budget, 1e-6), 1.0)
+    return (
+        Objective("ttft_p95", "latency", family="serving_ttft_seconds",
+                  threshold_s=_ms("FLAGS_slo_ttft_p95_ms", 1000.0),
+                  quantile=0.95),
+        Objective("decode_p50", "latency",
+                  family="serving_token_decode_seconds",
+                  threshold_s=_ms("FLAGS_slo_decode_p50_ms", 250.0),
+                  quantile=0.50),
+        Objective("error_rate", "ratio", bad="serving_errors_total",
+                  good="serving_requests_finished_total",
+                  target=1.0 - budget),
+        Objective("availability", "health", target=0.999),
+    )
+
+
+# ---------------------------------------------------------------------------
+# health primitive (shared with /healthz)
+# ---------------------------------------------------------------------------
+
+
+def hard_health(registry: Optional[_metrics.Registry] = None) -> dict:
+    """The HARD liveness verdict: engine poisoned (the
+    serving_engine_poisoned gauge — flips the moment _poison() runs) or
+    a watchdog in the stalled state. /healthz 503s on exactly these;
+    the availability objective counts ticks where they held."""
+    reg = registry or _metrics.default_registry()
+    poisoned = 0.0
+    fam = reg.get("serving_engine_poisoned")
+    if fam is not None:
+        for _labels, cell in fam.samples():
+            try:
+                poisoned = max(poisoned, float(cell.value))
+            except (TypeError, ValueError):
+                pass
+    stalled = _flight.any_stalled()
+    return {"ok": poisoned < 1.0 and not stalled,
+            "poisoned": poisoned >= 1.0, "stalled": stalled}
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+# module-wide snapshot counter: the off-path alloc guard pins it flat
+_counts = {"snapshots": 0}
+
+
+def snapshots_taken() -> int:
+    return _counts["snapshots"]
+
+
+class SloEngine:
+    """Windowed SLO evaluation over a bounded snapshot ring.
+
+    Injectable for tests: `clock` (wall seconds), `registry`
+    (None = the process default at each use), `objectives`,
+    `window_s` (None = FLAGS_slo_window_s), `health_fn`
+    (None = hard_health on the engine's registry)."""
+
+    def __init__(self, objectives: Optional[Tuple[Objective, ...]] = None,
+                 registry: Optional[_metrics.Registry] = None,
+                 clock: Callable[[], float] = time.time,
+                 window_s: Optional[float] = None,
+                 min_tick_s: float = 1.0, capacity: int = 4096,
+                 health_fn: Optional[Callable[[], bool]] = None):
+        self._objectives = objectives
+        self._registry = registry
+        self._clock = clock
+        self._window_s = window_s
+        self._min_tick_s = float(min_tick_s)
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._health_fn = health_fn
+        self._health_good = 0
+        self._health_total = 0
+        self.last_report: Optional[dict] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def _reg(self) -> _metrics.Registry:
+        return self._registry or _metrics.default_registry()
+
+    def objectives(self) -> Tuple[Objective, ...]:
+        return self._objectives if self._objectives is not None \
+            else default_objectives()
+
+    def window(self) -> float:
+        return float(self._window_s) if self._window_s else base_window_s()
+
+    def windows(self) -> List[float]:
+        b = self.window()
+        return sorted({m * b for _n, s, l, _t in BURN_POLICIES
+                       for m in (s, l)})
+
+    # -- snapshots ---------------------------------------------------------
+
+    def _hist_state(self, reg, family):
+        fam = reg.get(family)
+        if fam is None or fam.kind != "histogram":
+            return None
+        bounds = None
+        counts = None
+        for _labels, cell in fam.samples():
+            c, _s, _t = cell.state()
+            if counts is None:
+                bounds = cell.buckets
+                counts = list(c)
+            else:
+                # children of one family share the bucket schema
+                # (Registry rejects kwargs mismatches), so elementwise
+                # summation merges labeled cells
+                for i, v in enumerate(c):
+                    counts[i] += v
+        if counts is None:
+            return None
+        return (bounds, counts)
+
+    def _counter_value(self, reg, family) -> float:
+        fam = reg.get(family)
+        if fam is None:
+            return 0.0
+        total = 0.0
+        for _labels, cell in fam.samples():
+            try:
+                total += float(cell.value)
+            except (TypeError, ValueError):
+                pass
+        return total
+
+    def _snapshot(self) -> dict:
+        reg = self._reg()
+        hists: Dict[str, tuple] = {}
+        ctrs: Dict[str, float] = {}
+        needs_health = False
+        for obj in self.objectives():
+            if obj.kind == "latency":
+                st = self._hist_state(reg, obj.family)
+                if st is not None:
+                    hists[obj.family] = st
+            elif obj.kind == "ratio":
+                ctrs[obj.bad] = self._counter_value(reg, obj.bad)
+                ctrs[obj.good] = self._counter_value(reg, obj.good)
+            elif obj.kind == "health":
+                needs_health = True
+        if needs_health:
+            if self._health_fn is not None:
+                ok = bool(self._health_fn())
+            else:
+                ok = bool(hard_health(reg)["ok"])
+            self._health_total += 1
+            if ok:
+                self._health_good += 1
+        _counts["snapshots"] += 1
+        return {"ts": self._clock(), "hists": hists, "ctrs": ctrs,
+                "health": (self._health_good, self._health_total)}
+
+    def tick(self, force: bool = False) -> bool:
+        """Append a snapshot if the last one is at least `min_tick_s`
+        old (or `force`). Returns True when one was taken. Call sites
+        guard on `enabled()` — the engine itself is unconditional so
+        tests can drive it directly."""
+        with self._lock:
+            if not force and self._ring and \
+                    self._clock() - self._ring[-1]["ts"] < self._min_tick_s:
+                return False
+            self._ring.append(self._snapshot())
+            return True
+
+    # -- evaluation --------------------------------------------------------
+
+    def _baseline(self, now: float, window_s: float) -> Optional[dict]:
+        """The newest snapshot at least `window_s` old; clamps to the
+        OLDEST snapshot when history is shorter than the window (the
+        report carries `actual_s` so a clamped window is visible)."""
+        cutoff = now - window_s
+        base = None
+        for snap in self._ring:
+            if snap["ts"] <= cutoff:
+                base = snap
+            else:
+                break
+        if base is None and self._ring:
+            base = self._ring[0]
+        return base
+
+    @staticmethod
+    def _latency_delta(obj: Objective, now_st, base_st):
+        """(good, total) over the window from bucket-count deltas."""
+        if now_st is None:
+            return 0, 0
+        bounds, counts = now_st
+        if base_st is not None and base_st[0] == bounds:
+            counts = [max(a - b, 0)
+                      for a, b in zip(counts, base_st[1])]
+        total = sum(counts)
+        # threshold snaps to the first ladder rung >= threshold (the
+        # defaults sit exactly on rungs); observations at the rung are
+        # counted good (le-inclusive, matching observe()'s bisect_left).
+        # The shrink tolerance keeps a threshold computed as exactly a
+        # rung (1000 ms / 1e3) from falling PAST it on float error.
+        idx = bisect.bisect_left(bounds, obj.threshold_s * (1 - 1e-9))
+        idx = min(idx, len(bounds) - 1)
+        good = sum(counts[:idx + 1])
+        return good, total
+
+    def _eval_objective(self, obj: Objective, now: float,
+                        cur: dict) -> dict:
+        wins: Dict[str, dict] = {}
+        for w in self.windows():
+            base = self._baseline(now, w)
+            actual = now - base["ts"] if base is not None else 0.0
+            if obj.kind == "latency":
+                good, total = self._latency_delta(
+                    obj, cur["hists"].get(obj.family),
+                    base["hists"].get(obj.family)
+                    if base is not None else None)
+            elif obj.kind == "ratio":
+                bad_d = cur["ctrs"].get(obj.bad, 0.0) - (
+                    base["ctrs"].get(obj.bad, 0.0) if base else 0.0)
+                good_d = cur["ctrs"].get(obj.good, 0.0) - (
+                    base["ctrs"].get(obj.good, 0.0) if base else 0.0)
+                bad_d, good_d = max(bad_d, 0.0), max(good_d, 0.0)
+                good, total = good_d, good_d + bad_d
+            else:  # health
+                g0, t0 = base["health"] if base is not None else (0, 0)
+                g1, t1 = cur["health"]
+                good, total = max(g1 - g0, 0), max(t1 - t0, 0)
+            compliance = good / total if total else None
+            bad_frac = (1.0 - compliance) if compliance is not None \
+                else 0.0
+            wins[f"{int(w)}s"] = {
+                "window_s": w,
+                "actual_s": round(actual, 3),
+                "total": round(total, 3),
+                "good": round(good, 3),
+                "compliance": round(compliance, 6)
+                if compliance is not None else None,
+                "burn_rate": round(bad_frac / obj.budget, 4),
+            }
+        alerts = {}
+        for pname, s_mult, l_mult, thr in BURN_POLICIES:
+            b = self.window()
+            short = wins[f"{int(s_mult * b)}s"]
+            long_ = wins[f"{int(l_mult * b)}s"]
+            alerts[pname] = bool(
+                short["total"] and long_["total"]
+                and short["burn_rate"] >= thr
+                and long_["burn_rate"] >= thr)
+        # headline compliance: the fast-burn LONG window (12x base —
+        # "the SLO window"); no data reads as compliant, with total=0
+        # visible in the window row
+        headline = wins[f"{int(BURN_POLICIES[0][2] * self.window())}s"]
+        out = {"objective": obj.name, "kind": obj.kind,
+               "target": round(obj.compliance_target, 6),
+               "compliance": headline["compliance"]
+               if headline["compliance"] is not None else 1.0,
+               "met": headline["compliance"] is None
+               or headline["compliance"] >= obj.compliance_target,
+               "windows": wins, "alerts": alerts,
+               "firing": any(alerts.values())}
+        if obj.kind == "latency":
+            out["threshold_s"] = obj.threshold_s
+        return out
+
+    def evaluate(self) -> dict:
+        """Evaluate every objective over every policy window against
+        the snapshot ring (no new snapshot; call tick()/collect() for
+        that). Pure read — safe from a scrape thread."""
+        with self._lock:
+            if not self._ring:
+                return {"ts": self._clock(), "objectives": [],
+                        "load_score": load_score(
+                            registry=self._registry),
+                        "window_base_s": self.window()}
+            cur = self._ring[-1]
+            now = cur["ts"]
+            rows = [self._eval_objective(obj, now, cur)
+                    for obj in self.objectives()]
+        report = {"ts": now, "window_base_s": self.window(),
+                  "objectives": rows,
+                  "load_score": load_score(registry=self._registry),
+                  "firing": sorted(r["objective"] for r in rows
+                                   if r["firing"])}
+        self.last_report = report
+        return report
+
+    def collect(self) -> dict:
+        """tick(force) + evaluate + export the gauges — what a /metrics
+        scrape and a fleet shard flush run so their expositions carry
+        fresh slo_* samples."""
+        self.tick(force=True)
+        report = self.evaluate()
+        self.export(report)
+        return report
+
+    def export(self, report: dict,
+               registry: Optional[_metrics.Registry] = None):
+        reg = registry or self._reg()
+        comp = reg.gauge(
+            "slo_compliance",
+            "Windowed SLO compliance per objective (good fraction over "
+            "the fast-burn long window; 1.0 when the window holds no "
+            "data).", labels=("objective",))
+        burn = reg.gauge(
+            "slo_burn_rate",
+            "Error-budget burn multiple per objective and window "
+            "(1.0 = burning exactly at budget; SRE fast/slow alert "
+            "pairs evaluate these).", labels=("objective", "window"))
+        alert = reg.gauge(
+            "slo_alert",
+            "1 while the named multi-window burn-rate policy is firing "
+            "for the objective (both its windows burning above the "
+            "policy threshold).", labels=("objective", "policy"))
+        load = reg.gauge(
+            "serving_load_score",
+            "Composite admission-control load signal: busy-slot "
+            "fraction + queue depth (in units of max_batch) + KV page "
+            "pressure. 0 = idle; a multi-replica router sends the next "
+            "request to the replica with the LOWEST score.")
+        for row in report["objectives"]:
+            comp.labels(row["objective"]).set(row["compliance"])
+            for wname, wrow in row["windows"].items():
+                burn.labels(row["objective"], wname).set(
+                    wrow["burn_rate"])
+            for pname, firing in row["alerts"].items():
+                alert.labels(row["objective"], pname).set(
+                    1.0 if firing else 0.0)
+        load.set(report.get("load_score") or 0.0)
+
+
+# ---------------------------------------------------------------------------
+# load score
+# ---------------------------------------------------------------------------
+
+
+def load_score(engines=None,
+               registry: Optional[_metrics.Registry] = None) -> float:
+    """Busy slots + queue depth + KV pressure, summed over the
+    process's tracked serving engines (httpd.tracked_engines()); falls
+    back to the serving gauges when no engine object is reachable
+    (e.g. recomputing from a scraped exposition). 0.0 with no serving
+    at all — a trainer rank is 'idle' to a request router."""
+    if engines is None:
+        try:
+            from . import httpd as _httpd
+
+            engines = _httpd.tracked_engines()
+        except Exception:  # noqa: BLE001 — telemetry never raises
+            engines = []
+    if engines:
+        max_batch = sum(e.max_batch for e in engines) or 1
+        active = sum(1 for e in engines for s in e.slots if s.active)
+        queue = sum(len(e._pending) for e in engines)
+        pages = sum(e._n_pages_total for e in engines) or 1
+        free = sum(len(e._free_pages) for e in engines)
+        return round(active / max_batch + queue / max_batch
+                     + (1.0 - free / pages), 4)
+    reg = registry or _metrics.default_registry()
+
+    def _g(name):
+        fam = reg.get(name)
+        if fam is None:
+            return None
+        vals = [cell.value for _l, cell in fam.samples()]
+        return sum(vals) if vals else None
+
+    occ = _g("serving_batch_occupancy")
+    if occ is None:
+        return 0.0
+    queue = _g("serving_queue_depth") or 0.0
+    util = _g("serving_page_pool_utilization") or 0.0
+    # without the engine object max_batch is unknown; 8 (the common
+    # bench batch) keeps queue pressure on a comparable scale
+    return round(occ + queue / 8.0 + util, 4)
+
+
+# ---------------------------------------------------------------------------
+# process-global default engine + module API
+# ---------------------------------------------------------------------------
+
+_default: Optional[SloEngine] = None
+_default_lock = threading.Lock()
+
+
+def default_engine() -> SloEngine:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = SloEngine()
+    return _default
+
+
+def tick():
+    """Per-step hook (serving _step_metrics / trainer instrumented
+    step): two flag reads when the telemetry plane is off, one bounded
+    snapshot at most every min_tick_s when on."""
+    if not enabled():
+        return
+    default_engine().tick()
+
+
+def collect(force: bool = True) -> Optional[dict]:
+    """Evaluate + export now (scrape handlers, fleet flush, tools).
+    Runs even when `enabled()` is false — an explicit call (a test, an
+    ephemeral-port server) IS the opt-in."""
+    return default_engine().collect()
+
+
+def firing() -> List[str]:
+    """Objectives with a burn-rate alert currently firing (from the
+    last collect; empty before one ran)."""
+    rep = default_engine().last_report
+    return list(rep.get("firing") or []) if rep else []
+
+
+def _reset_for_tests():
+    global _default
+    _default = None
+    _counts["snapshots"] = 0

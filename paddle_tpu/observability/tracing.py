@@ -445,7 +445,8 @@ class Tracer:
 
     # -- export ------------------------------------------------------------
 
-    def to_chrome_trace(self, pid: Optional[int] = None) -> List[dict]:
+    def to_chrome_trace(self, pid: Optional[int] = None,
+                        since_s: Optional[float] = None) -> List[dict]:
         """The ring as a Chrome trace-event ARRAY (the JSON Array Format
         both Perfetto and chrome://tracing load directly). Stable field
         set per event: name/cat/ph/ts/dur/pid/tid/args ("X"), instants
@@ -453,9 +454,17 @@ class Tracer:
 
         `pid` defaults to the OS pid; the fleet exporter passes the RANK
         instead, so merged multi-rank traces render one process lane per
-        rank in the viewer (fleet.py)."""
+        rank in the viewer (fleet.py).
+
+        `since_s` keeps only spans that ENDED within the trailing
+        window — the /debug/trace?secs=N on-demand capture
+        (observability/httpd.py) downloads the last N seconds of the
+        ring without draining it."""
         pid = os.getpid() if pid is None else int(pid)
         recs = list(self._ring)
+        if since_s is not None:
+            cutoff = _clock() - float(since_s)
+            recs = [r for r in recs if r[3] >= cutoff]
         events: List[dict] = []
         seen_tids = set()
         for ph, name, t0, t1, tid, trace_id, attrs in recs:
@@ -535,8 +544,9 @@ def open_spans():
     return _default.open_spans()
 
 
-def to_chrome_trace(pid: Optional[int] = None):
-    return _default.to_chrome_trace(pid=pid)
+def to_chrome_trace(pid: Optional[int] = None,
+                    since_s: Optional[float] = None):
+    return _default.to_chrome_trace(pid=pid, since_s=since_s)
 
 
 def write_trace(path: str, pid: Optional[int] = None) -> int:

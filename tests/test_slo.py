@@ -1,0 +1,268 @@
+"""SLO engine (observability/slo.py): windowed compliance from
+histogram snapshots, burn-rate goldens, the SRE fast-burn + slow-burn
+multi-window alert pair, objective recovery, ratio/health objectives,
+gauge export, and the load score."""
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import slo
+
+# test objective set: a p95 latency SLO at 1.0 s (budget 0.05) on the
+# shared ladder, an error-rate SLO at 1% budget, and a health SLO
+TTFT = slo.Objective("ttft_p95", "latency",
+                     family="serving_ttft_seconds",
+                     threshold_s=1.0, quantile=0.95)
+ERR = slo.Objective("error_rate", "ratio", bad="serving_errors_total",
+                    good="serving_requests_finished_total",
+                    target=0.99)
+HEALTH = slo.Objective("availability", "health", target=0.999)
+
+
+def _engine(objectives, clock, reg=None, health_fn=None):
+    return slo.SloEngine(objectives=objectives, registry=reg,
+                         clock=clock, window_s=300.0, min_tick_s=0.0,
+                         health_fn=health_fn)
+
+
+class _Clock:
+    def __init__(self, t0=1_000_000.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def _row(report, name):
+    return next(r for r in report["objectives"]
+                if r["objective"] == name)
+
+
+class TestLatencyObjective:
+    def test_compliance_and_burn_golden(self):
+        reg = om.Registry()
+        clk = _Clock()
+        eng = _engine((TTFT,), clk, reg)
+        hist = reg.histogram("serving_ttft_seconds", "t")
+        eng.tick(force=True)
+        clk.t += 250.0
+        for _ in range(18):
+            hist.observe(0.5)   # good (<= 1.0 s)
+        for _ in range(2):
+            hist.observe(2.0)   # bad
+        eng.tick(force=True)
+        rep = eng.evaluate()
+        row = _row(rep, "ttft_p95")
+        w = row["windows"]["300s"]
+        assert w["total"] == 20 and w["good"] == 18
+        assert w["compliance"] == pytest.approx(0.9)
+        # bad_frac 0.1 over budget 0.05 -> burn 2.0
+        assert w["burn_rate"] == pytest.approx(2.0)
+        # 10% violations misses a p95 target
+        assert row["met"] is False
+        # 2x burn is nowhere near the 14.4 page threshold
+        assert row["alerts"] == {"fast_burn": False,
+                                 "slow_burn": False}
+
+    def test_threshold_is_le_inclusive_on_the_ladder(self):
+        reg = om.Registry()
+        clk = _Clock()
+        eng = _engine((TTFT,), clk, reg)
+        hist = reg.histogram("serving_ttft_seconds", "t")
+        eng.tick(force=True)
+        clk.t += 10.0
+        hist.observe(0.9)   # good
+        hist.observe(1.0)   # exactly the threshold rung: good (le)
+        hist.observe(1.1)   # bad
+        eng.tick(force=True)
+        w = _row(eng.evaluate(), "ttft_p95")["windows"]["300s"]
+        assert w["good"] == 2 and w["total"] == 3
+
+    def test_no_data_reads_compliant(self):
+        reg = om.Registry()
+        eng = _engine((TTFT,), _Clock(), reg)
+        eng.tick(force=True)
+        row = _row(eng.evaluate(), "ttft_p95")
+        assert row["compliance"] == 1.0 and row["met"] is True
+        assert all(w["burn_rate"] == 0.0
+                   for w in row["windows"].values())
+        assert row["windows"]["300s"]["total"] == 0
+
+
+class TestBurnAlerts:
+    def _drive(self, reg, clk, eng, hist):
+        """Good history, then a sustained 100%-bad burst: both SRE
+        pairs fire."""
+        eng.tick(force=True)                 # t0
+        clk.t += 100.0
+        for _ in range(10):
+            hist.observe(0.05)               # early good traffic
+        eng.tick(force=True)                 # t0+100
+        clk.t = clk.t - 100.0 + 3000.0
+        eng.tick(force=True)                 # t0+3000
+        clk.t += 300.0
+        for _ in range(500):
+            hist.observe(5.0)                # bad burst, part 1
+        eng.tick(force=True)                 # t0+3300
+        clk.t += 200.0
+        for _ in range(500):
+            hist.observe(5.0)                # bad burst, part 2
+        eng.tick(force=True)                 # t0+3500
+
+    def test_fast_and_slow_pairs_fire_then_recover(self):
+        reg = om.Registry()
+        clk = _Clock()
+        eng = _engine((TTFT,), clk, reg)
+        hist = reg.histogram("serving_ttft_seconds", "t")
+        self._drive(reg, clk, eng, hist)
+        row = _row(eng.evaluate(), "ttft_p95")
+        # short fast window (300s): the delta vs the t0+3000 snapshot
+        # is 1000 bad / 0 good -> burn = 1.0/0.05 = 20
+        assert row["windows"]["300s"]["burn_rate"] == \
+            pytest.approx(20.0)
+        # long fast window (3600s) clamps to the oldest snapshot:
+        # 1000 bad + 10 good -> bad_frac 1000/1010 -> burn ~19.8
+        assert row["windows"]["3600s"]["burn_rate"] == \
+            pytest.approx(1000 / 1010 / 0.05, rel=1e-3)
+        assert row["alerts"]["fast_burn"] is True
+        assert row["alerts"]["slow_burn"] is True
+        assert row["firing"] is True
+
+        # RECOVERY step 1: 400 s of good traffic — the short window
+        # clears, the long window still burns, and the multi-window
+        # rule therefore STOPS firing (a recovered blip cannot page)
+        clk.t += 400.0
+        for _ in range(100):
+            hist.observe(0.05)
+        eng.tick(force=True)
+        row = _row(eng.evaluate(), "ttft_p95")
+        assert row["windows"]["300s"]["burn_rate"] == pytest.approx(0.0)
+        assert row["windows"]["3600s"]["burn_rate"] > 14.4
+        assert row["alerts"]["fast_burn"] is False
+
+        # RECOVERY step 2: once the bad burst ages out of the fast
+        # windows entirely, headline compliance returns to 1.0
+        clk.t += 4100.0
+        for _ in range(50):
+            hist.observe(0.05)
+        eng.tick(force=True)
+        row = _row(eng.evaluate(), "ttft_p95")
+        assert row["compliance"] == pytest.approx(1.0)
+        assert row["met"] is True
+        assert row["alerts"] == {"fast_burn": False,
+                                 "slow_burn": False}
+
+
+class TestRatioAndHealth:
+    def test_error_rate_objective(self):
+        reg = om.Registry()
+        clk = _Clock()
+        eng = _engine((ERR,), clk, reg)
+        bad = reg.counter("serving_errors_total", "t")
+        good = reg.counter("serving_requests_finished_total", "t")
+        eng.tick(force=True)
+        clk.t += 200.0
+        good.inc(98)
+        bad.inc(2)
+        eng.tick(force=True)
+        w = _row(eng.evaluate(), "error_rate")["windows"]["300s"]
+        # 2 bad of 100 outcomes over a 1% budget -> burn 2.0
+        assert w["compliance"] == pytest.approx(0.98)
+        assert w["burn_rate"] == pytest.approx(2.0)
+
+    def test_health_objective_counts_ticks(self):
+        reg = om.Registry()
+        clk = _Clock()
+        state = {"ok": True}
+        eng = _engine((HEALTH,), clk, reg,
+                      health_fn=lambda: state["ok"])
+        eng.tick(force=True)
+        for _ in range(3):
+            clk.t += 10.0
+            eng.tick(force=True)
+        state["ok"] = False
+        clk.t += 10.0
+        eng.tick(force=True)
+        w = _row(eng.evaluate(), "availability")["windows"]["300s"]
+        # deltas vs the first snapshot: 4 ticks, 3 healthy
+        assert w["total"] == 4 and w["good"] == 3
+        assert w["compliance"] == pytest.approx(0.75)
+
+    def test_hard_health_reads_poison_gauge(self):
+        reg = om.Registry()
+        assert slo.hard_health(reg)["ok"] is True
+        reg.gauge("serving_engine_poisoned", "t").set(1.0)
+        h = slo.hard_health(reg)
+        assert h["ok"] is False and h["poisoned"] is True
+
+
+class TestExport:
+    def test_gauges_exported(self):
+        reg = om.Registry()
+        clk = _Clock()
+        eng = _engine((TTFT, ERR), clk, reg)
+        hist = reg.histogram("serving_ttft_seconds", "t")
+        eng.tick(force=True)
+        clk.t += 100.0
+        hist.observe(0.5)
+        eng.tick(force=True)
+        eng.export(eng.evaluate())
+        assert reg.value("slo_compliance", objective="ttft_p95") == 1.0
+        assert reg.value("slo_burn_rate", objective="ttft_p95",
+                         window="300s") == 0.0
+        assert reg.value("slo_alert", objective="ttft_p95",
+                         policy="fast_burn") == 0.0
+        # the exposition carries them (what a scrape/shard sees)
+        text = om.to_prometheus(reg, const_labels={})
+        assert 'slo_compliance{objective="error_rate"}' in text
+        assert "serving_load_score" in text
+
+    def test_default_objectives_read_flags(self):
+        prev = paddle.get_flags(["FLAGS_slo_ttft_p95_ms",
+                                 "FLAGS_slo_error_budget"])
+        paddle.set_flags({"FLAGS_slo_ttft_p95_ms": 500.0,
+                          "FLAGS_slo_error_budget": 0.05})
+        try:
+            objs = {o.name: o for o in slo.default_objectives()}
+            assert objs["ttft_p95"].threshold_s == pytest.approx(0.5)
+            assert objs["error_rate"].target == pytest.approx(0.95)
+            assert objs["error_rate"].budget == pytest.approx(0.05)
+            assert set(objs) == {"ttft_p95", "decode_p50",
+                                 "error_rate", "availability"}
+        finally:
+            paddle.set_flags(prev)
+
+
+class _FakeSlot:
+    def __init__(self, active):
+        self.active = active
+
+
+class _FakeEngine:
+    def __init__(self, max_batch, active, pending, free, total):
+        self.max_batch = max_batch
+        self.slots = [_FakeSlot(i < active) for i in range(max_batch)]
+        self._pending = [None] * pending
+        self._free_pages = list(range(free))
+        self._n_pages_total = total
+
+
+class TestLoadScore:
+    def test_from_engines(self):
+        # 2/4 slots busy + 2 queued (0.5) + half the KV pool used
+        e = _FakeEngine(max_batch=4, active=2, pending=2, free=8,
+                        total=16)
+        assert slo.load_score(engines=[e]) == pytest.approx(1.5)
+        # idle engine scores 0
+        idle = _FakeEngine(max_batch=4, active=0, pending=0, free=16,
+                           total=16)
+        assert slo.load_score(engines=[idle]) == pytest.approx(0.0)
+
+    def test_registry_fallback(self):
+        reg = om.Registry()
+        assert slo.load_score(engines=[], registry=reg) == 0.0
+        reg.gauge("serving_batch_occupancy", "t").set(0.5)
+        reg.gauge("serving_queue_depth", "t").set(4)
+        reg.gauge("serving_page_pool_utilization", "t").set(0.25)
+        assert slo.load_score(engines=[], registry=reg) == \
+            pytest.approx(0.5 + 4 / 8.0 + 0.25)

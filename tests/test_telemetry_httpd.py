@@ -1,0 +1,417 @@
+"""Live telemetry plane (observability/httpd.py): endpoint semantics
+against the REAL ServingEngine (readyz 503-before-warmup, healthz
+poison flip within one request), scrape consistency under concurrent
+stepping, the zero-overhead off path, fleet endpoint advertisement,
+and the live-scrape -> fleet report round trip."""
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import fleet as fleet_mod
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.observability import httpd
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import slo, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Fresh plane per test; neutralize poison-gauge leakage from
+    other suites (test_memwatch poisons engines into the process
+    default registry on purpose)."""
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+    om.default_registry().gauge("serving_engine_poisoned").set(0.0)
+    yield
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+    om.default_registry().gauge("serving_engine_poisoned").set(0.0)
+
+
+def _tiny_engine(**kw):
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           seq=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("page_size", 8)
+    return ServingEngine(m, **kw), cfg
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _server():
+    srv = httpd.start_server(port=0, host="127.0.0.1")
+    return srv, f"http://127.0.0.1:{srv.port}"
+
+
+def _assert_exposition_consistent(text):
+    """Every histogram in a scrape must satisfy: cumulative bucket
+    series nondecreasing and _count == the +Inf bucket — the invariant
+    Histogram.state() pins even mid-observe."""
+    samples = fleet_mod._parse_prom_samples(text)
+    assert samples, "unparseable exposition"
+    by_hist = {}
+    for name, rows in samples.items():
+        if name.endswith("_bucket"):
+            for lab, v in rows:
+                key = (name[:-len("_bucket")],
+                       tuple(sorted((k, v2) for k, v2 in lab.items()
+                                    if k != "le")))
+                by_hist.setdefault(key, {})[float(
+                    lab["le"].replace("+Inf", "inf"))] = v
+    for (hname, lab), buckets in by_hist.items():
+        ubs = sorted(buckets)
+        series = [buckets[u] for u in ubs]
+        assert series == sorted(series), \
+            f"{hname}{lab}: non-monotone buckets {series}"
+        counts = samples.get(hname + "_count", [])
+        for clab, cval in counts:
+            ckey = tuple(sorted((k, v) for k, v in clab.items()))
+            if ckey == lab:
+                assert cval == buckets[float("inf")], \
+                    f"{hname}: _count {cval} != +Inf bucket " \
+                    f"{buckets[float('inf')]}"
+    return samples
+
+
+class TestEndpoints:
+    def test_readyz_503_before_warmup_200_after(self):
+        """Bugfix guard (real engine): a router must not get traffic
+        admitted before warmup() prepays the compiles."""
+        eng, _cfg = _tiny_engine()
+        _srv, base = _server()
+        code, body = _get(base, "/readyz")
+        assert code == 503
+        payload = json.loads(body)
+        assert payload["status"] == "unready"
+        assert payload["engines"][0]["warmed"] is False
+        eng.warmup()
+        code, body = _get(base, "/readyz")
+        assert code == 200
+        assert json.loads(body)["engines"][0]["warmed"] is True
+
+    def test_readyz_503_on_kv_exhaustion_and_poison(self):
+        eng, _cfg = _tiny_engine()
+        eng._warmup_done = True  # isolate the KV check
+        code, _p = httpd.ready_payload()
+        assert code == 200
+        free, eng._free_pages = eng._free_pages, []
+        code, payload = httpd.ready_payload()
+        assert code == 503 and \
+            payload["engines"][0]["kv_pages_free"] == 0
+        eng._free_pages = free
+        eng._poisoned = "test"
+        code, payload = httpd.ready_payload()
+        assert code == 503 and payload["engines"][0]["poisoned"]
+
+    def test_healthz_flips_503_within_one_request_of_poison(self):
+        """Bugfix guard (real engine): _poison() sets the gauge
+        synchronously, so the very next /healthz must 503."""
+        eng, _cfg = _tiny_engine()
+        _srv, base = _server()
+        code, _b = _get(base, "/healthz")
+        assert code == 200
+        eng._poison("test: injected")
+        code, body = _get(base, "/healthz")
+        assert code == 503
+        payload = json.loads(body)
+        assert payload["status"] == "unhealthy"
+        assert payload["checks"]["poisoned"]["ok"] is False
+
+    def test_healthz_watchdog_stall_and_recovery(self, tmp_path):
+        wd = flight.Watchdog(deadline=30.0, dump_dir=str(tmp_path),
+                             name="httpd-test")
+        wd.start()
+        try:
+            code, _b = httpd.health_payload()
+            assert code == 200
+            wd._stalled = True  # what a missed deadline sets
+            assert flight.any_stalled()
+            code, payload = httpd.health_payload()
+            assert code == 503
+            assert payload["checks"]["watchdog"]["ok"] is False
+            wd.beat()  # a beat re-arms -> healthy again
+            code, _b = httpd.health_payload()
+            assert code == 200
+        finally:
+            wd.stop()
+
+    def test_healthz_heartbeat_staleness_opt_in(self):
+        import time as time_mod
+
+        prev_hb = dict(fleet_mod._hb)
+        prev = paddle.get_flags(["FLAGS_healthz_stale_s"])
+        try:
+            fleet_mod._hb.update(
+                {"step": 7, "beats": 3, "ts": time_mod.time() - 60.0})
+            # default: age reported, never fatal (idle engine != dead)
+            code, payload = httpd.health_payload()
+            assert code == 200
+            assert payload["checks"]["heartbeat"]["age_s"] >= 59.0
+            paddle.set_flags({"FLAGS_healthz_stale_s": 1.0})
+            code, payload = httpd.health_payload()
+            assert code == 503
+            assert payload["checks"]["heartbeat"]["ok"] is False
+        finally:
+            paddle.set_flags(prev)
+            fleet_mod._hb.update(prev_hb)
+
+    def test_metrics_statusz_stacks_and_trace_window(self):
+        eng, cfg = _tiny_engine()
+        _srv, base = _server()
+        prev = paddle.get_flags(["FLAGS_trace_sample"])
+        paddle.set_flags({"FLAGS_trace_sample": 1.0})
+        try:
+            rng = np.random.RandomState(0)
+            eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                            max_new_tokens=3)
+            # scrape CONCURRENTLY with live decode steps: every
+            # response must be a consistent exposition (the
+            # scrape-while-stepping stress, over HTTP)
+            results = []
+
+            def scraper():
+                for _ in range(20):
+                    code, body = _get(base, "/metrics")
+                    results.append((code, body))
+
+            t = threading.Thread(target=scraper)
+            t.start()
+            finished = eng.run()
+            t.join()
+            assert len(finished) == 1
+            for code, body in results:
+                assert code == 200
+                _assert_exposition_consistent(body.decode())
+            # the final scrape carries serving + slo families
+            code, body = _get(base, "/metrics")
+            samples = _assert_exposition_consistent(body.decode())
+            assert "serving_tokens_total" in samples
+            objectives = {lab.get("objective") for lab, _v in
+                          samples.get("slo_compliance", [])}
+            assert {"ttft_p95", "decode_p50", "error_rate",
+                    "availability"} <= objectives
+            assert samples.get("slo_burn_rate")
+            assert samples.get("serving_load_score")
+            assert samples.get("telemetry_scrapes_total")
+            # /statusz: engine + ledger + slo + flags in one JSON
+            code, body = _get(base, "/statusz")
+            assert code == 200
+            status = json.loads(body)
+            assert status["serving"][0]["kv"]["pages_total"] == \
+                eng._n_pages_total
+            assert status["ready"]["code"] in (200, 503)
+            assert "FLAGS_telemetry_port" in status["flags"]
+            assert status["slo"] is not None
+            # /debug/stacks names at least this thread
+            code, body = _get(base, "/debug/stacks")
+            assert code == 200
+            assert "python thread stacks" in body.decode()
+            # /debug/trace?secs=N window capture: recent spans present,
+            # a zero-width window empty; response is a download
+            code, body = _get(base, "/debug/trace?secs=600")
+            events = json.loads(body)
+            assert isinstance(events, list)
+            assert any(e.get("ph") == "X" for e in events)
+            code, body = _get(base, "/debug/trace?secs=0.000001")
+            assert all(e.get("ph") == "M" for e in json.loads(body))
+            # unknown path -> 404
+            code, _b = _get(base, "/nope")
+            assert code == 404
+        finally:
+            paddle.set_flags(prev)
+
+    def test_load_score_tracks_engine_state(self):
+        eng, cfg = _tiny_engine()
+        assert slo.load_score() == pytest.approx(0.0)
+        rng = np.random.RandomState(0)
+        eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                        max_new_tokens=3)
+        # queued but not admitted: queue term only
+        assert slo.load_score() == pytest.approx(1 / 2, abs=1e-6)
+        eng.run()
+        assert slo.load_score() == pytest.approx(0.0)
+
+
+class TestScrapeConsistency:
+    def test_concurrent_scrape_registry_invariants(self):
+        """The registry-level half of the thread-safety audit: a tight
+        observe/inc/set loop races to_prometheus + snapshot; every
+        exposition must parse with monotone buckets, _count == +Inf,
+        and counters monotone ACROSS scrapes."""
+        reg = om.Registry()
+        hist = reg.histogram("h_seconds", "t")
+        ctr = reg.counter("c_total", "t")
+        gauge = reg.gauge("g", "t")
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                hist.observe(0.001 * (i % 7))
+                ctr.inc()
+                gauge.set(i)
+                i += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            last_ctr = 0.0
+            for _ in range(200):
+                with reg.lock:
+                    text = om.to_prometheus(reg, const_labels={})
+                try:
+                    samples = _assert_exposition_consistent(text)
+                    cval = samples["c_total"][0][1]
+                    assert cval >= last_ctr, "counter went backwards"
+                    last_ctr = cval
+                    # snapshot() holds the same invariant
+                    for row in om.snapshot(reg):
+                        if row["kind"] == "histogram":
+                            assert row["buckets"]["+Inf"] == \
+                                row["count"]
+                except AssertionError as e:
+                    errors.append(str(e))
+                    break
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors[0]
+
+    def test_histogram_state_consistency_unit(self):
+        h = om.Histogram()
+        for v in (0.001, 0.5, 100.0):
+            h.observe(v)
+        counts, hsum, total = h.state()
+        assert total == 3 == h.count
+        assert hsum == pytest.approx(h.sum)
+        assert h.bucket_counts()[float("inf")] == 3
+
+
+class TestOffPathAndFleet:
+    def test_port_zero_is_one_flag_read_no_allocs(self):
+        """FLAGS_telemetry_port=0: no server, no SLO snapshots, zero
+        registry/span allocations across live decode steps."""
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(0)
+        eng.add_request(rng.randint(0, cfg.vocab_size, (5,)),
+                        max_new_tokens=3)
+        eng.step()  # first step pays prefill/compile allocations
+        reg = om.default_registry()
+        tracer = tracing.default_tracer()
+        a0 = reg.allocations
+        s0 = tracer.spans_created
+        snaps0 = slo.snapshots_taken()
+        while eng.has_work():
+            eng.step()
+        assert httpd.ensure_server() is None
+        assert httpd.server() is None
+        assert reg.allocations == a0
+        assert tracer.spans_created == s0
+        assert slo.snapshots_taken() == snaps0
+
+    def test_slo_ticks_when_plane_enabled(self, tmp_path):
+        prev = paddle.get_flags(["FLAGS_telemetry_dir"])
+        paddle.set_flags({"FLAGS_telemetry_dir": str(tmp_path)})
+        try:
+            snaps0 = slo.snapshots_taken()
+            slo.tick()
+            assert slo.snapshots_taken() == snaps0 + 1
+        finally:
+            paddle.set_flags(prev)
+            fleet_mod._reset_for_tests()
+
+    def test_heartbeat_advertises_endpoint(self, tmp_path):
+        srv, _base = _server()
+        reg = om.Registry()
+        exp = fleet_mod.FleetExporter(
+            str(tmp_path), rank=0, world_size=1, interval=60.0,
+            registry=reg, tracer=tracing.Tracer(),
+            recorder=flight.FlightRecorder(),
+            log=fleet_mod.CollectiveLog())
+        exp.flush()
+        hb = json.load(open(tmp_path / "rank_0" / "heartbeat.json"))
+        assert hb["endpoint"] == srv.address()
+        assert hb["endpoint"].endswith(f":{srv.port}")
+        # endpoints_from_heartbeats discovers it for --scrape auto
+        assert fleet_mod.endpoints_from_heartbeats(str(tmp_path)) == \
+            [srv.address()]
+
+    def test_scrape_to_shards_and_report_section(self, tmp_path):
+        _srv, base = _server()
+        # prime slo gauges through a real scrape path
+        code, _b = _get(base, "/metrics")
+        assert code == 200
+        out = str(tmp_path / "live")
+        res = fleet_mod.scrape_to_shards([base], out)
+        assert list(res) == [0] and "shard" in res[0]
+        shard = res[0]["shard"]
+        assert os.path.exists(os.path.join(shard, "metrics.prom"))
+        assert os.path.exists(os.path.join(shard, "healthz.json"))
+        assert os.path.exists(os.path.join(shard, "heartbeat.json"))
+        report = fleet_mod.aggregate(out)
+        assert report["slo"], "scraped shard yielded no SLO rows"
+        objs = {r["objective"] for r in report["slo"]}
+        assert "ttft_p95" in objs
+        text = fleet_mod.format_report(report)
+        assert "SLO compliance per rank" in text
+        # a dead endpoint is reported, not fatal
+        res = fleet_mod.scrape_to_shards(
+            ["127.0.0.1:1"], str(tmp_path / "dead"))
+        assert all("error" in v for v in res.values())
+        # two endpoints claiming the same rank label (replicas started
+        # by hand, both rank=0) must land in DISTINCT shards, not
+        # silently overwrite each other
+        res = fleet_mod.scrape_to_shards([base, base],
+                                         str(tmp_path / "dup"))
+        assert sorted(res) == [0, 1]
+        assert all("shard" in v for v in res.values())
+
+    def test_slo_table_burn_and_alert_parse(self, tmp_path):
+        shard = tmp_path / "rank_3"
+        shard.mkdir()
+        (shard / "metrics.prom").write_text(
+            'slo_compliance{objective="ttft_p95",rank="3"} 0.9\n'
+            'slo_burn_rate{objective="ttft_p95",window="300s",'
+            'rank="3"} 20\n'
+            'slo_burn_rate{objective="ttft_p95",window="3600s",'
+            'rank="3"} 15\n'
+            'slo_alert{objective="ttft_p95",policy="fast_burn",'
+            'rank="3"} 1\n'
+            'serving_load_score{rank="3"} 2.5\n')
+        rows = fleet_mod.slo_table({3: str(shard)})
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["rank"] == 3 and r["compliance"] == 0.9
+        assert r["worst_burn"] == 20 and r["worst_window"] == "300s"
+        assert r["alerts"] == ["fast_burn"]
+        assert r["load_score"] == 2.5
+        report = {"shards": {3: str(shard)}, "ranks": [], "dead": [],
+                  "missing": [], "stragglers": [],
+                  "straggler_summary": [],
+                  "hbm": {"ranks": [], "skewed": []},
+                  "ledger": [], "slo": rows, "artifacts": {},
+                  "root": str(tmp_path)}
+        text = fleet_mod.format_report(report)
+        assert "SLO ALERT: rank 3 ttft_p95 fast_burn" in text

@@ -83,15 +83,20 @@ fi
 # additionally enforces the memory & compile observability gate
 # (ISSUE 6): the smoke warms up, then must show ZERO serving decode
 # recompiles after warmup (fails loudly with the compilewatch storm
-# report) and a non-empty memory exposition (/tmp/ci_memory.prom)
+# report) and a non-empty memory exposition (/tmp/ci_memory.prom).
+# --http (ISSUE 8) additionally boots the live telemetry plane on an
+# ephemeral port and gates the endpoints: /readyz 503 before warmup /
+# 200 after, /metrics 200 + parseable exposition with at least one
+# evaluated SLO objective carrying a burn-rate gauge, /statusz JSON,
+# and /healthz flipping 200 -> 503 across an injected engine poison
 if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_trace_sample=1 \
     FLAGS_memwatch=1 FLAGS_compilewatch=1 FLAGS_stepledger=1 \
     python tools/serving_metrics_snapshot.py \
       --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json \
-      --mem /tmp/ci_memory.prom; then
+      --mem /tmp/ci_memory.prom --http; then
   echo "CI: traced serving smoke FAILED (workload, zero-decode-" \
-       "recompiles-after-warmup gate, or empty memory exposition —" \
-       "see the compilewatch report above)" >&2
+       "recompiles-after-warmup gate, empty memory exposition, or a" \
+       "live-telemetry endpoint gate — see the report above)" >&2
   rc=1
 elif ! timeout 120 env JAX_PLATFORMS=cpu \
     python tools/trace_report.py /tmp/ci_trace.json; then
@@ -160,9 +165,12 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
 fi
 
 # fleet telemetry smoke: 2 ranks export rank shards with staggered
-# synthetic collectives; the smoke asserts shard layout + that the
-# aggregator names the injected straggler + merged-trace pid lanes,
-# then fleet_report.py --require-skew re-runs the analysis as the
+# synthetic collectives AND live per-rank telemetry endpoints; the
+# smoke asserts shard layout + that the aggregator names the injected
+# straggler + merged-trace pid lanes + the live-scrape round trip
+# (fleet_report.py --scrape ep0,ep1 --require-slo against the running
+# workers must print a per-rank SLO section naming every rank), then
+# fleet_report.py --require-skew re-runs the analysis as the
 # user-facing gate (exit 2 on no shards / empty skew table)
 if ! timeout 600 env JAX_PLATFORMS=cpu \
     python tools/fleet_smoke.py --dir /tmp/ci_fleet; then

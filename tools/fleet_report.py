@@ -14,7 +14,19 @@ Loads every `rank_<i>/` shard under a `FLAGS_telemetry_dir` root
   per-rank peak device-memory utilization vs the fleet median ("rank 3
   peak 92.0% vs fleet median 71.0%") — the skewed rank is the one that
   OOMs first, and expert/sequence imbalance shows up here before it
-  shows up as a crash.
+  shows up as a crash;
+- the per-rank SLO table (observability/slo.py): compliance, worst
+  burn rate + window, and firing burn alerts per objective, plus the
+  rank's serving_load_score — the signals an SLO-aware router ranks
+  replicas by.
+
+`--scrape host:port,host:port` pulls LIVE /metrics (+ healthz/readyz/
+statusz) from per-rank telemetry-plane endpoints
+(observability/httpd.py, FLAGS_telemetry_port) and lays them out as
+rank shards under the root before aggregating — the same report, from
+running engines instead of (or alongside) flushed files. `--scrape
+auto` discovers endpoints from the heartbeats the shards under the
+root already carry.
 
 Artifacts written next to the shards (or --out-dir): `fleet.prom` (one
 Prometheus exposition, every sample rank-labeled) and
@@ -23,9 +35,11 @@ load in Perfetto directly).
 
     python tools/fleet_report.py /tmp/ci_fleet
     python tools/fleet_report.py /tmp/ci_fleet --require-skew  # CI gate
+    python tools/fleet_report.py /tmp/live --scrape rank0:9100,rank1:9101
 
-Exit codes: 0 = report printed, 2 = no shards found (or, with
---require-skew, an empty skew table — CI treats both as red).
+Exit codes: 0 = report printed, 2 = no shards found / nothing scraped
+(or, with --require-skew, an empty skew table; with --require-slo, an
+empty SLO table — CI treats these as red).
 """
 from __future__ import annotations
 
@@ -52,10 +66,45 @@ def main(argv=None) -> int:
     ap.add_argument("--require-skew", action="store_true",
                     help="exit 2 when no cross-rank collective "
                          "sequences aligned (CI gate)")
+    ap.add_argument("--require-slo", action="store_true",
+                    help="exit 2 when no rank exported an evaluated "
+                         "SLO objective (CI gate for the live "
+                         "telemetry plane)")
+    ap.add_argument("--scrape", default=None, metavar="EP,EP,...",
+                    help="comma-separated live telemetry endpoints "
+                         "(host:port or URLs; observability/httpd.py) "
+                         "to pull /metrics from INTO the root before "
+                         "aggregating, or 'auto' to discover them "
+                         "from the shards' heartbeat endpoints")
     args = ap.parse_args(argv)
 
     from paddle_tpu.observability import fleet
 
+    if args.scrape:
+        if args.scrape.strip().lower() == "auto":
+            eps = fleet.endpoints_from_heartbeats(args.root)
+            if not eps:
+                print(f"fleet_report: --scrape auto found no live "
+                      f"endpoints in the heartbeats under {args.root} "
+                      f"(was FLAGS_telemetry_port set on the job?)",
+                      file=sys.stderr)
+                return 2
+        else:
+            eps = [e for e in args.scrape.split(",") if e.strip()]
+        scraped = fleet.scrape_to_shards(eps, args.root)
+        ok = {r: v for r, v in scraped.items() if "shard" in v}
+        for _r, v in sorted(scraped.items()):
+            if "error" in v:
+                print(f"fleet_report: scrape of {v['endpoint']} "
+                      f"FAILED: {v['error']}", file=sys.stderr)
+        if not ok:
+            print(f"fleet_report: none of the {len(eps)} endpoints "
+                  f"could be scraped", file=sys.stderr)
+            return 2
+        print(f"scraped {len(ok)}/{len(eps)} live endpoints into "
+              f"{args.root}: "
+              + ", ".join(f"rank {r} <- {v['endpoint']}"
+                          for r, v in sorted(ok.items())))
     report = fleet.aggregate(args.root, out_dir=args.out_dir,
                              stale_s=args.stale_s, top=args.top)
     if not report["shards"]:
@@ -67,6 +116,11 @@ def main(argv=None) -> int:
     if args.require_skew and not report["stragglers"]:
         print("fleet_report: --require-skew and the skew table is "
               "empty", file=sys.stderr)
+        return 2
+    if args.require_slo and not report["slo"]:
+        print("fleet_report: --require-slo and no rank exported an "
+              "evaluated SLO objective (slo_compliance samples "
+              "missing from the shards)", file=sys.stderr)
         return 2
     return 0
 

@@ -9,11 +9,17 @@ then aggregates and sanity-checks the merged view:
   (rank 1 sleeps before every collective, the others after — same
   per-step period, so only the collective ENTER times drift);
 - the merged trace is a valid Chrome trace-event array with one pid
-  lane per rank.
+  lane per rank;
+- the LIVE telemetry plane round trip (ISSUE 8): every worker boots a
+  per-rank HTTP endpoint (observability/httpd.py, ephemeral port,
+  advertised via its heartbeat), and while the workers are still
+  alive the parent runs `tools/fleet_report.py --scrape ep0,ep1
+  --require-slo` against them — the scraped report must contain a
+  non-empty per-rank SLO section naming every rank.
 
 tools/ci.sh then re-runs the analysis through tools/fleet_report.py
 --require-skew as the user-facing gate. Artifacts stay under --dir
-(default /tmp/ci_fleet).
+(default /tmp/ci_fleet; the live-scrape shards under <dir>/live).
 
     python tools/fleet_smoke.py --dir /tmp/ci_fleet
 """
@@ -52,22 +58,43 @@ def _ready_barrier(rank: int, world: int, tdir: str,
 
 
 def worker(rank: int, world: int, tdir: str) -> int:
-    """One synthetic rank: staggered collectives + heartbeats."""
+    """One synthetic rank: staggered collectives + heartbeats + a live
+    telemetry endpoint that stays up until the parent finishes its
+    --scrape pass."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed import collective as coll
-    from paddle_tpu.observability import fleet
+    from paddle_tpu.observability import fleet, httpd, slo
+    from paddle_tpu.observability import metrics as om
 
+    # the live plane: ephemeral port on loopback; the heartbeat carries
+    # the address so the parent can discover it from the shard
+    httpd.start_server(port=0, host="127.0.0.1")
+    # synthetic serving signal so the SLO engine has an objective to
+    # evaluate (50 ms "TTFT" per step — well inside the 1 s budget)
+    ttft = om.default_registry().histogram(
+        "serving_ttft_seconds",
+        "Time from add_request() to the request's first committed "
+        "token (queue wait + prefill).")
     x = paddle.to_tensor(np.ones((1024,), np.float32))
     _ready_barrier(rank, world, tdir)
     for step in range(N_STEPS):
         if rank == STRAGGLER_RANK:
             time.sleep(STEP_S)  # late INTO the collective every step
         coll.all_reduce(x)
+        ttft.observe(0.05)
         fleet.heartbeat(step)
+        slo.tick()
         if rank != STRAGGLER_RANK:
             time.sleep(STEP_S)  # same period, on-time into the next op
+    fleet.flush_now()
+    # hold the endpoint open for the parent's live scrape; the parent
+    # touches .scrape_done when it is through
+    deadline = time.time() + 120.0
+    done = os.path.join(tdir, ".scrape_done")
+    while time.time() < deadline and not os.path.exists(done):
+        time.sleep(0.05)
     fleet.flush_now()
     return 0
 
@@ -100,6 +127,47 @@ def main(argv=None) -> int:
             [sys.executable, os.path.abspath(__file__),
              "--worker", str(rank), "--ranks", str(args.ranks),
              "--dir", args.dir], env=env))
+
+    # ---- live-scrape phase (workers still running) -------------------
+    # discover each rank's telemetry endpoint from the heartbeat it
+    # flushes, then run the user-facing scrape gate against the LIVE
+    # engines: fleet_report --scrape must produce a non-empty per-rank
+    # SLO section. The .scrape_done file releases the workers after.
+    done_file = os.path.join(args.dir, ".scrape_done")
+    scrape_rc, scrape_out = 1, ""
+    try:
+        endpoints = {}
+        deadline = time.time() + 120.0
+        while time.time() < deadline and len(endpoints) < args.ranks:
+            for rank in range(args.ranks):
+                hb_path = os.path.join(args.dir, f"rank_{rank}",
+                                       "heartbeat.json")
+                try:
+                    with open(hb_path) as f:
+                        hb = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if hb.get("endpoint"):
+                    endpoints[rank] = hb["endpoint"]
+            if len(endpoints) < args.ranks:
+                time.sleep(0.1)
+        if len(endpoints) == args.ranks:
+            live_dir = os.path.join(args.dir, "live")
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fleet_report.py"),
+                 live_dir, "--scrape",
+                 ",".join(endpoints[r] for r in sorted(endpoints)),
+                 "--require-slo"],
+                capture_output=True, text=True, timeout=120)
+            scrape_rc, scrape_out = r.returncode, r.stdout + r.stderr
+        else:
+            scrape_out = (f"only {len(endpoints)}/{args.ranks} live "
+                          f"endpoints appeared in heartbeats")
+    finally:
+        open(done_file, "w").close()  # release the workers either way
+
     rcs = []
     for p in procs:
         try:
@@ -161,6 +229,24 @@ def main(argv=None) -> int:
             print(f"fleet smoke FAILED: merged exposition has no "
                   f'rank="{rank}" samples', file=sys.stderr)
             return 1
+    # live-scrape gate: the mid-run fleet_report --scrape --require-slo
+    # must have succeeded with every rank in its SLO section
+    if scrape_rc != 0:
+        print(f"fleet smoke FAILED: live --scrape gate rc={scrape_rc}:"
+              f"\n{scrape_out[-2000:]}", file=sys.stderr)
+        return 1
+    if "SLO compliance per rank" not in scrape_out:
+        print(f"fleet smoke FAILED: scraped report has no per-rank "
+              f"SLO section:\n{scrape_out[-2000:]}", file=sys.stderr)
+        return 1
+    # the flushed shards carry the same slo_* gauges — the shard-based
+    # report's SLO table must name every rank too
+    slo_ranks = {r["rank"] for r in report.get("slo", [])}
+    if slo_ranks != set(range(args.ranks)):
+        print(f"fleet smoke FAILED: shard SLO table covers ranks "
+              f"{sorted(slo_ranks)}, want {list(range(args.ranks))}",
+              file=sys.stderr)
+        return 1
     print(f"fleet smoke OK: {args.ranks} shards, top skew "
           f"{rows[0]['skew_s'] * 1e3:.1f} ms on {rows[0]['op']} "
           f"#{rows[0]['seq']} (rank {rows[0]['last_rank']}), "

@@ -18,15 +18,64 @@ then FAILS (exit 1, storm report on stderr) if any serving decode
 program recompiled after warmup — the zero-decode-recompiles half of
 the gate: in-traffic decode compiles are exactly the latency cliff
 warmup exists to prepay.
+
+`--url http://host:port` skips the smoke entirely and snapshots a LIVE
+engine's /metrics exposition into --out (the telemetry plane,
+observability/httpd.py) — one tool covers files and live endpoints.
+
+`--http` boots the telemetry plane on an ephemeral port during the
+smoke and gates the endpoints end to end: /readyz must be 503 BEFORE
+warmup and 200 after, /metrics must be a parseable exposition carrying
+at least one evaluated SLO objective with a burn-rate gauge, /statusz
+must be JSON with the engine's state, and an injected poison must flip
+/healthz 200 -> 503 within one request (the ISSUE-8 acceptance gates,
+wired into tools/ci.sh's traced smoke).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _http_get(base, path, timeout=10.0):
+    # one HTTP-fetch implementation repo-wide (503 bodies preserved)
+    from paddle_tpu.observability import fleet
+
+    return fleet._http_get(base + path, timeout=timeout)
+
+
+def snapshot_url(url: str, out: str) -> int:
+    """Scrape a live endpoint's /metrics into `out` (exit 0/2)."""
+    from paddle_tpu.observability import fleet
+    from paddle_tpu.observability import metrics as om
+
+    base = fleet.normalize_endpoint(url)
+    try:
+        code, body = fleet._http_get(base + "/metrics")
+    except Exception as e:  # noqa: BLE001
+        print(f"live snapshot FAILED: {base}/metrics unreachable: "
+              f"{e!r}", file=sys.stderr)
+        return 2
+    if code != 200:
+        print(f"live snapshot FAILED: {base}/metrics returned {code}",
+              file=sys.stderr)
+        return 2
+    text = body.decode("utf-8", "replace")
+    samples = fleet._parse_prom_samples(text)
+    if not samples:
+        print(f"live snapshot FAILED: {base}/metrics yielded no "
+              f"parseable samples", file=sys.stderr)
+        return 2
+    om.atomic_write(out, text)
+    print(f"live snapshot OK: {len(samples)} families, "
+          f"{len(text.splitlines())} exposition lines from {base} -> "
+          f"{out}")
+    return 0
 
 
 def main():
@@ -47,7 +96,20 @@ def main():
                          "under this fleet telemetry dir "
                          "(FLAGS_telemetry_dir) into --out — composes "
                          "this tool with fleet output")
+    ap.add_argument("--url", default=None, metavar="URL",
+                    help="skip the smoke: scrape a LIVE engine's "
+                         "/metrics (observability/httpd.py endpoint, "
+                         "http://host:port) into --out")
+    ap.add_argument("--http", action="store_true",
+                    help="boot the telemetry plane on an ephemeral "
+                         "port during the smoke and gate /metrics + "
+                         "/healthz (200 -> 503 across an injected "
+                         "poison) + /readyz (503 before warmup, 200 "
+                         "after) + /statusz (CI live-endpoint gate)")
     args = ap.parse_args()
+
+    if args.url:
+        return snapshot_url(args.url, args.out)
 
     if args.merge:
         from paddle_tpu.observability import fleet
@@ -87,11 +149,30 @@ def main():
     model = LlamaForCausalLM(cfg)
     model.eval()
     engine = ServingEngine(model, max_batch=2, max_seq_len=32, page_size=8)
-    if compilewatch.enabled():
+    http_base = None
+    if args.http:
+        from paddle_tpu.observability import httpd as httpd_mod
+
+        srv = httpd_mod.start_server(port=0, host="127.0.0.1")
+        http_base = f"http://127.0.0.1:{srv.port}"
+        # readiness contract: 503 until warmup() completes — a router
+        # admitting traffic earlier would eat the compile cliff
+        code, _b = _http_get(http_base, "/readyz")
+        if code != 503:
+            print(f"http gate FAILED: /readyz before warmup returned "
+                  f"{code}, want 503", file=sys.stderr)
+            return 1
+    if compilewatch.enabled() or args.http:
         # prepay the decode programs and mark warmup done — every
         # serving compile after this point is an in-traffic recompile,
         # and the steady-state gate below requires ZERO on decode
         engine.warmup()
+    if http_base:
+        code, body = _http_get(http_base, "/readyz")
+        if code != 200:
+            print(f"http gate FAILED: /readyz after warmup returned "
+                  f"{code} ({body[:200]!r}), want 200", file=sys.stderr)
+            return 1
     reg = om.default_registry()
     # delta-based: warmup (when compilewatch is on) ran its own
     # throwaway request through these counters already
@@ -172,11 +253,63 @@ def main():
         # as a liveness artifact
         print(memwatch.report_text(top=10), end="")
         mem_note = f"; {n_mem} memory samples -> {args.mem}"
+    http_note = ""
+    if http_base:
+        from paddle_tpu.observability import fleet
+        from paddle_tpu.observability import httpd as httpd_mod
+
+        # live scrape: parseable exposition with at least one evaluated
+        # SLO objective carrying a burn-rate gauge (ISSUE-8 acceptance)
+        code, body = _http_get(http_base, "/metrics")
+        text = body.decode("utf-8", "replace")
+        samples = fleet._parse_prom_samples(text)
+        if code != 200 or not samples:
+            print(f"http gate FAILED: /metrics code {code}, "
+                  f"{len(samples)} families", file=sys.stderr)
+            return 1
+        objectives = {lab.get("objective")
+                      for lab, _v in samples.get("slo_compliance", [])}
+        burn_objs = {lab.get("objective")
+                     for lab, _v in samples.get("slo_burn_rate", [])}
+        if not (objectives and objectives & burn_objs):
+            print(f"http gate FAILED: no evaluated SLO objective with "
+                  f"a burn-rate gauge in the live exposition "
+                  f"(compliance: {sorted(objectives)}, burn: "
+                  f"{sorted(burn_objs)})", file=sys.stderr)
+            return 1
+        code, body = _http_get(http_base, "/statusz")
+        try:
+            status = json.loads(body)
+        except ValueError:
+            status = None
+        if code != 200 or not isinstance(status, dict) \
+                or not status.get("serving"):
+            print(f"http gate FAILED: /statusz code {code} or no "
+                  f"serving section", file=sys.stderr)
+            return 1
+        # liveness contract: an injected poison must flip /healthz to
+        # 503 on the very next request (the gauge is set inside
+        # _poison, no polling loop in between)
+        code, _b = _http_get(http_base, "/healthz")
+        if code != 200:
+            print(f"http gate FAILED: /healthz pre-poison returned "
+                  f"{code}, want 200", file=sys.stderr)
+            return 1
+        engine._poison("serving_metrics_snapshot --http: injected "
+                       "poison for the healthz gate")
+        code, body = _http_get(http_base, "/healthz")
+        if code != 503:
+            print(f"http gate FAILED: /healthz after poison returned "
+                  f"{code}, want 503 ({body[:200]!r})", file=sys.stderr)
+            return 1
+        httpd_mod.stop_server()
+        http_note = (f"; http gates OK ({len(objectives)} SLO "
+                     f"objectives live at {http_base})")
     n_lines = sum(1 for _ in open(args.out))
     print(f"serving smoke OK: {n_req} requests, "
           f"{int(checks['serving_tokens_total'])} tokens; "
           f"{n_lines} exposition lines -> {args.out}{trace_note}"
-          f"{mem_note}")
+          f"{mem_note}{http_note}")
     return 0
 
 
