@@ -537,11 +537,21 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     # BENCH_SERVING_ASYNC=N keeps N bursts in flight (device-side decode
     # carry): the host round-trip + token replay overlap device compute
     async_depth = int(os.environ.get("BENCH_SERVING_ASYNC", "0"))
+    # BENCH_SERVING_SPEC=W turns on self-speculative decoding with a
+    # W-token verify window (greedy-exact; BENCH_SERVING_SPEC_LAYERS
+    # overrides the shallow-exit draft depth). Spec and async are
+    # mutually exclusive — spec wins when both are set.
+    spec = int(os.environ.get("BENCH_SERVING_SPEC", "0"))
+    spec_layers = int(os.environ.get("BENCH_SERVING_SPEC_LAYERS", "0"))
+    if spec:
+        async_depth = 0
     engine = ServingEngine(model, max_batch=max_batch,
                            max_seq_len=prompt_len + new_tokens,
                            page_size=16, decode_strategy="greedy_search",
                            decode_burst=burst, kv_cache_quant=kv_quant,
-                           async_depth=async_depth)
+                           async_depth=async_depth,
+                           spec_decode=spec or None,
+                           spec_draft_layers=spec_layers or None)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
                for _ in range(max_batch)]
@@ -569,6 +579,13 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "decode_burst": burst, "async_depth": async_depth,
                   "quant": quant or None,
                   "kv_quant": kv_quant,
+                  "spec_decode": engine.spec_decode or None,
+                  "draft_layers": engine.spec_draft_layers
+                  if engine.spec_decode else None,
+                  "acceptance_rate": round(
+                      engine._spec_accepted_total
+                      / engine._spec_proposed_total, 4)
+                  if engine._spec_proposed_total else None,
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers,
@@ -588,7 +605,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                             f"kv={kv_quant}" if kv_quant else "",
                             f"burst={burst}" if burst != default_burst
                             else "",
-                            f"async={async_depth}" if async_depth else "")
+                            f"async={async_depth}" if async_depth else "",
+                            f"spec={spec}" if spec else "")
                 if t]
         key = f"serving:{size}" + ((":" + ",".join(tags)) if tags else "")
         _bank_tpu_result(key, result)
@@ -620,7 +638,27 @@ def _piggyback_extra_configs():
         os.environ.get("BENCH_EXTRA_BUDGET", "900"))
     jobs = [("llama_1b", {"BENCH_CONFIG": "llama", "BENCH_MODEL": "1b"}),
             ("resnet", {"BENCH_CONFIG": "resnet"}),
-            ("serving", {"BENCH_CONFIG": "serving"})]
+            ("serving", {"BENCH_CONFIG": "serving"}),
+            # the decode-speed matrix (ROADMAP item 2 / ISSUE 9):
+            # {bf16, int8, int4} x {spec off/on} serving rows, each
+            # banked into BENCH_HISTORY.jsonl so bench_compare arms the
+            # >= 2x decode target per arm (budget-bounded like the rest)
+            ("serving_int8",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_QUANT": "weight_only_int8"}),
+            ("serving_int4",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_QUANT": "weight_only_int4"}),
+            ("serving_spec",
+             {"BENCH_CONFIG": "serving", "BENCH_SERVING_SPEC": "4"}),
+            ("serving_int8_spec",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_QUANT": "weight_only_int8",
+              "BENCH_SERVING_SPEC": "4"}),
+            ("serving_int4_spec",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_QUANT": "weight_only_int4",
+              "BENCH_SERVING_SPEC": "4"})]
     for name, env_over in jobs:
         remaining = deadline - _time.monotonic()
         if remaining <= 10:

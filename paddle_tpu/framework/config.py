@@ -247,6 +247,33 @@ define_flag("FLAGS_slo_error_budget", 0.01,
             "serving_errors_total) may be at most this fraction of "
             "outcomes (errors + finished requests) before the budget "
             "burns.", type_=float)
+define_flag("FLAGS_quant_matmul", "auto",
+            "Dispatch for the weight-only quantized linear matmul "
+            "(kernels/quant_matmul.py): 'auto' (default) consults the "
+            "FLAGS_autotune winner table for the quant_matmul op and "
+            "falls back to the legacy traced-dequant XLA expression "
+            "(bit-identical to the pre-kernel lowering) when the tuner "
+            "is off; 'fused' forces the fused dequant-in-kernel Pallas "
+            "path at the largest supported block grid (tests/smokes); "
+            "'xla' forces the traced-dequant path.")
+define_flag("FLAGS_spec_decode", 0,
+            "Self-speculative decoding window for the serving engine "
+            "(inference/serving.py): when >= 2, greedy decode drafts "
+            "window-1 tokens with the cheap draft path, verifies the "
+            "whole window in ONE batched target forward over the paged "
+            "KV cache, and commits the greedy-exact accepted prefix "
+            "plus one corrected token (output token streams are "
+            "bit-identical to non-speculative greedy decoding; "
+            "rejection rewinds by page-table/context truncation). 0 "
+            "(default) = off. Engine kwarg spec_decode overrides.",
+            type_=int)
+define_flag("FLAGS_spec_draft_layers", 0,
+            "Layers in the shallow-exit self-speculative draft path: "
+            "the draft runs the first N decoder layers + final norm + "
+            "lm head (LayerSkip-style), reusing the target's exact "
+            "paged KV for those layers. 0 (default) = half the model's "
+            "layers (rounded up). Ignored when the engine was given a "
+            "separate draft_model.", type_=int)
 define_flag("FLAGS_flash_bwd_min_seq", 0,
             "Min seq for the Pallas streamed backward in training "
             "attention; 0 defers to the built-in default (4096). At "

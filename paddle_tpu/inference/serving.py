@@ -44,7 +44,8 @@ class _EngineMetrics:
                  "queue_wait", "occupancy", "page_util", "prefill_hits",
                  "prefill_misses", "preemptions", "aborts", "tokens",
                  "finished", "poisoned", "errors", "kv_occupancy",
-                 "kv_frag", "kv_free")
+                 "kv_frag", "kv_free", "spec_proposed", "spec_accepted",
+                 "spec_acceptance")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -123,6 +124,25 @@ class _EngineMetrics:
         self.kv_free = reg.gauge(
             "serving_kv_pages_free",
             "KV pages currently free in the pool (FLAGS_memwatch).")
+        # speculative decoding (spec_decode >= 2): draft-token economics.
+        # acceptance = accepted / proposed; each verify forward commits
+        # accepted + 1 tokens, so decode throughput scales with it
+        self.spec_proposed = reg.counter(
+            "spec_tokens_proposed_total",
+            "Draft tokens proposed by the speculative-decoding draft "
+            "path (per active slot per spec round: window-1, capped at "
+            "the slot's remaining token budget so acceptance measures "
+            "draft quality, not budget geometry).")
+        self.spec_accepted = reg.counter(
+            "spec_tokens_accepted_total",
+            "Proposed draft tokens the target verify forward accepted "
+            "(greedy-exact prefix match; the +1 corrected token each "
+            "round is not counted here).")
+        self.spec_acceptance = reg.histogram(
+            "serving_spec_acceptance_ratio",
+            "Per-request draft acceptance rate observed at request "
+            "finish (accepted / proposed over the request's life).",
+            buckets=_memwatch.RATIO_BUCKETS)
 
 
 @dataclass
@@ -138,6 +158,10 @@ class _Slot:
     needs_first_sample: bool = False  # consume prefill-time sample next step
     _first_token: int = -1
     trace_id: int = -1    # span-tracing correlation id (-1: not traced)
+    # speculative decoding per-request accounting (acceptance histogram
+    # observed at finish; reset at admission)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # per-request sampling: only the greedy flag lives on the slot (the
     # all-greedy fast path reads it every step); numeric params stay in
     # ServingEngine._req_params — ONE source of truth across preemption
@@ -171,7 +195,9 @@ class ServingEngine:
     def __init__(self, model, max_batch=4, max_seq_len=256, page_size=16,
                  decode_strategy="greedy_search", temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
-                 decode_burst=1, kv_cache_quant=None, async_depth=0):
+                 decode_burst=1, kv_cache_quant=None, async_depth=0,
+                 spec_decode=None, spec_draft_layers=None,
+                 draft_model=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         max_pos = getattr(model.config, "max_position_embeddings", None)
@@ -289,6 +315,75 @@ class ServingEngine:
         # only in rng consumption order (the key chains on device instead
         # of being re-split per burst on the host).
         self.async_depth = max(0, int(async_depth))
+        # self-speculative decoding (README.md "Quantized decode +
+        # speculative decoding"): greedy rounds draft window-1 tokens
+        # with a cheap path — the first spec_draft_layers decoder layers
+        # (LayerSkip-style shallow exit over the target's own paged KV)
+        # or an optional separate draft_model with its own page pools —
+        # then verify the whole window in ONE batched target forward
+        # over the paged cache; the greedy-exact accepted prefix plus
+        # one corrected token commits, and rejection rewinds by context
+        # truncation (the pages past the accepted prefix simply stay
+        # masked). Output token streams are bit-identical to
+        # non-speculative greedy decoding.
+        from ..framework import config as _config
+
+        sd = spec_decode if spec_decode is not None \
+            else _config.get_flag("FLAGS_spec_decode", 0)
+        self.spec_decode = int(sd) if int(sd) >= 2 else 0
+        if self.spec_decode and self.async_depth:
+            raise ValueError(
+                "spec_decode and async_depth are mutually exclusive: "
+                "the speculative round already keeps the device busy "
+                "across the window, and the async pipeline's stale-"
+                "carry pages cannot express the verify rewind")
+        self._draft_model = draft_model if self.spec_decode else None
+        L = self.cfg.num_hidden_layers
+        if self._draft_model is not None:
+            self.spec_draft_layers = None
+        else:
+            dl = spec_draft_layers if spec_draft_layers is not None \
+                else _config.get_flag("FLAGS_spec_draft_layers", 0)
+            dl = int(dl) if int(dl) > 0 else -(-L // 2)
+            self.spec_draft_layers = max(1, min(dl, L))
+        self._spec_draft_fns: Dict[int, object] = {}
+        self._spec_verify_fns: Dict[int, object] = {}
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._draft_params = None
+        self._draft_buffers = None
+        self._draft_k_scales = self._draft_v_scales = None
+        if self._draft_model is not None:
+            # the draft model decodes the SAME positions, so it shares
+            # the block tables / context lens and only needs its own
+            # page payloads (its layer count / kv geometry differ)
+            dcfg = self._draft_model.config
+            dkvh = getattr(dcfg, "num_key_value_heads",
+                           dcfg.num_attention_heads)
+            dhd = dcfg.hidden_size // dcfg.num_attention_heads
+            dL = dcfg.num_hidden_layers
+            try:
+                d_dtype = next(
+                    iter(self._draft_model.parameters()))._data.dtype
+            except StopIteration:
+                d_dtype = jnp.float32
+            if kv_cache_quant == "int8":
+                d_dtype = jnp.int8
+                self._draft_k_scales, self._draft_v_scales = map(
+                    list, zip(*[_pa.alloc_page_scales(
+                        n_pages, page_size, dkvh) for _ in range(dL)]))
+            self._draft_k_pages = [
+                jnp.zeros((dkvh, n_pages, page_size, dhd), d_dtype)
+                for _ in range(dL)]
+            self._draft_v_pages = [
+                jnp.zeros((dkvh, n_pages, page_size, dhd), d_dtype)
+                for _ in range(dL)]
+            if self.mesh is not None:
+                from ..models.trainer import place_model
+
+                place_model(self._draft_model, self.mesh)
+        else:
+            self._draft_k_pages = self._draft_v_pages = None
         # params pytree cached across steps (round-2 verdict weak #5:
         # rebuilding it every decode step); call refresh_params() after
         # mutating model weights
@@ -302,6 +397,14 @@ class ServingEngine:
         self._poisoned = None
         self._n_pages_total = n_pages
         self._m = _EngineMetrics()
+        # stepledger quant correction (observability/stepledger.py):
+        # XLA's cost_analysis bills the dequantized float weight
+        # intermediate as bytes accessed, but the HBM traffic of a
+        # load-fused / dequant-in-kernel matmul is the int8/int4 bytes —
+        # compute the (float - int) weight delta ONCE so every decode
+        # entry's roofline classifies against honest bytes
+        self._quant_algo, self._quant_bytes_delta = \
+            self._quant_weight_delta()
         # OOM graceful degradation (memwatch channel): a decode-time
         # RESOURCE_EXHAUSTED gets ONE preemption round (shed the
         # youngest slot, retry) before the engine poisons — see
@@ -347,6 +450,14 @@ class ServingEngine:
         e.g. live weight reload between requests)."""
         self._params = None
         self._buffers = None
+        self._draft_params = None
+        self._draft_buffers = None
+
+    def _cached_draft_params(self):
+        if self._draft_params is None:
+            self._draft_params = self._draft_model.parameters_pytree()
+            self._draft_buffers = self._draft_model.buffers_pytree()
+        return self._draft_params, self._draft_buffers
 
     # ------------------------------------------------------------------
     # admission
@@ -450,6 +561,8 @@ class ServingEngine:
             s.admit_seq = self._admit_seq
             self._admit_seq += 1
             s.needs_first_sample = True
+            s.spec_proposed = 0
+            s.spec_accepted = 0
             s.active = True
             if self._traces:
                 tr = self._traces.get(rid)
@@ -485,15 +598,20 @@ class ServingEngine:
         # comes from the prefill-time sample) so warmup compiles the SAME
         # burst program traffic will use. step() still falls back to the
         # single-step program when every active row is on its last token,
-        # so a second 2-token request warms that program too.
-        max_new = self.decode_burst + 1
+        # so a second 2-token request warms that program too. A spec
+        # engine's greedy request must carry window+1 of budget so the
+        # draft scan + the batched verify forward compile here, not
+        # under traffic.
+        max_new = max(self.decode_burst, self.spec_decode) + 1
         plen = int(prompt_len) if prompt_len is not None else max(
             1, min(self.page_size, self.max_seq_len - max_new))
-        if prompt_len is not None and self.decode_burst > 1 and \
+        if prompt_len is not None and \
+                (self.decode_burst > 1 or self.spec_decode) and \
                 plen + max_new > self.max_seq_len:
             raise ValueError(
                 f"warmup(prompt_len={plen}) leaves no room for a "
-                f"decode_burst={self.decode_burst} budget within "
+                f"decode_burst={self.decode_burst} / "
+                f"spec_decode={self.spec_decode} budget within "
                 f"max_seq_len={self.max_seq_len}: the burst program would "
                 f"NOT be compiled and the first real request would pay "
                 f"the compile in-traffic. Use a shorter prompt_len (<= "
@@ -672,19 +790,23 @@ class ServingEngine:
     # prefill: batched dense-cache forward on the admitted prompts, then
     # one scatter of all their K/V into the pages
     # ------------------------------------------------------------------
-    def _get_prefill_fn(self, nb, bucket, all_greedy):
+    def _get_prefill_fn(self, nb, bucket, all_greedy, which="target"):
         """One compiled prefill per (batch-bucket, token-bucket,
         all-greedy?): prompts pad to a page multiple, batch pads to a
         power of two. The all-greedy specialization skips the per-row
-        sampler's vocab sort entirely (argmax only)."""
-        fn = self._prefill_fns.get((nb, bucket, all_greedy))
+        sampler's vocab sort entirely (argmax only). which="draft"
+        compiles the same program over the separate draft model (its
+        pages must hold the prompt too; the sampled first token is
+        ignored — the target's prefill sample is the stream's)."""
+        fn = self._prefill_fns.get((nb, bucket, all_greedy, which))
         if fn is not None:
             self._m.prefill_hits.inc()
             return fn
         self._m.prefill_misses.inc()
         _flight.record_event("serving.prefill_compile", nb=nb,
-                             bucket=bucket, all_greedy=all_greedy)
-        model = self.model
+                             bucket=bucket, all_greedy=all_greedy,
+                             which=which)
+        model = self.model if which == "target" else self._draft_model
         from ..jit.api import _LayerScope
         from ..models.generation import (sample_logits,
                                          sample_logits_per_row)
@@ -710,9 +832,9 @@ class ServingEngine:
                 vs = jnp.stack([as_array(v) for k, v in caches])
             return first, ks, vs  # ks: [L, nb, bucket, kvh, hd]
 
-        fn = self._prefill_fns[(nb, bucket, all_greedy)] = \
+        fn = self._prefill_fns[(nb, bucket, all_greedy, which)] = \
             _cw.watch_jit("serving.prefill", jax.jit(pure_prefill),
-                          tag=(nb, bucket, all_greedy))
+                          tag=(nb, bucket, all_greedy, which))
         return fn
 
     def _prefill_batch(self, new):
@@ -762,6 +884,35 @@ class ServingEngine:
                     _pa.prefill_paged_kv_cache(
                         self.k_pages[li], self.v_pages[li],
                         ks[li][:n], vs[li][:n], tables, lens)
+        if self._draft_model is not None:
+            # the separate draft model needs the prompt in ITS pages too
+            # (two-model speculative decoding prefills twice — the draft
+            # is small, that is the trade); its sampled token is ignored
+            fn_d = self._get_prefill_fn(nb, bucket, all_greedy,
+                                        which="draft")
+            dparams, dbuffers = self._cached_draft_params()
+            _f, dks, dvs = fn_d(dparams, dbuffers, jnp.asarray(padded),
+                                jnp.asarray(true_lens),
+                                jax.random.key_data(sk),
+                                jnp.asarray(greedy), jnp.asarray(temp),
+                                jnp.asarray(tk), jnp.asarray(tp_arr))
+            for li in range(len(self._draft_k_pages)):
+                if self._draft_k_scales is not None:
+                    (self._draft_k_pages[li], self._draft_k_scales[li],
+                     self._draft_v_pages[li],
+                     self._draft_v_scales[li]) = \
+                        _pa.prefill_paged_kv_cache_q8(
+                            self._draft_k_pages[li],
+                            self._draft_k_scales[li],
+                            self._draft_v_pages[li],
+                            self._draft_v_scales[li],
+                            dks[li][:n], dvs[li][:n], tables, lens)
+                else:
+                    self._draft_k_pages[li], self._draft_v_pages[li] = \
+                        _pa.prefill_paged_kv_cache(
+                            self._draft_k_pages[li],
+                            self._draft_v_pages[li],
+                            dks[li][:n], dvs[li][:n], tables, lens)
         # re-pin: the eager scatter can drop the kv-head tp sharding, and
         # the decode jit donates pages in this layout
         self._pin_pages()
@@ -895,6 +1046,276 @@ class ServingEngine:
             tag=("greedy" if all_greedy else "mixed", n_steps))
         return fn
 
+    # ------------------------------------------------------------------
+    # self-speculative decoding: draft cheap, verify the window in ONE
+    # target forward, commit the greedy-exact accepted prefix + 1
+    # ------------------------------------------------------------------
+    def _get_spec_draft_fn(self, n_draft):
+        """Compiled draft: a lax.scan of `n_draft` cheap greedy decode
+        steps. Shallow-exit mode runs the TARGET's first
+        spec_draft_layers decoder layers + final norm + lm head over the
+        target's own (exact, verify-written) paged KV for those layers;
+        draft-model mode runs the separate model over its own pools.
+        Draft writes land at the window positions and are overwritten by
+        the verify forward (shallow-exit) or stay draft-consistent for
+        the accepted prefix (draft model), so no rollback is needed."""
+        fn = self._spec_draft_fns.get(n_draft)
+        if fn is not None:
+            return fn
+        model = self._draft_model if self._draft_model is not None \
+            else self.model
+        max_layers = None if self._draft_model is not None \
+            else self.spec_draft_layers
+        serving_mesh = self.mesh
+        from ..jit.api import _LayerScope
+
+        def pure_draft(params, buffers, k_pages, v_pages, k_scales,
+                       v_scales, tokens, tables, lens, active, limit):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                def one(carry, _):
+                    tok, kps, vps, kss, vss, ln = carry
+                    caches = list(zip(kps, vps, kss, vss)) if kss \
+                        else list(zip(kps, vps))
+                    logits, new_caches = model.forward_paged(
+                        Tensor(tok[:, None]), caches, tables, ln,
+                        active=active, mesh=serving_mesh,
+                        limit_lens=limit, max_layers=max_layers)
+                    nxt = jnp.argmax(
+                        as_array(logits)[:, 0].astype(jnp.float32),
+                        axis=-1).astype(jnp.int32)
+                    nk = tuple(as_array(c[0]) for c in new_caches)
+                    nv = tuple(as_array(c[1]) for c in new_caches)
+                    nks = tuple(as_array(c[2])
+                                for c in new_caches) if kss else ()
+                    nvs = tuple(as_array(c[3])
+                                for c in new_caches) if kss else ()
+                    tok2 = jnp.where(active, nxt.astype(tok.dtype), tok)
+                    return (tok2, nk, nv, nks, nvs,
+                            ln + active.astype(ln.dtype)), nxt
+
+                carry, drafts = jax.lax.scan(
+                    one, (tokens, k_pages, v_pages, k_scales, v_scales,
+                          lens), None, length=n_draft)
+                _tok, nk, nv, nks, nvs, _ln = carry
+            return drafts, nk, nv, nks, nvs  # drafts: [n_draft, b] i32
+
+        fn = self._spec_draft_fns[n_draft] = _cw.watch_jit(
+            "serving.spec_draft",
+            jax.jit(pure_draft, donate_argnums=(2, 3, 4, 5)),
+            tag=(n_draft,))
+        return fn
+
+    def _get_spec_verify_fn(self, window):
+        """Compiled verify: ONE batched target forward over the [b,
+        window] token window (the pending last token + the drafts) at
+        positions lens..lens+window-1 of the paged cache — every
+        position's greedy argmax in a single dispatch, exactly the
+        parallel-verification trade speculative decoding buys."""
+        fn = self._spec_verify_fns.get(window)
+        if fn is not None:
+            return fn
+        model = self.model
+        serving_mesh = self.mesh
+        from ..jit.api import _LayerScope
+
+        def pure_verify(params, buffers, k_pages, v_pages, k_scales,
+                        v_scales, tokens, drafts, tables, lens, active,
+                        limit):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                # drafts may carry one extra trailing step (draft-model
+                # mode writes the last draft's KV into its own pools);
+                # the window consumes exactly window-1 of them
+                win = jnp.concatenate(
+                    [tokens[:, None],
+                     jnp.transpose(drafts)[:, :window - 1]
+                     .astype(tokens.dtype)], axis=1)
+                caches = list(zip(k_pages, v_pages, k_scales,
+                                  v_scales)) if k_scales \
+                    else list(zip(k_pages, v_pages))
+                logits, new_caches = model.forward_paged(
+                    Tensor(win), caches, tables, lens, active=active,
+                    mesh=serving_mesh, limit_lens=limit)
+                g = jnp.argmax(as_array(logits).astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)  # [b, window]
+                nk = tuple(as_array(c[0]) for c in new_caches)
+                nv = tuple(as_array(c[1]) for c in new_caches)
+                nks = tuple(as_array(c[2])
+                            for c in new_caches) if k_scales else ()
+                nvs = tuple(as_array(c[3])
+                            for c in new_caches) if k_scales else ()
+            return g, nk, nv, nks, nvs
+
+        fn = self._spec_verify_fns[window] = _cw.watch_jit(
+            "serving.spec_verify",
+            jax.jit(pure_verify, donate_argnums=(2, 3, 4, 5)),
+            tag=(window,))
+        return fn
+
+    def _spec_window(self, active, rem_of):
+        """The speculative window for this dispatch, or 0 when the round
+        must take the classic path: spec off, a non-greedy row in the
+        batch (acceptance is greedy-exact prefix matching), or every row
+        on its last token (nothing to draft)."""
+        if self.spec_decode < 2:
+            return 0
+        if max(rem_of.values()) <= 1:
+            return 0
+        if not all(self.slots[i].greedy for i in active):
+            return 0
+        return self.spec_decode
+
+    def _dispatch_spec(self, window, active, st, tokens):
+        """One speculative round for the active slots. Returns the list
+        of requests it finished, or None when an OOM preemption round
+        consumed a slot and the caller must rebuild its launch state and
+        retry. Page reservation for min(window, rem) positions per row
+        already happened in step()'s shared loop; overhang positions are
+        masked on device via `limit`."""
+        lens, act_mask = st["lens"], st["act_mask"]
+        limit = (lens + np.minimum(st["rem"], window)).astype(np.int32)
+        params, buffers = self._cached_params()
+        t0 = _time_mod.perf_counter()
+        tok0 = self._m.tokens.value
+        if self._traces:
+            for i in active:
+                tr = self._traces.get(self.slots[i].request_id)
+                if tr is not None and "decode_t0" not in tr.marks:
+                    tr.mark("decode_t0", t0)
+        led = _stepledger.begin()
+        shallow = self._draft_model is None
+        # shallow-exit drafts window-1 tokens (verify overwrites the
+        # target pages anyway); a separate draft model runs ONE extra
+        # step so the last draft token's KV lands in its own pools —
+        # the verify forward never writes those, and without it the
+        # next round's draft would attend a stale slot after a fully
+        # accepted window
+        n_scan = window - 1 if shallow else window
+        draft_fn = self._get_spec_draft_fn(n_scan)
+        verify_fn = self._get_spec_verify_fn(window)
+        Ld = self.spec_draft_layers if shallow else None
+        try:
+            # arg prep inside the try: transfer-time OOM must reach the
+            # forensics + preempt-retry path (same rule as burst/decode)
+            tok_dev = jnp.asarray(tokens)
+            tables_dev = jnp.asarray(self.block_tables)
+            lens_dev = jnp.asarray(lens)
+            act_dev = jnp.asarray(act_mask)
+            lim_dev = jnp.asarray(limit)
+            if shallow:
+                draft_args = (
+                    params, buffers, tuple(self.k_pages[:Ld]),
+                    tuple(self.v_pages[:Ld]),
+                    tuple((self.k_scales or [])[:Ld]),
+                    tuple((self.v_scales or [])[:Ld]),
+                    tok_dev, tables_dev, lens_dev, act_dev, lim_dev)
+            else:
+                dparams, dbuffers = self._cached_draft_params()
+                draft_args = (
+                    dparams, dbuffers, tuple(self._draft_k_pages),
+                    tuple(self._draft_v_pages),
+                    tuple(self._draft_k_scales or ()),
+                    tuple(self._draft_v_scales or ()),
+                    tok_dev, tables_dev, lens_dev, act_dev, lim_dev)
+            drafts, dk, dv, dks, dvs = draft_fn(*draft_args)
+            # re-point the drafted pools at the live buffers BEFORE the
+            # verify dispatch donates the engine's page lists again
+            if shallow:
+                self.k_pages[:Ld] = list(dk)
+                self.v_pages[:Ld] = list(dv)
+                if self.k_scales is not None:
+                    self.k_scales[:Ld] = list(dks)
+                    self.v_scales[:Ld] = list(dvs)
+            else:
+                self._draft_k_pages = list(dk)
+                self._draft_v_pages = list(dv)
+                if self._draft_k_scales is not None:
+                    self._draft_k_scales = list(dks)
+                    self._draft_v_scales = list(dvs)
+            verify_args = (
+                params, buffers, tuple(self.k_pages),
+                tuple(self.v_pages), tuple(self.k_scales or ()),
+                tuple(self.v_scales or ()), tok_dev, drafts,
+                tables_dev, lens_dev, act_dev, lim_dev)
+            g, nk, nv, nks, nvs = verify_fn(*verify_args)
+        except BaseException as e:
+            if _memwatch.is_oom(e) and \
+                    self._handle_decode_oom(e, "spec_decode"):
+                return None
+            self._poison_if_donated(
+                "spec decode fn raised after donating the KV pages",
+                self.k_pages, self.v_pages)
+            raise
+        if led is not None:
+            # the verify program dominates the round's device time —
+            # register ITS cost for the roofline; the draft rides in the
+            # same measured dispatch window
+            _stepledger.end(led, "serving.spec_verify",
+                            _time_mod.perf_counter(), out=(nk, nv, g))
+            _stepledger.register_from_lowered(
+                "serving.spec_verify", verify_fn, verify_args,
+                quant=self._quant_algo,
+                quant_bytes_delta=self._quant_bytes_correction())
+        self.k_pages, self.v_pages = list(nk), list(nv)
+        if self.k_scales is not None:
+            self.k_scales, self.v_scales = list(nks), list(nvs)
+        finished = self._commit_spec(np.asarray(drafts), np.asarray(g),
+                                     active, window)
+        self._step_metrics(t0, len(active), tok0)
+        return finished
+
+    def _commit_spec(self, drafts, g, active, window):
+        """Host replay of one speculative round. drafts: [window-1, b];
+        g: [b, window] target greedy tokens. Commit the longest prefix
+        where draft j matched the target's token j (greedy-exact: the
+        committed stream is exactly what non-speculative greedy decoding
+        would have produced), plus the one corrected token; rewind is
+        implicit — context_len only advances over the accepted inputs,
+        so the rejected tail's page slots are dead until overwritten."""
+        finished = []
+        for i in active:
+            s = self.slots[i]
+            if not s.active:
+                continue  # abort()ed from an on_token callback
+            committed = [int(g[i, 0])]
+            for j in range(1, window):
+                if int(drafts[j - 1, i]) != int(g[i, j - 1]):
+                    break
+                committed.append(int(g[i, j]))
+            rem = s.max_new_tokens - len(s.tokens)
+            committed = committed[:max(rem, 0)]
+            eos = self._req_eos(s.request_id)
+            if eos is not None:
+                for idx, tok in enumerate(committed):
+                    if tok == eos:
+                        committed = committed[:idx + 1]
+                        break
+            accepted = max(len(committed) - 1, 0)
+            # proposed = drafts this row could have COMMITTED (budget
+            # cap), not the raw scan length: a max_new_tokens=2 request
+            # in a window-4 engine can accept at most 1 draft however
+            # well the draft path agrees — charging 3 would make the
+            # acceptance rate measure budget geometry, not draft
+            # quality (eos truncation still deflates; eos ends the
+            # request, that is real)
+            proposed = max(min(window, rem) - 1, 0)
+            s.spec_proposed += proposed
+            s.spec_accepted += accepted
+            self._spec_proposed_total += proposed
+            self._spec_accepted_total += accepted
+            self._m.spec_proposed.inc(proposed)
+            self._m.spec_accepted.inc(accepted)
+            for tok in committed:
+                s.context_len += 1
+                s.tokens.append(tok)
+                self._stream(s.request_id, tok)
+                if not s.active:
+                    break  # the callback above aborted THIS request
+                if len(s.tokens) >= s.max_new_tokens or (
+                        eos is not None and tok == eos):
+                    finished.append(self._finish(i))
+                    break
+        return finished
+
     def _rem_of(self, active):
         """Remaining new-token budget per active slot — the ONE place the
         budget rule lives (k_burst sizing, page reservation, and the
@@ -973,6 +1394,78 @@ class ServingEngine:
                 f"compiled decode call raised after donating the KV page "
                 f"pools, so the engine holds deleted buffers. Recreate "
                 f"the engine; in-flight requests must be re-submitted.")
+
+    def _quant_weight_delta(self):
+        """(algo, bytes) of the model's weight-only quantization: the
+        per-forward byte overcount a cost_analysis pass makes when it
+        bills the dequantized float weight as traffic. Only layers
+        whose shape the fused kernel can actually serve count — a
+        quantized linear that fails `quant_matmul.supports` (e.g. an
+        n % 128 vocab projection) always dispatches via the XLA path
+        where the float weight IS materialized, so its cost_analysis
+        bytes are already honest. Zero for unquantized models. Never
+        raises."""
+        try:
+            from ..kernels import quant_matmul as _qm
+
+            try:
+                # the dequantized intermediate takes the activations'
+                # dtype — the first (float) param's, e.g. the embedding
+                float_itemsize = jnp.dtype(next(
+                    iter(self.model.parameters()))._data.dtype).itemsize
+            except StopIteration:
+                float_itemsize = 4
+            algo = None
+            delta = 0.0
+            stack = [self.model]
+            while stack:
+                layer = stack.pop()
+                for child in getattr(layer, "_sub_layers", {}).values():
+                    if type(child).__name__ == "WeightOnlyLinear" \
+                            and child._algo != "llm.int8":
+                        algo = algo or child._algo
+                        if _qm._default_blocks(
+                                child._in_features,
+                                child._out_features,
+                                child._weight_dtype,
+                                child._group_size) == (None, None):
+                            continue  # fused kernel can never serve it
+                        n_elems = (child._in_features
+                                   * child._out_features)
+                        float_bytes = n_elems * float_itemsize
+                        int_bytes = int(
+                            child.quant_weight._data.nbytes)
+                        delta += max(float_bytes - int_bytes, 0)
+                    else:
+                        stack.append(child)
+            return algo, float(delta)
+        except Exception:  # noqa: BLE001 — telemetry must never take
+            return None, 0.0  # engine construction down
+
+    def _quant_bytes_correction(self):
+        """The byte delta to subtract for the CURRENT dispatch mode:
+        only when the fused dequant-in-kernel path can actually serve
+        (mirrors quant_matmul_dispatch's gate). Under the XLA traced
+        dequant the float weight IS materialized, so cost_analysis's
+        bytes are already honest — subtracting there would misclassify
+        memory-bound decode as compute-bound, the opposite dishonesty.
+        Auto mode is an approximation: a per-shape xla winner still
+        gets the correction, but the never-slower tie-break makes
+        fused the common winner wherever the tuner is live."""
+        if not self._quant_bytes_delta:
+            return 0.0
+        from ..framework import config as _config
+        from ..kernels import autotune as _at
+        from ..kernels import quant_matmul as _qm
+
+        mode = str(_config.get_flag("FLAGS_quant_matmul",
+                                    "auto")).lower()
+        if mode == "fused":
+            return self._quant_bytes_delta
+        if mode == "auto" and _at.enabled() and (
+                not _qm._interpret() or _at.has_custom_timer()):
+            return self._quant_bytes_delta
+        return 0.0
 
     # ------------------------------------------------------------------
     # memory observability (memwatch channel)
@@ -1128,16 +1621,21 @@ class ServingEngine:
         # from the surviving slots and the dispatch retried.
         while True:
             rem_of = self._rem_of(active)
+            # speculative rounds replace the burst path when eligible
+            # (all-greedy batch with more than one token of budget)
+            spec_w = self._spec_window(active, rem_of)
             k_burst = self.decode_burst if (
                 self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
             # on-demand page growth for the positions this step writes
             # (one per single step, up to min(burst, remaining) for a
-            # burst); pool exhaustion preempts the youngest slot
-            # (recompute policy) and retries, so the oldest slots always
-            # make progress
+            # burst, up to min(window, remaining) for a spec round);
+            # pool exhaustion preempts the youngest slot (recompute
+            # policy) and retries, so the oldest slots always make
+            # progress
+            reserve = spec_w if spec_w else k_burst
             while True:
                 stalled = [i for i in active if not self._ensure_pages(
-                    i, min(k_burst, rem_of[i]))]
+                    i, min(reserve, rem_of[i]))]
                 if not stalled:
                     break
                 victim = max(stalled,
@@ -1147,6 +1645,20 @@ class ServingEngine:
                 if not active:
                     return finished_early
             st = self._decode_launch_state(active)
+            if spec_w:
+                tokens_np = tokens  # the [max_batch] last-token array
+                got = self._dispatch_spec(spec_w, active, st, tokens_np)
+                if got is None:
+                    # OOM preemption round: rebuild the launch state
+                    # from the surviving slots and retry the dispatch
+                    active = [i for i in active if self.slots[i].active]
+                    if not active:
+                        return finished_early
+                    continue
+                finished = finished_early + got
+                if finished:
+                    self._admit()
+                return finished
             all_greedy = st["all_greedy"]
             lens, act_mask = st["lens"], st["act_mask"]
             greedy, temp, tk, tp_arr = (st["greedy"], st["temp"],
@@ -1206,7 +1718,10 @@ class ServingEngine:
                                     _time_mod.perf_counter(),
                                     out=(nk, nv, toks))
                     _stepledger.register_from_lowered(
-                        "serving.decode_burst", fn, burst_args)
+                        "serving.decode_burst", fn, burst_args,
+                        quant=self._quant_algo,
+                        quant_bytes_delta=(
+                            self._quant_bytes_correction() * k_burst))
                 self.k_pages, self.v_pages = list(nk), list(nv)
                 if self.k_scales is not None:
                     self.k_scales, self.v_scales = list(nks), list(nvs)
@@ -1249,7 +1764,9 @@ class ServingEngine:
                                 _time_mod.perf_counter(),
                                 out=(nk, nv, nxt))
                 _stepledger.register_from_lowered(
-                    "serving.decode_step", fn, decode_args)
+                    "serving.decode_step", fn, decode_args,
+                    quant=self._quant_algo,
+                    quant_bytes_delta=self._quant_bytes_correction())
             break
         self.k_pages, self.v_pages = list(nk), list(nv)
         if self.k_scales is not None:
@@ -1335,6 +1852,9 @@ class ServingEngine:
         s = self.slots[slot_idx]
         self._release_slot(slot_idx)
         self._m.finished.inc()
+        if s.spec_proposed > 0:
+            self._m.spec_acceptance.observe(
+                s.spec_accepted / s.spec_proposed)
         trace_id = self._finish_trace(s.request_id, tokens=len(s.tokens)) \
             if self._traces else None
         _flight.record_event("serving.finish", rid=s.request_id,

@@ -50,6 +50,7 @@ KERNEL_VERSIONS = {
     "flash_bwd_dkv": "fa-v2",
     "paged_decode": "pa-v1",
     "rms_norm": "rn-v1",
+    "quant_matmul": "qm-v1",
 }
 
 BLOCK_GRID = (128, 256, 512)
@@ -752,6 +753,73 @@ def _choose_paged_decode(b, n_q_heads, n_kv_heads, head_dim, page_size,
         return True
 
     return get_tuner().pick("paged_decode", bucket, cands, make_args,
+                            eligible)
+
+
+def choose_quant_matmul(m, k, n, weight_dtype, group_size, dtype):
+    """Measured dispatch for the weight-only quantized linear
+    (kernels/quant_matmul.py). Candidates: the XLA traced-dequant
+    matmul and the fused dequant-in-kernel Pallas variant over the
+    (block_n, block_k) grid. Winner meta: {"impl": "xla"} or
+    {"impl": "fused", "block_n": bn, "block_k": bk}."""
+    return _memo(
+        ("quant_matmul", m, k, n, str(weight_dtype), int(group_size),
+         str(dtype)),
+        lambda: _choose_quant_matmul(m, k, n, weight_dtype, group_size,
+                                     dtype))
+
+
+def _choose_quant_matmul(m, k, n, weight_dtype, group_size, dtype):
+    if not measurement_allowed():
+        return None
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import quant_matmul as qm
+
+    bm = bucket_pow2(m)
+    bucket = (("m", bm), ("k", int(k)), ("n", int(n)),
+              ("wd", str(weight_dtype)), ("gs", int(group_size)),
+              ("dt", str(dtype)))
+
+    def xla_fn(x, qw, s):
+        return qm.quant_matmul_xla(x, qw, s, weight_dtype)
+
+    cands: List[Candidate] = [
+        Candidate("xla", "xla", xla_fn, {"impl": "xla"})]
+    for bn in qm.BLOCK_GRID_N:
+        for bk in qm.BLOCK_GRID_K:
+            if not qm.supports(bm, k, n, weight_dtype, group_size, bn,
+                               bk):
+                continue
+
+            def fused_fn(x, qw, s, _bn=bn, _bk=bk):
+                return qm.quant_matmul_fused(x, qw, s, weight_dtype,
+                                             group_size, _bn, _bk)
+
+            cands.append(Candidate(f"fused:{bn}x{bk}", "pallas",
+                                   fused_fn,
+                                   {"impl": "fused", "block_n": bn,
+                                    "block_k": bk}))
+
+    def make_args():
+        kx, kw = jax.random.split(jax.random.PRNGKey(4))
+        x = jax.random.normal(kx, (bm, k), jnp.float32).astype(dtype)
+        rows = k // 2 if weight_dtype == "int4" else k
+        qw = (jax.random.normal(kw, (rows, n)) * 64).astype(jnp.int8)
+        groups = 1 if group_size == -1 else k // group_size
+        shape = (n,) if group_size == -1 else (groups, n)
+        s = jnp.full(shape, 1.0 / 64, jnp.float32)
+        return x, qw, s
+
+    def eligible(c):
+        if c.meta["impl"] == "xla":
+            return True
+        return qm.supports(m, k, n, weight_dtype, group_size,
+                           c.meta["block_n"], c.meta["block_k"])
+
+    return get_tuner().pick("quant_matmul", bucket, cands, make_args,
                             eligible)
 
 

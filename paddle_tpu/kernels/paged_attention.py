@@ -259,6 +259,107 @@ def prefill_paged_kv_cache_q8(k_pages, k_scales, v_pages, v_scales,
     return k_pages, k_scales, v_pages, v_scales
 
 
+def _window_write_coords(k_pages, block_tables, start_lens, s,
+                         limit_lens, active):
+    """Flat (page, slot) write coordinates for a [b, s] token window:
+    row b's token w lands at position start_lens[b] + w. Positions at
+    or beyond limit_lens[b] (and inactive rows) are redirected to the
+    out-of-range page index so mode='drop' discards them — the
+    speculative-verify window may overhang a row's token budget, and
+    those overhang positions must not touch pages the row never
+    reserved. The ONE copy of that budget-safety invariant, shared by
+    the float and int8-KV scatter paths."""
+    page_size = k_pages.shape[2]
+    pos = start_lens[:, None] + jnp.arange(s, dtype=start_lens.dtype)
+    page_idx = jnp.minimum(pos // page_size, block_tables.shape[1] - 1)
+    page_ids = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    slots = pos % page_size
+    valid = pos < (start_lens[:, None] + s if limit_lens is None
+                   else limit_lens[:, None])
+    if active is not None:
+        valid = valid & active[:, None]
+    page_ids = jnp.where(valid, page_ids, k_pages.shape[1])
+    return page_ids.reshape(-1), slots.reshape(-1)
+
+
+def scatter_paged_kv_window(k_pages, v_pages, k_seq, v_seq, block_tables,
+                            start_lens, limit_lens=None, active=None):
+    """Scatter a WINDOW of s new tokens per sequence into its pages
+    (coordinates + overhang masking: `_window_write_coords`).
+    k_seq/v_seq: [b, s, kv_heads, head_dim]."""
+    b, s = k_seq.shape[0], k_seq.shape[1]
+    kvh = k_seq.shape[2]
+    flat_pages, flat_slots = _window_write_coords(
+        k_pages, block_tables, start_lens, s, limit_lens, active)
+    kk = k_seq.astype(k_pages.dtype).transpose(2, 0, 1, 3) \
+        .reshape(kvh, b * s, -1)
+    vv = v_seq.astype(v_pages.dtype).transpose(2, 0, 1, 3) \
+        .reshape(kvh, b * s, -1)
+    k_pages = k_pages.at[:, flat_pages, flat_slots, :].set(kk, mode="drop")
+    v_pages = v_pages.at[:, flat_pages, flat_slots, :].set(vv, mode="drop")
+    return k_pages, v_pages
+
+
+def scatter_paged_kv_window_q8(k_pages, k_scales, v_pages, v_scales,
+                               k_seq, v_seq, block_tables, start_lens,
+                               limit_lens=None, active=None):
+    """int8 variant of `scatter_paged_kv_window`: per-(row, token, head)
+    symmetric quant, scatter value + scale."""
+    b, s = k_seq.shape[0], k_seq.shape[1]
+    kvh = k_seq.shape[2]
+    kq, ks = _quant_kv_token(k_seq)  # [b, s, kvh, d], [b, s, kvh]
+    vq, vs = _quant_kv_token(v_seq)
+    flat_pages, flat_slots = _window_write_coords(
+        k_pages, block_tables, start_lens, s, limit_lens, active)
+    kk = kq.transpose(2, 0, 1, 3).reshape(kvh, b * s, -1)
+    vv = vq.transpose(2, 0, 1, 3).reshape(kvh, b * s, -1)
+    k_pages = k_pages.at[:, flat_pages, flat_slots, :].set(kk, mode="drop")
+    v_pages = v_pages.at[:, flat_pages, flat_slots, :].set(vv, mode="drop")
+    k_scales = k_scales.at[:, flat_pages, flat_slots].set(
+        ks.transpose(2, 0, 1).reshape(kvh, b * s), mode="drop")
+    v_scales = v_scales.at[:, flat_pages, flat_slots].set(
+        vs.transpose(2, 0, 1).reshape(kvh, b * s), mode="drop")
+    return k_pages, k_scales, v_pages, v_scales
+
+
+def paged_attention_window_xla(q, k_pages, v_pages, block_tables,
+                               context_lens, scale=None, k_scales=None,
+                               v_scales=None):
+    """Multi-token window attention over the paged cache (the
+    speculative-verify forward): query w of row b attends positions
+    < context_lens[b] + w + 1 — its own just-written token included,
+    matching the single-token path's `lens + 1` convention. Dense
+    gather like `paged_attention_xla`; the window is a handful of
+    tokens so the verify matmul is [s, S] per head, still tiny.
+
+    q: [b, s, num_q_heads, head_dim] -> [b, s, num_q_heads, head_dim]
+    """
+    b, s, n_q_heads, head_dim = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(head_dim))
+    k_dense = k_pages[:, block_tables]
+    v_dense = v_pages[:, block_tables]
+    S = block_tables.shape[1] * page_size
+    k_dense = k_dense.reshape(n_kv_heads, b, S, head_dim)
+    v_dense = v_dense.reshape(n_kv_heads, b, S, head_dim)
+    if k_scales is not None:
+        ks = k_scales[:, block_tables, :page_size].reshape(n_kv_heads, b, S)
+        vs = v_scales[:, block_tables, :page_size].reshape(n_kv_heads, b, S)
+        k_dense = k_dense.astype(jnp.float32) * ks[..., None]
+        v_dense = v_dense.astype(jnp.float32) * vs[..., None]
+    qf = q.reshape(b, s, n_kv_heads, group, head_dim).astype(jnp.float32)
+    sc = jnp.einsum("bwhgd,hbsd->bhgws", qf,
+                    k_dense.astype(jnp.float32)) * scale
+    q_pos = context_lens[:, None] + jnp.arange(s)[None, :]  # [b, w]
+    mask = jnp.arange(S)[None, None, :] <= q_pos[:, :, None]  # [b, w, S]
+    sc = jnp.where(mask[:, None, None], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhgws,hbsd->bwhgd", p, v_dense.astype(jnp.float32))
+    return out.reshape(b, s, n_q_heads, head_dim).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # decode attention
 # ---------------------------------------------------------------------------

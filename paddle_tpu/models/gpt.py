@@ -146,19 +146,22 @@ class GPTAttention(nn.Layer):
         return self.out_proj(out), (nk, nv)
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None, mesh=None):
-        """Single-token decode over a paged KV cache: the GPT serving
-        path (reference: fused_multi_transformer GPT configs). Positions
-        are learned embeddings applied at the model level, so unlike
-        LLaMA there is no per-step rotation — the shared
-        `paged_attention_step` runs with rotate=None."""
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None):
+        """Decode over a paged KV cache: the GPT serving path
+        (reference: fused_multi_transformer GPT configs); s > 1 is the
+        speculative-verify window. Positions are learned embeddings
+        applied at the model level, so unlike LLaMA there is no
+        per-step rotation — the shared `paged_attention_step` runs with
+        rotate=None."""
         from .paged_step import paged_attention_step
 
-        b = hidden_states.shape[0]
-        q, k, v = self._split_qkv(self.qkv_proj(hidden_states), b, 1)
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        q, k, v = self._split_qkv(self.qkv_proj(hidden_states), b, s)
         out, new_cache = paged_attention_step(
             q, k, v, paged_cache, block_tables, context_lens,
-            active=active, mesh=mesh, kv_heads=self.num_heads)
+            active=active, mesh=mesh, kv_heads=self.num_heads,
+            limit_lens=limit_lens)
         return self.out_proj(out), new_cache
 
 
@@ -205,10 +208,12 @@ class GPTDecoderLayer(nn.Layer):
         return h + self.mlp(self.ln_2(h)), new_cache
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None, mesh=None):
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None):
         a, new_cache = self.attn.forward_paged(
             self.ln_1(hidden_states), paged_cache, block_tables,
-            context_lens, active=active, mesh=mesh)
+            context_lens, active=active, mesh=mesh,
+            limit_lens=limit_lens)
         h = hidden_states + a
         return h + self.mlp(self.ln_2(h)), new_cache
 
@@ -271,16 +276,22 @@ class GPTModel(nn.Layer):
         return self.ln_f(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None, mesh=None):
-        # per-ROW learned positions: slot b's new token sits at
-        # context_lens[b] (unlike forward_cached's shared scalar offset)
-        pos = Tensor(as_array(context_lens).astype(jnp.int64)[:, None])
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None, max_layers=None):
+        # per-ROW learned positions: slot b's window tokens sit at
+        # context_lens[b]..+s-1 (unlike forward_cached's shared scalar
+        # offset); max_layers = shallow-exit draft (ln_f still applies)
+        s = input_ids.shape[1]
+        pos = Tensor(as_array(context_lens).astype(jnp.int64)[:, None]
+                     + jnp.arange(s, dtype=jnp.int64)[None, :])
         h = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        layers = self.layers if max_layers is None \
+            else list(self.layers)[:max_layers]
         new_caches = []
-        for layer, cache in zip(self.layers, paged_caches):
+        for layer, cache in zip(layers, paged_caches):
             h, nc = layer.forward_paged(h, cache, block_tables,
                                         context_lens, active=active,
-                                        mesh=mesh)
+                                        mesh=mesh, limit_lens=limit_lens)
             new_caches.append(nc)
         return self.ln_f(h), new_caches
 
@@ -309,10 +320,12 @@ class GPTForCausalLM(CausalLMBase):
         return self._head(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None, mesh=None):
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None, max_layers=None):
         h, new_caches = self.gpt.forward_paged(
             input_ids, paged_caches, block_tables, context_lens,
-            active=active, mesh=mesh)
+            active=active, mesh=mesh, limit_lens=limit_lens,
+            max_layers=max_layers)
         return self._head(h), new_caches
 
     def _backbone_embed_weight(self):

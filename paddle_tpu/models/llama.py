@@ -240,37 +240,43 @@ class LlamaAttention(nn.Layer):
         return self.o_proj(out)
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None, mesh=None):
-        """Single-token decode over a paged KV cache (serving path,
-        SURVEY.md §7 phase 10). hidden_states: [b, 1, hidden];
-        paged_cache: (k_pages, v_pages) [kv_heads, n_pages, page_size, d];
-        context_lens[b]: tokens already in the cache for that slot (the new
-        token lands there); active[b]=False rows skip the cache write
-        (retired serving slots with stale block tables). Returns
-        (out [b, 1, hidden], new_cache)."""
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None):
+        """Decode over a paged KV cache (serving path, SURVEY.md §7
+        phase 10). hidden_states: [b, s, hidden] — s == 1 is the classic
+        single-token decode step; s > 1 is a speculative-verify WINDOW
+        (all s tokens' K/V scatter at positions context_lens..+s-1, each
+        position attends its own causal prefix). paged_cache:
+        (k_pages, v_pages) [kv_heads, n_pages, page_size, d];
+        context_lens[b]: tokens already in the cache for that slot (the
+        new tokens land there); active[b]=False rows skip the cache write
+        (retired serving slots with stale block tables); limit_lens[b]:
+        window positions at/beyond it write nothing (budget overhang).
+        Returns (out [b, s, hidden], new_cache)."""
         from ..ops.manipulation import reshape
         from .paged_step import paged_attention_step
 
-        b = hidden_states.shape[0]
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
         q = reshape(self.q_proj(hidden_states),
-                    [b, 1, self.num_heads, self.head_dim])
+                    [b, s, self.num_heads, self.head_dim])
         k = reshape(self.k_proj(hidden_states),
-                    [b, 1, self.num_kv_heads, self.head_dim])
+                    [b, s, self.num_kv_heads, self.head_dim])
         v = reshape(self.v_proj(hidden_states),
-                    [b, 1, self.num_kv_heads, self.head_dim])
+                    [b, s, self.num_kv_heads, self.head_dim])
         theta = self.rope_theta
         head_dim = self.head_dim
 
         def rotate(qq, kk, lens):
-            # per-slot rope at position lens[b] (shared tables, rope.py)
-            cos, sin = rope_tables(1, head_dim, base=theta, dtype=qq.dtype,
-                                   position_offset=lens)
+            # per-slot rope at positions lens[b]..lens[b]+s-1 (shared
+            # tables, rope.py — a [b] offset yields [b, s, d/2] tables)
+            cos, sin = rope_tables(qq.shape[1], head_dim, base=theta,
+                                   dtype=qq.dtype, position_offset=lens)
             return apply_rope(qq, cos, sin), apply_rope(kk, cos, sin)
 
         out, new_cache = paged_attention_step(
             q, k, v, paged_cache, block_tables, context_lens,
             active=active, mesh=mesh, kv_heads=self.num_kv_heads,
-            rotate=rotate)
+            rotate=rotate, limit_lens=limit_lens)
         return self.o_proj(out), new_cache
 
     def _cached_attention(self, q, k, v, kv_cache, cur_len, b, s):
@@ -363,12 +369,13 @@ class LlamaDecoderLayer(nn.Layer):
         return residual + h2, new_cache
 
     def forward_paged(self, hidden_states, paged_cache, block_tables,
-                      context_lens, active=None, mesh=None):
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         h, new_cache = self.self_attn.forward_paged(
             h, paged_cache, block_tables, context_lens, active=active,
-            mesh=mesh)
+            mesh=mesh, limit_lens=limit_lens)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
@@ -419,13 +426,20 @@ class LlamaModel(nn.Layer):
         return self.norm(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None, mesh=None):
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None, max_layers=None):
+        """max_layers: run only the first N decoder layers (the
+        LayerSkip-style shallow-exit draft path of self-speculative
+        decoding) — `paged_caches` then carries N entries and the final
+        norm still applies, so the lm head sees a normed early exit."""
         h = self.embed_tokens(input_ids)
+        layers = self.layers if max_layers is None \
+            else list(self.layers)[:max_layers]
         new_caches = []
-        for layer, cache in zip(self.layers, paged_caches):
+        for layer, cache in zip(layers, paged_caches):
             h, nc = layer.forward_paged(h, cache, block_tables,
                                         context_lens, active=active,
-                                        mesh=mesh)
+                                        mesh=mesh, limit_lens=limit_lens)
             new_caches.append(nc)
         return self.norm(h), new_caches
 
@@ -457,10 +471,12 @@ class LlamaForCausalLM(CausalLMBase):
         return self._head(h), new_caches
 
     def forward_paged(self, input_ids, paged_caches, block_tables,
-                      context_lens, active=None, mesh=None):
+                      context_lens, active=None, mesh=None,
+                      limit_lens=None, max_layers=None):
         h, new_caches = self.llama.forward_paged(
             input_ids, paged_caches, block_tables, context_lens,
-            active=active, mesh=mesh)
+            active=active, mesh=mesh, limit_lens=limit_lens,
+            max_layers=max_layers)
         return self._head(h), new_caches
 
     def _backbone_embed_weight(self):

@@ -1,14 +1,24 @@
-"""Shared single-token paged-attention step for serving decode.
+"""Shared paged-attention step for serving decode.
 
 The serving path (reference: fused_multi_transformer_op, SURVEY.md §2.1)
-is model-agnostic once q/k/v for the new token exist: write the token's
-K/V into the paged pools (float or int8+scales), run decode attention
-over the pages (measured XLA-gather/Pallas dispatch), all inside an
-optional shard_map manual over tp — heads are embarrassingly parallel,
-so q/k/v shard on the head dim, pools on their kv-head dim, ZERO
-collectives inside. Model-specific position encoding (LLaMA rope) plugs
-in via `rotate(q, k, lens)` applied INSIDE the mapped step, where the
-per-slot positions are available.
+is model-agnostic once q/k/v for the new token(s) exist: write the
+token K/V into the paged pools (float or int8+scales), run decode
+attention over the pages (measured XLA-gather/Pallas dispatch), all
+inside an optional shard_map manual over tp — heads are embarrassingly
+parallel, so q/k/v shard on the head dim, pools on their kv-head dim,
+ZERO collectives inside. Model-specific position encoding (LLaMA rope)
+plugs in via `rotate(q, k, lens)` applied INSIDE the mapped step, where
+the per-slot positions are available.
+
+Two shapes of step share this entry:
+- s == 1: classic single-token decode (the per-page Pallas kernel /
+  measured dispatch).
+- s > 1: a WINDOW step — the speculative-decoding verify forward
+  (inference/serving.py): all s tokens' K/V scatter into the pages at
+  positions lens..lens+s-1 (positions at/beyond `limit_lens` masked —
+  the window may overhang a row's budget), then every window position
+  attends its own causal prefix in one dense-gather attention
+  (kernels.paged_attention.paged_attention_window_xla).
 """
 from __future__ import annotations
 
@@ -20,16 +30,19 @@ from ..tensor import Tensor, _apply_op, as_array
 
 def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
                          active=None, mesh=None, kv_heads=None,
-                         rotate=None):
-    """q: [b, 1, heads, d]; k/v: [b, 1, kv_heads, d] (Tensors).
+                         rotate=None, limit_lens=None):
+    """q: [b, s, heads, d]; k/v: [b, s, kv_heads, d] (Tensors; s == 1 is
+    the classic decode step, s > 1 the speculative-verify window).
     paged_cache: (k_pages, v_pages) or (k_pages, v_pages, k_scales,
-    v_scales) for int8 pages. Returns (out [b, 1, heads*d] Tensor,
-    new_cache tuple)."""
+    v_scales) for int8 pages. limit_lens: optional [b] — window
+    positions at or beyond it write nothing (budget overhang). Returns
+    (out [b, s, heads*d] Tensor, new_cache tuple)."""
     from ..distributed import mesh as _mesh
     from ..distributed.sharding_utils import in_manual_region
     from ..kernels import paged_attention as _pa
 
     b = q.shape[0]
+    s_win = int(q.shape[1])
     n_heads = q.shape[2]
     head_dim = q.shape[3]
     if kv_heads is None:
@@ -40,24 +53,50 @@ def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
     else:
         k_pages, v_pages = paged_cache
     act = active if active is not None else True
+    limit = limit_lens
 
-    def step(qq, kk, vv, kp, vp, tables, lens, act_mask, *scales):
+    def step(qq, kk, vv, kp, vp, tables, lens, act_mask, *rest):
+        if kv_quant:
+            ksc, vsc = rest[:2]
+            rest = rest[2:]
+        lim = rest[0] if limit is not None else None
         if rotate is not None:
             qq, kk = rotate(qq, kk, lens)
-        attn = _pa.paged_attention_dispatch
+        if s_win == 1:
+            attn = _pa.paged_attention_dispatch
+            # a row at/past its limit writes NOTHING: the draft scan of
+            # a row that exhausted its budget would otherwise write
+            # through stale (or zero) block-table entries into pages
+            # owned by OTHER live requests (its own output is discarded
+            # by the host commit, but the clobbered page is not)
+            wm = act_mask if lim is None else act_mask & (lens < lim)
+            if kv_quant:
+                kp2, ksc2, vp2, vsc2 = _pa.update_paged_kv_cache_q8(
+                    kp, ksc, vp, vsc, kk[:, 0], vv[:, 0],
+                    tables, lens, active=wm)
+                out = attn(qq[:, 0], kp2, vp2, tables, lens + 1,
+                           k_scales=ksc2, v_scales=vsc2)
+                return out[:, None], kp2, vp2, ksc2, vsc2
+            kp2, vp2 = _pa.update_paged_kv_cache(
+                kp, vp, kk[:, 0].astype(kp.dtype),
+                vv[:, 0].astype(vp.dtype), tables, lens, active=wm)
+            out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
+            return out[:, None], kp2, vp2
+        # window step (speculative verify): scatter the whole window,
+        # then per-position causal attention over the paged prefix
         if kv_quant:
-            ksc, vsc = scales
-            kp2, ksc2, vp2, vsc2 = _pa.update_paged_kv_cache_q8(
-                kp, ksc, vp, vsc, kk[:, 0], vv[:, 0],
-                tables, lens, active=act_mask)
-            out = attn(qq[:, 0], kp2, vp2, tables, lens + 1,
-                       k_scales=ksc2, v_scales=vsc2)
-            return out[:, None], kp2, vp2, ksc2, vsc2
-        kp2, vp2 = _pa.update_paged_kv_cache(
-            kp, vp, kk[:, 0].astype(kp.dtype), vv[:, 0].astype(vp.dtype),
-            tables, lens, active=act_mask)
-        out = attn(qq[:, 0], kp2, vp2, tables, lens + 1)
-        return out[:, None], kp2, vp2
+            kp2, ksc2, vp2, vsc2 = _pa.scatter_paged_kv_window_q8(
+                kp, ksc, vp, vsc, kk, vv, tables, lens,
+                limit_lens=lim, active=act_mask)
+            out = _pa.paged_attention_window_xla(
+                qq, kp2, vp2, tables, lens, k_scales=ksc2,
+                v_scales=vsc2)
+            return out, kp2, vp2, ksc2, vsc2
+        kp2, vp2 = _pa.scatter_paged_kv_window(
+            kp, vp, kk, vv, tables, lens, limit_lens=lim,
+            active=act_mask)
+        out = _pa.paged_attention_window_xla(qq, kp2, vp2, tables, lens)
+        return out, kp2, vp2
 
     from jax.sharding import PartitionSpec as _P
 
@@ -67,12 +106,13 @@ def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
     tp = int(mesh.shape["tp"]) if mesh is not None \
         and "tp" in mesh.axis_names else 1
     if tp > 1 and not in_manual_region() and kv_heads % tp == 0:
-        hs = _P(None, None, "tp")      # [b, 1, heads, hd]
+        hs = _P(None, None, "tp")      # [b, s, heads, hd]
         ps = _P("tp")                  # [kvh, n_pages, page, hd]
         rs = _P()
         # scale pools shard with their kv heads too: [kvh, n_pages, 128]
         in_specs = (hs, hs, hs, ps, ps, rs, rs, rs) + \
-            ((ps, ps) if kv_quant else ())
+            ((ps, ps) if kv_quant else ()) + \
+            ((rs,) if limit is not None else ())
         out_specs = (hs, ps, ps) + ((ps, ps) if kv_quant else ())
         run = jax.shard_map(
             step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -84,6 +124,8 @@ def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
             Tensor(jnp.broadcast_to(jnp.asarray(act, bool), (b,)))]
     if kv_quant:
         args += [Tensor(as_array(k_scales)), Tensor(as_array(v_scales))]
+    if limit is not None:
+        args += [Tensor(as_array(limit))]
     res = _apply_op(run, *args, _name="paged_attention")
     if kv_quant:
         out, new_k, new_v, new_ks, new_vs = res
@@ -93,5 +135,5 @@ def paged_attention_step(q, k, v, paged_cache, block_tables, context_lens,
         new_cache = (new_k, new_v)
     from ..ops.manipulation import reshape
 
-    out = reshape(out, [b, 1, n_heads * head_dim])
+    out = reshape(out, [b, s_win, n_heads * head_dim])
     return out, new_cache

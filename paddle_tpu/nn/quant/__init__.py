@@ -94,22 +94,16 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
 
 
 def _dequant_jnp(qw, scale, weight_dtype, group_size, out_dtype):
-    """Inline dequantization (traced; XLA fuses it into the consumer)."""
-    if weight_dtype == "int4":
-        # sign-extending nibble unpack: low via <<4 then arithmetic >>4,
-        # high via arithmetic >>4 (int8 shifts are arithmetic)
-        lo = jnp.right_shift(jnp.left_shift(qw, 4), 4)
-        hi = jnp.right_shift(qw, 4)
-        k2, n = qw.shape
-        q = jnp.stack([lo, hi], axis=1).reshape(k2 * 2, n)
-    else:
-        q = qw
-    k, n = q.shape
-    s = scale if scale.ndim == 2 else scale[None, :]
-    groups = s.shape[0]
-    w = q.reshape(groups, k // groups, n).astype(out_dtype) \
-        * s[:, None, :].astype(out_dtype)
-    return w.reshape(k, n)
+    """Inline dequantization (traced; XLA fuses it into the consumer).
+
+    Delegates to `kernels.quant_matmul.dequantize` — ONE copy of the
+    layout-critical nibble-unpack + group-scale expansion, shared with
+    the fused kernel's reference path (group count is inferred from the
+    scale's shape, same as here; `group_size` stays for signature
+    parity)."""
+    from ...kernels.quant_matmul import dequantize
+
+    return dequantize(qw, scale, weight_dtype, out_dtype)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1,
@@ -127,17 +121,23 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """y = x @ dequant(weight) + bias (reference: `weight_only_linear`).
 
-    The dequant (convert + scale) is traced inline so XLA fuses it into
-    the matmul's weight load — the weight's HBM footprint stays int8/int4.
+    The matmul routes through `kernels.quant_matmul.quant_matmul_dispatch`
+    — with the autotuner on (or FLAGS_quant_matmul=fused) the measured
+    winner may be the fused dequant-in-kernel Pallas path, which streams
+    int8/int4 weight tiles + group scales into VMEM and dequantizes
+    inside the matmul loop (the bf16 weight never exists in HBM).
+    Otherwise the legacy traced dequant (convert + scale, fused into the
+    weight load by XLA) runs bit-identically to the pre-kernel behavior.
     """
     if weight_dtype not in ("int8", "int4"):
         raise ValueError("weight_dtype must be 'int8' or 'int4'")
     if weight_scale is None:
         raise ValueError("weight_scale is required")
 
+    from ...kernels.quant_matmul import quant_matmul_dispatch
+
     def f(a, q, s, *b):
-        w = _dequant_jnp(q, s, weight_dtype, group_size, a.dtype)
-        out = jnp.matmul(a, w)
+        out = quant_matmul_dispatch(a, q, s, weight_dtype, group_size)
         return out + b[0] if b else out
 
     args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())

@@ -751,6 +751,8 @@ def rank_table(shards: Dict[int, str],
         except OSError:
             samples = {}
         hb = heartbeats.get(rank, {})
+        proposed = _total(samples, "spec_tokens_proposed_total")
+        accepted = _total(samples, "spec_tokens_accepted_total")
         out.append({
             "rank": rank,
             "step": hb.get("step"),
@@ -762,6 +764,10 @@ def rank_table(shards: Dict[int, str],
             "ttft_ms": _hist_mean_ms(samples, "serving_ttft_seconds"),
             "collective_wait_s": _total(
                 samples, "collective_wait_seconds_total"),
+            # speculative-decoding acceptance (None when the rank never
+            # ran a spec round — vanilla serving/train workloads)
+            "spec_acceptance": round(accepted / proposed, 4)
+            if proposed else None,
         })
     return out
 
@@ -1125,15 +1131,19 @@ def format_report(report: dict) -> str:
         lines.append("== per-rank summary ==")
         lines.append(f"{'rank':>5} {'step':>8} {'beat_age_s':>11} "
                      f"{'train_step_ms':>14} {'decode_step_ms':>15} "
-                     f"{'ttft_ms':>9} {'coll_wait_s':>12}")
+                     f"{'ttft_ms':>9} {'coll_wait_s':>12} "
+                     f"{'spec_acc%':>10}")
         for r in report["ranks"]:
+            acc = r.get("spec_acceptance")
+            acc_s = f"{acc * 100.0:.1f}" if acc is not None else "-"
             lines.append(
                 f"{r['rank']:>5} {str(r['step']):>8} "
                 f"{_fmt_opt_ms(r['beat_age_s']):>11} "
                 f"{_fmt_opt_ms(r['train_step_ms']):>14} "
                 f"{_fmt_opt_ms(r['decode_step_ms']):>15} "
                 f"{_fmt_opt_ms(r['ttft_ms']):>9} "
-                f"{_fmt_opt_ms(r['collective_wait_s']):>12}")
+                f"{_fmt_opt_ms(r['collective_wait_s']):>12} "
+                f"{acc_s:>10}")
         lines.append("")
     for r in report["missing"]:
         lines.append(f"MISSING RANK: rank {r} declared by the job but "

@@ -199,6 +199,20 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
         alloc_tokens = sum(s.n_pages * e.page_size
                            for s in e.slots if s.active)
         used_tokens = sum(s.context_len for s in e.slots if s.active)
+        spec = None
+        if getattr(e, "spec_decode", 0):
+            proposed = getattr(e, "_spec_proposed_total", 0)
+            accepted = getattr(e, "_spec_accepted_total", 0)
+            spec = {
+                "window": e.spec_decode,
+                "draft_layers": getattr(e, "spec_draft_layers", None),
+                "draft_model": getattr(e, "_draft_model", None)
+                is not None,
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": round(accepted / proposed, 4)
+                if proposed else None,
+            }
         serving.append({
             "engine": i,
             "max_batch": e.max_batch,
@@ -217,6 +231,7 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
                     1.0 - used_tokens / alloc_tokens, 4)
                 if alloc_tokens else 0.0,
             },
+            "spec": spec,
             "slots": slots,
         })
     from . import fleet as _fleet

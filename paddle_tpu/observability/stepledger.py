@@ -442,23 +442,38 @@ def has_cost(entry: str) -> bool:
 def register_cost(entry: str, flops: float = 0.0,
                   bytes_accessed: float = 0.0, n_devices: int = 1,
                   peak_flops=None, peak_bw=None,
-                  registry=None) -> dict:
+                  registry=None, quant=None,
+                  quant_bytes_delta: float = 0.0) -> dict:
     """Record an entry point's analytical cost (and the device peaks it
     rooflines against) and publish the gauges. Peaks default to the
     shared device_peaks table for the process's device; unknown devices
-    (CPU test backend) record 0 and classify `unknown`."""
+    (CPU test backend) record 0 and classify `unknown`.
+
+    quant / quant_bytes_delta: weight-only-quantized executables tag
+    the entry (the roofline row carries `quant`) and correct the
+    cost_analysis byte count — XLA bills the dequantized bf16/f32
+    weight intermediate as memory traffic, but the HBM bytes a
+    dequant-in-kernel (or load-fused) matmul actually moves are the
+    int8/int4 ones, so the caller subtracts the (float - int) weight
+    delta to keep intensity classification and stepledger_mfu honest
+    for quantized decode."""
     if peak_flops is None:
         peak_flops = _peaks.detect_peak_flops()
     if peak_bw is None:
         peak_bw = _peaks.detect_peak_hbm_bytes_per_s()
     _counts["costs"] += 1
+    nbytes = float(bytes_accessed or 0.0)
+    if quant_bytes_delta:
+        nbytes = max(nbytes - float(quant_bytes_delta), 0.0)
     cost = {
         "flops": float(flops or 0.0),
-        "bytes_accessed": float(bytes_accessed or 0.0),
+        "bytes_accessed": nbytes,
         "n_devices": max(int(n_devices), 1),
         "peak_flops": float(peak_flops or 0.0),
         "peak_bw": float(peak_bw or 0.0),
     }
+    if quant:
+        cost["quant"] = str(quant)
     with _lock:
         _costs[entry] = cost
     h = _make_handles(registry) if registry is not None else _h()
@@ -495,13 +510,17 @@ def _abstract(obj):
 
 
 def register_from_lowered(entry: str, jitted, args,
-                          kwargs=None) -> Optional[dict]:
+                          kwargs=None, quant=None,
+                          quant_bytes_delta: float = 0.0
+                          ) -> Optional[dict]:
     """Register `entry`'s cost by AOT-lowering the jitted callable on
     the abstracted `args` and reading the compiled program's
     cost_analysis. Once per entry point; compiles the program a second
     time (the AOT path does not share the jit executable cache), so it
     only runs under FLAGS_stepledger. Never raises — a lowering failure
-    records a zero-cost sentinel so it is not retried every step."""
+    records a zero-cost sentinel so it is not retried every step.
+    quant/quant_bytes_delta: see register_cost — quantized-weight
+    executables correct the bf16-intermediate byte overcount."""
     if not enabled() or entry in _costs:
         return _costs.get(entry)
     try:
@@ -516,7 +535,8 @@ def register_from_lowered(entry: str, jitted, args,
         except Exception:  # noqa: BLE001
             n_dev = 1
         return register_cost(entry, c["flops"], c["bytes_accessed"],
-                             n_devices=n_dev)
+                             n_devices=n_dev, quant=quant,
+                             quant_bytes_delta=quant_bytes_delta)
     except Exception as e:  # noqa: BLE001 — cost is optional telemetry
         with _lock:
             _costs[entry] = {"flops": 0.0, "bytes_accessed": 0.0,
@@ -567,6 +587,10 @@ def roofline(entry: str) -> dict:
         "comm_fraction": round(comm_frac, 4),
         "bound": classify(flops, nbytes, pf, pb, comm_frac),
     }
+    if cost.get("quant"):
+        # weight-only-quantized executable: bytes_accessed above already
+        # carries the int-weight-traffic correction (register_cost)
+        out["quant"] = cost["quant"]
     if agg:
         mfu = _mfu(cost, agg["steps"], agg["wall"])
         if mfu is not None:

@@ -327,6 +327,13 @@ class TestGoldenSchema:
                (("bh", 8), ("sq", 512), ("skv", 512), ("d", 128),
                 ("causal", 1), ("dt", "bfloat16")),
                _timed_candidates(table), lambda: (None,))
+        # the quant_matmul op (ISSUE 9) persists through the same schema
+        qtable = {"xla": ("xla", 0.8), "fused:256x256": ("pallas", 0.4)}
+        at.set_timer(_timer_for(qtable))
+        t.pick("quant_matmul",
+               (("m", 8), ("k", 1024), ("n", 4096), ("wd", "int4"),
+                ("gs", 128), ("dt", "bfloat16")),
+               _timed_candidates(qtable), lambda: (None,))
         got = json.load(open(t.cache_path()))
         golden_path = os.path.join(os.path.dirname(__file__), "data",
                                    "autotune_cache_golden.json")

@@ -67,6 +67,70 @@ class TestWeightQuantize:
             weight_quantize(w, group_size=32)
 
 
+class TestInt4RoundTripGolden:
+    """The int4 storage contract (ISSUE 9 satellite): pack layout,
+    group_size variants, odd in_features. This golden is THE reference
+    the fused dequant-matmul kernel (kernels/quant_matmul.py) is checked
+    against — its unpack path must invert exactly this layout."""
+
+    def test_pack_layout_golden(self):
+        """Hand-computed nibble pack: byte row r holds logical rows 2r
+        (low nibble) and 2r+1 (high nibble), int8 arithmetic shifts
+        recover the signed lattice values."""
+        # scale = absmax/7 = 1.0 per column -> q == w exactly
+        w = np.array([[7., -7.], [1., -1.], [-3., 5.], [0., 2.]],
+                     np.float32)
+        qw, scale = weight_quantize(paddle.to_tensor(w),
+                                    algo="weight_only_int4")
+        q = np.asarray(qw.numpy())
+        assert q.dtype == np.int8 and q.shape == (2, 2)
+        # byte 0: col0 lo=7 (0x7) hi=1 -> 0x17 = 23;
+        #         col1 lo=-7 (0x9) hi=-1 (0xF) -> 0xF9 = -7
+        # byte 1: col0 lo=-3 (0xD) hi=0 -> 0x0D = 13;
+        #         col1 lo=5 (0x5) hi=2 -> 0x25 = 37
+        np.testing.assert_array_equal(q, [[23, -7], [13, 37]])
+        np.testing.assert_array_equal(np.asarray(scale.numpy()),
+                                      np.ones(2, np.float32))
+        wd = _np(weight_dequantize(qw, scale, algo="weight_only_int4"))
+        np.testing.assert_array_equal(wd, w)
+
+    @pytest.mark.parametrize("group_size", [-1, 64, 128])
+    def test_round_trip_exact_on_lattice(self, group_size):
+        """Weights already on the int4 lattice of their group absmax
+        round-trip exactly through quantize -> dequantize for every
+        supported group_size."""
+        rng = np.random.RandomState(31)
+        k, n = 256, 48
+        levels = rng.randint(-7, 8, (k, n)).astype(np.float32)
+        groups = 1 if group_size == -1 else k // group_size
+        gscale = rng.uniform(0.01, 0.2, (groups, n)).astype(np.float32)
+        w = (levels.reshape(groups, k // groups, n)
+             * gscale[:, None, :]).reshape(k, n)
+        # pin each group's absmax so scale reproduces gscale exactly
+        w.reshape(groups, k // groups, n)[:, 0, :] = 7.0 * gscale
+        qw, scale = weight_quantize(paddle.to_tensor(w),
+                                    algo="weight_only_int4",
+                                    group_size=group_size)
+        s = np.asarray(scale.numpy())
+        np.testing.assert_allclose(s if s.ndim == 2 else s[None, :],
+                                   gscale, rtol=1e-6)
+        wd = _np(weight_dequantize(qw, scale, algo="weight_only_int4",
+                                   group_size=group_size))
+        np.testing.assert_allclose(wd, w, rtol=1e-5, atol=1e-6)
+
+    def test_odd_in_features_rejected(self):
+        """int4 packs two rows per byte along the in dim — an odd
+        in_features has no byte layout and must be rejected loudly, not
+        silently truncated."""
+        w = paddle.to_tensor(np.random.RandomState(32)
+                             .randn(127, 8).astype(np.float32))
+        with pytest.raises(ValueError, match="even in_features"):
+            weight_quantize(w, algo="weight_only_int4")
+        # int8 has no pack constraint: odd k must keep working
+        qw, _ = weight_quantize(w, algo="weight_only_int8")
+        assert qw.shape == [127, 8]
+
+
 class TestWeightOnlyLinear:
     def test_matches_dequant_matmul_exactly(self):
         rng = np.random.RandomState(2)

@@ -1,0 +1,339 @@
+"""Self-speculative decoding for the serving engine (ISSUE 9 tentpole b).
+
+The spec path drafts window-1 tokens with a cheap forward (shallow-exit
+over the first spec_draft_layers layers, or a separate draft model),
+verifies the whole window in ONE batched target forward over the paged
+KV cache, and commits the greedy-exact accepted prefix plus one
+corrected token. The contract these tests pin: a spec engine is
+OBSERVATIONALLY IDENTICAL to the single-step greedy engine — token
+streams, eos truncation, preemption, finish order — because acceptance
+is exact greedy prefix matching (the committed stream IS what vanilla
+greedy decoding would have produced). Plus the observability contract:
+spec_tokens_proposed/accepted_total counters, the per-request acceptance
+histogram at finish, and the /statusz spec section.
+"""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # engine tests compile several programs
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM)
+from paddle_tpu.observability import metrics as om
+
+
+def _tiny_model(vocab=97, hidden=32, layers=4, heads=4, seq=64, seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads, seq=seq)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _run(engine, prompts, max_news, **kw):
+    rids = [engine.add_request(p, max_new_tokens=n, **kw)
+            for p, n in zip(prompts, max_news)]
+    finished = {f.request_id: f for f in engine.run()}
+    assert sorted(finished) == sorted(rids)
+    return [finished[r].output_ids for r in rids]
+
+
+class TestSpecGreedyExact:
+    def test_matches_single_step_mixed_budgets(self):
+        # budgets straddle the window: 1 (finishes at prefill sample),
+        # 3 (mid-window), 4 (exactly one window), 9 (window tail)
+        m, cfg = _tiny_model()
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, cfg.vocab_size, (n,))
+                   for n in (4, 6, 5, 7)]
+        max_news = [1, 3, 4, 9]
+        kw = dict(max_batch=4, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        out1 = _run(ServingEngine(m, **kw), prompts, max_news)
+        outS = _run(ServingEngine(m, spec_decode=4, **kw), prompts,
+                    max_news)
+        for a, b in zip(out1, outS):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("window", [2, 3])
+    def test_window_sizes(self, window):
+        m, cfg = _tiny_model(seed=1)
+        p = np.random.RandomState(3).randint(0, cfg.vocab_size, (5,))
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        ref, = _run(ServingEngine(m, **kw), [p], [9])
+        out, = _run(ServingEngine(m, spec_decode=window, **kw), [p], [9])
+        np.testing.assert_array_equal(ref, out)
+
+    def test_eos_mid_window_truncates_identically(self):
+        m, cfg = _tiny_model()
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        # pick a greedy token whose FIRST occurrence is past position 0
+        # so the eos stop lands mid-window, not on the prefill sample
+        stop_at = None
+        for seed in range(5, 30):
+            p = np.random.RandomState(seed).randint(
+                0, cfg.vocab_size, (4,))
+            probe, = _run(ServingEngine(m, **kw), [p], [8])
+            cand = [i for i in range(1, len(probe))
+                    if int(probe[i]) not in [int(t) for t in probe[:i]]]
+            if cand:
+                stop_at = cand[0]
+                break
+        assert stop_at is not None, \
+            "no prompt produced a fresh mid-stream token"
+        eos = int(probe[stop_at])
+        out1, = _run(ServingEngine(m, **kw), [p], [8], eos_token_id=eos)
+        outS, = _run(ServingEngine(m, spec_decode=4, **kw), [p], [8],
+                     eos_token_id=eos)
+        np.testing.assert_array_equal(out1, outS)
+        assert outS[-1] == eos and len(outS) == stop_at + 1
+
+    def test_preemption_under_spec(self):
+        # page pool sized so concurrent slots exhaust it mid-stream: the
+        # spec path reserves min(window, rem) pages and must preempt the
+        # youngest on exhaustion, still completing everyone exactly
+        m, cfg = _tiny_model()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, cfg.vocab_size, (4,))
+                   for _ in range(3)]
+        kw = dict(max_batch=3, max_seq_len=16, page_size=8,
+                  decode_strategy="greedy_search")
+        out1 = _run(ServingEngine(m, **kw), prompts, [10, 10, 10])
+        outS = _run(ServingEngine(m, spec_decode=3, **kw), prompts,
+                    [10, 10, 10])
+        for a, b in zip(out1, outS):
+            np.testing.assert_array_equal(a, b)
+
+    def test_gpt_model_window_path(self):
+        # learned positions (GPT) take the per-row window offsets path
+        # in forward_paged — the spec stream must still be greedy-exact
+        paddle.seed(2)
+        cfg = GPTConfig.tiny(vocab=89, hidden=32, layers=4, heads=4,
+                             seq=64)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        p = np.random.RandomState(5).randint(0, cfg.vocab_size, (5,))
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        ref, = _run(ServingEngine(m, **kw), [p], [8])
+        out, = _run(ServingEngine(m, spec_decode=4, **kw), [p], [8])
+        np.testing.assert_array_equal(ref, out)
+
+    def test_kv_quant_int8_spec_parity(self):
+        # int8 paged KV: the window scatter writes values + scales; the
+        # spec stream must match the single-step int8 stream exactly
+        # (same quantization lattice, same greedy argmax)
+        m, cfg = _tiny_model(seed=3)
+        p = np.random.RandomState(9).randint(0, cfg.vocab_size, (5,))
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search", kv_cache_quant="int8")
+        ref, = _run(ServingEngine(m, **kw), [p], [8])
+        out, = _run(ServingEngine(m, spec_decode=3, **kw), [p], [8])
+        np.testing.assert_array_equal(ref, out)
+
+    def test_separate_draft_model_greedy_exact(self):
+        # two-model speculative decoding: a half-depth draft model with
+        # its own page pools proposes; outputs stay greedy-exact because
+        # the TARGET verify decides every committed token
+        m, cfg = _tiny_model()
+        paddle.seed(4)
+        dcfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                                seq=64)
+        draft = LlamaForCausalLM(dcfg)
+        draft.eval()
+        p = np.random.RandomState(13).randint(0, cfg.vocab_size, (5,))
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        ref, = _run(ServingEngine(m, **kw), [p], [8])
+        eng = ServingEngine(m, spec_decode=3, draft_model=draft, **kw)
+        assert eng.spec_draft_layers is None  # draft model owns depth
+        out, = _run(eng, [p], [8])
+        np.testing.assert_array_equal(ref, out)
+
+
+class TestSpecScheduling:
+    def test_sampling_row_falls_back_to_classic_path(self):
+        # acceptance is greedy-exact prefix matching: a batch with a
+        # sampling row must take the classic dispatch (no spec round),
+        # and the greedy row's stream stays equal to the vanilla one
+        m, cfg = _tiny_model()
+        rng = np.random.RandomState(19)
+        pg = rng.randint(0, cfg.vocab_size, (5,))
+        ps = rng.randint(0, cfg.vocab_size, (5,))
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        ref, = _run(ServingEngine(m, **kw), [pg], [6])
+        e = ServingEngine(m, spec_decode=4, **kw)
+        rid_g = e.add_request(pg, max_new_tokens=6)
+        rid_s = e.add_request(ps, max_new_tokens=6,
+                              decode_strategy="sampling",
+                              temperature=0.9)
+        fin = {f.request_id: f for f in e.run()}
+        np.testing.assert_array_equal(fin[rid_g].output_ids, ref)
+        assert len(fin[rid_s].output_ids) == 6
+        assert e._spec_proposed_total == 0  # never drafted
+
+    def test_spec_rejects_async_depth(self):
+        m, _cfg = _tiny_model()
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                          spec_decode=4, async_depth=2)
+
+    def test_window_below_two_is_off(self):
+        m, _cfg = _tiny_model()
+        e = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                          spec_decode=1)
+        assert e.spec_decode == 0
+
+    def test_flag_default_and_kwarg_override(self):
+        m, cfg = _tiny_model()
+        paddle.set_flags({"FLAGS_spec_decode": 3,
+                          "FLAGS_spec_draft_layers": 1})
+        try:
+            e = ServingEngine(m, max_batch=2, max_seq_len=32,
+                              page_size=8)
+            assert e.spec_decode == 3 and e.spec_draft_layers == 1
+            e2 = ServingEngine(m, max_batch=2, max_seq_len=32,
+                               page_size=8, spec_decode=2,
+                               spec_draft_layers=2)
+            assert e2.spec_decode == 2 and e2.spec_draft_layers == 2
+        finally:
+            paddle.set_flags({"FLAGS_spec_decode": 0,
+                              "FLAGS_spec_draft_layers": 0})
+
+    def test_draft_layers_default_is_half_depth(self):
+        m, cfg = _tiny_model()  # 4 layers
+        e = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                          spec_decode=4)
+        assert e.spec_draft_layers == 2
+
+    def test_warmup_compiles_spec_programs(self):
+        m, cfg = _tiny_model()
+        e = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                          decode_strategy="greedy_search", spec_decode=3)
+        e.warmup()
+        assert e._spec_draft_fns and e._spec_verify_fns
+        # traffic after warmup reuses the cached programs end-to-end
+        p = np.random.RandomState(23).randint(0, cfg.vocab_size, (4,))
+        out, = _run(e, [p], [6])
+        assert len(out) == 6
+
+
+class TestWindowLimitMask:
+    def test_single_token_step_masked_at_limit(self):
+        """Regression: the s==1 (draft-scan) step of a row at/past its
+        budget limit must write NOTHING — its stale block-table entries
+        can alias pages owned by OTHER live requests, and the clobber
+        broke greedy-exactness even though the row's own drafted token
+        is discarded by the host commit."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.paged_step import paged_attention_step
+        from paddle_tpu.tensor import Tensor, as_array
+
+        b, h, d, ps, npages = 2, 2, 4, 8, 4
+        k_pages = jnp.zeros((h, npages, ps, d), jnp.float32)
+        v_pages = jnp.zeros((h, npages, ps, d), jnp.float32)
+        tables = jnp.array([[0, 1], [2, 3]], jnp.int32)
+        lens = jnp.array([3, 5], jnp.int32)
+        limit = jnp.array([3, 6], jnp.int32)  # row 0 AT limit, row 1 not
+        rng = np.random.RandomState(0)
+        q = Tensor(rng.randn(b, 1, h, d).astype(np.float32))
+        k = Tensor(rng.randn(b, 1, h, d).astype(np.float32))
+        v = Tensor(rng.randn(b, 1, h, d).astype(np.float32))
+        _out, (nk, nv) = paged_attention_step(
+            q, k, v, (k_pages, v_pages), tables, lens,
+            active=np.array([True, True]), limit_lens=limit)
+        nk, nv = np.asarray(as_array(nk)), np.asarray(as_array(nv))
+        # row 0 (lens == limit): its pages 0..1 stay untouched
+        assert not nk[:, :2].any() and not nv[:, :2].any()
+        # row 1 (lens < limit): exactly its position 5 slot written
+        assert nk[:, 2, 5].any() and nv[:, 2, 5].any()
+        written = np.argwhere(nk.any(axis=(0, 3)))
+        np.testing.assert_array_equal(written, [[2, 5]])
+
+    def test_greedy_exact_across_slot_reuse_waves(self):
+        """The end-to-end form: a first wave of requests finishes and
+        frees its pages, leaving stale block-table entries on the
+        reused slots; a second mixed-budget wave (one row draining to
+        rem=1 while its neighbor keeps decoding) must stay greedy-exact
+        — pre-fix, the drained row's overhang draft writes clobbered
+        the neighbor's live pages through the stale entries."""
+        m, cfg = _tiny_model()
+        rng = np.random.RandomState(41)
+        waves = [([rng.randint(0, cfg.vocab_size, (4,)),
+                   rng.randint(0, cfg.vocab_size, (6,))], [10, 10]),
+                 ([rng.randint(0, cfg.vocab_size, (7,)),
+                   rng.randint(0, cfg.vocab_size, (5,))], [2, 12])]
+        kw = dict(max_batch=2, max_seq_len=32, page_size=8,
+                  decode_strategy="greedy_search")
+        e1 = ServingEngine(m, **kw)
+        eS = ServingEngine(m, spec_decode=4, **kw)
+        for prompts, budgets in waves:
+            ref = _run(e1, prompts, budgets)
+            out = _run(eS, prompts, budgets)
+            for a, b in zip(ref, out):
+                np.testing.assert_array_equal(a, b)
+
+
+class TestSpecObservability:
+    def test_counters_and_acceptance_histogram(self):
+        m, cfg = _tiny_model()
+        reg = om.Registry()
+        prev = om.default_registry()
+        om.set_default_registry(reg)
+        try:
+            e = ServingEngine(m, max_batch=2, max_seq_len=32,
+                              page_size=8,
+                              decode_strategy="greedy_search",
+                              spec_decode=3)
+            p = np.random.RandomState(29).randint(0, cfg.vocab_size,
+                                                  (5,))
+            out, = _run(e, [p], [8])
+        finally:
+            om.set_default_registry(prev)
+        proposed = reg.value("spec_tokens_proposed_total")
+        accepted = reg.value("spec_tokens_accepted_total")
+        assert proposed > 0
+        assert 0 <= accepted <= proposed
+        assert e._spec_proposed_total == proposed
+        assert e._spec_accepted_total == accepted
+        # the per-request acceptance histogram observed ONE finish
+        text = om.to_prometheus(reg)
+        assert "spec_tokens_proposed_total" in text
+        assert "spec_tokens_accepted_total" in text
+        assert "serving_spec_acceptance_ratio" in text
+        # Registry.value on a histogram returns its observation count
+        assert reg.value("serving_spec_acceptance_ratio") == 1
+
+    def test_statusz_spec_section(self):
+        from paddle_tpu.observability import httpd
+
+        m, cfg = _tiny_model()
+        e = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8,
+                          decode_strategy="greedy_search", spec_decode=3)
+        p = np.random.RandomState(31).randint(0, cfg.vocab_size, (5,))
+        _run(e, [p], [6])
+        payload = httpd.statusz_payload()
+        mine = [s for s in payload["serving"]
+                if s.get("spec") is not None]
+        assert mine, "no spec section in /statusz serving entries"
+        spec = mine[-1]["spec"]
+        assert spec["window"] == 3 and spec["draft_layers"] == 2
+        assert spec["proposed"] > 0
+        if spec["proposed"]:
+            assert spec["acceptance_rate"] is not None
+
+    def test_vanilla_engine_has_no_spec_section(self):
+        from paddle_tpu.observability import httpd
+
+        m, _cfg = _tiny_model()
+        e = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=8)
+        payload = httpd.statusz_payload()
+        mine = [s for s in payload["serving"] if s["max_batch"] == 2]
+        assert mine and mine[-1]["spec"] is None

@@ -67,10 +67,19 @@ METRICS = (
 
 # geometry AND the tuning knobs mfu_sweep varies at identical geometry
 # (recompute/scan/fused_ce trade throughput legitimately — a sweep
-# variant's history row must never baseline a canonical run); a key
-# absent on EITHER side is not compared, so pre-knob rows stay usable
+# variant's history row must never baseline a canonical run). A key
+# absent on EITHER side is not compared, so pre-knob rows stay usable.
 GEOMETRY_KEYS = ("batch", "seq", "hidden", "layers", "prompt_len",
                  "new_tokens", "recompute", "scan_layers", "fused_ce")
+
+# the serving decode knobs are comparability keys too — a speculative
+# or quantized row must never baseline a vanilla run or vice versa —
+# but with ABSENT == None: pre-knob baseline rows (no spec_decode key)
+# are vanilla runs, and skipping the key would let a ~2x speculative
+# row baseline the vanilla 357 tok/s capture, the exact mis-baselining
+# these keys exist to prevent
+KNOB_KEYS_ABSENT_IS_NONE = ("quant", "kv_quant", "spec_decode",
+                            "draft_layers")
 
 
 def _get(row, path):
@@ -153,6 +162,9 @@ def comparable(fresh: dict, base: dict) -> bool:
         return False
     for k in GEOMETRY_KEYS:
         if k in fe and k in be and fe[k] != be[k]:
+            return False
+    for k in KNOB_KEYS_ABSENT_IS_NONE:
+        if (k in fe or k in be) and fe.get(k) != be.get(k):
             return False
     return True
 

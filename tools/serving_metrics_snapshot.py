@@ -78,6 +78,71 @@ def snapshot_url(url: str, out: str) -> int:
     return 0
 
 
+def run_spec_smoke(window: int, min_acceptance: float) -> int:
+    """Speculative-decoding + weight-only-int8 CI smoke (ISSUE 9): the
+    model's linears swap to int8 storage routed through the fused
+    dequant-matmul kernel in interpret mode (FLAGS_quant_matmul=fused),
+    a spec engine (window `window`, shallow-exit draft) decodes the
+    same greedy prompts as a vanilla engine, and the smoke asserts
+    token-for-token output equality (greedy-exact), a non-zero
+    spec_tokens_accepted_total, and — when --min-acceptance > 0 — that
+    the observed acceptance rate clears the gate."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nn.quant import quantize_for_inference
+    from paddle_tpu.observability import metrics as om
+
+    paddle.set_flags({"FLAGS_quant_matmul": "fused"})
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=128, layers=4, heads=4,
+                           seq=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    quantize_for_inference(model, algo="weight_only_int8",
+                           exclude=("lm_head",))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in (6, 9, 4)]
+    budgets = (12, 7, 10)
+
+    def decode(**kw):
+        eng = ServingEngine(model, max_batch=2, max_seq_len=32,
+                            page_size=8, **kw)
+        rids = [eng.add_request(p, max_new_tokens=m)
+                for p, m in zip(prompts, budgets)]
+        fin = {f.request_id: f.output_ids.tolist() for f in eng.run()}
+        return [fin[r] for r in rids], eng
+
+    base, _eng = decode()
+    spec, eng = decode(spec_decode=window)
+    if base != spec:
+        print(f"spec smoke FAILED: speculative output differs from "
+              f"baseline greedy decode\n  base: {base}\n  spec: {spec}",
+              file=sys.stderr)
+        return 1
+    reg = om.default_registry()
+    proposed = reg.value("spec_tokens_proposed_total")
+    accepted = reg.value("spec_tokens_accepted_total")
+    if not accepted:
+        print(f"spec smoke FAILED: spec_tokens_accepted_total == 0 "
+              f"(proposed {proposed}) — the draft path never agreed "
+              f"with the target", file=sys.stderr)
+        return 1
+    rate = accepted / proposed if proposed else 0.0
+    if min_acceptance > 0 and rate < min_acceptance:
+        print(f"spec smoke FAILED: acceptance {rate:.3f} < "
+              f"--min-acceptance {min_acceptance}", file=sys.stderr)
+        return 1
+    print(f"spec smoke OK: window {window}, draft_layers "
+          f"{eng.spec_draft_layers}, int8 fused quant_matmul, "
+          f"{int(accepted)}/{int(proposed)} drafts accepted "
+          f"(acceptance {rate:.3f}), outputs greedy-exact")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="/tmp/ci_metrics.prom")
@@ -100,6 +165,16 @@ def main():
                     help="skip the smoke: scrape a LIVE engine's "
                          "/metrics (observability/httpd.py endpoint, "
                          "http://host:port) into --out")
+    ap.add_argument("--spec", type=int, default=0, metavar="WINDOW",
+                    help="skip the normal smoke: run the speculative-"
+                         "decoding + weight_only_int8 smoke instead — "
+                         "fused dequant-matmul kernel in interpret "
+                         "mode, greedy-exact output equality vs "
+                         "non-speculative decode, accepted counter > 0")
+    ap.add_argument("--min-acceptance", type=float, default=0.0,
+                    help="with --spec: fail (exit 1) when the observed "
+                         "draft acceptance rate is below this fraction "
+                         "(0 = report only)")
     ap.add_argument("--http", action="store_true",
                     help="boot the telemetry plane on an ephemeral "
                          "port during the smoke and gate /metrics + "
@@ -110,6 +185,9 @@ def main():
 
     if args.url:
         return snapshot_url(args.url, args.out)
+
+    if args.spec:
+        return run_spec_smoke(args.spec, args.min_acceptance)
 
     if args.merge:
         from paddle_tpu.observability import fleet
