@@ -7,6 +7,7 @@ from . import (  # noqa: F401
     flags,
     jax_compat,
     jit_side_effects,
+    retries,
     weak_float,
 )
 

@@ -1,0 +1,148 @@
+"""Rule: unbounded-retry — an infinite retry loop around a collective
+or a decode dispatch.
+
+`while True: try: all_reduce(x) except: continue` turns a persistent
+fault into a silent livelock: the rank spins forever re-entering a
+collective its peers already abandoned (or re-dispatching a decode that
+will OOM every time), burning the reservation with no progress and no
+error. The fault-tolerance plane (README.md "Fault tolerance") is built
+on BOUNDED retries — the serving engine's OOM handler retries once then
+escalates to drain->rebuild->re-admit, and recovery itself is capped by
+FLAGS_serving_max_recoveries with exponential backoff.
+
+Two shapes are flagged:
+
+- a `while True` / `while 1` loop whose `except` handler retries
+  (`continue`) a try body that calls a collective or a decode/dispatch
+  entry point, with no escape (`raise`/`break`/`return`) and no
+  backoff (`sleep`/`backoff` call) in the handler;
+- recursive retry: an `except` handler that re-invokes its OWN
+  enclosing function (the recursion IS the loop) with no re-raise,
+  where the function dispatches a collective or decode call.
+
+A loop that re-raises after bookkeeping, breaks out, returns, counts
+attempts in a `for`/bounded loop, or sleeps before retrying is clean.
+A deliberate hot-poll documents itself with
+`# tpu-lint: disable=unbounded-retry`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_parts, register
+from .collectives import UNAMBIGUOUS
+
+# leaf-name substrings that mark a call as a decode/serving dispatch
+_DISPATCH_HINTS = ("decode", "dispatch")
+# a handler that sleeps (or calls an explicit backoff helper) before
+# retrying is pacing itself — not the livelock shape this rule hunts
+_BACKOFF_CALLS = {"sleep", "backoff"}
+
+
+def _retryable_leaf(call: ast.Call):
+    """The call's leaf name when it is a collective or decode dispatch,
+    else None."""
+    parts = dotted_parts(call.func)
+    if not parts:
+        return None
+    leaf = parts[-1]
+    if leaf in UNAMBIGUOUS:
+        return leaf
+    low = leaf.lower()
+    if any(h in low for h in _DISPATCH_HINTS):
+        return leaf
+    return None
+
+
+def _first_retryable(node_or_body):
+    nodes = node_or_body if isinstance(node_or_body, list) else [node_or_body]
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                leaf = _retryable_leaf(n)
+                if leaf is not None:
+                    return leaf
+    return None
+
+
+def _has_backoff(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Call):
+            parts = dotted_parts(n.func)
+            if parts and parts[-1] in _BACKOFF_CALLS:
+                return True
+    return False
+
+
+def _is_while_true(node: ast.While) -> bool:
+    t = node.test
+    return isinstance(t, ast.Constant) and bool(t.value) is True
+
+
+@register
+class UnboundedRetryRule(Rule):
+    name = "unbounded-retry"
+    description = ("infinite retry loop (while-True except-continue, or "
+                   "recursive re-invoke from an except handler) around "
+                   "a collective or decode dispatch with no bound, "
+                   "escape, or backoff — a persistent fault becomes a "
+                   "silent livelock")
+
+    def check(self, ctx):
+        yield from self._walk(ctx, ctx.tree, func=None)
+
+    def _walk(self, ctx, node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node
+        elif isinstance(node, ast.While) and _is_while_true(node):
+            yield from self._check_while(ctx, node)
+        elif isinstance(node, ast.ExceptHandler) and func is not None:
+            yield from self._check_recursive(ctx, node, func)
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(ctx, child, func)
+
+    def _check_while(self, ctx, loop: ast.While):
+        for n in ast.walk(loop):
+            if not isinstance(n, ast.Try):
+                continue
+            leaf = _first_retryable(n.body)
+            if leaf is None:
+                continue
+            for h in n.handlers:
+                retries = any(isinstance(x, ast.Continue)
+                              for x in ast.walk(h))
+                escapes = any(isinstance(x, (ast.Raise, ast.Break,
+                                             ast.Return))
+                              for x in ast.walk(h))
+                if retries and not escapes and not _has_backoff(h):
+                    yield ctx.finding(
+                        self.name, loop,
+                        f"`while True` retries `{leaf}` forever: the "
+                        f"except handler only `continue`s — no retry "
+                        f"bound, no escape, no backoff. A persistent "
+                        f"fault livelocks this rank while its peers "
+                        f"move on; bound the attempts (or back off) "
+                        f"and re-raise so the elastic restart / "
+                        f"recovery path can fire")
+                    return  # one finding per loop is signal enough
+
+    def _check_recursive(self, ctx, handler: ast.ExceptHandler, func):
+        if any(isinstance(x, ast.Raise) for x in ast.walk(handler)):
+            return
+        if _has_backoff(handler):
+            return
+        if _first_retryable(func) is None:
+            return
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Call):
+                parts = dotted_parts(n.func)
+                if parts and parts[-1] == func.name:
+                    yield ctx.finding(
+                        self.name, n,
+                        f"except handler re-invokes `{func.name}` — "
+                        f"recursion as an unbounded retry around a "
+                        f"collective/decode dispatch (each failure "
+                        f"recurses again; a persistent fault livelocks "
+                        f"or blows the stack). Pass an attempt budget "
+                        f"and re-raise when it is spent")
+                    return

@@ -1,4 +1,4 @@
-"""Distributed checkpointing: async, sharded, re-shardable.
+"""Distributed checkpointing: async, sharded, re-shardable, verified.
 
 Reference parity (SURVEY.md §5 "Checkpoint / resume"): the reference saves
 per-rank shards (fleet.save/load, GroupShardedStage3 gather-or-local save)
@@ -9,20 +9,37 @@ a target sharding and re-shards in flight, and AsyncCheckpointer overlaps
 serialization with the next train step. The converter is therefore not a
 tool but a restore argument.
 
+Fault tolerance (README.md "Fault tolerance"): every managed save writes a
+sidecar manifest (`<dir>/manifests/<step>.json`: per-leaf crc32 checksums +
+optional resume-exact trainer state) and, once the async write lands, an
+empty `<step>.COMMITTED` marker — the two-phase commit that makes a torn
+write detectable. `restore()` walks steps newest-first, skips uncommitted
+manifests, verifies checksums, and falls back to the last-known-good step
+on corruption (counted in `checkpoint_restore_fallbacks_total`). Retention
+never deletes the last-known-good committed step, even when newer
+unverified saves exist.
+
 Surface:
     save_state_dict(state, path)              # blocking sharded save
     load_state_dict(path, template|state)     # reshard-on-load
     CheckpointManager(dir, max_to_keep=…)     # periodic async save/restore
+    trainer_state_snapshot / apply_trainer_state   # resume-exact RNG+step
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..tensor import Tensor
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A restored checkpoint failed manifest checksum verification."""
 
 
 def _to_arrays(obj):
@@ -97,6 +114,11 @@ def _make_checkpoint_metrics(reg):
         reg.histogram("checkpoint_save_seconds",
                       "Wall time inside the save call (async "
                       "managers: dispatch time only)."),
+        reg.counter("checkpoint_restore_fallbacks_total",
+                    "Restore candidates skipped on the way to a good "
+                    "checkpoint: uncommitted (torn) manifests and "
+                    "checksum-verification failures — each one is a "
+                    "step of training the job replays."),
     )
 
 
@@ -121,7 +143,7 @@ def save_state_dict(state_dict, path, overwrite=True):
     from ..observability import flight_recorder as _flight
     from ..observability import tracing as _tracing
 
-    saves_c, save_h = _checkpoint_metrics()
+    saves_c, save_h, _ = _checkpoint_metrics()
     t0 = _time.perf_counter()
     path = os.path.abspath(path)
     with _tracing.span("checkpoint.save", path=path):
@@ -153,14 +175,82 @@ def load_state_dict(path, template=None, mesh=None, spec_fn=None,
     return _to_tensors(out, template) if return_tensors else out
 
 
+def _leaf_checksums(arrays) -> Dict[str, dict]:
+    """Deterministic path -> {crc, dtype, shape} over the array pytree
+    handed to orbax (dict/list nesting, sorted dict keys)."""
+    out: Dict[str, dict] = {}
+
+    def rec(o, path):
+        if isinstance(o, dict):
+            for k in sorted(o):
+                rec(o[k], path + (str(k),))
+        elif isinstance(o, (list, tuple)):
+            for i, v in enumerate(o):
+                rec(v, path + (str(i),))
+        elif hasattr(o, "dtype") and hasattr(o, "shape"):
+            a = np.asarray(o)
+            out["/".join(path)] = {
+                "crc": zlib.crc32(a.tobytes()) & 0xFFFFFFFF,
+                "dtype": str(a.dtype),
+                "shape": list(a.shape),
+            }
+
+    rec(arrays, ())
+    return out
+
+
+def trainer_state_snapshot(step: int, data_position=None, stream=None):
+    """Resume-exact trainer state for a manifest: global step, the
+    KeyStream RNG state (key data + fold-in counter), and an opaque
+    dataloader position. JSON-serializable by construction."""
+    from ..framework import random as _random
+
+    stream = stream if stream is not None else _random.current_stream()
+    key, counter = stream.state()
+    kd = np.asarray(jax.random.key_data(key))
+    return {
+        "step": int(step),
+        "rng": {
+            "key_data": [int(x) for x in kd.ravel().tolist()],
+            "shape": list(kd.shape),
+            "counter": int(counter),
+        },
+        "data_position": data_position,
+    }
+
+
+def apply_trainer_state(snapshot, stream=None):
+    """Install a trainer_state_snapshot(): restores the KeyStream so the
+    resumed run draws the exact key sequence the killed run would have —
+    the bit-identical-loss half of the chaos drill. Returns the snapshot
+    (callers read step / data_position from it)."""
+    from ..framework import random as _random
+
+    stream = stream if stream is not None else _random.current_stream()
+    rng = snapshot.get("rng")
+    if rng:
+        kd = np.asarray(rng["key_data"], dtype=np.uint32)
+        kd = kd.reshape(rng.get("shape", kd.shape))
+        stream.set_state((jax.random.wrap_key_data(kd),
+                          int(rng["counter"])))
+    return snapshot
+
+
 class CheckpointManager:
     """Periodic async checkpointing with retention (the reference's
-    fleet.save + elastic restart-from-checkpoint loop, HAPI ModelCheckpoint).
+    fleet.save + elastic restart-from-checkpoint loop, HAPI ModelCheckpoint)
+    plus two-phase commit + verify-on-restore (module docstring).
 
     mgr = CheckpointManager(dir, max_to_keep=3, save_interval_steps=100)
     mgr.save(step, state_dict)        # async: returns immediately
-    state = mgr.restore(step=None)    # latest by default
+    state = mgr.restore(step=None)    # newest COMMITTED + verified step
     mgr.wait(); mgr.close()
+
+    Commit protocol: save() dispatches the (possibly async) orbax write
+    and records the manifest; the COMMITTED marker lands only after the
+    write finishes — flushed at the NEXT save(), wait(), restore(), or
+    close(). Retention runs after commit and keeps the newest
+    max_to_keep steps PLUS the last-known-good committed step.
     """
 
     def __init__(self, directory, max_to_keep: int = 5,
@@ -168,52 +258,236 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(directory)
-        os.makedirs(self._dir, exist_ok=True)
+        self._manifest_dir = os.path.join(self._dir, "manifests")
+        os.makedirs(self._manifest_dir, exist_ok=True)
+        self._max_to_keep = max_to_keep
+        self._pending_commit: Optional[int] = None
+        # retention is ours (orbax's would drop the last-known-good step
+        # when newer unverified saves fill the window)
         opts = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
+            max_to_keep=None,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=opts)
 
-    def save(self, step: int, state_dict, force: bool = False) -> bool:
+    # -- manifest layout --------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"{int(step)}.json")
+
+    def _committed_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"{int(step)}.COMMITTED")
+
+    def is_committed(self, step: int) -> bool:
+        return os.path.exists(self._committed_path(step))
+
+    def committed_steps(self) -> List[int]:
+        return sorted(s for s in self._mgr.all_steps()
+                      if self.is_committed(s))
+
+    def last_known_good(self) -> Optional[int]:
+        """Newest step with a COMMITTED marker (after flushing any
+        pending commit)."""
+        self._flush_commit()
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    def manifest(self, step: int) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(step), encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- save / commit ----------------------------------------------------
+
+    def save(self, step: int, state_dict, force: bool = False,
+             trainer_state: Optional[dict] = None) -> bool:
         import time as _time
 
         import orbax.checkpoint as ocp
 
+        from .. import faults as _faults
         from ..observability import flight_recorder as _flight
         from ..observability import tracing as _tracing
 
-        saves_c, save_h = _checkpoint_metrics()
+        self._flush_commit()
+        saves_c, save_h, _ = _checkpoint_metrics()
         t0 = _time.perf_counter()
+        arrays = _to_arrays(state_dict)
         with _tracing.span("checkpoint.save", step=int(step),
                            dir=self._dir):
             saved = self._mgr.save(
                 int(step),
-                args=ocp.args.StandardSave(_to_arrays(state_dict)),
+                args=ocp.args.StandardSave(arrays),
                 force=force)
         if saved:
+            manifest = {
+                "format": 1,
+                "step": int(step),
+                "checksums": _leaf_checksums(arrays),
+            }
+            if trainer_state is not None:
+                manifest["trainer_state"] = trainer_state
+            text = json.dumps(manifest, sort_keys=True)
+            if _faults.enabled() and _faults.torn_write(int(step)):
+                # chaos checkpoint.torn_write: a crash mid-manifest —
+                # truncated JSON, and the COMMITTED marker never lands
+                with open(self._manifest_path(step), "w",
+                          encoding="utf-8") as f:
+                    f.write(text[: max(1, len(text) // 2)])
+            else:
+                tmp = self._manifest_path(step) + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(text)
+                os.replace(tmp, self._manifest_path(step))
+                self._pending_commit = int(step)
             saves_c.inc()
             save_h.observe(_time.perf_counter() - t0)
             _flight.record_event("checkpoint.save", step=int(step),
                                  dir=self._dir)
         return saved
 
-    def restore(self, step: Optional[int] = None, template=None,
-                mesh=None, spec_fn=None, return_tensors=True):
-        import orbax.checkpoint as ocp
+    def _flush_commit(self):
+        """Land the COMMITTED marker for the last dispatched save once
+        its (async) write finished, then prune."""
+        if self._pending_commit is None:
+            return
+        from ..observability import flight_recorder as _flight
 
-        if step is None:
-            step = self._mgr.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        self._mgr.wait_until_finished()
+        step, self._pending_commit = self._pending_commit, None
+        open(self._committed_path(step), "w").close()
+        _flight.record_event("checkpoint.commit", step=step,
+                             dir=self._dir)
+        self._prune()
+
+    def _prune(self):
+        """Keep the newest max_to_keep steps PLUS the last-known-good
+        committed step (the GC bugfix: a corrupt tail of newer saves
+        must never orphan the only restorable checkpoint)."""
+        if not self._max_to_keep or self._max_to_keep <= 0:
+            return
+        steps = sorted(self._mgr.all_steps())
+        keep = set(steps[-self._max_to_keep:])
+        committed = [s for s in steps if self.is_committed(s)]
+        if committed:
+            keep.add(committed[-1])
+        for s in steps:
+            if s in keep:
+                continue
+            self._mgr.delete(s)
+            for path in (self._manifest_path(s), self._committed_path(s)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    # -- restore / verify -------------------------------------------------
+
+    def restore(self, step: Optional[int] = None, template=None,
+                mesh=None, spec_fn=None, return_tensors=True,
+                verify: bool = True):
+        """Restore a state_dict. step=None walks steps newest-first:
+        uncommitted (torn) manifests are skipped and checksum failures
+        fall back to the next older committed step, both counted in
+        checkpoint_restore_fallbacks_total. An explicit step restores
+        exactly that step (verified when its manifest exists) and raises
+        CheckpointIntegrityError on mismatch."""
+        from ..observability import flight_recorder as _flight
+
+        self._flush_commit()
         abstract = _abstract_like(template, mesh=mesh, spec_fn=spec_fn) \
             if template is not None else None
-        out = self._mgr.restore(
-            int(step),
-            args=ocp.args.StandardRestore(abstract) if abstract is not None
-            else None)
-        return _to_tensors(out, template) if return_tensors else out
+        if step is not None:
+            out = self._restore_raw(step, abstract)
+            if verify:
+                self._verify(step, out)
+            return _to_tensors(out, template) if return_tensors else out
+
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        _, _, fallbacks_c = _checkpoint_metrics()
+        # legacy directories (pre-manifest layout) have no manifests at
+        # all: restore the newest step unverified rather than refusing
+        managed = any(os.path.exists(self._manifest_path(s))
+                      for s in steps)
+        failures = []
+        for s in steps:
+            if managed and not self.is_committed(s):
+                fallbacks_c.inc()
+                _flight.record_event("checkpoint.restore_fallback",
+                                     step=int(s), reason="uncommitted",
+                                     dir=self._dir)
+                failures.append(f"step {s}: no COMMITTED marker "
+                                f"(torn/unfinished write)")
+                continue
+            try:
+                out = self._restore_raw(s, abstract)
+                if verify and managed:
+                    self._verify(s, out)
+            except CheckpointIntegrityError as e:
+                fallbacks_c.inc()
+                _flight.record_event("checkpoint.restore_fallback",
+                                     step=int(s), reason="corrupt",
+                                     dir=self._dir)
+                failures.append(str(e))
+                continue
+            return _to_tensors(out, template) if return_tensors else out
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self._dir}: "
+            + "; ".join(failures))
+
+    def _restore_raw(self, step: int, abstract):
+        import orbax.checkpoint as ocp
+
+        # Always pass StandardRestore, even template-less: a FRESH
+        # process (the chaos drill's restarted rank) has no handler
+        # registry entry for the step, and args=None makes orbax refuse
+        # to infer one. Template-less restores come back as host arrays
+        # in the saved topology — exactly what the manifest checksums
+        # verify against.
+        return self._mgr.restore(
+            int(step), args=ocp.args.StandardRestore(abstract))
+
+    def _verify(self, step: int, arrays):
+        """Recompute leaf checksums against the manifest. A committed
+        manifest that no longer parses counts as corruption too."""
+        manifest = self.manifest(step)
+        if manifest is None:
+            if os.path.exists(self._manifest_path(step)):
+                raise CheckpointIntegrityError(
+                    f"step {step}: manifest unreadable (torn write?)")
+            return  # legacy step without a manifest: nothing to verify
+        want = manifest.get("checksums", {})
+        got = _leaf_checksums(arrays)
+        bad = [p for p in want
+               if got.get(p, {}).get("crc") != want[p]["crc"]]
+        missing = [p for p in want if p not in got]
+        if bad or missing:
+            raise CheckpointIntegrityError(
+                f"step {step}: checksum mismatch on "
+                f"{sorted(set(bad) | set(missing))[:4]} "
+                f"({len(bad)} bad / {len(missing)} missing of "
+                f"{len(want)} leaves)")
+
+    def restore_trainer_state(self, step: Optional[int] = None
+                              ) -> Optional[dict]:
+        """The resume-exact snapshot from the newest committed manifest
+        carrying one (or from `step`'s manifest). None when no manifest
+        has trainer state — callers start fresh."""
+        self._flush_commit()
+        candidates = [step] if step is not None else \
+            sorted(self.committed_steps(), reverse=True)
+        for s in candidates:
+            m = self.manifest(s)
+            if m and m.get("trainer_state") is not None:
+                return m["trainer_state"]
+        return None
+
+    # -- passthroughs ------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -226,9 +500,11 @@ class CheckpointManager:
 
     def wait(self):
         self._mgr.wait_until_finished()
+        self._flush_commit()
 
     def close(self):
         self._mgr.wait_until_finished()
+        self._flush_commit()
         self._mgr.close()
 
     def __enter__(self):

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults as _faults
 from ..framework import jax_compat as _jc
 from ..tensor import Tensor, as_array
 from . import mesh as _mesh
@@ -53,6 +54,13 @@ def _make_coll_handles(reg):
         "bytes": reg.counter(
             "collective_bytes_total",
             "Input bytes handed to each collective.", labels=("op",)),
+        "timeouts": reg.counter(
+            "collective_timeouts_total",
+            "Eager collectives that exceeded "
+            "FLAGS_collective_timeout_s and were converted from an "
+            "indefinite stall into a CollectiveTimeout raise (the "
+            "elastic controller restarts the pod on the resulting "
+            "nonzero exit).", labels=("op",)),
         "children": {},
     }
 
@@ -153,6 +161,55 @@ def _coll_exec(op: str, nbytes: float = 0.0):
     return _CollExec(op, nbytes, span, fleet_on)
 
 
+class CollectiveTimeout(RuntimeError):
+    """An eager collective exceeded FLAGS_collective_timeout_s. Raised
+    asynchronously into the stalled thread by the watchdog so a fleet
+    deadlock (e.g. one rank never entering a barrier) becomes a nonzero
+    exit the elastic controller can restart, instead of hanging the pod
+    until the job is killed."""
+
+
+def _watchdog_fire(op, timeout_s, tid):
+    """Timer callback (watchdog thread): telemetry first — the flight
+    recorder keeps the evidence even if the raise lands nowhere — then
+    the async raise into the stalled thread."""
+    import ctypes
+
+    from ..observability import flight_recorder as _flight
+    from ..observability import metrics as _om
+
+    global _coll_cache
+    try:
+        if _coll_cache is None:
+            _coll_cache = _om.HandleCache(_make_coll_handles)
+        _coll_cache.get()["timeouts"].labels(op).inc()
+        _flight.record_event("collective.timeout", op=op,
+                             timeout_s=timeout_s)
+    except Exception:  # noqa: BLE001 — the raise must still go out
+        pass
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(CollectiveTimeout))
+
+
+def _watchdog_arm(op: str):
+    """One flag read when FLAGS_collective_timeout_s is 0 (the default);
+    otherwise a daemon Timer that fires _watchdog_fire at the deadline.
+    Callers cancel it in a finally."""
+    from ..framework import config as _config
+
+    timeout_s = float(_config.get_flag("FLAGS_collective_timeout_s",
+                                       0.0) or 0.0)
+    if timeout_s <= 0:
+        return None
+    import threading
+
+    timer = threading.Timer(timeout_s, _watchdog_fire,
+                            args=(op, timeout_s, threading.get_ident()))
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def _axes_for_group(group):
     m = _mesh.get_mesh(optional=True)
     if m is None:
@@ -175,8 +232,16 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce (eager identity at world=1; psum under jit)."""
     nbytes = _count_collective("all_reduce", as_array(tensor),
                                instant=False)
-    with _coll_exec("all_reduce", nbytes):
-        return _all_reduce_impl(tensor, op, group)
+    wd = _watchdog_arm("all_reduce")
+    try:
+        if _faults.enabled():
+            _faults.maybe_stall_collective("all_reduce")
+            _faults.maybe_fail_collective("all_reduce")
+        with _coll_exec("all_reduce", nbytes):
+            return _all_reduce_impl(tensor, op, group)
+    finally:
+        if wd is not None:
+            wd.cancel()
 
 
 def _all_reduce_impl(tensor, op, group):
@@ -224,8 +289,16 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     # counts as "reduce", not "all_reduce": one API call, one increment
     nbytes = _count_collective("reduce", as_array(tensor),
                                instant=False)
-    with _coll_exec("reduce", nbytes):
-        return _all_reduce_impl(tensor, op, group)
+    wd = _watchdog_arm("reduce")
+    try:
+        if _faults.enabled():
+            _faults.maybe_stall_collective("reduce")
+            _faults.maybe_fail_collective("reduce")
+        with _coll_exec("reduce", nbytes):
+            return _all_reduce_impl(tensor, op, group)
+    finally:
+        if wd is not None:
+            wd.cancel()
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -303,8 +376,16 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def barrier(group=None):
     _count_collective("barrier", instant=False)
-    with _coll_exec("barrier"):
-        (jax.device_put(0) + 0).block_until_ready()
+    wd = _watchdog_arm("barrier")
+    try:
+        if _faults.enabled():
+            _faults.maybe_stall_collective("barrier")
+            _faults.maybe_fail_collective("barrier")
+        with _coll_exec("barrier"):
+            (jax.device_put(0) + 0).block_until_ready()
+    finally:
+        if wd is not None:
+            wd.cancel()
 
 
 def new_group(ranks=None, backend=None, timeout=None):

@@ -149,6 +149,7 @@ class CollectiveController:
                     print(f"[launch] a rank exited {failed}; elastic pod "
                           f"restart {self.pod_restarts}/"
                           f"{self.ctx.max_restarts}", file=sys.stderr)
+                    self._record_restart(failed)
                     self._teardown()
                     for c in self.pod:
                         c.start()
@@ -163,6 +164,35 @@ class CollectiveController:
                     self._aggregate_telemetry()
                     return failed
             time.sleep(poll_interval)
+
+    def _record_restart(self, exit_code):
+        """Durable restart breadcrumb (telemetry_dir/pod_restarts.json):
+        tools/chaos_drill.py asserts the elastic restart actually fired,
+        and operators correlate it with the resumed step. Best-effort."""
+        tdir = self.ctx.telemetry_dir
+        if not tdir:
+            return
+        try:
+            import json
+
+            # a kill can land before any rank's flusher created the
+            # telemetry dir — the breadcrumb must not depend on that
+            os.makedirs(tdir, exist_ok=True)
+            path = os.path.join(tdir, "pod_restarts.json")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    events = json.load(f)
+            except (OSError, ValueError):
+                events = []
+            events.append({"restart": self.pod_restarts,
+                           "exit_code": exit_code, "t": time.time()})
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(events, f, indent=1)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 — best-effort breadcrumb
+            print(f"[launch] restart breadcrumb failed: {e}",
+                  file=sys.stderr)
 
     def _teardown(self):
         # broadcast SIGINT first (overlapping grace windows), then the

@@ -1,0 +1,23 @@
+"""Deterministic fault injection (README.md "Fault tolerance").
+
+`paddle_tpu.faults.chaos` is the schedule engine; this package re-exports
+the call-site API so integration points read
+`from paddle_tpu import faults` / `faults.maybe_kill(step)`.
+"""
+from .chaos import (  # noqa: F401
+    SITES,
+    ChaosFault,
+    InjectedOOM,
+    enabled,
+    fire,
+    invocations,
+    maybe_decode_oom,
+    maybe_fail_collective,
+    maybe_hang_dataloader,
+    maybe_kill,
+    maybe_slow,
+    maybe_stall_collective,
+    parse_schedule,
+    reset,
+    torn_write,
+)

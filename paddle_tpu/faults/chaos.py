@@ -1,0 +1,318 @@
+"""Deterministic, seeded chaos injection (README.md "Fault tolerance").
+
+`FLAGS_chaos` holds a schedule of named fault sites wired into the
+training, serving, checkpoint, and collective layers:
+
+    FLAGS_chaos="rank.kill@step=5:rank=1:n=1;decode.oom@p=0.5:n=3"
+
+Entries are ';'- (or ',')-separated `site@key=val:key=val`. Sites:
+
+    collective.stall      sleep `delay` s inside the collective (the
+                          watchdog's CollectiveTimeout can land mid-sleep)
+    collective.fail       raise ChaosFault from the collective
+    decode.oom            raise InjectedOOM — message carries
+                          RESOURCE_EXHAUSTED so memwatch.is_oom() and the
+                          serving OOM recovery path treat it as the real thing
+    checkpoint.torn_write torn manifest: truncated JSON, no COMMITTED marker
+    rank.kill             os._exit(137) — SIGKILL-equivalent; atexit flushes
+                          are deliberately skipped
+    rank.slow             sleep `delay` s in the train step (straggler)
+    dataloader.hang       sleep `delay` s in the dataloader fetch (bounded)
+
+Triggers (all optional; an entry with none fires on every invocation):
+
+    step=N   fire when the caller-supplied step == N; sites that pass no
+             step use the site's invocation index
+    p=F      pseudo-probability per invocation — a pure hash of
+             (FLAGS_chaos_seed, site, invocation index), so a schedule
+             replays identically across runs and ranks
+    n=K      at most K total fires for this entry; with FLAGS_chaos_dir
+             set the count persists in a sentinel file, surviving the
+             elastic controller's pod restart (tools/chaos_drill.py
+             kills a rank ONCE, not once per incarnation)
+    rank=R   only on rank R (PADDLE_TRAINER_ID)
+    delay=S  sleep length for the stall/slow/hang sites
+
+Off-path discipline (same as tracing/memwatch): every `maybe_*` helper
+opens with one `get_flag` read and returns — no schedule parse, no
+invocation counting, no allocations — when `FLAGS_chaos` is empty. The
+on-path records `chaos_injections_total{site}` and a flight-recorder
+breadcrumb per fire.
+"""
+from __future__ import annotations
+
+import os
+import time
+import zlib
+from typing import Dict, List, Optional
+
+from ..framework import config as _config
+
+SITES = (
+    "collective.stall",
+    "collective.fail",
+    "decode.oom",
+    "checkpoint.torn_write",
+    "rank.kill",
+    "rank.slow",
+    "dataloader.hang",
+)
+
+# default sleep per delaying site when the entry carries no delay=
+_DEFAULT_DELAY = {
+    "collective.stall": 30.0,
+    "rank.slow": 0.25,
+    "dataloader.hang": 5.0,
+}
+
+
+class ChaosFault(RuntimeError):
+    """Injected failure (collective.fail). Deliberately a RuntimeError:
+    recovery paths must handle it exactly like an organic fault."""
+
+
+class InjectedOOM(RuntimeError):
+    """Injected device OOM. The message embeds RESOURCE_EXHAUSTED so
+    observability.memwatch.is_oom() classifies it as a real OOM and the
+    serving engine's recovery path fires without special-casing."""
+
+
+# ---------------------------------------------------------------------------
+# schedule parsing (cached on the flag string)
+# ---------------------------------------------------------------------------
+
+def parse_schedule(spec: str) -> Dict[str, List[dict]]:
+    """`site@key=val:key=val;...` -> {site: [rule, ...]}. Unknown sites
+    raise (a typo'd schedule silently injecting nothing is worse than a
+    loud failure at parse time)."""
+    out: Dict[str, List[dict]] = {}
+    for idx, raw in enumerate(spec.replace(",", ";").split(";")):
+        entry = raw.strip()
+        if not entry:
+            continue
+        site, _, args = entry.partition("@")
+        site = site.strip()
+        if site not in SITES:
+            raise ValueError(
+                f"FLAGS_chaos: unknown site {site!r} in {entry!r} "
+                f"(sites: {', '.join(SITES)})")
+        rule: dict = {"site": site, "idx": idx, "src": entry}
+        for pair in args.split(":"):
+            pair = pair.strip()
+            if not pair:
+                continue
+            key, _, val = pair.partition("=")
+            key = key.strip()
+            if key == "step":
+                rule["step"] = int(val)
+            elif key == "p":
+                rule["p"] = float(val)
+            elif key == "n":
+                rule["n"] = int(val)
+            elif key == "rank":
+                rule["rank"] = int(val)
+            elif key == "delay":
+                rule["delay"] = float(val)
+            else:
+                raise ValueError(
+                    f"FLAGS_chaos: unknown trigger {key!r} in {entry!r} "
+                    f"(triggers: step, p, n, rank, delay)")
+        out.setdefault(site, []).append(rule)
+    return out
+
+
+_cache: Optional[tuple] = None          # (spec string, parsed schedule)
+_counts: Dict[str, int] = {}            # site -> invocation index
+_fires: Dict[str, int] = {}             # rule src -> in-memory fire count
+_metric_cache = None
+
+
+def enabled() -> bool:
+    """One flag read; the whole subsystem when chaos is off."""
+    return bool(_config.get_flag("FLAGS_chaos", ""))
+
+
+def reset():
+    """Drop parsed schedule, invocation counters, and fire counts
+    (tests; FLAGS_chaos_dir sentinels are files and survive)."""
+    global _cache
+    _cache = None
+    _counts.clear()
+    _fires.clear()
+
+
+def _schedule() -> Dict[str, List[dict]]:
+    global _cache
+    spec = _config.get_flag("FLAGS_chaos", "")
+    if _cache is None or _cache[0] != spec:
+        _cache = (spec, parse_schedule(spec))
+    return _cache[1]
+
+
+def invocations(site: str) -> int:
+    """How many times a site has been evaluated (on-path only)."""
+    return _counts.get(site, 0)
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def _hash_p(site: str, k: int) -> float:
+    seed = int(_config.get_flag("FLAGS_chaos_seed", 0))
+    h = zlib.crc32(f"{seed}:{site}:{k}".encode("utf-8"))
+    return h / float(1 << 32)
+
+
+def _sentinel_path(rule: dict) -> Optional[str]:
+    d = _config.get_flag("FLAGS_chaos_dir", "")
+    if not d:
+        return None
+    return os.path.join(d, f"chaos_{rule['site']}.{rule['idx']}.fired")
+
+
+def _fire_count(rule: dict) -> int:
+    path = _sentinel_path(rule)
+    if path is None:
+        return _fires.get(rule["src"], 0)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def _record_fire(rule: dict, step):
+    path = _sentinel_path(rule)
+    if path is None:
+        _fires[rule["src"]] = _fires.get(rule["src"], 0) + 1
+    else:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(f"step={step} t={time.time():.3f}\n")
+    # on-path telemetry: labeled counter + flight breadcrumb
+    global _metric_cache
+    try:
+        from ..observability import flight_recorder as _flight
+        from ..observability import metrics as _om
+
+        if _metric_cache is None:
+            _metric_cache = _om.HandleCache(lambda reg: reg.counter(
+                "chaos_injections_total",
+                "Faults injected by the FLAGS_chaos schedule "
+                "(faults/chaos.py), by site.", labels=("site",)))
+        _metric_cache.get().labels(rule["site"]).inc()
+        _flight.record_event("chaos.inject", site=rule["site"],
+                             rule=rule["src"], step=step)
+    except Exception:  # noqa: BLE001 — injection must outlive telemetry
+        pass
+
+
+def _matches(rule: dict, site: str, k: int, step) -> bool:
+    if "rank" in rule and _rank() != rule["rank"]:
+        return False
+    if "step" in rule:
+        at = step if step is not None else k
+        if at != rule["step"]:
+            return False
+    if "p" in rule and _hash_p(site, k) >= rule["p"]:
+        return False
+    if "n" in rule and _fire_count(rule) >= rule["n"]:
+        return False
+    return True
+
+
+def fire(site: str, step=None) -> Optional[dict]:
+    """Evaluate a site against the schedule; returns the matched rule
+    (fire recorded) or None. On-path only — callers guard with
+    `enabled()` or use the `maybe_*` helpers, which guard internally."""
+    rules = _schedule().get(site)
+    k = _counts.get(site, 0)
+    _counts[site] = k + 1
+    if not rules:
+        return None
+    for rule in rules:
+        if _matches(rule, site, k, step):
+            _record_fire(rule, step if step is not None else k)
+            return rule
+    return None
+
+
+def _sleep(rule: dict, site: str):
+    """Cooperative sleep in short slices so an async-raised
+    CollectiveTimeout (or KeyboardInterrupt) lands mid-stall instead of
+    after it."""
+    total = rule.get("delay", _DEFAULT_DELAY.get(site, 1.0))
+    deadline = time.monotonic() + total
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return
+        time.sleep(min(left, 0.01))
+
+
+# ---------------------------------------------------------------------------
+# per-site helpers — ONE line at the integration point; each opens with
+# a single flag read and returns immediately when chaos is off
+# ---------------------------------------------------------------------------
+
+def maybe_stall_collective(op: str = ""):
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    rule = fire("collective.stall")
+    if rule is not None:
+        _sleep(rule, "collective.stall")
+
+
+def maybe_fail_collective(op: str = ""):
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    if fire("collective.fail") is not None:
+        raise ChaosFault(f"chaos: injected collective failure in "
+                         f"{op or 'collective'}")
+
+
+def maybe_decode_oom():
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    if fire("decode.oom") is not None:
+        raise InjectedOOM(
+            "RESOURCE_EXHAUSTED: chaos-injected decode OOM "
+            "(faults/chaos.py decode.oom site)")
+
+
+def torn_write(step=None) -> bool:
+    """checkpoint.torn_write: True -> the caller must write a torn
+    manifest (truncated JSON, no COMMITTED marker)."""
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return False
+    return fire("checkpoint.torn_write", step) is not None
+
+
+def maybe_kill(step=None):
+    """rank.kill: hard process death. os._exit skips atexit/telemetry
+    flushes on purpose — the drill must prove recovery from an unclean
+    kill, not from a graceful shutdown."""
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    if fire("rank.kill", step) is not None:
+        os._exit(137)
+
+
+def maybe_slow(step=None):
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    rule = fire("rank.slow", step)
+    if rule is not None:
+        _sleep(rule, "rank.slow")
+
+
+def maybe_hang_dataloader():
+    if not _config.get_flag("FLAGS_chaos", ""):
+        return
+    rule = fire("dataloader.hang")
+    if rule is not None:
+        _sleep(rule, "dataloader.hang")

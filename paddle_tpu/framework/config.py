@@ -243,10 +243,13 @@ define_flag("FLAGS_slo_decode_p50_ms", 250.0,
             "serving_token_decode_seconds histogram).", type_=float)
 define_flag("FLAGS_slo_error_budget", 0.01,
             "Error-budget fraction for the error_rate SLO objective: "
-            "serving failure events (decode OOMs, engine poisons; "
-            "serving_errors_total) may be at most this fraction of "
-            "outcomes (errors + finished requests) before the budget "
-            "burns.", type_=float)
+            "UNRECOVERED serving failures (engine poisons, requests "
+            "dropped after their retry budget; serving_errors_total) "
+            "may be at most this fraction of outcomes (errors + "
+            "finished requests) before the budget burns. Failures the "
+            "engine heals from (drain->rebuild->re-admit) count into "
+            "serving_recoveries_total instead and do not burn budget.",
+            type_=float)
 define_flag("FLAGS_quant_matmul", "auto",
             "Dispatch for the weight-only quantized linear matmul "
             "(kernels/quant_matmul.py): 'auto' (default) consults the "
@@ -282,6 +285,57 @@ define_flag("FLAGS_flash_bwd_min_seq", 0,
             "cliff the seq-8192 XLA reference hit); the streamed kernel "
             "is the memory-safe default from 4096 and measured 8.3x "
             "faster at 8192.", type_=int)
+define_flag("FLAGS_chaos", "",
+            "Deterministic fault-injection schedule (faults/chaos.py): "
+            "';'-separated entries `site@key=val:key=val`. Sites: "
+            "collective.stall, collective.fail, decode.oom, "
+            "checkpoint.torn_write, rank.kill, rank.slow, "
+            "dataloader.hang. Triggers: step=N (fire when the caller's "
+            "step — or the site's invocation index — equals N), p=F "
+            "(seeded pseudo-probability per invocation), n=K (max "
+            "fires), rank=R (only this rank), delay=S (seconds, for "
+            "stall/slow/hang). Empty (default) = chaos off; the "
+            "disabled path is one flag read, zero allocations.")
+define_flag("FLAGS_chaos_seed", 0,
+            "Seed for the FLAGS_chaos p= pseudo-probability triggers: "
+            "fire/no-fire is a pure hash of (seed, site, invocation "
+            "index), so a schedule replays identically across runs and "
+            "ranks.", type_=int)
+define_flag("FLAGS_chaos_dir", "",
+            "When set, n=-limited chaos fires persist sentinel files "
+            "here so a schedule survives a process restart — e.g. "
+            "`rank.kill@step=5:n=1` kills once and stays quiet after "
+            "the elastic controller restarts the pod (the drill in "
+            "tools/chaos_drill.py). Empty: fire counts are in-memory "
+            "only.")
+define_flag("FLAGS_serving_max_recoveries", 3,
+            "Recovery budget for the serving engine's self-healing "
+            "path (inference/serving.py): at most this many "
+            "drain->rebuild->re-admit cycles per engine before the "
+            "next fatal fault poisons it permanently. Each recovery "
+            "backs off exponentially from "
+            "FLAGS_serving_recovery_backoff_s.", type_=int)
+define_flag("FLAGS_serving_request_retries", 2,
+            "Per-request retry budget across engine recoveries: an "
+            "in-flight request is re-queued (prompt + tokens committed "
+            "so far) at most this many times; past the budget it is "
+            "dropped and counts as an unrecovered failure "
+            "(serving_errors_total).", type_=int)
+define_flag("FLAGS_serving_recovery_backoff_s", 0.5,
+            "Base of the exponential backoff the serving engine sleeps "
+            "between draining and re-admitting during a recovery: "
+            "backoff * 2^(recovery-1) seconds. 0 disables the sleep "
+            "(tests).", type_=float)
+define_flag("FLAGS_collective_timeout_s", 0.0,
+            "Watchdog deadline for eager collectives "
+            "(distributed/collective.py): when > 0, a collective that "
+            "has not returned after this many seconds records a "
+            "flight-recorder event, increments "
+            "collective_timeouts_total, and raises CollectiveTimeout "
+            "in the stalled thread — converting an indefinite fleet "
+            "stall into a nonzero exit the elastic controller can "
+            "restart. 0 (default) = no watchdog; the disabled path is "
+            "one flag read.", type_=float)
 
 
 # ---------------------------------------------------------------------------

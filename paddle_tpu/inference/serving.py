@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults as _faults
 from ..autograd import tape as _tape
 from ..kernels import paged_attention as _pa
 from ..observability import compilewatch as _cw
@@ -43,9 +44,9 @@ class _EngineMetrics:
     __slots__ = ("ttft", "step_lat", "token_lat", "queue_depth",
                  "queue_wait", "occupancy", "page_util", "prefill_hits",
                  "prefill_misses", "preemptions", "aborts", "tokens",
-                 "finished", "poisoned", "errors", "kv_occupancy",
-                 "kv_frag", "kv_free", "spec_proposed", "spec_accepted",
-                 "spec_acceptance")
+                 "finished", "poisoned", "errors", "recoveries",
+                 "kv_occupancy", "kv_frag", "kv_free", "spec_proposed",
+                 "spec_accepted", "spec_acceptance")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -100,11 +101,22 @@ class _EngineMetrics:
             "fast).")
         self.errors = reg.counter(
             "serving_errors_total",
-            "Serving failure events: decode-dispatch OOMs and engine "
-            "poisons. The error_rate SLO objective (observability/"
-            "slo.py) burns its budget on these, against "
-            "serving_requests_finished_total as the good-event "
-            "counter.")
+            "UNRECOVERED serving failures: engine poisons and requests "
+            "dropped after exhausting their recovery retry budget. "
+            "Failures the engine heals from (drain->rebuild->re-admit) "
+            "count into serving_recoveries_total instead. The "
+            "error_rate SLO objective (observability/slo.py) burns its "
+            "budget on these, against serving_requests_finished_total "
+            "as the good-event counter.")
+        self.recoveries = reg.counter(
+            "serving_recoveries_total",
+            "Successful engine self-heals (drain->rebuild->re-admit; "
+            "README.md \"Fault tolerance\"), by cause: decode_oom "
+            "(a dispatch-time RESOURCE_EXHAUSTED), oom_storm (OOM "
+            "persisted past the single preemption round), "
+            "donated_buffers (a compiled call raised after donating "
+            "the KV pools). Bounded by FLAGS_serving_max_recoveries.",
+            labels=("cause",))
         # memwatch channel (README.md "Memory & compile observability"):
         # per-step KV page-pool distributions, observed only when
         # FLAGS_memwatch is on — handles still resolve here so the on
@@ -410,6 +422,17 @@ class ServingEngine:
         # youngest slot, retry) before the engine poisons — see
         # _handle_decode_oom
         self._oom_retried = False
+        # self-healing (README.md "Fault tolerance"): instead of
+        # permanently poisoning on a donated-pool failure or an OOM
+        # storm, the engine drains in-flight requests back to the queue,
+        # rebuilds its page pools, and re-admits — bounded by
+        # FLAGS_serving_max_recoveries over its lifetime and by
+        # FLAGS_serving_request_retries per request (_begin_recovery).
+        # /readyz is 503 while _recovering; /healthz reports "degraded"
+        # once _recoveries > 0.
+        self._recovering = False
+        self._recoveries = 0
+        self._retry_counts: Dict[int, int] = {}  # rid -> requeue count
         # live telemetry plane (README.md "Live telemetry plane"):
         # /readyz is 503 until warmup() completes and while the KV pool
         # is exhausted; tracking is a weakref append — the engine never
@@ -710,6 +733,7 @@ class ServingEngine:
                 self._pending.pop(i)
                 self._prompts.pop(request_id, None)
                 self._req_params.pop(request_id, None)
+                self._retry_counts.pop(request_id, None)
                 self._m.aborts.inc()
                 self._m.queue_depth.set(len(self._pending))
                 self._finish_trace(request_id, aborted="queue")
@@ -721,6 +745,7 @@ class ServingEngine:
                 self._release_slot(idx)
                 self._prompts.pop(request_id, None)
                 self._req_params.pop(request_id, None)
+                self._retry_counts.pop(request_id, None)
                 self._m.aborts.inc()
                 self._finish_trace(request_id, aborted="slot")
                 _flight.record_event("serving.abort", rid=request_id,
@@ -1372,9 +1397,16 @@ class ServingEngine:
             return True
 
     def _poison_if_donated(self, why: str, *page_lists):
+        """Post-donation failure: the pools the engine holds are dead
+        buffers. Route through the drain->rebuild->re-admit recovery
+        (the pools come back as fresh zero pages; in-flight requests
+        requeue and re-prefill) — the original exception still
+        propagates from the caller, but the NEXT step() serves again.
+        Past the recovery budget this poisons, the old fail-fast
+        behavior."""
         for pages in page_lists:
             if pages and self._buffers_deleted(pages):
-                self._poison(why)
+                self._begin_recovery("donated_buffers", why)
                 return
 
     def _poison(self, why: str):
@@ -1527,32 +1559,160 @@ class ServingEngine:
         lines.append(f"pending queue: {len(self._pending)} request(s)")
         return "\n".join(lines)
 
+    def _begin_recovery(self, cause: str, why: str) -> bool:
+        """Self-heal the engine: drain -> rebuild -> re-admit
+        (README.md "Fault tolerance") instead of the old permanent
+        poison.
+
+        Drain: every active slot requeues at the FRONT of pending with
+        its tokens so far (recompute policy, exactly _preempt's), but
+        bounded by a per-request retry budget
+        (FLAGS_serving_request_retries) so one pathological request
+        cannot pin the engine in a crash loop — over-budget requests
+        are dropped and counted as UNRECOVERED errors. Rebuild: the KV
+        page pools (possibly deleted buffers after a donation failure)
+        reallocate fresh, the free list / block tables / slot structs
+        reset, and an exponential backoff
+        (FLAGS_serving_recovery_backoff_s * 2^(attempt-1)) absorbs
+        thundering-herd retries. Re-admit happens on the next step()'s
+        _admit(), which re-prefills each requeued request's context.
+
+        Bounded by FLAGS_serving_max_recoveries over the engine's
+        lifetime; past that budget the engine poisons (fail fast, the
+        pre-recovery behavior). Returns True when the engine recovered
+        and the caller may keep serving, False when it poisoned.
+        /readyz is 503 while the rebuild runs (self._recovering);
+        /healthz reports "degraded" once self._recoveries > 0."""
+        from ..framework import config as _config
+
+        budget = int(_config.get_flag("FLAGS_serving_max_recoveries", 3))
+        if self._recoveries >= budget:
+            self._poison(f"recovery budget exhausted "
+                         f"({self._recoveries}/{budget}): {why}")
+            return False
+        self._recoveries += 1
+        self._recovering = True
+        try:
+            _trace.instant("serving.recovery", cause=cause, why=why)
+            _flight.record_event("serving.recovery", cause=cause,
+                                 attempt=self._recoveries, why=why)
+            retries = int(_config.get_flag(
+                "FLAGS_serving_request_retries", 2))
+            for idx, s in enumerate(self.slots):
+                if not s.active:
+                    s.trace_id = -1
+                    continue
+                rid = s.request_id
+                n = self._retry_counts.get(rid, 0) + 1
+                if n > retries:
+                    # retry budget spent: drop — an UNRECOVERED failure
+                    # (the error_rate SLO burns on it), same emission
+                    # semantics as abort()
+                    self._m.errors.inc()
+                    self._m.aborts.inc()
+                    self._prompts.pop(rid, None)
+                    self._req_params.pop(rid, None)
+                    self._retry_counts.pop(rid, None)
+                    self._finish_trace(rid, aborted="recovery")
+                    _flight.record_event("serving.recovery_drop",
+                                         rid=rid, retries=n - 1)
+                else:
+                    self._retry_counts[rid] = n
+                    self._pending.insert(
+                        0, (rid, self._prompts[rid], s.max_new_tokens,
+                            list(s.tokens)))
+                # deactivate by hand: _release_slot would push page ids
+                # from a table we are about to wipe onto the free list
+                s.active = False
+                s.n_pages = 0
+                s.trace_id = -1
+            # rebuild: fresh pools — the old lists may hold deleted
+            # buffers, and even live ones hold KV for contexts that
+            # will re-prefill anyway (mirrors __init__'s allocation)
+            L = self.cfg.num_hidden_layers
+            kvh = getattr(self.cfg, "num_key_value_heads",
+                          self.cfg.num_attention_heads)
+            hd = self.cfg.hidden_size // self.cfg.num_attention_heads
+            n_pages = self._n_pages_total
+            if self.kv_cache_quant == "int8":
+                self.k_scales, self.v_scales = map(list, zip(*[
+                    _pa.alloc_page_scales(n_pages, self.page_size, kvh)
+                    for _ in range(L)]))
+            self.k_pages = [
+                jnp.zeros((kvh, n_pages, self.page_size, hd),
+                          self.kv_dtype) for _ in range(L)]
+            self.v_pages = [
+                jnp.zeros((kvh, n_pages, self.page_size, hd),
+                          self.kv_dtype) for _ in range(L)]
+            if self._page_sharding is not None:
+                self._pin_pages()
+            if self._draft_model is not None:
+                dcfg = self._draft_model.config
+                dkvh = getattr(dcfg, "num_key_value_heads",
+                               dcfg.num_attention_heads)
+                dhd = dcfg.hidden_size // dcfg.num_attention_heads
+                dL = dcfg.num_hidden_layers
+                try:
+                    d_dtype = next(iter(
+                        self._draft_model.parameters()))._data.dtype
+                except StopIteration:
+                    d_dtype = jnp.float32
+                if self.kv_cache_quant == "int8":
+                    d_dtype = jnp.int8
+                    self._draft_k_scales, self._draft_v_scales = map(
+                        list, zip(*[_pa.alloc_page_scales(
+                            n_pages, self.page_size, dkvh)
+                            for _ in range(dL)]))
+                self._draft_k_pages = [
+                    jnp.zeros((dkvh, n_pages, self.page_size, dhd),
+                              d_dtype) for _ in range(dL)]
+                self._draft_v_pages = [
+                    jnp.zeros((dkvh, n_pages, self.page_size, dhd),
+                              d_dtype) for _ in range(dL)]
+            self._free_pages = list(range(n_pages))
+            self.block_tables[:] = 0
+            self._release_gen += 1
+            self._oom_retried = False
+            self._m.queue_depth.set(len(self._pending))
+            self._m.recoveries.labels(cause).inc()
+            backoff = float(_config.get_flag(
+                "FLAGS_serving_recovery_backoff_s", 0.5))
+            if backoff > 0:
+                _time_mod.sleep(backoff * (2 ** (self._recoveries - 1)))
+        finally:
+            self._recovering = False
+        return True
+
     def _handle_decode_oom(self, exc, where: str) -> bool:
         """RESOURCE_EXHAUSTED in a compiled decode call: write the
         forensic dump (ranked live buffers + the page-table report),
         then degrade gracefully ONCE — preempt the lowest-priority
         (youngest-admitted) slot and tell the caller to retry the
         dispatch. A second OOM, or one that already consumed the
-        donated pools, poisons the engine instead (fail fast, never a
-        silent crash). Returns True when the caller should retry."""
+        donated pools, escalates to the drain->rebuild->re-admit
+        recovery (_begin_recovery) — and only past the recovery budget
+        does the engine poison. Returns True when the caller should
+        retry the dispatch (against the surviving slots, or an empty
+        batch after a full drain)."""
         path = _memwatch.dump_oom(f"serving_{where}", exc=exc,
                                   extra=self._page_table_report())
         _flight.record_event("serving.oom", where=where, dump=path)
-        self._m.errors.inc()  # the error_rate SLO burns on decode OOMs
         if any(pages and self._buffers_deleted(pages)
                for pages in (self.k_pages, self.v_pages)):
-            self._poison(f"{where} raised RESOURCE_EXHAUSTED after "
-                         f"donating the KV pages (forensics: {path})")
-            return False
+            return self._begin_recovery(
+                "decode_oom",
+                f"{where} raised RESOURCE_EXHAUSTED after donating the "
+                f"KV pages (forensics: {path})")
         if self._oom_retried:
-            self._poison(f"{where} OOM persisted after a preemption "
-                         f"round (forensics: {path})")
-            return False
+            return self._begin_recovery(
+                "oom_storm",
+                f"{where} OOM persisted after a preemption round "
+                f"(forensics: {path})")
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
-            self._poison(f"{where} OOM with no active slots "
-                         f"(forensics: {path})")
-            return False
+            return self._begin_recovery(
+                "decode_oom",
+                f"{where} OOM with no active slots (forensics: {path})")
         victim = max(active, key=lambda i: self.slots[i].admit_seq)
         self._oom_retried = True
         _flight.record_event("serving.oom_preempt",
@@ -1645,6 +1805,21 @@ class ServingEngine:
                 if not active:
                     return finished_early
             st = self._decode_launch_state(active)
+            if _faults.enabled():
+                # deterministic chaos (faults/chaos.py): an injected
+                # decode OOM takes the SAME handler as an organic
+                # RESOURCE_EXHAUSTED from the compiled call
+                try:
+                    _faults.maybe_decode_oom()
+                except BaseException as e:
+                    if _memwatch.is_oom(e) and \
+                            self._handle_decode_oom(e, "decode"):
+                        active = [i for i in active
+                                  if self.slots[i].active]
+                        if not active:
+                            return finished_early
+                        continue
+                    raise
             if spec_w:
                 tokens_np = tokens  # the [max_batch] last-token array
                 got = self._dispatch_spec(spec_w, active, st, tokens_np)
@@ -1860,6 +2035,7 @@ class ServingEngine:
         _flight.record_event("serving.finish", rid=s.request_id,
                              tokens=len(s.tokens), trace_id=trace_id)
         self._req_params.pop(s.request_id, None)
+        self._retry_counts.pop(s.request_id, None)
         # pop with default: an on_token callback may have abort()ed the
         # request between the decode step and this finish
         prompt = self._prompts.pop(s.request_id, None)
@@ -1941,6 +2117,11 @@ class ServingEngine:
                  jax.random.key_data(sk))
         pages = (tuple(self.k_pages), tuple(self.v_pages),
                  tuple(self.k_scales or ()), tuple(self.v_scales or ()))
+        # recovery sentinel: if a failure inside this pipeline drains and
+        # rebuilds the engine (_begin_recovery via _poison_if_donated),
+        # the finally below must NOT re-point the rebuilt pools at the
+        # stale (deleted) `pages` tuple
+        recov0 = self._recoveries
         inflight = deque()
         finished = []
         dispatched = 0
@@ -2029,10 +2210,11 @@ class ServingEngine:
                         # request
                         stop = True
         finally:
-            self.k_pages, self.v_pages = list(pages[0]), list(pages[1])
-            if self.k_scales is not None:
-                self.k_scales, self.v_scales = (list(pages[2]),
-                                                list(pages[3]))
+            if self._recoveries == recov0:
+                self.k_pages, self.v_pages = list(pages[0]), list(pages[1])
+                if self.k_scales is not None:
+                    self.k_scales, self.v_scales = (list(pages[2]),
+                                                    list(pages[3]))
         if finished:
             self._admit()
         return finished, dispatched

@@ -207,6 +207,10 @@ class _MultiProcessIter:
     def __next__(self):
         import time as _time
 
+        from .. import faults as _faults
+
+        if _faults.enabled():
+            _faults.maybe_hang_dataloader()
         fetch_h, _, batches_c = _loader_metrics()
         while True:
             if all(self._done):
@@ -296,6 +300,12 @@ class _Iter:
             self._prefetch_q.put(StopIteration)
 
     def __next__(self):
+        from .. import faults as _faults
+
+        if _faults.enabled():
+            # chaos dataloader.hang: bounded fetch stall — shows up in
+            # train_data_wait_seconds, not a real deadlock
+            _faults.maybe_hang_dataloader()
         fetch_h, depth_g, batches_c = _loader_metrics()
         if self.iterable:
             batch = []

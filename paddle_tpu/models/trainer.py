@@ -56,6 +56,7 @@ def _instrument_step(step_fn, model=None):
     source of truth."""
     import time as _time
 
+    from .. import faults as _faults
     from ..observability import fleet as _fleet
     from ..observability import flight_recorder as _flight
     from ..observability import memwatch as _memwatch
@@ -111,6 +112,14 @@ def _instrument_step(step_fn, model=None):
             pass           # the train loop down
 
     def instrumented(input_ids, labels):
+        # deterministic chaos (faults/chaos.py; one flag read when
+        # off): rank.kill dies HARD (os._exit 137 — the elastic
+        # controller must restart the pod and the trainer must resume
+        # from the last committed checkpoint), rank.slow injects a
+        # straggler sleep. Both key on the wrapper's own step count.
+        if _faults.enabled():
+            _faults.maybe_kill(int(steps_c.value))
+            _faults.maybe_slow(int(steps_c.value))
         # per-step span trace (head-sampled; NOOP_TRACE when
         # FLAGS_trace_sample=0 — one flag read, zero allocations)
         trc = _trace.start_trace("train.step") if _trace.enabled() \

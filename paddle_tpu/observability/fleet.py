@@ -903,6 +903,51 @@ def slo_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def recoveries_table(shards: Dict[int, str]) -> List[dict]:
+    """One row per rank with fault-tolerance counters from the rank's
+    metrics.prom (README.md "Fault tolerance"): serving self-heals by
+    cause (serving_recoveries_total), unrecovered serving errors,
+    checkpoint restore fallbacks, collective watchdog timeouts, and
+    injected chaos faults by site. Ranks with every counter at zero are
+    omitted — the section only appears when something actually fired."""
+    out = []
+    for rank, path in sorted(shards.items()):
+        try:
+            with open(os.path.join(path, "metrics.prom")) as fh:
+                samples = _parse_prom_samples(fh.read())
+        except OSError:
+            continue
+        recov = {}
+        for labels, v in samples.get("serving_recoveries_total", []):
+            cause = labels.get("cause")
+            if cause and v > 0:
+                recov[cause] = recov.get(cause, 0.0) + v
+        chaos = {}
+        for labels, v in samples.get("chaos_injections_total", []):
+            site = labels.get("site")
+            if site and v > 0:
+                chaos[site] = chaos.get(site, 0.0) + v
+        errors = sum(v for _, v in
+                     samples.get("serving_errors_total", []))
+        fallbacks = sum(v for _, v in
+                        samples.get("checkpoint_restore_fallbacks_total",
+                                    []))
+        timeouts = sum(v for _, v in
+                       samples.get("collective_timeouts_total", []))
+        if not (recov or chaos or errors or fallbacks or timeouts):
+            continue
+        out.append({
+            "rank": rank,
+            "recoveries": recov,
+            "recoveries_total": sum(recov.values()),
+            "errors_unrecovered": errors,
+            "restore_fallbacks": fallbacks,
+            "collective_timeouts": timeouts,
+            "chaos_injections": chaos,
+        })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # live-endpoint scraping (the pull half of the telemetry plane)
 # ---------------------------------------------------------------------------
@@ -1093,6 +1138,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "hbm": hbm_skew(hbm_table(shards)),
         "ledger": ledger_table(shards),
         "slo": slo_table(shards),
+        "recoveries": recoveries_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -1270,6 +1316,37 @@ def format_report(report: dict) -> str:
                     f"— this rank is burning its error budget; route "
                     f"traffic elsewhere (serving_load_score) and check "
                     f"its ledger/straggler rows above")
+        lines.append("")
+    recov_rows = report.get("recoveries") or []
+    if recov_rows:
+        lines.append("")
+        lines.append("== recoveries per rank (fault tolerance: "
+                     "self-heals, fallbacks, injected faults) ==")
+        for r in recov_rows:
+            recov = r["recoveries"]
+            recov_s = ", ".join(
+                f"{c}={int(v)}" for c, v in sorted(recov.items())) \
+                if recov else "-"
+            chaos = r["chaos_injections"]
+            chaos_s = ", ".join(
+                f"{s}={int(v)}" for s, v in sorted(chaos.items())) \
+                if chaos else "-"
+            lines.append(
+                f"  rank {r['rank']}: serving recoveries "
+                f"[{recov_s}], unrecovered errors "
+                f"{int(r['errors_unrecovered'])}, checkpoint restore "
+                f"fallbacks {int(r['restore_fallbacks'])}, collective "
+                f"timeouts {int(r['collective_timeouts'])}, chaos "
+                f"injections [{chaos_s}]")
+        for r in recov_rows:
+            if r["errors_unrecovered"] > 0:
+                lines.append(
+                    f"UNRECOVERED: rank {r['rank']} dropped "
+                    f"{int(r['errors_unrecovered'])} serving "
+                    f"request(s)/poisoned past its recovery budget — "
+                    f"the error_rate SLO burned on these; check its "
+                    f"flight recorder (serving.recovery_drop / "
+                    f"serving.poisoned events)")
         lines.append("")
     art = report["artifacts"]
     if art:

@@ -132,6 +132,11 @@ def health_payload(registry: Optional[_metrics.Registry] = None
     eng_poisoned = any(getattr(e, "_poisoned", None)
                        for e in tracked_engines())
     poisoned = bool(hard["poisoned"] or eng_poisoned)
+    # engines that self-healed (drain->rebuild->re-admit) stay healthy
+    # but degrade the status — the operator should know the process is
+    # running on a recovery budget (README.md "Fault tolerance")
+    recovered = sum(int(getattr(e, "_recoveries", 0))
+                    for e in tracked_engines())
     checks = {
         "poisoned": {"ok": not poisoned},
         "watchdog": {"ok": not hard["stalled"],
@@ -149,15 +154,18 @@ def health_payload(registry: Optional[_metrics.Registry] = None
     degraded = _slo.firing()
     ok = all(c["ok"] for c in checks.values())
     status = "unhealthy" if not ok else (
-        "degraded" if degraded else "ok")
+        "degraded" if degraded or recovered else "ok")
     return (200 if ok else 503), {
         "status": status, "checks": checks,
+        "engine_recoveries": recovered,
         "slo_alerts_firing": degraded}
 
 
 def ready_payload() -> Tuple[int, dict]:
     """(status_code, payload). Ready iff every tracked serving engine
-    finished warmup(), none is poisoned, and each KV page pool has at
+    finished warmup(), none is poisoned or mid-recovery
+    (drain->rebuild — the router must not send traffic while the page
+    pools are being reallocated), and each KV page pool has at
     least one free page (an exhausted pool cannot admit work — the
     router should drain elsewhere until preemption/finishes free
     pages). A process with no serving engine (a trainer rank) is
@@ -168,11 +176,14 @@ def ready_payload() -> Tuple[int, dict]:
     for i, e in enumerate(engines):
         warmed = bool(getattr(e, "_warmup_done", False))
         poisoned = getattr(e, "_poisoned", None)
+        recovering = bool(getattr(e, "_recovering", False))
         kv_free = len(e._free_pages)
-        row_ok = warmed and not poisoned and kv_free > 0
+        row_ok = warmed and not poisoned and not recovering \
+            and kv_free > 0
         ok = ok and row_ok
         rows.append({"engine": i, "ok": row_ok, "warmed": warmed,
                      "poisoned": bool(poisoned),
+                     "recovering": recovering,
                      "kv_pages_free": kv_free,
                      "kv_pages_total": e._n_pages_total})
     payload = {"status": "ready" if ok else "unready",

@@ -1,0 +1,55 @@
+"""Fixture: unbounded-retry positives (and clean bounded/backoff
+shapes that must NOT fire)."""
+import time
+
+import paddle_tpu.distributed.collective as coll
+
+
+def hammer(x):
+    # POSITIVE: infinite except-continue retry around a collective
+    while True:  # line 10: flagged
+        try:
+            coll.all_reduce(x)
+            return x
+        except RuntimeError:
+            continue
+
+
+def decode_dispatch(engine, batch):
+    # POSITIVE: recursion as the retry loop
+    try:
+        return engine.decode(batch)
+    except RuntimeError:
+        return decode_dispatch(engine, batch)  # line 23: flagged
+
+
+def bounded(x):
+    # clean: attempt budget, re-raises when spent
+    for _ in range(3):
+        try:
+            coll.all_reduce(x)
+            return x
+        except RuntimeError:
+            continue
+    raise RuntimeError("all_reduce: retries exhausted")
+
+
+def paced(x):
+    # clean: backs off before retrying
+    while True:
+        try:
+            coll.all_reduce(x)
+            return x
+        except RuntimeError:
+            time.sleep(0.5)
+            continue
+
+
+def escalates(engine, batch):
+    # clean: handler re-raises after bookkeeping
+    while True:
+        try:
+            return engine.decode(batch)
+        except RuntimeError:
+            engine.note_failure()
+            raise

@@ -1,0 +1,393 @@
+"""Fault-tolerance plane (README.md "Fault tolerance"): chaos schedule
+parsing + deterministic triggers + the off-path zero-alloc guarantee,
+torn-checkpoint fallback to last-known-good, GC protection of the only
+restorable step, resume-exact RNG state, collective fail/timeout
+injection, serving self-heal (drain->rebuild->re-admit) with the
+recovery budget, the /healthz degraded + /readyz mid-recovery
+contracts, and the fleet "recoveries per rank" table."""
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import faults
+from paddle_tpu.faults import ChaosFault, InjectedOOM, parse_schedule
+from paddle_tpu.framework import config as _config
+from paddle_tpu.framework import random as _random
+from paddle_tpu.observability import metrics as _metrics
+
+
+@pytest.fixture
+def chaos(tmp_path):
+    """Set a chaos schedule via the returned helper; flags + parsed
+    schedule state restored/reset around the test."""
+    prev = paddle.get_flags(
+        ["FLAGS_chaos", "FLAGS_chaos_seed", "FLAGS_chaos_dir"])
+
+    def arm(spec, seed=0, use_dir=False):
+        paddle.set_flags({
+            "FLAGS_chaos": spec,
+            "FLAGS_chaos_seed": seed,
+            "FLAGS_chaos_dir": str(tmp_path / "chaos_state")
+            if use_dir else "",
+        })
+        faults.reset()
+
+    yield arm
+    paddle.set_flags(prev)
+    faults.reset()
+
+
+def _counter(name, **labels):
+    try:
+        return _metrics.default_registry().value(name, **labels)
+    except KeyError:
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# schedule grammar + triggers
+# ---------------------------------------------------------------------------
+
+
+class TestSchedule:
+    def test_grammar(self):
+        sched = parse_schedule(
+            "rank.kill@step=5:rank=1:n=1; decode.oom@p=0.5,"
+            "collective.stall@delay=2")
+        assert sched["rank.kill"][0]["step"] == 5
+        assert sched["rank.kill"][0]["rank"] == 1
+        assert sched["rank.kill"][0]["n"] == 1
+        assert sched["decode.oom"][0]["p"] == 0.5
+        assert sched["collective.stall"][0]["delay"] == 2.0
+
+    def test_unknown_site_raises(self):
+        with pytest.raises(ValueError, match="unknown site"):
+            parse_schedule("gpu.melt@step=1")
+
+    def test_unknown_trigger_raises(self):
+        with pytest.raises(ValueError, match="unknown trigger"):
+            parse_schedule("decode.oom@when=later")
+
+    def test_step_trigger(self, chaos):
+        chaos("rank.slow@step=3:delay=0.0")
+        fired = [faults.fire("rank.slow", step=s) is not None
+                 for s in range(6)]
+        assert fired == [False, False, False, True, False, False]
+
+    def test_rank_trigger_other_rank_never_fires(self, chaos,
+                                                 monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        chaos("decode.oom@rank=1")
+        assert faults.fire("decode.oom") is None
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        assert faults.fire("decode.oom") is not None
+
+    def test_p_trigger_is_deterministic(self, chaos):
+        chaos("decode.oom@p=0.3", seed=42)
+        first = [faults.fire("decode.oom") is not None
+                 for _ in range(64)]
+        faults.reset()  # new "run", same seed
+        assert [faults.fire("decode.oom") is not None
+                for _ in range(64)] == first
+        assert 0 < sum(first) < 64  # actually probabilistic
+        chaos("decode.oom@p=0.3", seed=43)
+        assert [faults.fire("decode.oom") is not None
+                for _ in range(64)] != first
+
+    def test_n_budget_in_memory(self, chaos):
+        chaos("decode.oom@n=2")
+        fires = sum(faults.fire("decode.oom") is not None
+                    for _ in range(10))
+        assert fires == 2
+
+    def test_n_budget_survives_restart_via_sentinel(self, chaos):
+        # FLAGS_chaos_dir persistence: reset() simulates the restarted
+        # process; the sentinel keeps the kill from re-firing (the
+        # chaos drill's rank.kill@n=1 contract)
+        chaos("rank.kill@n=1", use_dir=True)
+        assert faults.fire("rank.kill") is not None
+        faults.reset()
+        assert all(faults.fire("rank.kill") is None for _ in range(5))
+        sentinels = os.listdir(
+            _config.get_flag("FLAGS_chaos_dir", ""))
+        assert sentinels == ["chaos_rank.kill.0.fired"]
+
+    def test_fire_counts_injection_metric(self, chaos):
+        before = _counter("chaos_injections_total", site="decode.oom")
+        chaos("decode.oom@n=1")
+        with pytest.raises(InjectedOOM, match="RESOURCE_EXHAUSTED"):
+            faults.maybe_decode_oom()
+        assert _counter("chaos_injections_total",
+                        site="decode.oom") == before + 1
+
+    def test_injected_oom_classifies_as_real_oom(self):
+        from paddle_tpu.observability import memwatch
+        assert memwatch.is_oom(InjectedOOM(
+            "RESOURCE_EXHAUSTED: chaos-injected decode OOM"))
+
+    def test_delay_sites_sleep(self, chaos):
+        chaos("rank.slow@n=1:delay=0.05;dataloader.hang@n=1:delay=0.05")
+        t0 = time.monotonic()
+        faults.maybe_slow(0)
+        faults.maybe_hang_dataloader()
+        assert time.monotonic() - t0 >= 0.1
+        # budgets spent: both return immediately now
+        t0 = time.monotonic()
+        faults.maybe_slow(1)
+        faults.maybe_hang_dataloader()
+        assert time.monotonic() - t0 < 0.05
+
+
+class TestOffPath:
+    def test_chaos_off_is_one_flag_read_no_allocs(self, chaos):
+        chaos("")
+        reg = _metrics.default_registry()
+        before = reg.allocations
+        for _ in range(50):
+            faults.maybe_decode_oom()
+            faults.maybe_stall_collective("all_reduce")
+            faults.maybe_fail_collective("all_reduce")
+            faults.maybe_kill(0)
+            faults.maybe_slow(0)
+            faults.maybe_hang_dataloader()
+            assert faults.torn_write(0) is False
+        assert reg.allocations == before
+        # the schedule was never parsed, sites never counted
+        assert faults.invocations("decode.oom") == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: torn-write fallback, GC last-known-good, resume-exact RNG
+# ---------------------------------------------------------------------------
+
+
+def _state(step):
+    return {"w": np.full((4,), float(step), dtype=np.float32),
+            "b": np.arange(3, dtype=np.int32) + step}
+
+
+class TestCheckpointFaults:
+    def test_torn_write_falls_back_to_last_known_good(self, chaos,
+                                                      tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        chaos("checkpoint.torn_write@step=3")
+        before = _counter("checkpoint_restore_fallbacks_total")
+        with CheckpointManager(tmp_path / "ckpt", max_to_keep=5,
+                               async_save=False) as cm:
+            for s in (1, 2, 3):
+                assert cm.save(s, _state(s), force=True)
+            cm.wait()
+            # step 3's manifest is truncated JSON with no COMMITTED
+            # marker; restore() must skip it and land on step 2
+            assert not cm.is_committed(3)
+            out = cm.restore(return_tensors=False)
+            np.testing.assert_array_equal(
+                np.asarray(out["w"]), np.full((4,), 2.0))
+            assert cm.last_known_good() == 2
+        assert _counter("checkpoint_restore_fallbacks_total") > before
+
+    def test_gc_never_deletes_last_known_good(self, chaos, tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        # every save after step 1 is torn: retention (max_to_keep=2)
+        # would keep only {3, 4} — the fix also pins committed step 1
+        chaos("checkpoint.torn_write@step=2;"
+              "checkpoint.torn_write@step=3;"
+              "checkpoint.torn_write@step=4")
+        with CheckpointManager(tmp_path / "ckpt", max_to_keep=2,
+                               async_save=False) as cm:
+            for s in (1, 2, 3, 4):
+                assert cm.save(s, _state(s), force=True)
+            cm.wait()
+            # run a retention pass over the full tail: the newest-2
+            # window is {3, 4} (both torn) — step 1, the only
+            # restorable checkpoint, must survive it
+            cm._prune()
+            assert 1 in cm.all_steps()
+            assert cm.last_known_good() == 1
+            out = cm.restore(return_tensors=False)
+            np.testing.assert_array_equal(
+                np.asarray(out["w"]), np.full((4,), 1.0))
+
+    def test_resume_exact_rng_roundtrip(self):
+        from paddle_tpu.distributed.checkpoint import (
+            apply_trainer_state, trainer_state_snapshot)
+
+        paddle.seed(123)
+        _random.next_key()  # advance the stream a bit
+        snap = trainer_state_snapshot(step=5, data_position=7)
+        import jax
+        want = [np.asarray(jax.random.uniform(_random.next_key(), (3,)))
+                for _ in range(4)]
+        # a DIFFERENT process state: reseed, then install the snapshot
+        paddle.seed(999)
+        restored = apply_trainer_state(snap)
+        assert restored["step"] == 5 and restored["data_position"] == 7
+        got = [np.asarray(jax.random.uniform(_random.next_key(), (3,)))
+               for _ in range(4)]
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# collectives: injected failure + watchdog timeout
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveFaults:
+    def test_injected_collective_failure(self, chaos):
+        import paddle_tpu.distributed.collective as coll
+        from paddle_tpu.tensor import Tensor
+
+        chaos("collective.fail@n=1")
+        with pytest.raises(ChaosFault, match="all_reduce"):
+            coll.all_reduce(Tensor(np.ones((2,), np.float32)))
+        # budget spent: the next call goes through
+        coll.all_reduce(Tensor(np.ones((2,), np.float32)))
+
+    def test_watchdog_turns_stall_into_timeout(self, chaos):
+        import paddle_tpu.distributed.collective as coll
+        from paddle_tpu.distributed.collective import CollectiveTimeout
+        from paddle_tpu.tensor import Tensor
+
+        before = _counter("collective_timeouts_total", op="all_reduce")
+        prev = paddle.get_flags(["FLAGS_collective_timeout_s"])
+        paddle.set_flags({"FLAGS_collective_timeout_s": 0.2})
+        try:
+            chaos("collective.stall@n=1:delay=30")
+            t0 = time.monotonic()
+            with pytest.raises(CollectiveTimeout):
+                coll.all_reduce(Tensor(np.ones((2,), np.float32)))
+            assert time.monotonic() - t0 < 10  # not the 30 s stall
+        finally:
+            paddle.set_flags(prev)
+        assert _counter("collective_timeouts_total",
+                        op="all_reduce") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# serving: self-heal, recovery budget, readiness/health contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestServingRecovery:
+    def test_oom_storm_recovers_then_budget_poisons(self, chaos):
+        from paddle_tpu.inference import ServingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.observability import httpd
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               seq=64)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        errors0 = _counter("serving_errors_total")
+        recov0 = _counter("serving_recoveries_total", cause="oom_storm")
+        prev = paddle.get_flags(["FLAGS_serving_max_recoveries",
+                                 "FLAGS_serving_recovery_backoff_s"])
+        paddle.set_flags({"FLAGS_serving_max_recoveries": 3,
+                          "FLAGS_serving_recovery_backoff_s": 0.0})
+        engine = ServingEngine(m, max_batch=2, max_seq_len=32,
+                               page_size=8,
+                               decode_strategy="greedy_search")
+        try:
+            # two injected decode OOMs: the first preempts-and-retries,
+            # the second (same step, nothing left to preempt the pool
+            # blames) escalates to drain->rebuild->re-admit
+            chaos("decode.oom@n=2")
+            rid = engine.add_request(np.arange(1, 6),
+                                     max_new_tokens=4)
+            done = {f.request_id: f for f in engine.run()}
+            assert rid in done and len(done[rid].output_ids) == 4
+            assert engine._poisoned is None
+            assert engine._recoveries == 1
+            assert _counter("serving_recoveries_total",
+                            cause="oom_storm") == recov0 + 1
+            # the request RECOVERED: the unrecovered-error SLO counter
+            # must not move
+            assert _counter("serving_errors_total") == errors0
+
+            # readiness contract: 503 mid-rebuild, 200 after
+            engine._warmup_done = True
+            code, payload = httpd.ready_payload()
+            assert code == 200, payload
+            engine._recovering = True
+            code, payload = httpd.ready_payload()
+            assert code == 503
+            assert payload["engines"][0]["recovering"] is True
+            engine._recovering = False
+
+            # health contract: recovered-but-alive reports degraded
+            code, payload = httpd.health_payload()
+            assert code == 200
+            assert payload["status"] == "degraded"
+            assert payload["engine_recoveries"] >= 1
+
+            # recovery budget: past FLAGS_serving_max_recoveries the
+            # engine poisons for real and the failure COUNTS
+            paddle.set_flags({"FLAGS_serving_max_recoveries": 1})
+            assert engine._begin_recovery("decode_oom", "test") is False
+            assert engine._poisoned is not None
+            assert _counter("serving_errors_total") == errors0 + 1
+            code, _ = httpd.health_payload()
+            assert code == 503
+        finally:
+            paddle.set_flags(prev)
+            del engine
+            gc.collect()  # drop the poisoned engine from httpd tracking
+
+
+# ---------------------------------------------------------------------------
+# fleet: the "recoveries per rank" post-mortem table
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRecoveries:
+    def _shard(self, tmp_path, rank, text):
+        d = tmp_path / f"rank_{rank}"
+        d.mkdir()
+        (d / "metrics.prom").write_text(text)
+        return str(d)
+
+    def test_recoveries_table_from_shards(self, tmp_path):
+        from paddle_tpu.observability import fleet
+
+        shards = {
+            0: self._shard(tmp_path, 0, (
+                'serving_recoveries_total{cause="oom_storm"} 2\n'
+                'chaos_injections_total{site="decode.oom"} 4\n'
+                'serving_errors_total 1\n'
+                'checkpoint_restore_fallbacks_total 3\n'
+                'collective_timeouts_total{op="all_reduce"} 1\n')),
+            1: self._shard(tmp_path, 1, (  # all quiet: omitted
+                'serving_recoveries_total{cause="oom_storm"} 0\n'
+                'serving_errors_total 0\n')),
+        }
+        rows = fleet.recoveries_table(shards)
+        assert [r["rank"] for r in rows] == [0]
+        row = rows[0]
+        assert row["recoveries"] == {"oom_storm": 2.0}
+        assert row["recoveries_total"] == 2.0
+        assert row["errors_unrecovered"] == 1.0
+        assert row["restore_fallbacks"] == 3.0
+        assert row["collective_timeouts"] == 1.0
+        assert row["chaos_injections"] == {"decode.oom": 4.0}
+
+    def test_format_report_names_unrecovered_drops(self, tmp_path):
+        from paddle_tpu.observability import fleet
+
+        self._shard(tmp_path, 0, (
+            'serving_recoveries_total{cause="donated_buffers"} 1\n'
+            'serving_errors_total 2\n'))
+        (tmp_path / "rank_0" / "heartbeat.json").write_text(
+            '{"rank": 0, "ts": 0, "step": 0, "beats": 1}')
+        report = fleet.aggregate(str(tmp_path))
+        text = fleet.format_report(report)
+        assert "recoveries per rank" in text
+        assert "UNRECOVERED" in text and "rank 0" in text

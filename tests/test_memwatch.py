@@ -237,8 +237,16 @@ class TestOomForensics:
             return fn
 
         eng._get_decode_fn = always
-        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
-            eng.run()
+        # recovery budget 0 = the fail-fast contract: a persistent OOM
+        # poisons after ONE preemption round instead of escalating to
+        # the drain->rebuild self-heal (README.md "Fault tolerance")
+        prev = paddle.get_flags(["FLAGS_serving_max_recoveries"])
+        paddle.set_flags({"FLAGS_serving_max_recoveries": 0})
+        try:
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                eng.run()
+        finally:
+            paddle.set_flags(prev)
         # poisoned with the persistence verdict, not a silent crash
         assert eng._poisoned and "preemption round" in eng._poisoned
         assert reg.value("serving_engine_poisoned") == 1.0
@@ -248,13 +256,20 @@ class TestOomForensics:
         assert len(glob.glob(
             str(memwatch_on / "oom_serving_decode_*"))) == 2
 
-    def test_post_donation_oom_poisons_without_retry(self, memwatch_on):
-        # an OOM that already consumed the donated pools cannot retry:
-        # dump + poison immediately (the ADVICE round-5 invariant)
+    def test_post_donation_oom_recovers_with_fresh_pools(self,
+                                                         memwatch_on):
+        # an OOM that already consumed the donated pools cannot retry
+        # the dispatch against them: the engine drains, rebuilds the KV
+        # pools, and re-admits (README.md "Fault tolerance") — the
+        # request completes on the SAME engine, no poison, no raise
+        reg = om.default_registry()
         eng, cfg = _tiny_engine()
-        eng.add_request(np.arange(4), max_new_tokens=4)
+        rid = eng.add_request(np.arange(4), max_new_tokens=4)
+        real = eng._get_decode_fn
 
         def boom(all_greedy):
+            eng._get_decode_fn = real  # re-admit uses the real program
+
             def fn(params, buffers, k_pages, v_pages, *a, **k):
                 for p in list(k_pages) + list(v_pages):
                     p.delete()
@@ -263,9 +278,22 @@ class TestOomForensics:
             return fn
 
         eng._get_decode_fn = boom
-        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
-            eng.step()
-        assert eng._poisoned and "donating" in eng._poisoned
+        prev = paddle.get_flags(["FLAGS_serving_recovery_backoff_s"])
+        paddle.set_flags({"FLAGS_serving_recovery_backoff_s": 0.0})
+        try:
+            r0 = reg.value("serving_recoveries_total",
+                           cause="decode_oom")
+            assert eng.step() == []  # drained mid-recovery
+            assert not eng._poisoned
+            assert eng._recoveries == 1
+            assert reg.value("serving_recoveries_total",
+                             cause="decode_oom") == r0 + 1
+            assert not eng._buffers_deleted(eng.k_pages)
+            out = eng.run()  # the drained request re-prefills cleanly
+            assert [f.request_id for f in out] == [rid]
+            assert len(out[0].output_ids) == 4
+        finally:
+            paddle.set_flags(prev)
         assert glob.glob(str(memwatch_on / "oom_serving_decode_*"))
 
     def test_trainer_oom_dump(self, memwatch_on):

@@ -315,8 +315,16 @@ class TestServingTelemetry:
             return fn
 
         eng._get_decode_fn = boom
-        with pytest.raises(RuntimeError, match="simulated"):
-            eng.step()
+        # recovery budget 0: the donated-buffer failure must fail fast
+        # (poison) instead of draining and rebuilding the pools — the
+        # self-heal path is pinned in tests/test_faults.py
+        prev = paddle.get_flags(["FLAGS_serving_max_recoveries"])
+        paddle.set_flags({"FLAGS_serving_max_recoveries": 0})
+        try:
+            with pytest.raises(RuntimeError, match="simulated"):
+                eng.step()
+        finally:
+            paddle.set_flags(prev)
         assert eng._poisoned
         assert reg.value("serving_engine_poisoned") == 1.0
         # subsequent calls fail fast with the clear poisoned error, NOT

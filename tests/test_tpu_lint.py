@@ -36,6 +36,10 @@ def lint_fixture(name, **kw):
     ("jit_side_effect_pos.py", "side-effect-under-jit", [10, 11]),
     ("donated_pos.py", "donated-arg-reuse", [9]),
     ("flags_pos.py", "flag-hygiene", [6]),
+    # unbounded retry: while-True except-continue around a collective,
+    # and recursion-as-retry around a decode dispatch; the bounded,
+    # backoff-paced, and re-raising variants below them stay clean
+    ("unbounded_retry_pos.py", "unbounded-retry", [10, 23]),
 ])
 def test_fixture_triggers_exactly_its_rule(fixture, rule, expect_lines):
     findings = lint_fixture(fixture)
@@ -48,7 +52,7 @@ def test_registry_ships_all_six_rules():
     assert set(RULES) >= {
         "jax-compat", "weak-float-in-kernel",
         "rank-divergent-collective", "side-effect-under-jit",
-        "donated-arg-reuse", "flag-hygiene"}
+        "donated-arg-reuse", "flag-hygiene", "unbounded-retry"}
     for cls in RULES.values():
         assert cls.description
 

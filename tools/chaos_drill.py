@@ -1,0 +1,260 @@
+"""Chaos drill: rank kill -> elastic restart -> resume-exact training
+(tools/ci.sh; README.md "Fault tolerance").
+
+Parent mode: wipes --dir, then runs the drill in two phases:
+
+1. Reference: one uninterrupted single-rank training run (chaos off,
+   fresh checkpoint dir) logging every step's loss at full precision
+   (%.17g — bit-exact text).
+2. Chaos: a 2-rank pod under the elastic launcher
+   (distributed.launch.CollectiveController, max_restarts=2) with
+   `FLAGS_chaos="rank.kill@step=K:rank=1:n=1"`: rank 1 dies HARD
+   (os._exit 137, no atexit) mid-training, the controller restarts the
+   WHOLE pod, and every rank resumes from its last COMMITTED manifest
+   checkpoint — step, model/optimizer state, and the KeyStream RNG
+   position (trainer_state_snapshot / apply_trainer_state), so the
+   resumed data+dropout key sequence continues exactly where the dead
+   incarnation's checkpoint left it.
+
+The drill then asserts, failing loudly on each:
+
+- the kill actually fired, exactly once (the FLAGS_chaos_dir sentinel
+  has one line — it also suppresses a re-kill after the restart);
+- the controller performed >=1 elastic pod restart
+  (telemetry_dir/pod_restarts.json breadcrumb);
+- the chaos job still exited 0;
+- rank 0's per-step losses are BIT-IDENTICAL to the reference run's
+  (string equality of the %.17g text, final value per step) — the
+  resume-exact guarantee, not an approximate continuation.
+
+Artifacts stay under --dir (default /tmp/ci_chaos): ref/ and chaos/
+checkpoints + loss logs, logs/workerlog.N, telemetry/ fleet shards.
+
+    python tools/chaos_drill.py --dir /tmp/ci_chaos
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def worker(args) -> int:
+    """One training rank: deterministic tiny-Llama loop with per-step
+    committed checkpoints carrying resume-exact trainer state. Both the
+    reference run and every pod incarnation execute THIS function — the
+    bit-identical comparison needs one code path, not two."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (
+        CheckpointManager, apply_trainer_state, trainer_state_snapshot)
+    from paddle_tpu.framework import random as _random
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_train_step)
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    base = os.path.join(args.dir, args.tag)
+    ckpt = os.path.join(base, f"ckpt_rank{rank}")
+    log_path = os.path.join(base, f"losses_rank{rank}.log")
+    os.makedirs(base, exist_ok=True)
+
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=32, hidden=16, layers=2, heads=2, seq=8)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    step_fn = build_train_step(model, opt, mesh=None, donate=False)
+
+    cm = CheckpointManager(ckpt, max_to_keep=3, async_save=False)
+    start = 0
+    ts = cm.restore_trainer_state()
+    if ts is not None:
+        import jax.tree_util as jtu
+
+        from paddle_tpu.tensor import Tensor, as_array
+
+        state = jtu.tree_map(
+            as_array, cm.restore(int(ts["step"])),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        model.load_pytree(state["params"])
+        step_fn._opt_state_holder["state"] = state["opt"]
+        # KeyStream key + fold-in counter: the resumed run draws the
+        # EXACT key sequence the killed run would have drawn next
+        apply_trainer_state(ts)
+        start = int(ts["data_position"])
+    with open(log_path, "a") as log:
+        for s in range(start, args.steps):
+            # data from the global KeyStream — exercises the RNG half
+            # of resume-exactness (np.random would resume trivially)
+            x = paddle.to_tensor(np.asarray(jax.random.randint(
+                _random.next_key(), (4, 8), 0, 32)))
+            y = paddle.to_tensor(np.asarray(jax.random.randint(
+                _random.next_key(), (4, 8), 0, 32)))
+            loss = float(step_fn(x, y))
+            # log BEFORE checkpointing: a kill between the two re-runs
+            # step s and re-logs the identical value; the reverse order
+            # would lose line s forever
+            log.write(f"{s} {loss:.17g} resumed={start > 0}\n")
+            log.flush()
+            cm.save(s, {"params": model.parameters_pytree(),
+                        "opt": step_fn._opt_state_holder["state"]},
+                    force=True,
+                    trainer_state=trainer_state_snapshot(
+                        s, data_position=s + 1))
+            # commit NOW (manifest COMMITTED marker): a kill on the very
+            # next step must find step s restorable, not torn
+            cm.wait()
+    cm.close()
+    return 0
+
+
+def _read_losses(path):
+    """{step: '%.17g' loss text} — FINAL value per step (a resumed run
+    re-logs the steps after its restored checkpoint)."""
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[int(parts[0])] = parts[1]
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="/tmp/ci_chaos")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--kill-step", type=int, default=4,
+                    help="rank 1 dies before executing this step")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--tag", default="chaos", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return worker(args)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+
+    # ---- phase 1: uninterrupted reference run (chaos off) ------------
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TRAINER_ID": "0",
+                "FLAGS_chaos": "", "FLAGS_chaos_dir": ""})
+    env.pop("FLAGS_telemetry_dir", None)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--dir", args.dir, "--steps", str(args.steps), "--tag", "ref"],
+        env=env, capture_output=True, text=True, timeout=420)
+    if r.returncode != 0:
+        print(f"chaos drill FAILED: reference run rc={r.returncode}:\n"
+              f"{(r.stdout + r.stderr)[-2000:]}", file=sys.stderr)
+        return 1
+    ref = _read_losses(os.path.join(args.dir, "ref",
+                                    "losses_rank0.log"))
+    if set(ref) != set(range(args.steps)):
+        print(f"chaos drill FAILED: reference logged steps "
+              f"{sorted(ref)}, want 0..{args.steps - 1}",
+              file=sys.stderr)
+        return 1
+
+    # ---- phase 2: 2-rank pod, scheduled rank kill, elastic restart ---
+    from paddle_tpu.distributed.launch.context import JobContext
+    from paddle_tpu.distributed.launch.controller import (
+        CollectiveController)
+
+    chaos_state = os.path.join(args.dir, "chaos_state")
+    telemetry = os.path.join(args.dir, "telemetry")
+    os.makedirs(chaos_state, exist_ok=True)
+    ctx = JobContext(
+        script=os.path.abspath(__file__),
+        script_args=["--worker", "--dir", args.dir,
+                     "--steps", str(args.steps), "--tag", "chaos"],
+        nproc_per_node=2, max_restarts=2,
+        log_dir=os.path.join(args.dir, "logs"),
+        telemetry_dir=telemetry,
+        envs={"JAX_PLATFORMS": "cpu",
+              "FLAGS_chaos":
+                  f"rank.kill@step={args.kill_step}:rank=1:n=1",
+              "FLAGS_chaos_dir": chaos_state,
+              "FLAGS_chaos_seed": "0"})
+    rc = CollectiveController(ctx).run()
+    if rc != 0:
+        print(f"chaos drill FAILED: chaos job rc={rc} "
+              f"(logs: {ctx.log_dir}/workerlog.*)", file=sys.stderr)
+        return 1
+
+    # the kill fired exactly once (the sentinel both proves it and
+    # suppressed a re-kill after the restart)
+    sentinel = os.path.join(chaos_state, "chaos_rank.kill.0.fired")
+    if not os.path.exists(sentinel):
+        print("chaos drill FAILED: rank.kill never fired "
+              f"(no sentinel {sentinel})", file=sys.stderr)
+        return 1
+    with open(sentinel) as f:
+        fires = sum(1 for _ in f)
+    if fires != 1:
+        print(f"chaos drill FAILED: rank.kill fired {fires} times, "
+              f"want exactly 1 (restart must not re-kill)",
+              file=sys.stderr)
+        return 1
+
+    # the elastic restart actually happened
+    restarts_path = os.path.join(telemetry, "pod_restarts.json")
+    try:
+        with open(restarts_path) as f:
+            restarts = json.load(f)
+    except (OSError, ValueError):
+        restarts = []
+    if not restarts:
+        print(f"chaos drill FAILED: no pod restart recorded at "
+              f"{restarts_path}", file=sys.stderr)
+        return 1
+
+    # resume-exact: rank 0's final per-step losses are bit-identical
+    # (%.17g text) to the uninterrupted reference's
+    got = _read_losses(os.path.join(args.dir, "chaos",
+                                    "losses_rank0.log"))
+    if set(got) != set(range(args.steps)):
+        print(f"chaos drill FAILED: chaos run logged steps "
+              f"{sorted(got)}, want 0..{args.steps - 1}",
+              file=sys.stderr)
+        return 1
+    diverged = [s for s in range(args.steps) if got[s] != ref[s]]
+    if diverged:
+        detail = ", ".join(
+            f"step {s}: ref={ref[s]} chaos={got[s]}"
+            for s in diverged[:3])
+        print(f"chaos drill FAILED: losses diverged after restart at "
+              f"steps {diverged} ({detail})", file=sys.stderr)
+        return 1
+
+    # the chaos rank-1 log must show a resumed incarnation
+    r1 = os.path.join(args.dir, "chaos", "losses_rank1.log")
+    resumed = any("resumed=True" in line for line in open(r1)) \
+        if os.path.exists(r1) else False
+    if not resumed:
+        print("chaos drill FAILED: rank 1 never resumed from its "
+              "checkpoint after the restart", file=sys.stderr)
+        return 1
+
+    print(f"chaos drill OK: kill fired once at step {args.kill_step}, "
+          f"{len(restarts)} pod restart(s), {args.steps} steps "
+          f"bit-identical to the uninterrupted reference -> {args.dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,12 +199,27 @@ elif ! timeout 120 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# chaos drill (ISSUE 11, README.md "Fault tolerance"): scheduled
+# rank.kill (FLAGS_chaos) mid-training in a 2-rank elastic pod -> the
+# controller must restart the pod, every rank must resume from its last
+# COMMITTED manifest checkpoint (step + model/opt + KeyStream RNG), and
+# rank 0's per-step losses must be BIT-IDENTICAL to an uninterrupted
+# reference run. Exit 1 on a missed kill, no restart, or any divergence.
+# Artifacts (checkpoints, loss logs, workerlogs, fleet shards) stay
+# under /tmp/ci_chaos.
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/chaos_drill.py --dir /tmp/ci_chaos; then
+  echo "CI: chaos drill FAILED (kill never fired, no elastic restart," \
+       "or resumed losses diverged from the uninterrupted reference)" >&2
+  rc=1
+fi
+
 if [ $rc -ne 0 ]; then
   echo "CI RED (mode=$MODE) — do NOT commit" >&2
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
-       "/tmp/ci_bench_smoke.json (ledger waterfall:" \
+       "/tmp/ci_chaos/, /tmp/ci_bench_smoke.json (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi
 exit $rc
