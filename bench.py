@@ -48,6 +48,21 @@ def _enable_observability(paddle):
         print(f"bench observability disabled: {e}", file=sys.stderr)
 
 
+def _overlap_efficiency(entry):
+    """The run's measured collective overlap share (hidden / raw wait
+    seconds) from the stepledger aggregate — None when no collective
+    wait was observed (single-device runs)."""
+    try:
+        from paddle_tpu.observability import stepledger as _sl
+
+        a = _sl.snapshot().get(entry) or {}
+        raw = float(a.get("coll_raw", 0.0))
+        return round(float(a.get("coll_hidden", 0.0)) / raw, 4) \
+            if raw > 0 else None
+    except Exception:  # noqa: BLE001 — telemetry must never take the run
+        return None
+
+
 def _observability_columns():
     """The memory/compile columns for a bench row: the run's peak device
     bytes (allocator high-water mark; live-sweep max on CPU) and total
@@ -198,7 +213,8 @@ def _env_override_tag():
 
     keys = ("BENCH_HIDDEN", "BENCH_LAYERS", "BENCH_INTER", "BENCH_VOCAB",
             "BENCH_BATCH", "BENCH_SEQ", "BENCH_RECOMPUTE",
-            "BENCH_SCAN_LAYERS", "BENCH_FUSED_CE")
+            "BENCH_SCAN_LAYERS", "BENCH_FUSED_CE", "BENCH_OVERLAP",
+            "BENCH_GRAD_BUCKET_MB", "BENCH_PREFETCH_DEPTH")
     parts = [f"{k[6:].lower()}={os.environ[k]}" for k in sorted(keys)
              if k in os.environ]
     return (":" + ",".join(parts)) if parts else ""
@@ -355,6 +371,21 @@ def main():
         if env in os.environ:
             setattr(cfg, attr, int(os.environ[env]))
 
+    # overlap engine knobs (ISSUE 12): BENCH_OVERLAP=0 reverts to the
+    # legacy per-param grad sync so the piggyback matrix banks on/off
+    # rows at identical geometry; bucket/prefetch sizes are
+    # comparability keys too. Stepledger rides along (block cadence
+    # pushed past the run so it never syncs mid-timing) purely to
+    # measure overlap_efficiency = hidden/raw collective seconds.
+    overlap = os.environ.get("BENCH_OVERLAP", "1") == "1"
+    grad_bucket_mb = int(os.environ.get("BENCH_GRAD_BUCKET_MB", "25"))
+    prefetch_depth = int(os.environ.get("BENCH_PREFETCH_DEPTH", "2"))
+    paddle.set_flags({"FLAGS_train_overlap": overlap,
+                      "FLAGS_grad_bucket_mb": grad_bucket_mb,
+                      "FLAGS_prefetch_depth": prefetch_depth,
+                      "FLAGS_stepledger": True,
+                      "FLAGS_stepledger_block_every": 1_000_000})
+
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
@@ -425,6 +456,14 @@ def main():
                 3),
             "loss_first": round(loss_val, 4),
             "loss_last": round(final, 4),
+            # overlap comparability knobs: an overlap-off (or re-tuned
+            # bucket/prefetch) row must never baseline the canonical
+            # overlap-on capture or vice versa (tools/bench_compare.py
+            # KNOB_KEYS_ABSENT_IS_NONE)
+            "overlap": bool(overlap),
+            "grad_bucket_mb": grad_bucket_mb,
+            "prefetch_depth": prefetch_depth,
+            "overlap_efficiency": _overlap_efficiency("train.step"),
         },
     }
     result["extra"].update(_observability_columns())
@@ -639,6 +678,14 @@ def _piggyback_extra_configs():
     jobs = [("llama_1b", {"BENCH_CONFIG": "llama", "BENCH_MODEL": "1b"}),
             ("resnet", {"BENCH_CONFIG": "resnet"}),
             ("serving", {"BENCH_CONFIG": "serving"}),
+            # overlap-engine A/B (ISSUE 12): the main run is the
+            # overlap-ON row; these bank the OFF row (and an explicit ON
+            # twin at the same tag) so BENCH_HISTORY carries both arms
+            # of the train-step overlap comparison at identical geometry
+            ("llama_overlap_off",
+             {"BENCH_CONFIG": "llama", "BENCH_OVERLAP": "0"}),
+            ("llama_overlap_on",
+             {"BENCH_CONFIG": "llama", "BENCH_OVERLAP": "1"}),
             # the decode-speed matrix (ROADMAP item 2 / ISSUE 9):
             # {bf16, int8, int4} x {spec off/on} serving rows, each
             # banked into BENCH_HISTORY.jsonl so bench_compare arms the

@@ -1,0 +1,108 @@
+"""Rule: sync-transfer-in-step-loop — a blocking host<->device
+transfer inside a train/serving step loop.
+
+The overlap engine (ISSUE 12) only hides collective and staging time
+when the step loop itself never blocks the dispatch pipeline: a bare
+`jax.device_put(batch)` stages synchronously on the main thread (the
+prefetcher exists to do it on a background thread, one batch ahead),
+`.block_until_ready()` drains the whole async dispatch queue, and
+`np.asarray(device_array)` is an implicit device->host read that does
+the same. Any of these inside the hot loop re-serializes exactly the
+work the engine overlapped — `train_data_wait_seconds` and the
+stepledger `data_wait`/`host` buckets grow back.
+
+Matching is heuristic but tight: the call must sit lexically inside a
+function whose name says it IS the hot path (`*step*` / `*loop*`),
+while builder/factory functions (`build_*`, `make_*`, `_make_*`) that
+merely CONSTRUCT staging closures stay out of scope. `asarray` is
+provenance-gated like the short collective names in
+rank-divergent-collective: only a call that resolves to numpy counts —
+a local `asarray` helper does not.
+
+Intentional sync points (latency measurement, the final loss read of a
+bench loop) document themselves with
+`# tpu-lint: disable=sync-transfer-in-step-loop`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_parts, register
+
+# function-name heuristic for "this IS the step loop"
+_HOT_MARKERS = ("step", "loop")
+# ...unless the name says it only BUILDS one (factories return the
+# closure; they run once, outside the loop)
+_BUILDER_PREFIXES = ("build", "_build", "make", "_make", "register",
+                     "_register")
+
+_ADVICE = {
+    "device_put": ("stage batches off-thread instead: "
+                   "models/trainer.py prefetch_batches / "
+                   "io/dataloader.py DevicePrefetcher keep batch N+1 "
+                   "staging while batch N computes"),
+    "block_until_ready": ("it drains the whole async dispatch queue — "
+                          "let the next step's data dependency (or the "
+                          "stepledger's sampled block) do the sync"),
+    "asarray": ("an implicit device->host read that blocks dispatch — "
+                "keep host reads out of the hot loop (read once after "
+                "the loop, or sample every Nth step)"),
+}
+
+
+def _is_hot_function(name: str) -> bool:
+    low = name.lower()
+    if low.startswith(_BUILDER_PREFIXES):
+        return False
+    return any(m in low for m in _HOT_MARKERS)
+
+
+@register
+class SyncTransferInStepLoopRule(Rule):
+    name = "sync-transfer-in-step-loop"
+    description = ("blocking host<->device transfer (jax.device_put / "
+                   ".block_until_ready() / np.asarray) inside a "
+                   "train/serving step loop — re-serializes the work "
+                   "the overlap engine hides")
+
+    def _classify(self, ctx, call: ast.Call):
+        """Which sync-transfer kind this call is, or None."""
+        func = call.func
+        parts = dotted_parts(func)
+        if isinstance(func, ast.Attribute) \
+                and func.attr == "block_until_ready":
+            return "block_until_ready"
+        if not parts:
+            return None
+        leaf = parts[-1]
+        if leaf == "device_put":
+            path = ctx.imports.expand(func) or leaf
+            if path.split(".")[0] == "jax" or path == "device_put":
+                return "device_put"
+            return None
+        if leaf == "asarray":
+            # provenance-gated: only numpy's asarray is a device->host
+            # read; a local staging helper named `asarray` is not
+            path = ctx.imports.expand(func) or ""
+            if path.split(".")[0] in ("numpy", "np"):
+                return "asarray"
+        return None
+
+    def check(self, ctx):
+        yield from self._walk(ctx, ctx.tree, hot=None)
+
+    def _walk(self, ctx, node, hot):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _is_hot_function(node.name):
+                hot = node.name
+            elif node.name.lower().startswith(_BUILDER_PREFIXES):
+                hot = None  # a builder nested in a hot fn runs once
+        elif isinstance(node, ast.Call) and hot is not None:
+            kind = self._classify(ctx, node)
+            if kind is not None:
+                yield ctx.finding(
+                    self.name, node,
+                    f"synchronous transfer `{kind}` inside step-loop "
+                    f"function `{hot}` — {_ADVICE[kind]}")
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(ctx, child, hot)

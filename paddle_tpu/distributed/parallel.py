@@ -1,17 +1,26 @@
 """DataParallel + parallel helpers (reference:
 python/paddle/fluid/dygraph/parallel.py — SURVEY.md §2.2 "DP (dygraph)").
 
-TPU-native: no Reducer/bucketed-allreduce machinery — under jit the grads of
-a batch-sharded step are psum'd by XLA (compiler-overlapped with backward
-compute, the same overlap the reference gets from comm streams). The eager
-DataParallel wrapper keeps `no_sync`/API parity and performs grad psum after
-backward when a dp axis exists.
+TPU-native twist on the reference Reducer: under jit the grads of a
+batch-sharded step are psum'd by XLA (compiler-overlapped with backward
+compute, the same overlap the reference gets from comm streams), so the
+jitted path needs only sharding annotations (jit/api.py). The eager
+DataParallel wrapper keeps `no_sync`/API parity and, with
+FLAGS_train_overlap on, coalesces grads into ~FLAGS_grad_bucket_mb flat
+buckets in reverse-backward order — one collective per bucket instead of
+one per param — dispatched asynchronously so the runtime can overlap
+bucket N's reduce with bucket N+1's work. Bucket membership must stay
+stable across steps (rebucketing mid-run would recompile every step):
+when it changes, sync falls back to the per-param reduce permanently and
+drops a flight-recorder breadcrumb.
 """
 from __future__ import annotations
 
 import contextlib
 
+from ..framework import config as _config
 from ..nn.layer_base import Layer
+from ..tensor import Tensor, as_array
 from . import collective as _collective
 from . import env as _env
 from . import mesh as _mesh
@@ -26,6 +35,11 @@ class DataParallel(Layer):
         self.add_sublayer("_layers", layers)
         self._grad_sync_enabled = True
         self.find_unused_parameters = find_unused_parameters
+        # bucket-membership contract: signature of the first synced step;
+        # a divergence (param added/removed, grad appearing/disappearing
+        # mid-bucket) permanently downgrades to the per-param reduce
+        self._bucket_signature = None
+        self._bucket_fallback = False
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -40,13 +54,45 @@ class DataParallel(Layer):
             self._grad_sync_enabled = prev
 
     def sync_gradients(self):
-        """psum grads over the dp axis (called by optimizer pre-step or
-        manually; inside jit this lowers to one fused all-reduce)."""
+        """Reduce grads over the dp axis (called by optimizer pre-step or
+        manually; inside jit this lowers to one fused all-reduce). With
+        FLAGS_train_overlap on, grads are coalesced into size-bucketed
+        flat buffers (reverse parameter order — the order backward
+        produces them) and reduced one collective per bucket."""
         if not self._grad_sync_enabled:
             return
         if _mesh.axis_size("dp") <= 1:
             return
-        for p in self._layers.parameters():
+        params = list(self._layers.parameters())
+        if (not _config.get_flag("FLAGS_train_overlap", True)
+                or self._bucket_fallback):
+            self._sync_per_param(params)
+            return
+        sig = _membership_signature(params)
+        if self._bucket_signature is None:
+            self._bucket_signature = sig
+        elif sig != self._bucket_signature:
+            # rebucketing every step would retrace/recompile the reduce;
+            # downgrade once, loudly, and stay downgraded
+            self._bucket_fallback = True
+            try:
+                from ..observability import flight_recorder as _flight
+
+                _flight.record_event(
+                    "grad_bucket.membership_changed",
+                    n_params=len(params),
+                    n_grads=sum(1 for p in params if p.grad is not None),
+                    fallback="per_param")
+            except Exception:  # noqa: BLE001 — breadcrumb must not break sync
+                pass
+            self._sync_per_param(params)
+            return
+        for bucket in _bucket_grads(
+                [p for p in params if p.grad is not None]):
+            _reduce_bucket(bucket)
+
+    def _sync_per_param(self, params):
+        for p in params:
             if p.grad is not None:
                 _collective.all_reduce(p.grad, op=_collective.ReduceOp.AVG,
                                        group="dp")
@@ -62,6 +108,60 @@ class DataParallel(Layer):
 
     def apply_collective_grads(self):
         self.sync_gradients()
+
+
+def _membership_signature(params):
+    """What the bucketed reducer keys its stability contract on: the
+    ordered (shape, dtype, has-grad) profile of every parameter."""
+    return tuple(
+        (i, tuple(p.shape), str(as_array(p).dtype), p.grad is not None)
+        for i, p in enumerate(params))
+
+
+def _bucket_grads(params):
+    """Partition grad-bearing params into coalescing buckets: reverse
+    parameter order (backward produces later layers' grads first, so the
+    first bucket can start reducing while earlier layers still compute),
+    consecutive same-dtype runs, at most FLAGS_grad_bucket_mb MiB each.
+    <= 0 MiB degenerates to one bucket per param."""
+    cap = int(_config.get_flag("FLAGS_grad_bucket_mb", 25)) << 20
+    buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
+    for p in reversed(params):
+        g = as_array(p.grad)
+        nbytes = g.size * g.dtype.itemsize
+        if cur and (g.dtype != cur_dtype or cur_bytes + nbytes > cap):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(p)
+        cur_bytes += nbytes
+        cur_dtype = g.dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _reduce_bucket(bucket):
+    """One collective for a whole bucket: flatten+concat member grads,
+    all_reduce the flat buffer (byte accounting / watchdog / chaos sites
+    all live inside all_reduce and see the coalesced op), then scatter
+    the reduced slices back into each param's grad. Elementwise reduce of
+    a concatenation is the same additions per element as per-param
+    reduces — losses stay bit-identical to the uncoalesced path."""
+    import jax.numpy as jnp
+
+    if len(bucket) == 1:
+        _collective.all_reduce(bucket[0].grad,
+                               op=_collective.ReduceOp.AVG, group="dp")
+        return
+    grads = [as_array(p.grad) for p in bucket]
+    flat = Tensor(jnp.concatenate([g.reshape(-1) for g in grads]))
+    _collective.all_reduce(flat, op=_collective.ReduceOp.AVG, group="dp")
+    reduced = as_array(flat)
+    off = 0
+    for p, g in zip(bucket, grads):
+        n = g.size
+        p.grad._rebind(reduced[off:off + n].reshape(g.shape))
+        off += n
 
 
 def init_parallel_env():

@@ -336,6 +336,36 @@ define_flag("FLAGS_collective_timeout_s", 0.0,
             "stall into a nonzero exit the elastic controller can "
             "restart. 0 (default) = no watchdog; the disabled path is "
             "one flag read.", type_=float)
+define_flag("FLAGS_train_overlap", True,
+            "Master switch for the train-step overlap engine. On "
+            "(default): DataParallel.sync_gradients coalesces grads "
+            "into size-bucketed flat reduces dispatched "
+            "asynchronously (distributed/parallel.py) and the jitted "
+            "train_step annotates its grad tree bucket-by-bucket so "
+            "XLA's latency-hiding scheduler can overlap bucket N's "
+            "collective with bucket N+1's backward compute "
+            "(jit/api.py). Off: the legacy one-all_reduce-per-param "
+            "loop — bit-identical losses either way (the reductions "
+            "are elementwise over the same addends).")
+define_flag("FLAGS_grad_bucket_mb", 25,
+            "Coalescing bucket size (MiB) for the bucketed gradient "
+            "reducer (distributed/parallel.py, jit/api.py): grads are "
+            "flattened into flat buffers of at most this many MiB in "
+            "reverse-backward order, so the first bucket's reduce can "
+            "start while earlier layers are still computing grads. "
+            "Matches the Paddle DataParallel comm_buffer_size default "
+            "of 25. <= 0 degenerates to one bucket per param.",
+            type_=int)
+define_flag("FLAGS_prefetch_depth", 2,
+            "Bounded staging depth of the double-buffered device "
+            "prefetcher (io/dataloader.py DevicePrefetcher): a "
+            "background thread keeps up to this many batches "
+            "device_put ahead of the consuming train loop (sharded "
+            "correctly from the start), so batch N+1's host->device "
+            "transfer overlaps batch N's compute and the stepledger "
+            "data_wait bucket trends to zero. <= 0 disables "
+            "prefetching (the iterator is passed through unchanged).",
+            type_=int)
 
 
 # ---------------------------------------------------------------------------

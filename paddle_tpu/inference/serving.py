@@ -1901,8 +1901,12 @@ class ServingEngine:
                 if self.k_scales is not None:
                     self.k_scales, self.v_scales = list(nks), list(nvs)
                 finished = finished_early
+                # intentional sync: the burst's tokens must reach the
+                # host to be emitted/stream-called — this is the one
+                # read per burst, not a stray transfer
                 finished.extend(self._replay_burst(
-                    np.asarray(toks), np.asarray(emits), active))
+                    np.asarray(toks), np.asarray(emits),  # tpu-lint: disable=sync-transfer-in-step-loop
+                    active))
                 self._step_metrics(t0, len(active), tok0)
                 if finished:
                     self._admit()
@@ -1946,7 +1950,9 @@ class ServingEngine:
         self.k_pages, self.v_pages = list(nk), list(nv)
         if self.k_scales is not None:
             self.k_scales, self.v_scales = list(nks), list(nvs)
-        nxt = np.asarray(nxt)
+        # intentional sync: the sampled token must reach the host to be
+        # appended/streamed — the one per-step read
+        nxt = np.asarray(nxt)  # tpu-lint: disable=sync-transfer-in-step-loop
         finished = finished_early
         for i in active:
             s = self.slots[i]

@@ -344,6 +344,144 @@ class _Iter:
             self._stop.set()
 
 
+# ---------------------------------------------------------------------------
+# DevicePrefetcher: double-buffered host->device staging
+# ---------------------------------------------------------------------------
+
+_STAGE_END = object()
+
+
+class _StageError:
+    """Carrier for an exception raised inside the staging thread; the
+    consumer re-raises it on its own stack."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_stage_cache = None
+
+
+def _stage_metrics():
+    global _stage_cache
+    from ..observability import metrics as _om
+
+    if _stage_cache is None:
+        _stage_cache = _om.HandleCache(lambda reg: (
+            reg.histogram(
+                "dataloader_stage_seconds",
+                "Host->device staging time per batch inside the "
+                "DevicePrefetcher thread (device_put with the target "
+                "sharding) — paid off the step loop's critical path."),
+            reg.gauge(
+                "dataloader_staged_depth",
+                "Batches already device-resident ahead of the consuming "
+                "step loop (bounded by FLAGS_prefetch_depth)."),
+        ))
+    return _stage_cache.get()
+
+
+class DevicePrefetcher:
+    """Double-buffered device staging over any batch iterator.
+
+    A background thread pulls batch N+1 from the wrapped iterator and
+    runs `place_fn` on it — the caller's sharded `jax.device_put`, so the
+    batch lands on device with the RIGHT layout from the start — while
+    batch N computes. Depth is bounded by FLAGS_prefetch_depth (or the
+    explicit `depth`); <= 0 degenerates to a synchronous passthrough
+    (place_fn applied inline, no thread). Staging is instrumented as a
+    `dataloader.stage` span (the stepledger maps the `dataloader.`
+    prefix into its data_wait bucket) plus the dataloader_stage_seconds
+    histogram and dataloader_staged_depth gauge. Exceptions raised by
+    the wrapped iterator or place_fn surface on the consumer's stack.
+    """
+
+    def __init__(self, it, place_fn, depth: Optional[int] = None):
+        from ..framework import config as _config
+
+        self._it = iter(it)
+        self._place = place_fn
+        if depth is None:
+            depth = int(_config.get_flag("FLAGS_prefetch_depth", 2))
+        self.depth = int(depth)
+        self._q = None
+        if self.depth > 0:
+            self._q = queue.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._producer, name="device-prefetch", daemon=True)
+            self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put: never wedges the daemon thread forever when the
+        consumer went away (close() flips the stop event)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self):
+        import time as _time
+
+        stage_h, depth_g = _stage_metrics()
+        try:
+            for batch in self._it:
+                if self._stop.is_set():
+                    return
+                t0 = _time.perf_counter()
+                staged = self._place(batch)
+                t1 = _time.perf_counter()
+                stage_h.observe(t1 - t0)
+                from ..observability import tracing as _tracing
+
+                if _tracing.enabled():
+                    _tracing.emit("dataloader.stage", t0, t1,
+                                  depth=self._q.qsize())
+                if not self._put(staged):
+                    return
+                depth_g.set(self._q.qsize())
+        except BaseException as e:  # noqa: BLE001 — surfaces on consumer
+            self._put(_StageError(e))
+        finally:
+            self._put(_STAGE_END)
+
+    def __next__(self):
+        if self._q is None:  # depth <= 0: synchronous passthrough
+            return self._place(next(self._it))
+        item = self._q.get()
+        _, depth_g = _stage_metrics()
+        depth_g.set(self._q.qsize())
+        if item is _STAGE_END:
+            raise StopIteration
+        if isinstance(item, _StageError):
+            raise item.exc
+        return item
+
+    def __iter__(self):
+        return self
+
+    def close(self):
+        if self._q is None:
+            return
+        self._stop.set()
+        # drain so a put-blocked producer can observe the stop event
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=2)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,

@@ -51,6 +51,7 @@ KERNEL_VERSIONS = {
     "paged_decode": "pa-v1",
     "rms_norm": "rn-v1",
     "quant_matmul": "qm-v1",
+    "matmul": "mm-v1",
 }
 
 BLOCK_GRID = (128, 256, 512)
@@ -821,6 +822,56 @@ def _choose_quant_matmul(m, k, n, weight_dtype, group_size, dtype):
 
     return get_tuner().pick("quant_matmul", bucket, cands, make_args,
                             eligible)
+
+
+def choose_matmul(m, k, n, dtype):
+    """Measured dispatch for the dense linear/MLP matmul
+    (kernels/matmul.py — the largest compute bucket in the roofline
+    report). Candidates: XLA's default lowering and the blocked Pallas
+    kernel over the (block_n, block_k) grid. Winner meta: {"impl":
+    "xla"} or {"impl": "pallas", "block_n": bn, "block_k": bk}."""
+    return _memo(("matmul", m, k, n, str(dtype)),
+                 lambda: _choose_matmul(m, k, n, dtype))
+
+
+def _choose_matmul(m, k, n, dtype):
+    if not measurement_allowed():
+        return None
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import matmul as mm
+
+    bm = bucket_pow2(m)
+    bucket = (("m", bm), ("k", int(k)), ("n", int(n)), ("dt", str(dtype)))
+
+    cands: List[Candidate] = [
+        Candidate("xla", "xla", mm.matmul_xla, {"impl": "xla"})]
+    for bn in mm.BLOCK_GRID_N:
+        for bk in mm.BLOCK_GRID_K:
+            if not mm.supports(bm, k, n, bn, bk):
+                continue
+
+            def pal_fn(x, w, _bn=bn, _bk=bk):
+                return mm.matmul_fused(x, w, _bn, _bk)
+
+            cands.append(Candidate(f"pallas:{bn}x{bk}", "pallas", pal_fn,
+                                   {"impl": "pallas", "block_n": bn,
+                                    "block_k": bk}))
+
+    def make_args():
+        kx, kw = jax.random.split(jax.random.PRNGKey(5))
+        x = jax.random.normal(kx, (bm, k), jnp.float32).astype(dtype)
+        w = jax.random.normal(kw, (k, n), jnp.float32).astype(dtype)
+        return x, w
+
+    def eligible(c):
+        if c.meta["impl"] == "xla":
+            return True
+        return mm.supports(m, k, n, c.meta["block_n"], c.meta["block_k"])
+
+    return get_tuner().pick("matmul", bucket, cands, make_args, eligible)
 
 
 def choose_rms_norm(rows, cols, dtype):

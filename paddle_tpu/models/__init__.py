@@ -11,4 +11,5 @@ from .gpt import (  # noqa: F401
     GPTModel,
 )
 from .generation import generate, sample_logits  # noqa: F401
-from .trainer import build_train_step, place_model  # noqa: F401
+from .trainer import (build_train_step, place_model,  # noqa: F401
+                      prefetch_batches)

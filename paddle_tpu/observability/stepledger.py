@@ -234,6 +234,13 @@ def _make_handles(reg):
             "Measured model-FLOPs utilization per entry point: "
             "cost_analysis FLOPs / (mean step wall * device peak * "
             "n_devices).", labels=("entry",)),
+        "overlap": reg.gauge(
+            "stepledger_overlap_efficiency",
+            "Collective overlap efficiency per entry point: the share "
+            "of raw collective wait hidden behind the step's dispatch "
+            "window (hidden / raw; 1.0 = fully overlapped, 0.0 = every "
+            "collective second exposed). The `collective` bucket "
+            "reports only the EXPOSED remainder.", labels=("entry",)),
     }
 
 
@@ -374,13 +381,21 @@ def end(snap, entry: str, t_dispatch: float, out=None, data_wait=0.0,
     # concurrent step on another thread (trainer + serving in one
     # process) can push the deltas past this entry's dispatch window —
     # cap them proportionally to the window so the named buckets can
-    # never exceed the exported wall (fractions stay <= 100%)
+    # never exceed the exported wall (fractions stay <= 100%). For the
+    # collective counter the clamp IS the overlap attribution: wait
+    # seconds in excess of the host dispatch window were, by
+    # construction, hidden behind compute (the bucketed async reducer
+    # issues reduces that drain while the device keeps working), so
+    # the `collective` bucket reports only the EXPOSED remainder and
+    # the hidden share feeds stepledger_overlap_efficiency.
+    raw_coll = coll_d
     window = max(t_dispatch - t0, 0.0)
     over = compile_d + coll_d
     if over > window:
         scale = window / over if over > 0 else 0.0
         compile_d *= scale
         coll_d *= scale
+    hidden_coll = max(raw_coll - coll_d, 0.0)
     host = max(window - compile_d - coll_d, 0.0)
     wall = max(t2 - t0, 0.0) + dw
     named = dw + compute + host + compile_d + coll_d
@@ -393,15 +408,19 @@ def end(snap, entry: str, t_dispatch: float, out=None, data_wait=0.0,
         if a is None:
             a = _agg[entry] = {"steps": 0, "wall": 0.0, "tokens": 0,
                                "blocked": 0,
+                               "coll_raw": 0.0, "coll_hidden": 0.0,
                                "buckets": {b: 0.0 for b in BUCKETS}}
         a["steps"] += 1
         a["wall"] += wall
         a["tokens"] += int(tokens or 0)
         a["blocked"] += 1 if blocked else 0
+        a["coll_raw"] += raw_coll
+        a["coll_hidden"] += hidden_coll
         for b, v in buckets.items():
             a["buckets"][b] += v
         agg_wall, agg_res = a["wall"], a["buckets"]["residual"]
         agg_steps = a["steps"]
+        agg_raw, agg_hidden = a["coll_raw"], a["coll_hidden"]
     h = _make_handles(registry) if registry is not None else _h()
     h["steps"].labels(entry).inc()
     h["wall"].labels(entry).inc(wall)
@@ -409,6 +428,8 @@ def end(snap, entry: str, t_dispatch: float, out=None, data_wait=0.0,
         h["seconds"].labels(entry, b).inc(v)
     h["residual_frac"].labels(entry).set(
         agg_res / agg_wall if agg_wall > 0 else 0.0)
+    h["overlap"].labels(entry).set(
+        agg_hidden / agg_raw if agg_raw > 0 else 0.0)
     cost = _costs.get(entry)
     if cost:
         mfu = _mfu(cost, agg_steps, agg_wall)

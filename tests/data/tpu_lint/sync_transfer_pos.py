@@ -1,0 +1,44 @@
+"""Positive fixture: sync-transfer-in-step-loop — blocking transfers
+inside step-loop functions; suppressed + builder + host-helper twins
+below them stay clean."""
+import numpy as np
+
+import jax
+
+
+def train_step_loop(batches, sharding, compute):
+    for batch in batches:
+        x = jax.device_put(batch, sharding)
+        loss = compute(x)
+        loss.block_until_ready()
+        print(np.asarray(loss))
+
+
+def decode_step(decode, tok):
+    out = decode(tok)
+    return np.asarray(out)
+
+
+def decode_step_measured(decode, tok):
+    # intentional sync point: latency measurement documents itself
+    out = decode(tok)
+    out.block_until_ready()  # tpu-lint: disable=sync-transfer-in-step-loop
+    return out
+
+
+def build_train_step(mesh):
+    # builder, not the loop: staging closures legitimately device_put
+    # (they run on the prefetch thread, not in the step loop)
+    def _place(a):
+        return jax.device_put(a, None)
+    return _place
+
+
+def host_helper(batch):
+    # no step/loop in the name: conversions off the hot path are fine
+    return np.asarray(batch)
+
+
+def custom_step(asarray, tok):
+    # provenance gate: a local `asarray` staging helper is NOT numpy's
+    return asarray(tok)

@@ -297,6 +297,48 @@ class TestDispatchWiring:
              ("quant", 0))))
         assert win is not None and win["winner"] == "pallas"
 
+    def test_matmul_tuned_winner_routes(self, tuner_env):
+        """Fake timer makes the blocked Pallas matmul win; F.linear must
+        execute it (interpret mode allows tuning only because a custom
+        timer is installed) and match XLA numerically."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.kernels import matmul as kmm
+
+        at.set_timer(lambda fn, args: 1.0
+                     if getattr(fn, "__name__", "") == "pal_fn" else 5.0)
+        x = paddle.to_tensor(np.asarray(_rand((64, 256), 6)))
+        w = paddle.to_tensor(np.asarray(_rand((256, 128), 7)))
+        y = F.linear(x, w)
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ w.numpy(),
+                                   atol=2e-4)
+        entry = at.get_tuner().lookup(at.Autotuner.make_key(
+            "matmul", (("m", 64), ("k", 256), ("n", 128),
+                       ("dt", "float32"))))
+        assert entry is not None
+        assert entry["winner"].startswith("pallas:")
+        bn, bk = map(int, entry["winner"].split(":")[1].split("x"))
+        assert bn in kmm.BLOCK_GRID_N and bk in kmm.BLOCK_GRID_K
+
+    def test_matmul_xla_winner_keeps_xla_path(self, tuner_env,
+                                              monkeypatch):
+        """When the measurement says XLA is faster, linear must NOT call
+        the Pallas kernel (never-slower-than-XLA contract)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.kernels import matmul as kmm
+
+        at.set_timer(lambda fn, args: 5.0
+                     if getattr(fn, "__name__", "") == "pal_fn" else 1.0)
+
+        def boom(*a, **kw):
+            raise AssertionError("pallas matmul ran despite XLA winning")
+
+        monkeypatch.setattr(kmm, "matmul_fused", boom)
+        x = paddle.to_tensor(np.asarray(_rand((64, 256), 8)))
+        w = paddle.to_tensor(np.asarray(_rand((256, 128), 9)))
+        y = F.linear(x, w)
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ w.numpy(),
+                                   atol=2e-4)
+
     def test_rms_norm_tuned_block_rows(self, tuner_env):
         import paddle_tpu.nn.functional as F
 
@@ -334,6 +376,12 @@ class TestGoldenSchema:
                (("m", 8), ("k", 1024), ("n", 4096), ("wd", "int4"),
                 ("gs", 128), ("dt", "bfloat16")),
                _timed_candidates(qtable), lambda: (None,))
+        # the dense matmul op (ISSUE 12) persists through the same schema
+        mtable = {"xla": ("xla", 1.6), "pallas:256x256": ("pallas", 0.9)}
+        at.set_timer(_timer_for(mtable))
+        t.pick("matmul",
+               (("m", 512), ("k", 1024), ("n", 4096), ("dt", "bfloat16")),
+               _timed_candidates(mtable), lambda: (None,))
         got = json.load(open(t.cache_path()))
         golden_path = os.path.join(os.path.dirname(__file__), "data",
                                    "autotune_cache_golden.json")

@@ -1,0 +1,339 @@
+"""Train-step overlap engine (ISSUE 12): bucketed grad reduce bit-parity
+vs the per-param path, bucket-membership stability fallback, chaos
+inside a coalesced reduce, jitted overlap-on/off loss parity (incl.
+gradient merge), and the double-buffered DevicePrefetcher."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.mesh as mesh_mod
+from paddle_tpu.distributed import parallel as par
+from paddle_tpu.framework import config as _config
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.tensor import Tensor, as_array
+
+
+@pytest.fixture(autouse=True)
+def _teardown_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+@pytest.fixture
+def overlap_flags():
+    """Restore the overlap knobs after the test."""
+    prev = paddle.get_flags(["FLAGS_train_overlap", "FLAGS_grad_bucket_mb",
+                             "FLAGS_prefetch_depth"])
+    yield
+    paddle.set_flags(prev)
+
+
+def _counter(name, **labels):
+    try:
+        return om.default_registry().value(name, **labels)
+    except KeyError:
+        return 0.0
+
+
+def _dp_net(seed=0):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                               paddle.nn.Linear(16, 4))
+    return par.DataParallel(net)
+
+
+def _set_grads(model, seed=7):
+    rng = np.random.RandomState(seed)
+    for p in model.parameters():
+        p.grad = paddle.to_tensor(
+            rng.randn(*[int(s) for s in p.shape]).astype(np.float32))
+
+
+def _grads(model):
+    return [np.asarray(as_array(p.grad)) for p in model.parameters()]
+
+
+# ---------------------------------------------------------------------------
+# bucket partition (pure helper)
+# ---------------------------------------------------------------------------
+
+
+class TestBucketPartition:
+    def _params(self, shapes, dtype="float32", seed=0):
+        rng = np.random.RandomState(seed)
+        out = []
+        for s in shapes:
+            p = paddle.to_tensor(rng.randn(*s).astype(dtype))
+            p.grad = paddle.to_tensor(rng.randn(*s).astype(dtype))
+            out.append(p)
+        return out
+
+    def test_reverse_backward_order_and_cap(self, overlap_flags):
+        # 4 KiB cap: each (1024,) f32 grad is 4 KiB, so every bucket
+        # must close after one member — and the order must be the
+        # REVERSE parameter order (backward produces later grads first)
+        paddle.set_flags({"FLAGS_grad_bucket_mb": 1})
+        params = self._params([(1024,)] * 3)
+        big = self._params([(300, 1024)] * 3)  # ~1.2 MiB each, 25 MiB cap
+        paddle.set_flags({"FLAGS_grad_bucket_mb": 25})
+        buckets = par._bucket_grads(big)
+        assert len(buckets) == 1 and buckets[0] == list(reversed(big))
+        # cap 0 degenerates to one bucket per param
+        paddle.set_flags({"FLAGS_grad_bucket_mb": 0})
+        buckets = par._bucket_grads(params)
+        assert [len(b) for b in buckets] == [1, 1, 1]
+        assert [b[0] for b in buckets] == list(reversed(params))
+
+    def test_dtype_change_closes_bucket(self, overlap_flags):
+        paddle.set_flags({"FLAGS_grad_bucket_mb": 25})
+        p32 = self._params([(64,), (64,)], dtype="float32")
+        p16 = self._params([(64,)], dtype="float16")
+        buckets = par._bucket_grads(p32 + p16)  # reversed: f16 first
+        assert len(buckets) == 2
+        assert [len(b) for b in buckets] == [1, 2]
+        assert str(as_array(buckets[0][0].grad).dtype) == "float16"
+
+
+# ---------------------------------------------------------------------------
+# eager DataParallel: bucketed vs per-param bit-parity + fallback
+# ---------------------------------------------------------------------------
+
+
+class TestEagerBucketedSync:
+    def test_bucketed_matches_per_param_bitwise(self, overlap_flags):
+        mesh_mod.init_mesh(dp=2)
+        ref = _dp_net()
+        _set_grads(ref)
+        paddle.set_flags({"FLAGS_train_overlap": False})
+        ref.sync_gradients()
+
+        bucketed = _dp_net()
+        _set_grads(bucketed)
+        paddle.set_flags({"FLAGS_train_overlap": True,
+                          "FLAGS_grad_bucket_mb": 25})
+        bucketed.sync_gradients()
+        for a, b in zip(_grads(ref), _grads(bucketed)):
+            assert np.array_equal(a, b)  # bit-identical, not allclose
+
+        # one-param-per-bucket degenerate cap must also be bit-identical
+        tiny = _dp_net()
+        _set_grads(tiny)
+        paddle.set_flags({"FLAGS_grad_bucket_mb": 0})
+        tiny.sync_gradients()
+        for a, b in zip(_grads(ref), _grads(tiny)):
+            assert np.array_equal(a, b)
+
+    def test_bucketed_sync_coalesces_collectives(self, overlap_flags):
+        mesh_mod.init_mesh(dp=2)
+        model = _dp_net()
+        _set_grads(model)
+        n_params = len(list(model.parameters()))
+        assert n_params >= 4
+        paddle.set_flags({"FLAGS_train_overlap": True,
+                          "FLAGS_grad_bucket_mb": 25})
+        before = _counter("collective_calls_total", op="all_reduce")
+        model.sync_gradients()
+        calls = _counter("collective_calls_total", op="all_reduce") - before
+        assert 0 < calls < n_params  # coalesced: fewer reduces than params
+
+    def test_no_sync_window_skips_the_reduce(self, overlap_flags):
+        mesh_mod.init_mesh(dp=2)
+        model = _dp_net()
+        _set_grads(model)
+        paddle.set_flags({"FLAGS_train_overlap": True})
+        before = _counter("collective_calls_total", op="all_reduce")
+        with model.no_sync():
+            model.sync_gradients()
+        assert _counter("collective_calls_total",
+                        op="all_reduce") == before
+        model.sync_gradients()  # window closed: reduces again
+        assert _counter("collective_calls_total",
+                        op="all_reduce") > before
+
+    def test_membership_change_falls_back_permanently(self, overlap_flags):
+        from paddle_tpu.observability import flight_recorder as fr
+
+        mesh_mod.init_mesh(dp=2)
+        model = _dp_net()
+        _set_grads(model)
+        paddle.set_flags({"FLAGS_train_overlap": True})
+        model.sync_gradients()  # records the membership signature
+        assert not model._bucket_fallback
+
+        # a grad disappearing mid-run (unused-parameter branch) breaks
+        # the bucket-stability contract: permanent per-param fallback
+        # plus a flight-recorder breadcrumb — never silently skipped
+        params = list(model.parameters())
+        params[1].grad = None
+        fr.default_recorder().clear()
+        model.sync_gradients()
+        assert model._bucket_fallback
+        kinds = [k for _, k, _ in fr.default_recorder().tail()]
+        assert "grad_bucket.membership_changed" in kinds
+        # still downgraded even after the signature would match again
+        _set_grads(model)
+        model.sync_gradients()
+        assert model._bucket_fallback
+
+    def test_chaos_stall_fires_inside_bucketed_reduce(self, overlap_flags):
+        # PR 11 recovery contract: the chaos collective.stall site +
+        # watchdog must catch a stall INSIDE the coalesced reduce just
+        # like a per-param one (the injection sites live in all_reduce,
+        # which the bucket path still calls)
+        from paddle_tpu import faults
+        from paddle_tpu.distributed.collective import CollectiveTimeout
+
+        mesh_mod.init_mesh(dp=2)
+        model = _dp_net()
+        _set_grads(model)
+        prev = paddle.get_flags(["FLAGS_chaos", "FLAGS_chaos_seed",
+                                 "FLAGS_collective_timeout_s"])
+        paddle.set_flags({"FLAGS_chaos": "collective.stall@n=1:delay=30",
+                          "FLAGS_chaos_seed": 0,
+                          "FLAGS_collective_timeout_s": 0.2,
+                          "FLAGS_train_overlap": True})
+        faults.reset()
+        try:
+            before = _counter("collective_timeouts_total", op="all_reduce")
+            t0 = time.monotonic()
+            with pytest.raises(CollectiveTimeout):
+                model.sync_gradients()
+            assert time.monotonic() - t0 < 10  # not the 30 s stall
+            assert _counter("collective_timeouts_total",
+                            op="all_reduce") == before + 1
+        finally:
+            paddle.set_flags(prev)
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# jitted train_step: overlap-on vs overlap-off loss bit-parity
+# ---------------------------------------------------------------------------
+
+
+def _jit_losses(overlap, stage=2, merge=1, n_steps=4, dp=2):
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_train_step)
+
+    paddle.set_flags({"FLAGS_train_overlap": overlap})
+    paddle.seed(0)
+    mesh = mesh_mod.init_mesh(dp=dp)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, seq=8)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = build_train_step(model, opt, mesh=mesh, sharding_stage=stage,
+                            gradient_merge_steps=merge)
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randint(0, 64, (dp, 8)))
+    y = paddle.to_tensor(rng.randint(0, 64, (dp, 8)))
+    losses = [float(step(x, y)) for _ in range(n_steps)]
+    mesh_mod.set_mesh(None)
+    return losses
+
+
+class TestJitOverlapParity:
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_losses_bit_identical_on_off(self, overlap_flags, stage):
+        on = _jit_losses(True, stage=stage)
+        off = _jit_losses(False, stage=stage)
+        assert all(np.isfinite(on)) and on[-1] < on[0]
+        assert on == off  # float equality: BIT-identical, not allclose
+
+    def test_gradient_merge_window_bit_identical(self, overlap_flags):
+        # accumulation windows: the bucket tree must ride the merge
+        # path's accum layout without perturbing a single mantissa bit
+        on = _jit_losses(True, stage=2, merge=2, n_steps=4)
+        off = _jit_losses(False, stage=2, merge=2, n_steps=4)
+        assert on == off
+
+
+# ---------------------------------------------------------------------------
+# double-buffered input staging
+# ---------------------------------------------------------------------------
+
+
+class TestDevicePrefetcher:
+    def test_orders_and_stages_ahead(self, overlap_flags):
+        from paddle_tpu.io.dataloader import DevicePrefetcher
+
+        staged = []
+
+        def place(b):
+            staged.append(b)
+            return b * 10
+
+        pf = DevicePrefetcher(iter([1, 2, 3]), place, depth=2)
+        try:
+            assert list(pf) == [10, 20, 30]
+            assert staged == [1, 2, 3]
+        finally:
+            pf.close()
+
+    def test_depth_zero_is_passthrough(self, overlap_flags):
+        from paddle_tpu.io.dataloader import DevicePrefetcher
+
+        pf = DevicePrefetcher(iter([4, 5]), lambda b: b + 1, depth=0)
+        assert pf._q is None  # no thread, no queue
+        assert list(pf) == [5, 6]
+
+    def test_producer_error_propagates_in_order(self, overlap_flags):
+        from paddle_tpu.io.dataloader import DevicePrefetcher
+
+        def gen():
+            yield 1
+            raise ValueError("torn batch")
+
+        pf = DevicePrefetcher(gen(), lambda b: b, depth=2)
+        try:
+            assert next(pf) == 1
+            with pytest.raises(ValueError, match="torn batch"):
+                next(pf)
+        finally:
+            pf.close()
+
+    def test_close_joins_the_stager(self, overlap_flags):
+        from paddle_tpu.io.dataloader import DevicePrefetcher
+
+        pf = DevicePrefetcher(iter(range(100)), lambda b: b, depth=2)
+        next(pf)
+        pf.close()
+        assert not pf._thread.is_alive()
+
+    def test_prefetch_batches_prestages_with_step_sharding(
+            self, overlap_flags):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       build_train_step, prefetch_batches)
+
+        paddle.set_flags({"FLAGS_train_overlap": True,
+                          "FLAGS_prefetch_depth": 2})
+        paddle.seed(0)
+        mesh = mesh_mod.init_mesh(dp=2)
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               seq=8)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = build_train_step(model, opt, mesh=mesh, sharding_stage=1)
+        put = step._data_put  # survives _instrument_step
+        rng = np.random.RandomState(3)
+        batches = [(paddle.to_tensor(rng.randint(0, 64, (2, 8))),
+                    paddle.to_tensor(rng.randint(0, 64, (2, 8))))
+                   for _ in range(3)]
+        it = prefetch_batches(step, list(batches))
+        losses = []
+        for x, y in it:
+            # staged with the step's own dp sharding: the step-loop
+            # _data_put fast path must pass it through untouched
+            assert put(x._data) is x._data
+            losses.append(float(step(x, y)))
+        assert len(losses) == 3 and all(np.isfinite(losses))
+
+        # depth <= 0 returns the raw iterator (no thread)
+        paddle.set_flags({"FLAGS_prefetch_depth": 0})
+        raw = prefetch_batches(step, list(batches))
+        from paddle_tpu.io.dataloader import DevicePrefetcher
+
+        assert not isinstance(raw, DevicePrefetcher)

@@ -156,6 +156,43 @@ class TestBuckets:
         assert total <= a["wall"] + 1e-9
         assert a["buckets"]["collective"] <= a["wall"] + 1e-9
 
+    def test_overlap_efficiency_golden_reconciliation(self, ledger_on):
+        # golden overlap attribution (ISSUE 12): a 0.2s raw collective
+        # delta against a 0.1s dispatch window means 0.1s was EXPOSED
+        # (the bucket) and 0.1s was hidden behind compute — efficiency
+        # hidden/raw = 0.5, and the named buckets still reconcile to
+        # the exported wall
+        reg = om.Registry()
+        c = reg.counter("collective_wait_seconds_total", "synthetic",
+                        labels=("op",))
+        c.labels("all_reduce").inc(0.2)
+        t_disp = time.perf_counter()
+        t0 = t_disp - 0.1  # window = exactly 0.1s
+        sl.end((t0, 0.0, 0.0), "unit.overlap", t_disp, registry=reg)
+        a = sl.snapshot()["unit.overlap"]
+        assert a["buckets"]["collective"] == pytest.approx(0.1)
+        assert a["coll_raw"] == pytest.approx(0.2)
+        assert a["coll_hidden"] == pytest.approx(0.1)
+        assert reg.value("stepledger_overlap_efficiency",
+                         entry="unit.overlap") == pytest.approx(0.5)
+        total = sum(a["buckets"].values())
+        assert total <= a["wall"] + 1e-9
+
+    def test_overlap_efficiency_zero_when_fully_exposed(self, ledger_on):
+        # raw delta fits inside the dispatch window: nothing was
+        # hidden, the bucket carries the full delta, efficiency 0.0
+        reg = om.Registry()
+        c = reg.counter("collective_wait_seconds_total", "synthetic",
+                        labels=("op",))
+        c.labels("all_reduce").inc(0.05)
+        t_disp = time.perf_counter()
+        t0 = t_disp - 0.1
+        sl.end((t0, 0.0, 0.0), "unit.exposed", t_disp, registry=reg)
+        a = sl.snapshot()["unit.exposed"]
+        assert a["buckets"]["collective"] == pytest.approx(0.05)
+        assert reg.value("stepledger_overlap_efficiency",
+                         entry="unit.exposed") == 0.0
+
     def test_block_every_cadence_is_per_entry(self, ledger_on):
         # two strictly-alternating entries under block_every=2: a
         # PROCESS-global modulus would block one entry always and the

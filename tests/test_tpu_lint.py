@@ -40,6 +40,11 @@ def lint_fixture(name, **kw):
     # and recursion-as-retry around a decode dispatch; the bounded,
     # backoff-paced, and re-raising variants below them stay clean
     ("unbounded_retry_pos.py", "unbounded-retry", [10, 23]),
+    # sync transfers in step loops: device_put, block_until_ready,
+    # np.asarray inside *step*/*loop* functions; the suppressed,
+    # builder-closure, host-helper, and local-asarray twins stay clean
+    ("sync_transfer_pos.py", "sync-transfer-in-step-loop",
+     [11, 13, 14, 19]),
 ])
 def test_fixture_triggers_exactly_its_rule(fixture, rule, expect_lines):
     findings = lint_fixture(fixture)
@@ -52,7 +57,8 @@ def test_registry_ships_all_six_rules():
     assert set(RULES) >= {
         "jax-compat", "weak-float-in-kernel",
         "rank-divergent-collective", "side-effect-under-jit",
-        "donated-arg-reuse", "flag-hygiene", "unbounded-retry"}
+        "donated-arg-reuse", "flag-hygiene", "unbounded-retry",
+        "sync-transfer-in-step-loop"}
     for cls in RULES.values():
         assert cls.description
 

@@ -79,7 +79,8 @@ GEOMETRY_KEYS = ("batch", "seq", "hidden", "layers", "prompt_len",
 # row baseline the vanilla 357 tok/s capture, the exact mis-baselining
 # these keys exist to prevent
 KNOB_KEYS_ABSENT_IS_NONE = ("quant", "kv_quant", "spec_decode",
-                            "draft_layers")
+                            "draft_layers", "overlap", "grad_bucket_mb",
+                            "prefetch_depth")
 
 
 def _get(row, path):

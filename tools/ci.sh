@@ -110,9 +110,33 @@ elif ! timeout 120 env JAX_PLATFORMS=cpu \
 # under 25%, and the report names the top optimization targets
 elif ! timeout 120 env JAX_PLATFORMS=cpu \
     python tools/step_ledger.py /tmp/ci_metrics_traced.prom \
-      --max-residual 0.25; then
+      --max-residual 0.25 --max-data-wait-frac 0.05; then
   echo "CI: step_ledger on /tmp/ci_metrics_traced.prom FAILED (empty" \
-       "waterfall or residual bucket >= 25% of step wall time)" >&2
+       "waterfall, residual bucket >= 25% of step wall time, or" \
+       "data_wait >= 5% — input starvation)" >&2
+  rc=1
+fi
+
+# overlap-engine parity gate (ISSUE 12): the bucketed async grad reduce
+# + double-buffered input staging must be a pure scheduling change — a
+# 2-rank CPU mini-train (gradient-merge window included) with the
+# overlap engine ON must produce per-step losses BIT-IDENTICAL to the
+# same run with it OFF. The overlap-on run also records the step
+# ledger, and step_ledger.py then gates its train.step data_wait
+# bucket under 5% of wall — prefetch-on input staging must keep the
+# step loop fed, not just exist.
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/overlap_parity.py \
+      --ledger-out /tmp/ci_overlap_ledger.prom; then
+  echo "CI: overlap parity FAILED (overlap-on losses diverged from" \
+       "overlap-off — the bucketed reduce or staging path changed the" \
+       "numerics, see the per-step table above)" >&2
+  rc=1
+elif ! timeout 120 env JAX_PLATFORMS=cpu \
+    python tools/step_ledger.py /tmp/ci_overlap_ledger.prom \
+      --max-data-wait-frac 0.05; then
+  echo "CI: overlap data-wait gate FAILED (train.step starves >= 5%" \
+       "of wall on input with prefetch on)" >&2
   rc=1
 fi
 
@@ -219,7 +243,8 @@ if [ $rc -ne 0 ]; then
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
-       "/tmp/ci_chaos/, /tmp/ci_bench_smoke.json (ledger waterfall:" \
+       "/tmp/ci_chaos/, /tmp/ci_bench_smoke.json," \
+       "/tmp/ci_overlap_ledger.prom (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi
 exit $rc

@@ -1,0 +1,116 @@
+"""Overlap-engine parity gate (tools/ci.sh, ISSUE 12): the bucketed
+async grad reduce + double-buffered input staging must be a pure
+SCHEDULING change — a 2-rank CPU mini-train with FLAGS_train_overlap on
+(bucketed reduce, prefetch staging) must produce per-step losses
+BIT-IDENTICAL (exact float equality, not allclose) to the same run with
+the overlap engine off (per-param reduce, raw iterator). Any mantissa
+drift means the bucket concat/scatter or the staging path changed the
+numerics, which would silently invalidate every loss-parity guarantee
+the fault-tolerance plane (PR 11) relies on.
+
+    python tools/overlap_parity.py            # exit 0 = bit-identical
+    python tools/overlap_parity.py --steps 6
+
+Exit codes: 0 = parity holds, 1 = losses diverged (the report names the
+first diverging step and both values in full repr precision).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=2")
+
+
+def _run(overlap: bool, steps: int, merge: int, ledger: bool = False):
+    """Per-step losses of a seeded tiny-Llama train on a dp=2 mesh."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.mesh as mesh_mod
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_train_step, prefetch_batches)
+
+    paddle.set_flags({"FLAGS_train_overlap": overlap,
+                      "FLAGS_grad_bucket_mb": 25,
+                      "FLAGS_prefetch_depth": 2 if overlap else 0,
+                      "FLAGS_stepledger": ledger,
+                      "FLAGS_stepledger_block_every": 1})
+    paddle.seed(0)
+    mesh = mesh_mod.init_mesh(dp=2)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, seq=8)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = build_train_step(model, opt, mesh=mesh, sharding_stage=2,
+                            gradient_merge_steps=merge)
+    rng = np.random.RandomState(3)
+    batches = [(paddle.to_tensor(rng.randint(0, 64, (2, 8))),
+                paddle.to_tensor(rng.randint(0, 64, (2, 8))))
+               for _ in range(steps)]
+    it = prefetch_batches(step, batches) if overlap else iter(batches)
+    losses = [float(step(x, y)) for x, y in it]
+    mesh_mod.set_mesh(None)
+    return losses
+
+
+def run_parity(steps: int = 4, merge: int = 2,
+               ledger_out: str | None = None) -> dict:
+    """Both runs + the verdict; importable for tests and the CI gate.
+
+    merge=2 by default so the accumulation window (the hardest case for
+    bucket-tree layout bugs) is always inside the parity contract.
+    With `ledger_out`, the overlap-ON run records the step ledger and
+    its exposition lands there — tools/step_ledger.py then gates its
+    `train.step` data_wait fraction (the prefetch-keeps-up proof).
+    """
+    on = _run(True, steps, merge, ledger=ledger_out is not None)
+    if ledger_out is not None:
+        from paddle_tpu.observability import metrics as om
+
+        with open(ledger_out, "w", encoding="utf-8") as f:
+            f.write(om.to_prometheus())
+    off = _run(False, steps, merge)
+    return {"steps": steps, "gradient_merge_steps": merge,
+            "losses_overlap_on": on, "losses_overlap_off": off,
+            "identical": on == off}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--gradient-merge-steps", type=int, default=2)
+    ap.add_argument("--ledger-out", default=None, metavar="PROM",
+                    help="record the step ledger on the overlap-ON run "
+                         "and write its Prometheus exposition here "
+                         "(for the step_ledger --max-data-wait-frac "
+                         "CI gate)")
+    args = ap.parse_args(argv)
+
+    r = run_parity(steps=args.steps, merge=args.gradient_merge_steps,
+                   ledger_out=args.ledger_out)
+    on, off = r["losses_overlap_on"], r["losses_overlap_off"]
+    for i, (a, b) in enumerate(zip(on, off)):
+        tag = "==" if a == b else "!="
+        print(f"step {i}: overlap-on {a!r} {tag} overlap-off {b!r}")
+    if not r["identical"]:
+        first = next(i for i, (a, b) in enumerate(zip(on, off))
+                     if a != b)
+        print(f"overlap_parity: FAILED — losses diverge at step "
+              f"{first}: {on[first]!r} (on) vs {off[first]!r} (off); "
+              f"the overlap engine changed the numerics, not just the "
+              f"schedule", file=sys.stderr)
+        return 1
+    print(f"overlap_parity: OK — {r['steps']} steps bit-identical "
+          f"(gradient_merge_steps={r['gradient_merge_steps']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,12 @@ def main(argv=None) -> int:
                     help="exit 1 when any entry's residual bucket "
                          "exceeds this fraction of its wall time "
                          "(CI gate: 0.25)")
+    ap.add_argument("--max-data-wait-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="exit 1 when any entry's data_wait bucket "
+                         "exceeds this fraction of its wall time — the "
+                         "input-starvation gate for prefetch-on runs "
+                         "(CI gate: 0.05)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.observability import stepledger
@@ -114,6 +120,19 @@ def main(argv=None) -> int:
                   f"FLAGS_compilewatch/FLAGS_telemetry_dir or lower "
                   f"FLAGS_stepledger_block_every to name it",
                   file=sys.stderr)
+            return 1
+    if args.max_data_wait_frac is not None:
+        worst = max(rows,
+                    key=lambda r: r["buckets"]["data_wait"]["frac"])
+        frac = worst["buckets"]["data_wait"]["frac"]
+        if frac > args.max_data_wait_frac:
+            print(f"step_ledger: data-wait gate FAILED — "
+                  f"{worst['entry']} starves "
+                  f"{frac * 100.0:.1f}% of step wall time on input "
+                  f"(> {args.max_data_wait_frac * 100.0:.0f}%); is "
+                  f"FLAGS_prefetch_depth > 0 and the staging thread "
+                  f"keeping up? (raise FLAGS_prefetch_depth or speed "
+                  f"up the host loader)", file=sys.stderr)
             return 1
     return 0
 

@@ -345,6 +345,36 @@ def main():
         print(f"rms_norm FAILED: {e}", file=sys.stderr)
     _dump(args.json, backend, rows, extra)
 
+    try:
+        from paddle_tpu.kernels import matmul as mm
+
+        # MLP-shaped matmul (ISSUE 12): tokens x hidden @ hidden x ffn —
+        # the largest compute bucket of the train step per the stepledger
+        # waterfall. Time the default fused blocks against the XLA
+        # lowering; the autotune section below races the full block grid.
+        m_mm, k_mm, n_mm = 4096, 4096, 16384
+        key = jax.random.PRNGKey(3)
+        kx, kw2 = jax.random.split(key)
+        xm = jax.random.normal(kx, (m_mm, k_mm), jnp.bfloat16)
+        wm = jax.random.normal(kw2, (k_mm, n_mm), jnp.bfloat16) * 0.02
+        f_pal = jax.jit(functools.partial(mm.matmul_fused,
+                                          block_n=256, block_k=256))
+        f_xla = jax.jit(mm.matmul_xla)
+        o_p = np.asarray(f_pal(xm, wm), np.float32)
+        o_x = np.asarray(f_xla(xm, wm), np.float32)
+        mm_err = float(np.max(np.abs(o_p - o_x)))
+        t_p = timeit(f_pal, xm, wm)
+        t_x = timeit(f_xla, xm, wm)
+        extra["matmul"] = dict(err_vs_xla=mm_err, t_pallas_ms=t_p * 1e3,
+                               t_xla_ms=t_x * 1e3,
+                               shape=[m_mm, k_mm, n_mm])
+        print(f"matmul: err={mm_err:.5f} pallas {t_p*1e3:.3f}ms "
+              f"xla {t_x*1e3:.3f}ms ({t_x/t_p:.2f}x)")
+    except Exception as e:  # noqa: BLE001
+        extra["matmul"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"matmul FAILED: {e}", file=sys.stderr)
+    _dump(args.json, backend, rows, extra)
+
     # --- autotune candidate table (ISSUE 2): time EVERY registered
     # candidate — XLA, flash fwd across the block grid, and both backward
     # strategies (fused pair + split dq/dkv at per-pass tuned blocks) —
@@ -405,6 +435,11 @@ def main():
                                    False)
             at.choose_paged_decode(8, 8, 8, 128, 128, 8, "bfloat16",
                                    False)
+            # MLP matmul family (ISSUE 12): both halves of the FFN at a
+            # training token count, plus a decode-sized m
+            at.choose_matmul(4096, 4096, 16384, "bfloat16")
+            at.choose_matmul(4096, 16384, 4096, "bfloat16")
+            at.choose_matmul(64, 4096, 16384, "bfloat16")
         except Exception as e:  # noqa: BLE001
             extra["autotune"]["entries"]["extra_ops_error"] = \
                 f"{type(e).__name__}: {e}"[:300]
