@@ -522,7 +522,14 @@ def bench_resnet(paddle, jax, on_tpu, n_dev):
 
 def bench_serving(paddle, jax, on_tpu, n_dev):
     """BASELINE config 5: continuous-batching decode throughput over the
-    paged KV cache (FusedMultiTransformer serving parity)."""
+    paged KV cache (FusedMultiTransformer serving parity).
+
+    BENCH_SERVING_REPLICAS=N (N>=2, CPU only) measures the multi-
+    replica ROUTER instead: N engine subprocesses fronted by
+    inference.Router — the horizontal-scaling row the disaggregated
+    serving plane banks (`replicas`/`router_policy` are comparability
+    keys in bench_compare, so this row never baselines a single-engine
+    run)."""
     import os
 
     import numpy as np
@@ -530,6 +537,9 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     from paddle_tpu.inference import ServingEngine
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+    replicas_n = int(os.environ.get("BENCH_SERVING_REPLICAS", "1"))
+    if replicas_n > 1 and not on_tpu:
+        return _bench_serving_router(jax, n_dev, replicas_n)
     size = os.environ.get("BENCH_SERVING_MODEL", "base")
     if on_tpu and size == "3b":
         # 2.2B-param proxy for the row-5 LLaMA-2-7B intent: bf16 weights
@@ -628,7 +638,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
                   "devices": n_dev, "backend": jax.default_backend(),
                   "hidden": cfg.hidden_size,
                   "layers": cfg.num_hidden_layers,
-                  "params_b": params_b}}
+                  "params_b": params_b,
+                  "replicas": 1, "router_policy": None}}
     result["extra"].update(_observability_columns())
     # serving rows additionally carry the steady-state check the CI
     # smoke gates on: decode recompiles after engine.warmup() must be 0
@@ -653,6 +664,96 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
         result["tpu_probe_error"] = PROBE_DIAG
         _attach_cached_evidence(result)
     return result
+
+
+def _bench_serving_router(jax, n_dev, replicas_n):
+    """The multi-replica router row: N CPU engine subprocesses at the
+    router-smoke geometry (tiny llama, batch 4, single-step decode),
+    discovered from fleet heartbeats and fronted by the Router. The
+    row measures BOTH arms in one invocation — the routed-1 baseline
+    and the routed-N aggregate — so `scaling_x` in extra is an
+    apples-to-apples fan-out factor at identical geometry, knobs, and
+    transport (N processes, N GILs; an in-process thread pool would
+    measure the GIL, not the plane). On a single-core CI box the
+    single-step-decode regime is the one where fan-out pays: serving
+    there is host-dispatch-bound (per-token sync + page growth), and
+    those host phases overlap across processes; batched-burst engines
+    saturate the core alone and pin scaling at ~1x until more cores
+    exist."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.inference import Router, auto_replicas
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+
+    prompt_len, new_tokens, max_batch = 8, 24, 4
+    vocab, hidden, layers = 97, 32, 2
+    root = tempfile.mkdtemp(prefix="bench_router_")
+    procs = []
+
+    def _measure(replicas, n_req, rng):
+        router = Router(replicas, workers=16).start()
+        try:
+            def _run(n):
+                t0 = time.perf_counter()
+                tickets = [router.submit(
+                    rng.randint(0, vocab, (prompt_len,)),
+                    max_new_tokens=new_tokens) for _ in range(n)]
+                outs = [t.result(timeout=120.0) for t in tickets]
+                dt = time.perf_counter() - t0
+                bad = [o for o in outs if not o.get("ok")]
+                assert not bad, f"routed request failed: {bad[0]}"
+                return sum(len(o.get("output_ids") or ())
+                           for o in outs) / dt
+            _run(8)            # warm the routed path end to end
+            return max(_run(n_req) for _ in range(2)), \
+                router.policy.name
+        finally:
+            router.close()
+
+    try:
+        procs = spawn_replicas(
+            replicas_n, root,
+            worker_args=["--vocab", str(vocab),
+                         "--hidden", str(hidden),
+                         "--layers", str(layers), "--heads", "4",
+                         "--max-batch", str(max_batch),
+                         "--max-seq-len", "64", "--page-size", "8",
+                         "--prompt-len", str(prompt_len)])
+        replicas = auto_replicas(root)
+        assert len(replicas) == replicas_n, \
+            f"discovered {len(replicas)}/{replicas_n} replicas"
+        rng = np.random.RandomState(0)
+        n_req = 24
+        single_tps, _ = _measure(replicas[:1], n_req, rng)
+        agg_tps, policy = _measure(replicas, n_req, rng)
+        result = {
+            "metric": "serving_decode_tokens_per_sec",
+            "value": round(agg_tps, 2),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "extra": {"requests": n_req, "batch": max_batch,
+                      "prompt_len": prompt_len,
+                      "new_tokens": new_tokens,
+                      "decode_burst": 1,
+                      "hidden": hidden, "layers": layers,
+                      "devices": n_dev,
+                      "backend": jax.default_backend(),
+                      "replicas": replicas_n,
+                      "router_policy": policy,
+                      "routed_single_tps": round(single_tps, 2),
+                      "scaling_x": round(agg_tps / single_tps, 2)}}
+        result["extra"].update(_observability_columns())
+        result["tpu_probe_error"] = PROBE_DIAG
+        _attach_cached_evidence(result)
+        return result
+    finally:
+        for p in procs:
+            p.stop()
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _piggyback_extra_configs():
@@ -705,7 +806,15 @@ def _piggyback_extra_configs():
             ("serving_int4_spec",
              {"BENCH_CONFIG": "serving",
               "BENCH_SERVING_QUANT": "weight_only_int4",
-              "BENCH_SERVING_SPEC": "4"})]
+              "BENCH_SERVING_SPEC": "4"}),
+            # the multi-replica router row (ISSUE 13): 2 engine
+            # subprocesses fronted by the Router at the single-engine
+            # smoke geometry — banks the horizontal-scaling arm next
+            # to the vertical decode rows above (CPU-only: the row
+            # measures process fan-out, not the chip)
+            ("serving_router2",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_REPLICAS": "2"})]
     for name, env_over in jobs:
         remaining = deadline - _time.monotonic()
         if remaining <= 10:

@@ -236,6 +236,15 @@ define_flag("FLAGS_slo_ttft_p95_ms", 1000.0,
             "token within this budget (evaluated from the "
             "serving_ttft_seconds histogram; thresholds snap to the "
             "shared latency bucket ladder).", type_=float)
+define_flag("FLAGS_slo_router_ttft_p95_ms", 1500.0,
+            "Routed-TTFT SLO threshold in milliseconds for the "
+            "multi-replica router (inference/router.py): the "
+            "router_ttft_p95 objective requires 95% of routed "
+            "requests to see their first token within this budget, "
+            "measured submit -> first committed token across router "
+            "queue + route + replica prefill (the router_ttft_seconds "
+            "histogram; evaluated by the router's own SloEngine, not "
+            "default_objectives()).", type_=float)
 define_flag("FLAGS_slo_decode_p50_ms", 250.0,
             "Per-token decode SLO threshold in milliseconds: the "
             "decode_p50 objective requires 50% of decode steps to "
@@ -366,6 +375,36 @@ define_flag("FLAGS_prefetch_depth", 2,
             "data_wait bucket trends to zero. <= 0 disables "
             "prefetching (the iterator is passed through unchanged).",
             type_=int)
+define_flag("FLAGS_scheduler_policy", "fifo",
+            "SchedulerPolicy the serving engine resolves at "
+            "construction (inference/scheduler.py registry): 'fifo' "
+            "(default — head-of-line admission, youngest-victim "
+            "recompute preemption, pow2/page-multiple prefill buckets, "
+            "{1, decode_burst} burst sizing; bit-identical to the "
+            "pre-extraction engine) or 'slo' (TTFT-burn-aware: sheds "
+            "head-of-line blocking for shortest-prompt-first while the "
+            "fast TTFT burn alert fires, and preempts the slot with "
+            "the most remaining budget instead of the youngest). An "
+            "explicit scheduler= argument to ServingEngine wins over "
+            "the flag.")
+define_flag("FLAGS_router_policy", "least_loaded",
+            "Replica-choice policy of the serving router "
+            "(inference/router.py): 'least_loaded' (default — lowest "
+            "serving_load_score among ready replicas, the contract "
+            "documented on SloEngine.load_score) or 'round_robin'. "
+            "Replicas failing /readyz (mid-recovery, poisoned, KV "
+            "exhausted) drain automatically under either policy.")
+define_flag("FLAGS_router_admission", True,
+            "Router admission control: when every ready replica's "
+            "fast TTFT burn alert is firing (or no replica is ready), "
+            "new requests are shed with 429 instead of queued — "
+            "protecting in-flight SLOs instead of building an "
+            "unbounded queue. Off: the router always enqueues.")
+define_flag("FLAGS_router_queue_depth", 256,
+            "Hard cap on the router's own queue (per router, across "
+            "replicas): past it requests shed with 429 regardless of "
+            "burn state — bounds memory and tail latency under "
+            "overload.", type_=int)
 
 
 # ---------------------------------------------------------------------------

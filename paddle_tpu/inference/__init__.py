@@ -15,7 +15,13 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..tensor import Tensor
-from .serving import ServingEngine  # noqa: F401
+from .replica import ReplicaServer  # noqa: F401
+from .router import (DisaggregatedServing, HttpReplica,  # noqa: F401
+                     LocalReplica, Router, RouterShed, auto_replicas)
+from .scheduler import (FifoSchedulerPolicy,  # noqa: F401
+                        SchedulerPolicy, SloAwareSchedulerPolicy,
+                        resolve_policy)
+from .serving import KVHandoff, ServingEngine  # noqa: F401
 
 
 class Config:

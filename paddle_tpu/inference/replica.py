@@ -1,0 +1,192 @@
+"""One serving replica: an engine loop thread + an HTTP submit bridge.
+
+``ServingEngine`` is single-threaded by design — the host scheduler
+mutates slot/page state between compiled calls. A *replica* wraps one
+engine with the two things the multi-replica router
+(``inference/router.py``) needs:
+
+- a drive loop on a daemon thread (``step()`` whenever there is work),
+  so the replica makes progress without a caller; submissions are
+  serialized against the loop with one lock, never mid-step;
+- ``POST /v1/generate`` mounted on this process's telemetry httpd
+  (observability/httpd.py ``register_route``) — a long-poll JSON
+  bridge, so a replica is reachable over the same port that already
+  serves ``/readyz`` and ``/metrics``. One port per replica is the
+  whole deployment contract.
+
+The bridge rides the existing observability plane on purpose: the
+router routes on ``/readyz`` + ``serving_load_score`` (PR 8/11
+contracts), and a replica that is draining for recovery answers 503
+there while its in-flight work finishes — no new protocol.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time as _time_mod
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..observability import flight_recorder as _flight
+from ..observability import httpd as _httpd
+
+GENERATE_ROUTE = "/v1/generate"
+
+
+class ReplicaServer:
+    """Drive one ServingEngine and expose it for routing.
+
+    server = ReplicaServer(engine).start()
+    rid = server.submit(prompt_ids, max_new_tokens=16)
+    out = server.wait(rid, timeout=30)   # {"output_ids": [...], ...}
+
+    The loop thread owns the engine; ``submit``/``wait`` are
+    thread-safe (the router's worker threads call them concurrently).
+    """
+
+    def __init__(self, engine, poll_s: float = 0.002,
+                 route: str = GENERATE_ROUTE):
+        self.engine = engine
+        self.poll_s = float(poll_s)
+        self.route = route
+        self._lock = threading.RLock()   # engine access: loop vs submit
+        self._cv = threading.Condition(threading.Lock())
+        self._results: Dict[int, dict] = {}
+        self._ttft: Dict[int, float] = {}   # rid -> perf_counter at
+        self._t_sub: Dict[int, float] = {}  # first token / at submit
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fatal: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        if self._thread is None:
+            _httpd.register_route(self.route, self._handle_generate)
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-replica", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        _httpd.unregister_route(self.route)
+
+    # -- submission ---------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               **params) -> int:
+        """Thread-safe add_request. The on_token hook is borrowed to
+        timestamp the replica-side first token (TTFT the router folds
+        into its routed-TTFT histogram)."""
+        if self._fatal:
+            raise RuntimeError(f"replica is down: {self._fatal}")
+        t_sub = _time_mod.perf_counter()
+        box = {}
+
+        def _first_token(rid, _tok, _box=box):
+            if "t" not in _box:
+                _box["t"] = _time_mod.perf_counter()
+
+        with self._lock:
+            rid = self.engine.add_request(
+                np.asarray(prompt_ids, np.int64),
+                max_new_tokens=int(max_new_tokens),
+                on_token=_first_token, **params)
+        with self._cv:
+            self._t_sub[rid] = t_sub
+            self._ttft[rid] = box  # resolved lazily at finish
+        return rid
+
+    def wait(self, rid: int, timeout: float = 60.0) -> Optional[dict]:
+        """Block until the request finishes; None on timeout. A replica
+        that went fatally down resolves every waiter with an error
+        payload instead of hanging them."""
+        deadline = _time_mod.monotonic() + timeout
+        with self._cv:
+            while rid not in self._results:
+                left = deadline - _time_mod.monotonic()
+                if left <= 0:
+                    return None
+                self._cv.wait(timeout=min(left, 0.5))
+            return self._results.pop(rid)
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 timeout: float = 60.0, **params) -> dict:
+        return self.wait(self.submit(prompt_ids, max_new_tokens,
+                                     **params), timeout=timeout) or {
+            "error": "timeout", "ok": False}
+
+    # -- the drive loop -----------------------------------------------
+    def _loop(self):
+        eng = self.engine
+        while not self._stop.is_set():
+            finished = []
+            try:
+                with self._lock:
+                    if eng.has_work():
+                        finished = eng.step()
+            except Exception as e:  # noqa: BLE001 — poisoned past the
+                # recovery budget (or a driver bug): resolve every
+                # waiter with the error; the router retries elsewhere
+                self._fatal = repr(e)
+                _flight.record_event("replica.fatal", error=self._fatal)
+                with self._cv:
+                    for rid in list(self._t_sub):
+                        self._resolve_locked(rid, {
+                            "ok": False, "error": self._fatal})
+                    self._cv.notify_all()
+                return
+            if finished:
+                with self._cv:
+                    for f in finished:
+                        self._resolve_locked(f.request_id, {
+                            "ok": True,
+                            "request_id": int(f.request_id),
+                            "output_ids":  # once per FINISHED request
+                                np.asarray(f.output_ids).tolist(),  # tpu-lint: disable=sync-transfer-in-step-loop
+                        })
+                    self._cv.notify_all()
+            else:
+                self._stop.wait(self.poll_s)
+
+    def _resolve_locked(self, rid, payload):
+        # caller holds self._cv
+        box = self._ttft.pop(rid, None) or {}
+        t_sub = self._t_sub.pop(rid, None)
+        if payload.get("ok") and t_sub is not None and "t" in box:
+            payload["ttft_s"] = max(0.0, box["t"] - t_sub)
+        self._results[rid] = payload
+
+    # -- the HTTP bridge ----------------------------------------------
+    def _handle_generate(self, method, query, body):
+        if method != "POST":
+            return (405, b"POST only\n", "text/plain; charset=utf-8")
+        try:
+            req = json.loads(body.decode() or "{}")
+            prompt = req["prompt_ids"]
+        except (ValueError, KeyError) as e:
+            return (400, (json.dumps({"ok": False,
+                                      "error": f"bad request: {e!r}"})
+                          + "\n").encode(), "application/json")
+        params = {k: req[k] for k in ("decode_strategy", "temperature",
+                                      "top_k", "top_p", "eos_token_id")
+                  if k in req}
+        timeout = float(req.get("timeout_s", 60.0))
+        try:
+            rid = self.submit(prompt,
+                              max_new_tokens=req.get("max_new_tokens",
+                                                     32),
+                              **params)
+        except (RuntimeError, ValueError) as e:
+            return (503, (json.dumps({"ok": False, "error": repr(e)})
+                          + "\n").encode(), "application/json")
+        out = self.wait(rid, timeout=timeout)
+        if out is None:
+            return (504, (json.dumps({"ok": False, "error": "timeout"})
+                          + "\n").encode(), "application/json")
+        code = 200 if out.get("ok") else 500
+        return (code, (json.dumps(out) + "\n").encode(),
+                "application/json")

@@ -1,0 +1,650 @@
+"""Multi-replica serving router: SLO-aware front door over N engines.
+
+One ``ServingEngine`` scales by getting faster; "millions of users"
+scales horizontally. The router owns the request queue and fans
+across N replicas, reusing the planes built for exactly this:
+
+- **readiness** — a replica is routable iff its ``/readyz`` contract
+  holds (warmed, not poisoned, not mid-recovery, KV pages free). A
+  replica that entered self-healing recovery (PR 11) drains
+  automatically: the router simply stops picking it until the rebuilt
+  engine re-admits.
+- **load** — replicas are ranked by ``serving_load_score`` (busy
+  slots + queue pressure + KV occupancy; observability/slo.py
+  documents this as the router's signal). ``least_loaded`` is the
+  default policy; ``round_robin`` exists for A/B baselines.
+- **admission** — when every ready replica's TTFT burn-rate alert is
+  firing, accepting more traffic only deepens the burn: the router
+  sheds (HTTP 429 semantics, ``RouterShed``) instead of queueing.
+  The router's own ``router_ttft_seconds`` histogram feeds a routed
+  TTFT objective (slo.router_objectives) evaluated by the router's
+  private SloEngine.
+- **spans** — every hop is traced: ``router.queue`` (submit ->
+  dispatch) and ``router.route`` (dispatch -> result, tagged with the
+  chosen replica) on the router's track; the replica's own
+  ``serving.queue``/``serving.prefill``/``serving.decode`` spans
+  complete the queue→route→prefill→decode picture in trace_report.
+
+Replica transports: ``LocalReplica`` wraps an in-process
+``ReplicaServer`` (deterministic tests, disaggregated pools);
+``HttpReplica`` talks to another process's telemetry port
+(``POST /v1/generate`` + ``GET /statusz``) — the deployment shape,
+and the one the throughput gates measure (N processes, N GILs).
+Discovery: ``auto_replicas()`` resolves live endpoints from fleet
+heartbeat ``endpoint`` fields — the same path ``fleet_report
+--scrape auto`` uses, so hand-listing ports is never required.
+
+Experimental disaggregation: ``DisaggregatedServing`` routes prefill
+to a prefill-pool engine and hands the paged KV to a decode-pool
+engine between steps (``ServingEngine.detach_request`` /
+``attach_request`` — the page-table handoff).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time as _time_mod
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework import config as _cfg
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _om
+from ..observability import slo as _slo
+from ..observability import tracing as _trace
+
+
+class RouterShed(Exception):
+    """Admission control rejected the request (HTTP 429 semantics):
+    either the router queue is at FLAGS_router_queue_depth, or
+    FLAGS_router_admission is on and every ready replica's TTFT burn
+    alert is firing."""
+
+    status = 429
+
+
+class _RouterMetrics:
+    """Handles resolved once against the default registry (the
+    serving-engine pattern: the hot path touches plain cells)."""
+
+    __slots__ = ("requests", "queue_depth", "ttft", "latency",
+                 "dispatches", "sheds")
+
+    def __init__(self, reg=None):
+        reg = reg or _om.default_registry()
+        self.requests = reg.counter(
+            "router_requests_total",
+            "Requests through the serving router by outcome: ok, "
+            "shed (admission control), failed (retries exhausted), "
+            "retried (re-dispatched after a replica error/timeout).",
+            labels=("outcome",))
+        self.queue_depth = reg.gauge(
+            "router_queue_depth",
+            "Requests waiting in the router queue (not yet dispatched "
+            "to a replica).")
+        self.ttft = reg.histogram(
+            "router_ttft_seconds",
+            "Routed TTFT: submit -> first committed token, including "
+            "router queue wait, route choice, and the replica's own "
+            "queue + prefill (feeds the router_ttft_p95 objective).")
+        self.latency = reg.histogram(
+            "router_request_seconds",
+            "Full routed request latency: submit -> final token "
+            "returned.")
+        self.dispatches = reg.counter(
+            "router_dispatches_total",
+            "Dispatches per replica (retries count again).",
+            labels=("replica",))
+        self.sheds = reg.counter(
+            "router_sheds_total",
+            "Requests shed by admission control, by reason "
+            "(queue_full | ttft_burning).", labels=("reason",))
+
+
+# ---------------------------------------------------------------------------
+# replica transports
+# ---------------------------------------------------------------------------
+
+
+class BaseReplica:
+    """Transport-agnostic replica handle: cached stats + generate."""
+
+    name = "replica"
+    stats_ttl_s = 0.25
+
+    def __init__(self):
+        self._cache = (-1e18, {"ready": False, "load": float("inf"),
+                               "ttft_burning": False})
+
+    def stats(self) -> dict:
+        """{"ready", "load", "ttft_burning"} — TTL-cached so a routing
+        decision costs a dict read, not an HTTP round trip."""
+        now = _time_mod.monotonic()
+        t, cached = self._cache
+        if now - t < self.stats_ttl_s:
+            return cached
+        try:
+            fresh = self._probe()
+        except Exception:  # noqa: BLE001 — an unreachable replica is
+            # "not ready", never a router crash
+            fresh = {"ready": False, "load": float("inf"),
+                     "ttft_burning": False}
+        self._cache = (now, fresh)
+        return fresh
+
+    def invalidate(self):
+        self._cache = (-1e18, self._cache[1])
+
+    def _probe(self) -> dict:
+        raise NotImplementedError
+
+    def generate(self, request: dict, timeout: float) -> dict:
+        raise NotImplementedError
+
+
+class LocalReplica(BaseReplica):
+    """In-process replica over a ReplicaServer — deterministic unit
+    tests and the disaggregated pools. Burn state is process-wide
+    (all local replicas share one metrics registry), so TTFT-burn
+    admission treats them as one blast radius — the per-replica
+    distinction only exists across processes (HttpReplica)."""
+
+    def __init__(self, server, name: Optional[str] = None):
+        super().__init__()
+        self.server = server
+        self.name = name or f"local:{id(server) & 0xffff:x}"
+
+    def _probe(self) -> dict:
+        e = self.server.engine
+        ready = (bool(getattr(e, "_warmup_done", False))
+                 and not getattr(e, "_poisoned", None)
+                 and not getattr(e, "_recovering", False)
+                 and len(e._free_pages) > 0
+                 and not self.server._fatal)
+        return {"ready": ready,
+                "load": _slo.load_score(engines=[e]),
+                "ttft_burning": any(n.startswith("ttft")
+                                    for n in _slo.firing())}
+
+    def generate(self, request: dict, timeout: float) -> dict:
+        params = {k: request[k] for k in
+                  ("decode_strategy", "temperature", "top_k", "top_p",
+                   "eos_token_id") if k in request}
+        rid = self.server.submit(
+            request["prompt_ids"],
+            max_new_tokens=request.get("max_new_tokens", 32), **params)
+        out = self.server.wait(rid, timeout=timeout)
+        if out is None:
+            raise TimeoutError(f"{self.name}: request {rid} timed out")
+        return out
+
+
+class HttpReplica(BaseReplica):
+    """A replica in another process, reached over its telemetry port:
+    stats from GET /statusz (ready verdict + load_score + firing SLO
+    alerts in one request), generation via POST /v1/generate."""
+
+    def __init__(self, endpoint: str, name: Optional[str] = None,
+                 probe_timeout: float = 2.0):
+        super().__init__()
+        from ..observability import fleet as _fleet
+
+        self._fleet = _fleet
+        self.base = _fleet.normalize_endpoint(endpoint)
+        self.name = name or endpoint
+        self.probe_timeout = probe_timeout
+
+    def _probe(self) -> dict:
+        code, body = self._fleet._http_get(
+            self.base + "/statusz", timeout=self.probe_timeout)
+        js = json.loads(body.decode("utf-8", "replace"))
+        ready = (js.get("ready") or {}).get("code") == 200
+        try:
+            load = float(js.get("load_score") or 0.0)
+        except (TypeError, ValueError):
+            load = 0.0
+        firing = (js.get("slo") or {}).get("firing") or []
+        return {"ready": ready and code == 200, "load": load,
+                "ttft_burning": any(str(n).startswith("ttft")
+                                    for n in firing)}
+
+    def generate(self, request: dict, timeout: float) -> dict:
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        payload = dict(request)
+        payload["timeout_s"] = timeout
+        data = json.dumps(payload).encode()
+        req = Request(self.base + "/v1/generate", data=data,
+                      headers={"Content-Type": "application/json"},
+                      method="POST")
+        try:
+            # the socket deadline outlives the server-side long-poll
+            with urlopen(req, timeout=timeout + 5.0) as r:
+                out = json.loads(r.read().decode("utf-8", "replace"))
+        except HTTPError as e:
+            body = e.read().decode("utf-8", "replace")
+            raise RuntimeError(
+                f"{self.name}: /v1/generate -> {e.code}: "
+                f"{body[:200]}") from e
+        if not out.get("ok"):
+            raise RuntimeError(
+                f"{self.name}: replica error: {out.get('error')}")
+        return out
+
+
+def auto_replicas(root: str) -> List[HttpReplica]:
+    """`--replicas auto`: resolve live replicas from the fleet
+    heartbeat `endpoint` fields under `root` (the exact path
+    `fleet_report --scrape auto` walks) — hand-listing ports is never
+    required when the replicas export fleet telemetry."""
+    from ..observability import fleet as _fleet
+
+    return [HttpReplica(ep)
+            for ep in _fleet.endpoints_from_heartbeats(root)]
+
+
+# ---------------------------------------------------------------------------
+# routing policies
+# ---------------------------------------------------------------------------
+
+
+class RouterPolicy:
+    name = "base"
+
+    def choose(self, ready: List[BaseReplica],
+               stats: Dict[str, dict]) -> BaseReplica:
+        """Pick from `ready` (never empty); `stats[name]` holds each
+        candidate's probe snapshot."""
+        raise NotImplementedError
+
+
+class LeastLoadedPolicy(RouterPolicy):
+    """Lowest serving_load_score wins — the contract documented on
+    slo.load_score: 'a multi-replica router sends the next request to
+    the replica with the LOWEST score'. Ties rotate round-robin:
+    a burst of dispatches against equally-idle replicas (TTL-cached
+    stats all read 0.0) must spread, not pile onto the first name."""
+
+    name = "least_loaded"
+    _EPS = 1e-6
+
+    def __init__(self):
+        self._rr = 0
+
+    def choose(self, ready, stats):
+        lo = min(stats[r.name]["load"] for r in ready)
+        tied = [r for r in ready
+                if stats[r.name]["load"] <= lo + self._EPS]
+        r = tied[self._rr % len(tied)]
+        self._rr += 1
+        return r
+
+
+class RoundRobinPolicy(RouterPolicy):
+    name = "round_robin"
+
+    def __init__(self):
+        self._n = 0
+
+    def choose(self, ready, stats):
+        r = ready[self._n % len(ready)]
+        self._n += 1
+        return r
+
+
+_ROUTER_POLICIES = {cls.name: cls
+                    for cls in (LeastLoadedPolicy, RoundRobinPolicy)}
+
+
+def resolve_router_policy(policy=None) -> RouterPolicy:
+    if isinstance(policy, RouterPolicy):
+        return policy
+    name = policy if policy is not None else \
+        _cfg.get_flag("FLAGS_router_policy", "least_loaded")
+    cls = _ROUTER_POLICIES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown router policy {name!r}; available: "
+                         f"{sorted(_ROUTER_POLICIES)}")
+    return cls()
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class _Ticket:
+    """One routed request's future."""
+
+    __slots__ = ("request", "t_submit", "t_dispatch", "attempts",
+                 "trace", "_event", "_result")
+
+    def __init__(self, request: dict):
+        self.request = request
+        self.t_submit = _time_mod.perf_counter()
+        self.t_dispatch = None
+        self.attempts = 0
+        self.trace = _trace.NOOP_TRACE
+        self._event = threading.Event()
+        self._result: Optional[dict] = None
+
+    def resolve(self, result: dict):
+        self._result = result
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self._event.wait(timeout=timeout):
+            return {"ok": False, "error": "router result timeout"}
+        return self._result
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+
+class Router:
+    """The async front door: own queue, worker-thread dispatch, SLO-
+    aware admission and replica choice.
+
+    router = Router([replica_a, replica_b]).start()
+    out = router.generate(prompt_ids, max_new_tokens=16)
+    router.close()
+    """
+
+    def __init__(self, replicas: List[BaseReplica], policy=None,
+                 admission: Optional[bool] = None,
+                 max_queue: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 max_attempts: Optional[int] = None,
+                 request_timeout_s: float = 120.0):
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        self.replicas = list(replicas)
+        self.policy = resolve_router_policy(policy)
+        self.admission = bool(_cfg.get_flag(
+            "FLAGS_router_admission", True)) \
+            if admission is None else bool(admission)
+        self.max_queue = int(_cfg.get_flag(
+            "FLAGS_router_queue_depth", 256)) \
+            if max_queue is None else int(max_queue)
+        self.workers = workers if workers is not None else \
+            max(2, 2 * len(replicas))
+        self.max_attempts = max_attempts if max_attempts is not None \
+            else 2 + len(replicas)
+        self.request_timeout_s = float(request_timeout_s)
+        self._m = _RouterMetrics()
+        # the router's OWN SLO engine: default objectives + routed
+        # TTFT (kept out of default_objectives so single-engine
+        # processes don't evaluate an empty histogram)
+        self._slo = _slo.SloEngine(
+            objectives=tuple(_slo.default_objectives())
+            + tuple(_slo.router_objectives()))
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._policy_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "Router":
+        if not self._threads:
+            for i in range(self.workers):
+                t = threading.Thread(target=self._worker,
+                                     name=f"router-worker-{i}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def close(self):
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+
+    # -- submission / admission ---------------------------------------
+    def _ready_stats(self):
+        stats = {r.name: r.stats() for r in self.replicas}
+        ready = [r for r in self.replicas if stats[r.name]["ready"]]
+        return ready, stats
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               **params) -> _Ticket:
+        """Queue a request; raises RouterShed (429) when admission
+        control rejects it. Returns a ticket; .result(timeout) blocks
+        for {"ok", "output_ids", ...}."""
+        with self._cv:
+            depth = len(self._q)
+        if depth >= self.max_queue:
+            self._m.sheds.labels("queue_full").inc()
+            self._m.requests.labels("shed").inc()
+            raise RouterShed(
+                f"router queue full ({depth}/{self.max_queue})")
+        if self.admission:
+            ready, stats = self._ready_stats()
+            if ready and all(stats[r.name]["ttft_burning"]
+                             for r in ready):
+                self._m.sheds.labels("ttft_burning").inc()
+                self._m.requests.labels("shed").inc()
+                raise RouterShed(
+                    "every ready replica's TTFT SLO is burning — "
+                    "shedding to protect in-flight requests")
+        request = dict(prompt_ids=np.asarray(
+            prompt_ids, np.int64).tolist(),
+            max_new_tokens=int(max_new_tokens), **params)
+        ticket = _Ticket(request)
+        if _trace.enabled():
+            ticket.trace = _trace.start_trace(
+                "router.request", own_track=True,
+                prompt_len=len(request["prompt_ids"]),
+                max_new=int(max_new_tokens))
+            ticket.trace.begin("router.queue")
+        with self._cv:
+            self._q.append(ticket)
+            self._m.queue_depth.set(len(self._q))
+            self._cv.notify()
+        _flight.record_event("router.submit",
+                             prompt_len=len(request["prompt_ids"]))
+        return ticket
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 timeout: Optional[float] = None, **params) -> dict:
+        t = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                        **params)
+        return t.result(timeout=timeout or self.request_timeout_s + 10)
+
+    # -- dispatch -----------------------------------------------------
+    def _pick(self, deadline: float) -> Optional[BaseReplica]:
+        """Wait (bounded) for a ready replica, then apply the policy.
+        Replicas mid-recovery fail /readyz and drain automatically —
+        they reappear here the moment the rebuilt engine re-admits."""
+        while not self._stop.is_set():
+            ready, stats = self._ready_stats()
+            if ready:
+                with self._policy_lock:
+                    return self.policy.choose(ready, stats)
+            if _time_mod.monotonic() >= deadline:
+                return None
+            _time_mod.sleep(0.02)
+        return None
+
+    def _worker(self):
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._q and not self._stop.is_set():
+                    self._cv.wait(timeout=0.25)
+                if self._stop.is_set():
+                    return
+                ticket = self._q.popleft()
+                self._m.queue_depth.set(len(self._q))
+            self._dispatch(ticket)
+            try:
+                self._slo.tick()
+            except Exception:  # noqa: BLE001 — telemetry never takes
+                pass           # the dispatch path down
+
+    def _requeue(self, ticket: _Ticket):
+        with self._cv:
+            self._q.appendleft(ticket)
+            self._m.queue_depth.set(len(self._q))
+            self._cv.notify()
+
+    def _dispatch(self, ticket: _Ticket):
+        deadline = _time_mod.monotonic() + self.request_timeout_s
+        replica = self._pick(deadline)
+        if replica is None:
+            self._m.requests.labels("failed").inc()
+            ticket.trace.finish(error="no ready replica")
+            ticket.resolve({"ok": False,
+                            "error": "no ready replica before "
+                                     "request timeout"})
+            return
+        ticket.attempts += 1
+        if ticket.t_dispatch is None:
+            ticket.t_dispatch = _time_mod.perf_counter()
+            ticket.trace.end("router.queue")
+        ticket.trace.begin("router.route", replica=replica.name,
+                           attempt=ticket.attempts)
+        self._m.dispatches.labels(replica.name).inc()
+        _flight.record_event("router.dispatch", replica=replica.name,
+                             attempt=ticket.attempts)
+        try:
+            left = max(1.0, deadline - _time_mod.monotonic())
+            out = replica.generate(ticket.request, timeout=left)
+        except Exception as e:  # noqa: BLE001 — a replica failure is
+            # routed around, not propagated: retry elsewhere until the
+            # attempt budget runs out. No request is lost silently.
+            ticket.trace.end("router.route", error=repr(e))
+            replica.invalidate()  # its cached "ready" is now suspect
+            _flight.record_event("router.dispatch_failed",
+                                 replica=replica.name, error=repr(e))
+            if ticket.attempts < self.max_attempts and \
+                    _time_mod.monotonic() < deadline:
+                self._m.requests.labels("retried").inc()
+                self._requeue(ticket)
+            else:
+                self._m.requests.labels("failed").inc()
+                ticket.trace.finish(error=repr(e))
+                ticket.resolve({"ok": False, "error": repr(e),
+                                "attempts": ticket.attempts})
+            return
+        now = _time_mod.perf_counter()
+        queue_s = ticket.t_dispatch - ticket.t_submit
+        if out.get("ttft_s") is not None:
+            # routed TTFT = router queue wait + the replica's own
+            # submit->first-token (its queue + prefill)
+            self._m.ttft.observe(queue_s + float(out["ttft_s"]))
+        self._m.latency.observe(now - ticket.t_submit)
+        self._m.requests.labels("ok").inc()
+        ticket.trace.end("router.route", replica=replica.name,
+                         tokens=len(out.get("output_ids") or ()))
+        ticket.trace.finish(ok=True)
+        out = dict(out)
+        out["replica"] = replica.name
+        out["attempts"] = ticket.attempts
+        ticket.resolve(out)
+
+    # -- introspection ------------------------------------------------
+    def stats(self) -> dict:
+        ready, stats = self._ready_stats()
+        with self._cv:
+            depth = len(self._q)
+        return {"policy": self.policy.name,
+                "admission": self.admission,
+                "queue_depth": depth,
+                "replicas": [dict(name=r.name, **stats[r.name])
+                             for r in self.replicas],
+                "ready": [r.name for r in ready]}
+
+
+# ---------------------------------------------------------------------------
+# experimental: disaggregated prefill/decode pools
+# ---------------------------------------------------------------------------
+
+
+class DisaggregatedServing:
+    """Prefill-pool -> decode-pool serving over the KV page-table
+    handoff (ServingEngine.detach_request / attach_request).
+
+    The prefill engine only ever admits + prefills (admit_pending);
+    each prefilled request's pages are gathered and re-scattered into
+    the decode engine, which runs the pure-decode steady state the
+    burst/async programs are built for. Both engines must agree on
+    model geometry, page_size, and KV quantization. Experimental:
+    in-process pools, host-side gather/scatter — the measured handoff
+    cost is the point (it bounds what a cross-host transport must
+    beat)."""
+
+    def __init__(self, prefill_engine, decode_engine):
+        self.prefill = prefill_engine
+        self.decode = decode_engine
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 **params) -> dict:
+        out = self.generate_many(
+            [dict(prompt_ids=prompt_ids,
+                  max_new_tokens=max_new_tokens, **params)])
+        return out[0]
+
+    def generate_many(self, requests: List[dict],
+                      max_steps: int = 10_000) -> List[dict]:
+        """Pipeline a batch through the pools: decode steps overlap
+        later requests' prefills (request i can be decoding while
+        request j is still queued on the prefill engine)."""
+        pe, de = self.prefill, self.decode
+        pe_rids: Dict[int, int] = {}    # prefill rid -> request index
+        de_rids: Dict[int, int] = {}    # decode rid -> request index
+        results: List[Optional[dict]] = [None] * len(requests)
+        for idx, req in enumerate(requests):
+            params = {k: req[k] for k in
+                      ("decode_strategy", "temperature", "top_k",
+                       "top_p", "eos_token_id") if k in req}
+            rid = pe.add_request(
+                np.asarray(req["prompt_ids"], np.int64),
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                **params)
+            pe_rids[rid] = idx
+        for _step in range(max_steps):
+            if not pe_rids and not de_rids:
+                break
+            if pe_rids:
+                pe.admit_pending()  # batched prefill, no decode
+                # hand over every prefilled slot the decode pool can
+                # host right now; the rest stay resident and move on a
+                # later iteration (pages free up as decodes finish)
+                for s in list(pe.slots):
+                    if not s.active or s.request_id not in pe_rids:
+                        continue
+                    if not any(not d.active for d in de.slots):
+                        break
+                    if len(de._free_pages) < s.n_pages:
+                        continue
+                    t_h0 = _time_mod.perf_counter()
+                    handoff = pe.detach_request(s.request_id)
+                    drid = de.attach_request(handoff)
+                    _flight.record_event(
+                        "router.kv_handoff",
+                        ctx=handoff.context_len,
+                        pages=int(handoff.k[0].shape[1])
+                        if handoff.k else 0,
+                        s=round(_time_mod.perf_counter() - t_h0, 6))
+                    de_rids[drid] = pe_rids.pop(s.request_id)
+            if de.has_work():
+                for f in de.step():
+                    idx = de_rids.pop(f.request_id, None)
+                    if idx is not None:
+                        results[idx] = {
+                            "ok": True,
+                            "output_ids":
+                                np.asarray(f.output_ids).tolist(),
+                        }
+        for idx, r in enumerate(results):
+            if r is None:
+                results[idx] = {"ok": False,
+                                "error": "disaggregated pipeline did "
+                                         "not finish the request"}
+        return results

@@ -32,6 +32,7 @@ from ..observability import slo as _slo
 from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
+from . import scheduler as _sched
 
 
 class _EngineMetrics:
@@ -180,6 +181,29 @@ class _Slot:
 
 
 @dataclass
+class KVHandoff:
+    """A prefilled request detached from one engine for adoption by
+    another (the disaggregated prefill->decode handoff): the host-side
+    gather of its KV pages plus everything the decode engine needs to
+    resume — context, committed tokens, the not-yet-committed
+    prefill-time sample, and the per-request sampling params."""
+
+    prompt_ids: np.ndarray
+    tokens: list
+    context_len: int
+    max_new_tokens: int
+    needs_first_sample: bool
+    first_token: int
+    req_params: dict
+    page_size: int
+    kv_cache_quant: object
+    k: list          # per layer: [kvh, n_pages, page_size, head_dim]
+    v: list
+    k_scales: object  # per layer or None (int8 KV only)
+    v_scales: object
+
+
+@dataclass
 class FinishedRequest:
     request_id: int
     prompt_ids: np.ndarray
@@ -209,7 +233,7 @@ class ServingEngine:
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
                  decode_burst=1, kv_cache_quant=None, async_depth=0,
                  spec_decode=None, spec_draft_layers=None,
-                 draft_model=None):
+                 draft_model=None, scheduler=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         max_pos = getattr(model.config, "max_position_embeddings", None)
@@ -293,6 +317,11 @@ class ServingEngine:
         self.block_tables = np.zeros((max_batch, self.pages_per_seq),
                                      np.int32)
         self.slots = [_Slot() for _ in range(max_batch)]
+        # the four scheduling decisions (admission order, preemption
+        # victim, prefill packing, burst sizing) are delegated to a
+        # pluggable policy; scheduler= accepts an instance, a registry
+        # name, or None (FLAGS_scheduler_policy)
+        self.scheduler = _sched.resolve_policy(scheduler)
         self._pending: List = []  # queued (rid, ids, max_new, prior_tokens)
         self._prompts: Dict[int, np.ndarray] = {}
         self._req_params: Dict[int, dict] = {}  # per-request sampling
@@ -556,13 +585,19 @@ class ServingEngine:
                 (i for i, s in enumerate(self.slots) if not s.active), None)
             if slot_idx is None:
                 break
-            rid, ids, max_new, prior = self._pending[0]
+            # admission ORDER is the scheduler policy's call (default:
+            # strict head-of-line FIFO); the page-fit commit check stays
+            # here so a policy bug cannot underflow the pool
+            pick = self.scheduler.select_admission(self)
+            if pick is None:
+                break
+            rid, ids, max_new, prior = self._pending[pick]
             ctx = np.concatenate([ids, np.asarray(prior, np.int64)]) \
                 if prior else ids
             need = -(-len(ctx) // self.page_size)  # ceil: prompt pages only
             if len(self._free_pages) < need:
                 break
-            self._pending.pop(0)
+            self._pending.pop(pick)
             rp = self._req_params.get(rid)
             # one-shot: a preempted request re-enters _pending with its
             # original t_enq — re-observing would book its prior decode
@@ -867,12 +902,15 @@ class ServingEngine:
         all admitted prompts + ONE paged scatter per layer."""
         n = len(new)
         t0_prefill = _time_mod.perf_counter() if self._traces else 0.0
-        nb = 1
-        while nb < n:
-            nb *= 2
-        nb = min(nb, self.max_batch)
+        # packing is the scheduler policy's call (default: next-pow2
+        # batch capped at max_batch, token bucket = next page multiple)
+        nb, bucket = self.scheduler.prefill_bucket(self, new)
+        # clamp against policy bugs: the batch must hold every prompt
+        # and the token bucket must page-align and cover the longest
+        nb = min(max(nb, n), self.max_batch)
         longest = max(len(ids) for _, ids in new)
-        bucket = -(-longest // self.page_size) * self.page_size
+        bucket = max(-(-bucket // self.page_size) * self.page_size,
+                     -(-longest // self.page_size) * self.page_size)
         all_greedy = all(self.slots[si].greedy for si, _ in new)
         fn = self._get_prefill_fn(nb, bucket, all_greedy)
         params, buffers = self._cached_params()
@@ -1713,7 +1751,7 @@ class ServingEngine:
             return self._begin_recovery(
                 "decode_oom",
                 f"{where} OOM with no active slots (forensics: {path})")
-        victim = max(active, key=lambda i: self.slots[i].admit_seq)
+        victim = self.scheduler.select_victim(self, active, "decode_oom")
         self._oom_retried = True
         _flight.record_event("serving.oom_preempt",
                              rid=self.slots[victim].request_id,
@@ -1784,8 +1822,11 @@ class ServingEngine:
             # speculative rounds replace the burst path when eligible
             # (all-greedy batch with more than one token of budget)
             spec_w = self._spec_window(active, rem_of)
-            k_burst = self.decode_burst if (
-                self.decode_burst > 1 and max(rem_of.values()) > 1) else 1
+            # scan length is the scheduler policy's call (default
+            # buckets to {1, decode_burst}); clamp to sizes the engine
+            # compiles programs for
+            k_burst = int(self.scheduler.burst_k(self, active, rem_of))
+            k_burst = self.decode_burst if k_burst > 1 else 1
             # on-demand page growth for the positions this step writes
             # (one per single step, up to min(burst, remaining) for a
             # burst, up to min(window, remaining) for a spec round);
@@ -1798,8 +1839,8 @@ class ServingEngine:
                     i, min(reserve, rem_of[i]))]
                 if not stalled:
                     break
-                victim = max(stalled,
-                             key=lambda i: self.slots[i].admit_seq)
+                victim = self.scheduler.select_victim(
+                    self, stalled, "page_stall")
                 self._preempt(victim)
                 active = [j for j in active if j != victim]
                 if not active:
@@ -2054,6 +2095,158 @@ class ServingEngine:
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.active for s in self.slots)
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode: KV handoff between engines
+    # ------------------------------------------------------------------
+    def admit_pending(self):
+        """Run one admission round (batched prefill of everything
+        admissible) WITHOUT decoding — the disaggregated prefill pool's
+        step: the router prefills here, then detach_request() carries
+        the paged KV to a decode-pool engine."""
+        self._check_poisoned()
+        self._admit()
+
+    def detach_request(self, request_id: int) -> "KVHandoff":
+        """Extract a prefilled request from this engine: gather its KV
+        pages to the host, free the slot, and return a KVHandoff that
+        attach_request() on a decode-pool engine accepts. Must be
+        called between steps (never while an async pipeline is in
+        flight — the pages gathered here must not have bursts pending
+        against them). The uncommitted prefill-time sample rides the
+        handoff, so the first token is committed exactly once, by the
+        attaching engine."""
+        self._check_poisoned()
+        slot_idx = next((i for i, s in enumerate(self.slots)
+                         if s.active and s.request_id == request_id),
+                        None)
+        if slot_idx is None:
+            raise KeyError(
+                f"request {request_id} is not active on this engine "
+                f"(pending requests must be admitted/prefilled first)")
+        s = self.slots[slot_idx]
+        page_idx = self.block_tables[slot_idx, :s.n_pages].copy()
+        k = [np.asarray(kp[:, page_idx]) for kp in self.k_pages]
+        v = [np.asarray(vp[:, page_idx]) for vp in self.v_pages]
+        if self.k_scales is not None:
+            ks = [np.asarray(sc[:, page_idx]) for sc in self.k_scales]
+            vs = [np.asarray(sc[:, page_idx]) for sc in self.v_scales]
+        else:
+            ks = vs = None
+        rp = dict(self._req_params.get(s.request_id, {}))
+        rp.pop("t_enq", None)  # TTFT belongs to the prefill engine's
+        # clock only when the first token committed there; the router
+        # observes routed TTFT end to end instead
+        handoff = KVHandoff(
+            prompt_ids=self._prompts.get(
+                s.request_id, np.zeros((0,), np.int64)),
+            tokens=list(s.tokens),
+            context_len=s.context_len,
+            max_new_tokens=s.max_new_tokens,
+            needs_first_sample=s.needs_first_sample,
+            first_token=s._first_token,
+            req_params=rp,
+            page_size=self.page_size,
+            kv_cache_quant=self.kv_cache_quant,
+            k=k, v=v, k_scales=ks, v_scales=vs)
+        self._release_slot(slot_idx)
+        self._prompts.pop(s.request_id, None)
+        self._req_params.pop(s.request_id, None)
+        self._retry_counts.pop(s.request_id, None)
+        if self._traces:
+            self._finish_trace(s.request_id, detached=True)
+        _flight.record_event("serving.detach", rid=s.request_id,
+                             ctx=s.context_len, pages=len(page_idx))
+        return handoff
+
+    def attach_request(self, handoff: "KVHandoff") -> int:
+        """Adopt a detached request: allocate a slot + pages, scatter
+        the handoff's KV into this engine's pools, and resume decoding
+        from its context. Returns the request's NEW id on this engine.
+        Must be called between steps. The engines must agree on
+        page_size, KV quantization, and model geometry (the page
+        shapes are checked)."""
+        self._check_poisoned()
+        if handoff.page_size != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: handoff {handoff.page_size} vs "
+                f"engine {self.page_size}")
+        if handoff.kv_cache_quant != self.kv_cache_quant:
+            raise ValueError(
+                f"kv_cache_quant mismatch: handoff "
+                f"{handoff.kv_cache_quant!r} vs engine "
+                f"{self.kv_cache_quant!r}")
+        if len(handoff.k) != len(self.k_pages) or (
+                handoff.k and handoff.k[0].shape[0] !=
+                self.k_pages[0].shape[0]) or (
+                handoff.k and handoff.k[0].shape[2:] !=
+                self.k_pages[0].shape[2:]):
+            raise ValueError(
+                "model geometry mismatch between the detaching and "
+                "attaching engines' KV page pools")
+        n_pages = handoff.k[0].shape[1] if handoff.k else 0
+        if handoff.context_len + max(
+                0, handoff.max_new_tokens - len(handoff.tokens)) \
+                > self.max_seq_len:
+            raise ValueError(
+                f"handoff needs up to "
+                f"{handoff.context_len + handoff.max_new_tokens} "
+                f"positions; engine max_seq_len={self.max_seq_len}")
+        slot_idx = next((i for i, s in enumerate(self.slots)
+                         if not s.active), None)
+        if slot_idx is None:
+            raise RuntimeError("attach_request: no free slot")
+        if len(self._free_pages) < n_pages:
+            raise RuntimeError(
+                f"attach_request: needs {n_pages} pages, "
+                f"{len(self._free_pages)} free")
+        dst = np.asarray([self._free_pages.pop()
+                          for _ in range(n_pages)], np.int32)
+        dd = jnp.asarray(dst)
+        for li in range(len(self.k_pages)):
+            self.k_pages[li] = self.k_pages[li].at[:, dd].set(
+                jnp.asarray(handoff.k[li], self.k_pages[li].dtype))
+            self.v_pages[li] = self.v_pages[li].at[:, dd].set(
+                jnp.asarray(handoff.v[li], self.v_pages[li].dtype))
+            if self.k_scales is not None:
+                self.k_scales[li] = self.k_scales[li].at[:, dd].set(
+                    jnp.asarray(handoff.k_scales[li]))
+                self.v_scales[li] = self.v_scales[li].at[:, dd].set(
+                    jnp.asarray(handoff.v_scales[li]))
+        if self._page_sharding is not None:
+            self._pin_pages()
+        rid = self._next_rid
+        self._next_rid += 1
+        ids = np.asarray(handoff.prompt_ids).reshape(-1).astype(np.int64)
+        self._prompts[rid] = ids
+        rp = dict(handoff.req_params)
+        rp.setdefault("greedy", True)
+        rp.setdefault("temperature", float(self.temperature))
+        rp.setdefault("top_k", int(self.top_k))
+        rp.setdefault("top_p", float(self.top_p))
+        rp.setdefault("eos", self.eos_token_id)
+        rp.setdefault("on_token", None)
+        self._req_params[rid] = rp
+        self.block_tables[slot_idx, :] = 0
+        self.block_tables[slot_idx, :n_pages] = dst
+        s = self.slots[slot_idx]
+        s.request_id = rid
+        s.tokens = list(handoff.tokens)
+        s.prompt_len = len(ids)
+        s.context_len = handoff.context_len
+        s.max_new_tokens = handoff.max_new_tokens
+        s.n_pages = n_pages
+        s.greedy = bool(rp["greedy"])
+        s.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        s.needs_first_sample = handoff.needs_first_sample
+        s._first_token = handoff.first_token
+        s.spec_proposed = 0
+        s.spec_accepted = 0
+        s.active = True
+        _flight.record_event("serving.attach", rid=rid,
+                             ctx=s.context_len, pages=n_pages)
+        return rid
 
     def _async_ok(self) -> bool:
         """Pipelined decode is only entered in the steady pure-decode

@@ -114,6 +114,35 @@ def tracked_engines() -> list:
 
 
 # ---------------------------------------------------------------------------
+# pluggable routes (the serving replica/router plane mounts here)
+# ---------------------------------------------------------------------------
+
+_routes: dict = {}  # path -> handler(method, query, body) -> (code, bytes, ctype)
+_routes_lock = threading.Lock()
+
+
+def register_route(path: str, handler):
+    """Mount an application route on this process's telemetry server
+    (e.g. the serving replica's POST /v1/generate). handler(method,
+    query, body_bytes) returns (status_code, body_bytes, content_type);
+    exceptions answer 500 without killing the server thread. Returns
+    the path for symmetry with unregister_route."""
+    with _routes_lock:
+        _routes[path] = handler
+    return path
+
+
+def unregister_route(path: str):
+    with _routes_lock:
+        _routes.pop(path, None)
+
+
+def _registered_route(path: str):
+    with _routes_lock:
+        return _routes.get(path)
+
+
+# ---------------------------------------------------------------------------
 # probe payloads (pure functions — the handlers and tests share them)
 # ---------------------------------------------------------------------------
 
@@ -327,18 +356,41 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — http.server API
+        self._handle("GET")
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        self._handle("POST")
+
+    def _handle(self, method: str):
         try:
             url = urlparse(self.path)
-            code, body, ctype, extra = self._route(
-                url.path.rstrip("/") or "/", parse_qs(url.query))
+            path = url.path.rstrip("/") or "/"
+            query = parse_qs(url.query)
+            body = b""
+            if method == "POST":
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except (TypeError, ValueError):
+                    n = 0
+                body = self.rfile.read(n) if n > 0 else b""
+            handler = _registered_route(path)
+            if handler is not None:
+                code, payload, ctype = handler(method, query, body)
+                extra = None
+            elif method == "POST":
+                code, ctype, extra = (405, "text/plain; charset=utf-8",
+                                      None)
+                payload = b"method not allowed\n"
+            else:
+                code, payload, ctype, extra = self._route(path, query)
         except BrokenPipeError:
             return
         except Exception as e:  # noqa: BLE001 — a handler bug must
             # answer 500, never kill the server thread
             code, ctype, extra = 500, "text/plain; charset=utf-8", None
-            body = f"internal error: {e!r}\n".encode()
+            payload = f"internal error: {e!r}\n".encode()
         try:
-            self._send(code, body, ctype, extra)
+            self._send(code, payload, ctype, extra)
         except (BrokenPipeError, ConnectionResetError):
             pass
 
@@ -405,12 +457,21 @@ class _Handler(BaseHTTPRequestHandler):
         return (404, b"not found\n", "text/plain; charset=utf-8", None)
 
 
+class _PlaneServer(ThreadingHTTPServer):
+    # the default listen backlog (5) drops SYNs under a router burst
+    # (N generate long-polls + readiness probes connect at once) and a
+    # dropped SYN costs the client the full ~1 s TCP retransmit — a
+    # bimodal latency cliff the router smoke measured before this
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class TelemetryServer:
     """One rank's HTTP plane: a ThreadingHTTPServer on a daemon thread
     (scrapes run concurrently with steps and never block them)."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0"):
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _PlaneServer((host, port), _Handler)
         self.httpd.daemon_threads = True
         self.host = host
         self.port = int(self.httpd.server_address[1])
@@ -534,3 +595,5 @@ def _reset_for_tests():
     _start_failed = False
     with _engines_lock:
         _engines.clear()
+    with _routes_lock:
+        _routes.clear()

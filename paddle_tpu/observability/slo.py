@@ -162,6 +162,26 @@ def default_objectives() -> Tuple[Objective, ...]:
     )
 
 
+def router_objectives() -> Tuple[Objective, ...]:
+    """The serving ROUTER's objectives (inference/router.py): routed
+    TTFT measured submit -> first committed token across router queue +
+    route + replica prefill — the end-to-end latency a client of the
+    multi-replica front door actually sees. Kept out of
+    default_objectives() so single-engine processes don't evaluate a
+    histogram that never fills; the router's own SloEngine runs
+    default + these."""
+    cfg = _flags()
+    try:
+        ms = float(cfg.get_flag("FLAGS_slo_router_ttft_p95_ms", 1500.0))
+    except (TypeError, ValueError):
+        ms = 1500.0
+    return (
+        Objective("router_ttft_p95", "latency",
+                  family="router_ttft_seconds",
+                  threshold_s=ms / 1e3, quantile=0.95),
+    )
+
+
 # ---------------------------------------------------------------------------
 # health primitive (shared with /healthz)
 # ---------------------------------------------------------------------------

@@ -80,7 +80,20 @@ GEOMETRY_KEYS = ("batch", "seq", "hidden", "layers", "prompt_len",
 # these keys exist to prevent
 KNOB_KEYS_ABSENT_IS_NONE = ("quant", "kv_quant", "spec_decode",
                             "draft_layers", "overlap", "grad_bucket_mb",
-                            "prefetch_depth")
+                            "prefetch_depth", "replicas",
+                            "router_policy")
+
+
+def _knob(extra: dict, key: str):
+    """Knob value normalized for comparability. `replicas` treats 1 ==
+    absent == None (a single-engine run IS the un-routed baseline —
+    pre-router history rows must keep baselining fresh single-engine
+    rows), while a multi-replica router row (replicas >= 2) never
+    matches a single-engine one."""
+    v = extra.get(key)
+    if key == "replicas" and v == 1:
+        return None
+    return v
 
 
 def _get(row, path):
@@ -165,7 +178,7 @@ def comparable(fresh: dict, base: dict) -> bool:
         if k in fe and k in be and fe[k] != be[k]:
             return False
     for k in KNOB_KEYS_ABSENT_IS_NONE:
-        if (k in fe or k in be) and fe.get(k) != be.get(k):
+        if (k in fe or k in be) and _knob(fe, k) != _knob(be, k):
             return False
     return True
 

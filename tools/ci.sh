@@ -223,6 +223,24 @@ elif ! timeout 120 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# multi-replica router smoke (ISSUE 13, README.md "Disaggregated
+# serving plane"): 2 CPU replica subprocesses discovered from fleet
+# heartbeats (auto_replicas), fronted by the SLO-aware Router. Gates:
+# an injected decode.oom chaos fault on replica 0 must drive recovery
+# AND the router must drain it (r0 leaves the ready set while r1
+# serves), no request may be lost across the fault, and the 2-replica
+# aggregate decode throughput must be >= 1.5x the single-replica
+# baseline measured in the same run (on a single-core box the floor
+# relaxes to 1.0x — two engine processes cannot express parallelism
+# on one core; the fault gates still apply in full).
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/router_smoke.py --dir /tmp/ci_router; then
+  echo "CI: router smoke FAILED (discovery, chaos drain, a lost" \
+       "request, or 2-replica throughput under 1.5x baseline — see" \
+       "the phase log above; worker logs in /tmp/ci_router/)" >&2
+  rc=1
+fi
+
 # chaos drill (ISSUE 11, README.md "Fault tolerance"): scheduled
 # rank.kill (FLAGS_chaos) mid-training in a 2-rank elastic pod -> the
 # controller must restart the pod, every rank must resume from its last
@@ -243,7 +261,7 @@ if [ $rc -ne 0 ]; then
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
-       "/tmp/ci_chaos/, /tmp/ci_bench_smoke.json," \
+       "/tmp/ci_chaos/, /tmp/ci_router/, /tmp/ci_bench_smoke.json," \
        "/tmp/ci_overlap_ledger.prom (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi

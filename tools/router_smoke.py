@@ -1,0 +1,233 @@
+"""Multi-replica router smoke (CI gate for the disaggregated serving
+plane): 2 CPU replica SUBPROCESSES, discovered from fleet heartbeats
+(`auto_replicas` — the `--replicas auto` path), fronted by the
+SLO-aware Router. Three phases:
+
+1. baseline    — Router over replica 1 alone; measured tokens/s.
+2. chaos drill — Router over both; replica 0 was armed (post-warmup)
+                 with `decode.oom@p=1.0:n=2`, so its first served
+                 decode hits the injected OOM, the retry hits the
+                 second, and the engine enters self-healing recovery.
+                 The gate asserts the router DRAINS it (replica 0
+                 leaves the ready set while replica 1 stays), that
+                 NO request is lost (every response ok with exactly
+                 max_new tokens — eos is never emitted by these
+                 random prompts' budget-bounded decodes), and that
+                 replica 0's /healthz reports engine_recoveries >= 1.
+3. throughput  — Router over both (chaos budget n=2 is spent);
+                 aggregate tokens/s must be >= RATIO_FLOOR x phase 1.
+                 Measured after recovery on purpose: the drill proves
+                 fault behavior, this phase proves the scaling claim
+                 — two processes, two GILs.
+
+Run: python tools/router_smoke.py [--dir /tmp/ci_router]
+Outputs one JSON line + exit 0/1.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def _cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+# Two engine PROCESSES need two cores to express parallelism. On a
+# single-core box the replicas timeshare one core, so the honest
+# invariant is "fan-out must not LOSE throughput" (the fault drill —
+# drain, no lost request, recovery — gates unconditionally either
+# way); the 1.5x scaling floor arms wherever >= 2 cores exist. On one
+# core the floor is 0.75: it catches structural collapse (requests
+# serializing through one replica, lost concurrency) while tolerating
+# process-timeshare overhead and shared-box noise. An earlier version
+# of this smoke showed 1.78x on one core — that was the httpd
+# listen-backlog defect (dropped SYNs cost the single-replica
+# baseline ~1 s TCP retransmits), not real scaling, and fixing the
+# defect is what exposed the core ceiling.
+RATIO_FLOOR = 1.5 if _cores() >= 2 else 0.75
+PROMPT_LEN = 8
+MAX_NEW = 24
+CHAOS = "decode.oom@p=1.0:n=2"
+RECOVERY_BACKOFF_S = 0.75   # widen the drain window the watcher samples
+
+
+class DrainWatch(threading.Thread):
+    """Sample router.stats() and record whether the victim replica
+    ever leaves the ready set while the healthy one stays in it —
+    the router-side evidence of the recovery drain."""
+
+    def __init__(self, router, victim: str, healthy: str):
+        super().__init__(name="drain-watch", daemon=True)
+        self.router = router
+        self.victim = victim
+        self.healthy = healthy
+        self.drained = False
+        self.both_ready_seen = False
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.is_set():
+            ready = set(self.router.stats()["ready"])
+            if self.victim in ready and self.healthy in ready:
+                self.both_ready_seen = True
+            if self.victim not in ready and self.healthy in ready:
+                self.drained = True
+            self._halt.wait(0.02)
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5.0)
+
+
+def run_phase(router, rng, n_requests: int, timeout: float = 120.0,
+              warm: int = 0):
+    """Submit n_requests concurrently, wait for all; returns
+    (outs, tokens_per_sec). `warm` requests run untimed first so a
+    timed phase never pays one-time costs the other phases already
+    paid (the throughput RATIO is the gate — both arms must be
+    equally warm)."""
+    if warm:
+        for t in [router.submit(rng.randint(0, 97, (PROMPT_LEN,)),
+                                max_new_tokens=MAX_NEW)
+                  for _ in range(warm)]:
+            t.result(timeout=timeout)
+    t0 = time.perf_counter()
+    tickets = [router.submit(
+        rng.randint(0, 97, (PROMPT_LEN,)), max_new_tokens=MAX_NEW)
+        for _ in range(n_requests)]
+    outs = [t.result(timeout=timeout) for t in tickets]
+    dt = time.perf_counter() - t0
+    tokens = sum(len(o.get("output_ids") or ()) for o in outs)
+    return outs, tokens / dt
+
+
+def check_all_ok(outs, phase: str):
+    for i, o in enumerate(outs):
+        if not o.get("ok"):
+            raise AssertionError(
+                f"{phase}: request {i} failed: {o.get('error')}")
+        got = len(o.get("output_ids") or ())
+        if got != MAX_NEW:
+            raise AssertionError(
+                f"{phase}: request {i} lost tokens: {got} != {MAX_NEW} "
+                f"(replica={o.get('replica')})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="/tmp/ci_router")
+    ap.add_argument("--requests", type=int, default=24)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from paddle_tpu.inference import Router, auto_replicas
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+    from paddle_tpu.observability import fleet as _fleet
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+
+    print(f"router_smoke: spawning 2 replica workers "
+          f"(chaos {CHAOS!r} on r0) under {args.dir}", file=sys.stderr)
+    procs = spawn_replicas(
+        2, args.dir,
+        worker_args=["--prompt-len", str(PROMPT_LEN),
+                     "--max-batch", "4", "--max-seq-len", "64",
+                     "--page-size", "8"],
+        chaos=CHAOS, chaos_replicas=(0,),
+        recovery_backoff=RECOVERY_BACKOFF_S)
+    rng = np.random.RandomState(11)
+    result = {"ok": False}
+    try:
+        # satellite contract: no hand-listed ports — discovery walks
+        # the fleet heartbeat `endpoint` fields under --dir
+        replicas = auto_replicas(args.dir)
+        assert len(replicas) == 2, \
+            f"auto_replicas found {len(replicas)} endpoints, want 2"
+        by_ep = {_fleet.normalize_endpoint(p.endpoint): p.name
+                 for p in procs}
+        for r in replicas:
+            r.name = by_ep[r.base]
+        victim = next(r for r in replicas if r.name == "r0")
+        healthy = next(r for r in replicas if r.name == "r1")
+
+        # phase 1: single-replica baseline (the healthy one — r0's
+        # chaos budget must stay intact for the drill)
+        solo = Router([healthy], workers=16).start()
+        outs, base_tps = run_phase(solo, rng, args.requests, warm=8)
+        check_all_ok(outs, "baseline")
+        outs, tps2 = run_phase(solo, rng, args.requests)
+        check_all_ok(outs, "baseline")
+        base_tps = max(base_tps, tps2)   # best-of-2 damps box noise
+        solo.close()
+        print(f"router_smoke: baseline (1 replica) "
+              f"{base_tps:.1f} tok/s over {args.requests} requests",
+              file=sys.stderr)
+
+        # phase 2: chaos drill over both replicas
+        both = Router(replicas, workers=16).start()
+        watch = DrainWatch(both, victim="r0", healthy="r1")
+        watch.start()
+        outs, _ = run_phase(both, rng, args.requests)
+        watch.stop()
+        check_all_ok(outs, "chaos drill")
+        code, body = _fleet._http_get(victim.base + "/healthz",
+                                      timeout=5.0)
+        health = json.loads(body.decode("utf-8", "replace"))
+        recoveries = int(health.get("engine_recoveries", 0))
+        assert recoveries >= 1, \
+            (f"chaos drill: r0 reports engine_recoveries="
+             f"{recoveries}; the injected decode.oom never drove "
+             f"recovery (healthz={health})")
+        assert watch.drained, \
+            ("chaos drill: r0 never left the router's ready set "
+             "while r1 stayed — the drain was not observed")
+        dispatched = {o.get("replica") for o in outs}
+        print(f"router_smoke: drill ok — r0 drained during recovery "
+              f"(recoveries={recoveries}), all {args.requests} "
+              f"requests survived (replicas used: "
+              f"{sorted(dispatched)})", file=sys.stderr)
+
+        # phase 3: 2-replica aggregate throughput (chaos spent; the
+        # drill already warmed this router end to end)
+        outs, two_tps = run_phase(both, rng, args.requests, warm=8)
+        check_all_ok(outs, "throughput")
+        outs, tps2 = run_phase(both, rng, args.requests)
+        check_all_ok(outs, "throughput")
+        two_tps = max(two_tps, tps2)     # best-of-2, like the baseline
+        both.close()
+        ratio = two_tps / base_tps if base_tps > 0 else 0.0
+        print(f"router_smoke: 2 replicas {two_tps:.1f} tok/s "
+              f"({ratio:.2f}x baseline, floor {RATIO_FLOOR}x on "
+              f"{_cores()} core(s))", file=sys.stderr)
+        assert ratio >= RATIO_FLOOR, \
+            (f"aggregate throughput {two_tps:.1f} tok/s is only "
+             f"{ratio:.2f}x the single-replica {base_tps:.1f} tok/s "
+             f"(floor {RATIO_FLOOR}x)")
+        result = {"ok": True, "baseline_tps": round(base_tps, 1),
+                  "two_replica_tps": round(two_tps, 1),
+                  "ratio": round(ratio, 2),
+                  "ratio_floor": RATIO_FLOOR, "cores": _cores(),
+                  "drained": watch.drained,
+                  "recoveries": recoveries,
+                  "requests": 4 * args.requests}
+    finally:
+        for p in procs:
+            p.stop()
+        print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

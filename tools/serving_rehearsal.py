@@ -40,10 +40,10 @@ def main():
             geometry = sys.argv[sys.argv.index("--geometry") + 1]
         except IndexError:
             raise SystemExit("--geometry takes a value: 7b, 13b or smoke")
-    if geometry not in ("7b", "13b", "smoke"):
-        raise SystemExit(f"unknown --geometry {geometry!r}: 7b, 13b or "
-                         "smoke (a typo here would bank a smoke-sized "
-                         "run under a real-looking key)")
+    if geometry not in ("7b", "13b", "smoke", "router"):
+        raise SystemExit(f"unknown --geometry {geometry!r}: 7b, 13b, "
+                         "smoke or router (a typo here would bank a "
+                         "smoke-sized run under a real-looking key)")
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed.mesh as mesh_mod
@@ -54,7 +54,8 @@ def main():
         cfg = LlamaConfig.llama2_7b()
     elif geometry == "13b":
         cfg = LlamaConfig.llama2_13b()
-    else:  # smoke geometry for CI-speed runs
+    else:  # smoke geometry for CI-speed runs; the router geometry
+        # reuses it per replica (2 x smoke_tp8 behind the Router)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=8,
                           num_attention_heads=8, num_key_value_heads=8)
@@ -124,6 +125,43 @@ def main():
     pd = result["per_device_bytes"]
     result["per_device_gb"] = round(
         (pd["arguments"] + pd["outputs"] + pd["temps"]) / 2**30, 2)
+
+    if geometry == "router":
+        # Router-plane rehearsal: 2 replicas of the smoke_tp8 engine
+        # behind the serving Router. Replica 0's compiled program above
+        # IS each replica's per-device story (deployed replicas are
+        # identical processes); what this branch adds is the fleet
+        # aggregate (2x KV pool / per-device bytes) and proof the
+        # router constructs over both replicas and enumerates them —
+        # no decode step runs, same contract as the other geometries.
+        from paddle_tpu.inference import Router
+        from paddle_tpu.inference.replica import ReplicaServer
+        from paddle_tpu.inference.router import LocalReplica
+
+        t0 = time.perf_counter()
+        paddle.seed(1)
+        engine2 = ServingEngine(model.__class__(cfg), max_batch=max_batch,
+                                max_seq_len=max_seq_len, page_size=16,
+                                decode_burst=burst, mesh=mesh,
+                                decode_strategy="greedy_search")
+        replicas = [
+            LocalReplica(ReplicaServer(engine), name="r0"),
+            LocalReplica(ReplicaServer(engine2), name="r1"),
+        ]
+        router = Router(replicas)
+        stats = router.stats()
+        t_router = time.perf_counter() - t0
+        assert [r["name"] for r in stats["replicas"]] == ["r0", "r1"]
+        result["router"] = {
+            "replicas": 2,
+            "policy": stats["policy"],
+            "admission": stats["admission"],
+            "router_build_s": round(t_router, 1),
+            "fleet_kv_pool_gb_total": round(2 * kv_bytes / 2**30, 2),
+            "fleet_per_device_gb": round(
+                2 * (pd["arguments"] + pd["outputs"] + pd["temps"])
+                / 2**30, 2),
+        }
     # merge by config key so a smoke run never clobbers the 7b row
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "SERVING_REHEARSAL.json")
