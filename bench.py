@@ -540,6 +540,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     replicas_n = int(os.environ.get("BENCH_SERVING_REPLICAS", "1"))
     if replicas_n > 1 and not on_tpu:
         return _bench_serving_router(jax, n_dev, replicas_n)
+    if os.environ.get("BENCH_SERVING_PREFIX", "") != "" and not on_tpu:
+        return _bench_serving_prefix(paddle, jax, n_dev)
     size = os.environ.get("BENCH_SERVING_MODEL", "base")
     if on_tpu and size == "3b":
         # 2.2B-param proxy for the row-5 LLaMA-2-7B intent: bf16 weights
@@ -663,6 +665,79 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     else:
         result["tpu_probe_error"] = PROBE_DIAG
         _attach_cached_evidence(result)
+    return result
+
+
+def _bench_serving_prefix(paddle, jax, n_dev):
+    """The shared-prefix serving row (ISSUE 15): N sequential requests
+    sharing a long system prompt, measuring mean TTFT (prefill + first
+    sample wall time) and the cached-token ratio. BENCH_SERVING_PREFIX
+    selects the arm (0 = cache-off baseline, 1 = prefix cache on);
+    BENCH_SERVING_CHUNK adds chunked prefill. `prefix_cache` and
+    `prefill_chunk` are comparability keys in bench_compare (absent ==
+    None, same rule as `replicas`), so arms never baseline each other.
+    CPU-only: the row measures recomputation avoided, not the chip."""
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pc = int(os.environ.get("BENCH_SERVING_PREFIX", "0") or 0)
+    chunk = int(os.environ.get("BENCH_SERVING_CHUNK", "0") or 0)
+    cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=2,
+                           seq=256)
+    page, shared_len, tail_len, n_req = 16, 96, 16, 6
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, max_batch=2,
+                           max_seq_len=shared_len + tail_len + page,
+                           page_size=page,
+                           decode_strategy="greedy_search",
+                           prefix_cache=pc, prefill_chunk=chunk)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, (shared_len,))
+    tails = [rng.randint(0, cfg.vocab_size, (tail_len,))
+             for _ in range(n_req + 2)]
+
+    def one(tail):
+        t0 = time.perf_counter()
+        rid = engine.add_request(np.concatenate([shared, tail]),
+                                 max_new_tokens=1)
+        finished = engine.run()
+        assert [f.request_id for f in finished] == [rid]
+        return time.perf_counter() - t0
+
+    # two priming requests: the first (cold) compiles the dense-prefill
+    # bucket and seeds the trie; the second compiles the suffix
+    # continuation program the timed hits will use
+    one(tails[0])
+    one(tails[1])
+    h0 = getattr(engine, "_prefix_hits_total", 0)
+    m0 = getattr(engine, "_prefix_misses_total", 0)
+    ttfts = [one(t) for t in tails[2:]]
+    hits = getattr(engine, "_prefix_hits_total", 0) - h0
+    misses = getattr(engine, "_prefix_misses_total", 0) - m0
+    ratio = round(hits / (hits + misses), 4) if hits + misses else 0.0
+    result = {
+        "metric": "serving_prefix_ttft_ms",
+        "value": round(sum(ttfts) / len(ttfts) * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": {"requests": n_req, "shared_len": shared_len,
+                  "tail_len": tail_len, "page_size": page,
+                  "prefix_cache": pc or None,
+                  "prefill_chunk": chunk or None,
+                  "cached_token_ratio": ratio,
+                  "cache_hit_tokens": hits, "cache_miss_tokens": misses,
+                  "ttft_p_max_ms": round(max(ttfts) * 1e3, 3),
+                  "devices": n_dev, "backend": jax.default_backend(),
+                  "replicas": 1, "router_policy": None}}
+    result["extra"].update(_observability_columns())
+    result["tpu_probe_error"] = PROBE_DIAG
+    _attach_cached_evidence(result)
     return result
 
 
@@ -814,7 +889,17 @@ def _piggyback_extra_configs():
             # measures process fan-out, not the chip)
             ("serving_router2",
              {"BENCH_CONFIG": "serving",
-              "BENCH_SERVING_REPLICAS": "2"})]
+              "BENCH_SERVING_REPLICAS": "2"}),
+            # the shared-prefix matrix (ISSUE 15): cache off baseline,
+            # cache on, cache on + chunked prefill — TTFT + cached-token
+            # ratio arms at identical geometry (CPU-only rows)
+            ("serving_prefix_off",
+             {"BENCH_CONFIG": "serving", "BENCH_SERVING_PREFIX": "0"}),
+            ("serving_prefix_on",
+             {"BENCH_CONFIG": "serving", "BENCH_SERVING_PREFIX": "1"}),
+            ("serving_prefix_chunk",
+             {"BENCH_CONFIG": "serving", "BENCH_SERVING_PREFIX": "1",
+              "BENCH_SERVING_CHUNK": "32"})]
     for name, env_over in jobs:
         remaining = deadline - _time.monotonic()
         if remaining <= 10:

@@ -391,9 +391,37 @@ define_flag("FLAGS_router_policy", "least_loaded",
             "Replica-choice policy of the serving router "
             "(inference/router.py): 'least_loaded' (default — lowest "
             "serving_load_score among ready replicas, the contract "
-            "documented on SloEngine.load_score) or 'round_robin'. "
+            "documented on SloEngine.load_score), 'round_robin', or "
+            "'cache_affinity' (rendezvous-hash the request's "
+            "page-aligned prompt prefix so repeat prefixes land on the "
+            "replica whose prefix cache owns the pages; requests "
+            "without a full-page prefix fall back to least-loaded). "
             "Replicas failing /readyz (mid-recovery, poisoned, KV "
-            "exhausted) drain automatically under either policy.")
+            "exhausted) drain automatically under every policy.")
+define_flag("FLAGS_prefix_cache", 0,
+            "Prefix-cache KV reuse for the serving engine "
+            "(inference/prefix_cache.py): when 1, freshly prefilled "
+            "FULL pages are cached in a content-addressed trie and "
+            "admission matches the longest page-aligned cached prefix, "
+            "sharing those pages (ref-counted) into the new slot's "
+            "block-table row so only the uncached suffix is prefilled. "
+            "Zero-ref pages are LRU-evicted under pool pressure. "
+            "Greedy output token streams are bit-identical to cache-off "
+            "decoding. 0 (default) = off. Engine kwarg prefix_cache "
+            "overrides. Incompatible with a separate draft_model.",
+            type_=int)
+define_flag("FLAGS_prefill_chunk", 0,
+            "Chunked-prefill token budget for the serving engine: when "
+            "> 0, prompt prefill (the uncached suffix, when "
+            "FLAGS_prefix_cache hits) runs in page-aligned chunks of at "
+            "most this many tokens through the paged window program, "
+            "interleaved with decode bursts — a long prefill no longer "
+            "stalls every in-flight request's ITL. The scheduler "
+            "policy's prefill_chunk_budget hook can shrink a step's "
+            "chunk (slo halves it under TTFT burn). 0 (default) = "
+            "dense one-shot prefill. Engine kwarg prefill_chunk "
+            "overrides. Incompatible with a separate draft_model.",
+            type_=int)
 define_flag("FLAGS_router_admission", True,
             "Router admission control: when every ready replica's "
             "fast TTFT burn alert is firing (or no replica is ready), "

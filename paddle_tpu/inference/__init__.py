@@ -15,8 +15,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..tensor import Tensor
+from .prefix_cache import PrefixCache, prefix_hash  # noqa: F401
 from .replica import ReplicaServer  # noqa: F401
-from .router import (DisaggregatedServing, HttpReplica,  # noqa: F401
+from .router import (CacheAffinityPolicy,  # noqa: F401
+                     DisaggregatedServing, HttpReplica,
                      LocalReplica, Router, RouterShed, auto_replicas)
 from .scheduler import (FifoSchedulerPolicy,  # noqa: F401
                         SchedulerPolicy, SloAwareSchedulerPolicy,

@@ -1,0 +1,186 @@
+"""Content-addressed prefix cache over the serving engine's KV pages.
+
+vLLM/SGLang-style shared-page prefix reuse (README.md "Prefix cache +
+chunked prefill"): a trie keyed on page-aligned token chunks maps
+`token prefix -> page list`, so admission can match the longest cached
+prefix, bump refcounts, and prefill only the uncached suffix. Sharing
+is FULL PAGES ONLY — a partially-filled tail page is never inserted,
+so a shared page is never written again (decode and prefill
+continuation always land at positions past the shared region; this is
+the copy-on-write guard by construction: the mutable tail is always a
+fresh, exclusively-owned page).
+
+Refcount accounting (the invariant tests/test_prefix_cache.py pins):
+the trie itself holds ONE reference on every page it caches, each slot
+row holds one reference per page in its block-table row, and
+``sum(page_refs) + len(free_pages) == n_pages`` at ALL times. A page
+whose only reference is the trie's (ref == 1) is "zero-ref" in the
+LRU sense — resident but reclaimable; ``evict(need)`` walks leaf
+nodes in least-recently-touched order, decrefs them back to the free
+list, and keeps hot prefixes resident under pool pressure.
+
+Node keys are the literal token tuples (exact, collision-free); the
+stable hash used by the router's ``cache_affinity`` policy lives in
+``prefix_hash`` so both sides agree on what "the prefix" is.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def prefix_hash(ids, page_size: int, max_pages: int = 4) -> Optional[int]:
+    """Stable 64-bit hash of a prompt's page-aligned prefix (at most
+    ``max_pages`` chunks) — the router's cache_affinity key. None when
+    the prompt is shorter than one full page (nothing shareable)."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    n = (len(ids) // page_size) * page_size
+    n = min(n, max_pages * page_size)
+    if n <= 0:
+        return None
+    dig = hashlib.blake2b(ids[:n].tobytes(), digest_size=8).digest()
+    return int.from_bytes(dig, "big")
+
+
+class _Node:
+    __slots__ = ("chunk", "page", "children", "parent", "tick")
+
+    def __init__(self, chunk: tuple, page: int, parent):
+        self.chunk = chunk
+        self.page = page
+        self.children: Dict[tuple, "_Node"] = {}
+        self.parent = parent
+        self.tick = 0
+
+
+class PrefixCache:
+    """The trie. Mutates the engine's ``page_refs``/``free_pages`` only
+    through the decref path of ``evict``/``clear`` — every incref it
+    takes (one per cached page, at insert) is visible in ``pages()``,
+    so the engine-level refcount invariant stays auditable."""
+
+    def __init__(self, page_size: int, page_refs: List[int],
+                 free_pages: List[int]):
+        self.page_size = page_size
+        self._refs = page_refs      # engine-owned, mutated in place
+        self._free = free_pages     # engine-owned, mutated in place
+        self._root: Dict[tuple, _Node] = {}
+        self._by_page: Dict[int, _Node] = {}
+        self._clock = 0
+        self.evictions = 0
+
+    # -- introspection -------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._by_page)
+
+    def pages(self) -> List[int]:
+        """Every page id the trie holds a reference on."""
+        return list(self._by_page)
+
+    def owns(self, page: int) -> bool:
+        return page in self._by_page
+
+    def evictable(self) -> int:
+        """Pages whose ONLY reference is the trie's — the soft-free
+        headroom admission may count on top of the free list (evicting
+        a parent requires evicting its children first, but every
+        ref==1 page is transitively reclaimable)."""
+        return sum(1 for p in self._by_page if self._refs[p] == 1)
+
+    def _chunks(self, ctx, n_pages: int):
+        ps = self.page_size
+        for j in range(n_pages):
+            yield tuple(int(t) for t in ctx[j * ps:(j + 1) * ps])
+
+    # -- match / insert ------------------------------------------------
+    def match(self, ctx) -> Tuple[List[int], int]:
+        """Longest cached page-aligned prefix of ``ctx``: returns
+        (page ids, tokens covered). Capped at len(ctx) - 1 tokens —
+        at least one suffix token is always recomputed so the first
+        sampled token has logits to come from (the vLLM convention).
+        Touches the matched path's LRU ticks; takes NO references —
+        the engine increfs the pages it commits to a slot row."""
+        max_pages = (len(ctx) - 1) // self.page_size
+        pages: List[int] = []
+        self._clock += 1
+        level = self._root
+        for chunk in self._chunks(ctx, max_pages):
+            node = level.get(chunk)
+            if node is None:
+                break
+            node.tick = self._clock
+            pages.append(node.page)
+            level = node.children
+        return pages, len(pages) * self.page_size
+
+    def insert(self, ctx, page_row) -> int:
+        """Cache the FULL pages of a freshly prefilled context:
+        ``page_row[j]`` holds tokens ctx[j*ps:(j+1)*ps]. Existing nodes
+        are kept (first writer wins — the duplicate page stays the
+        slot's exclusive copy); each NEW node takes the trie's
+        reference on its page. Returns the number of pages newly
+        cached."""
+        n_pages = len(ctx) // self.page_size
+        self._clock += 1
+        level = self._root
+        parent = None
+        added = 0
+        for j, chunk in enumerate(self._chunks(ctx, n_pages)):
+            node = level.get(chunk)
+            if node is None:
+                page = int(page_row[j])
+                if page in self._by_page:
+                    # the page already caches a DIFFERENT path (cannot
+                    # happen from engine flow — defensive): stop here
+                    break
+                node = _Node(chunk, page, parent)
+                level[chunk] = node
+                self._by_page[page] = node
+                self._refs[page] += 1
+                added += 1
+            node.tick = self._clock
+            parent = node
+            level = node.children
+        return added
+
+    # -- eviction ------------------------------------------------------
+    def evict(self, need: int) -> int:
+        """Free at least ``need`` pages by dropping least-recently-
+        touched leaf nodes whose page has no slot reference (ref == 1).
+        Returns pages actually freed (may be < need when everything
+        left is pinned by live slots)."""
+        freed = 0
+        while freed < need:
+            victim = None
+            for node in self._by_page.values():
+                if node.children or self._refs[node.page] != 1:
+                    continue
+                if victim is None or node.tick < victim.tick:
+                    victim = node
+            if victim is None:
+                break
+            self._drop(victim)
+            freed += 1
+            self.evictions += 1
+        return freed
+
+    def _drop(self, node: _Node):
+        level = node.parent.children if node.parent is not None \
+            else self._root
+        level.pop(node.chunk, None)
+        self._by_page.pop(node.page, None)
+        self._refs[node.page] -= 1
+        if self._refs[node.page] == 0:
+            self._free.append(node.page)
+
+    def clear(self) -> int:
+        """Drop every node WITHOUT touching refs/free (the engine's
+        recovery path rebuilds the pools and resets the accounting
+        wholesale — decref'ing into a list about to be reset would
+        double-count). Returns the number of nodes dropped."""
+        n = len(self._by_page)
+        self._root = {}
+        self._by_page = {}
+        return n

@@ -41,6 +41,8 @@ engine between steps (``ServingEngine.detach_request`` /
 """
 from __future__ import annotations
 
+import hashlib
+import inspect
 import json
 import threading
 import time as _time_mod
@@ -54,6 +56,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import metrics as _om
 from ..observability import slo as _slo
 from ..observability import tracing as _trace
+from .prefix_cache import prefix_hash as _prefix_hash
 
 
 class RouterShed(Exception):
@@ -257,7 +260,11 @@ class RouterPolicy:
     def choose(self, ready: List[BaseReplica],
                stats: Dict[str, dict]) -> BaseReplica:
         """Pick from `ready` (never empty); `stats[name]` holds each
-        candidate's probe snapshot."""
+        candidate's probe snapshot. A policy that declares a THIRD
+        parameter (``choose(ready, stats, request)``) also receives the
+        request dict being routed (prompt_ids et al) — the router
+        inspects the signature once at construction, so two-argument
+        policies keep working unchanged."""
         raise NotImplementedError
 
 
@@ -295,8 +302,49 @@ class RoundRobinPolicy(RouterPolicy):
         return r
 
 
+class CacheAffinityPolicy(LeastLoadedPolicy):
+    """Prefix-affinity routing (FLAGS_router_policy="cache_affinity"):
+    requests sharing a page-aligned prompt prefix land on the SAME
+    replica, so that replica's prefix cache (FLAGS_prefix_cache) owns
+    the shared pages and repeat prefixes hit instead of re-prefilling
+    N times across N replicas.
+
+    Rendezvous (highest-random-weight) hashing over the READY replicas,
+    keyed on ``prefix_cache.prefix_hash(prompt_ids)``: every replica
+    scores hash(prefix_key, replica_name) and the max wins — stable
+    under churn (a replica draining into recovery only moves ITS
+    prefixes; the rest keep their owner, unlike modulo hashing).
+    Requests with no full-page prefix fall back to least-loaded.
+
+    ``page_size`` sets the affinity granularity (tokens per hashed
+    chunk) and should match the engines' page_size; a mismatch only
+    coarsens/splits affinity buckets, never misroutes."""
+
+    name = "cache_affinity"
+    _MAX_PAGES = 4  # hash at most this many leading chunks
+
+    def __init__(self, page_size: Optional[int] = None):
+        super().__init__()
+        self.page_size = int(page_size) if page_size is not None else 16
+
+    def choose(self, ready, stats, request=None):
+        ids = request.get("prompt_ids") \
+            if isinstance(request, dict) else None
+        key = _prefix_hash(ids, self.page_size, self._MAX_PAGES) \
+            if ids else None
+        if key is None:
+            return super().choose(ready, stats)
+
+        def _weight(r):
+            return hashlib.blake2b(
+                f"{key}:{r.name}".encode(), digest_size=8).digest()
+
+        return max(ready, key=_weight)
+
+
 _ROUTER_POLICIES = {cls.name: cls
-                    for cls in (LeastLoadedPolicy, RoundRobinPolicy)}
+                    for cls in (LeastLoadedPolicy, RoundRobinPolicy,
+                                CacheAffinityPolicy)}
 
 
 def resolve_router_policy(policy=None) -> RouterPolicy:
@@ -386,6 +434,14 @@ class Router:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._policy_lock = threading.Lock()
+        # request-aware policies (cache_affinity) declare a third
+        # choose() parameter; inspect ONCE so the dispatch path stays
+        # a plain call either way
+        try:
+            self._policy_takes_request = len(inspect.signature(
+                self.policy.choose).parameters) >= 3
+        except (TypeError, ValueError):
+            self._policy_takes_request = False
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Router":
@@ -458,7 +514,8 @@ class Router:
         return t.result(timeout=timeout or self.request_timeout_s + 10)
 
     # -- dispatch -----------------------------------------------------
-    def _pick(self, deadline: float) -> Optional[BaseReplica]:
+    def _pick(self, deadline: float,
+              request: Optional[dict] = None) -> Optional[BaseReplica]:
         """Wait (bounded) for a ready replica, then apply the policy.
         Replicas mid-recovery fail /readyz and drain automatically —
         they reappear here the moment the rebuilt engine re-admits."""
@@ -466,6 +523,8 @@ class Router:
             ready, stats = self._ready_stats()
             if ready:
                 with self._policy_lock:
+                    if self._policy_takes_request:
+                        return self.policy.choose(ready, stats, request)
                     return self.policy.choose(ready, stats)
             if _time_mod.monotonic() >= deadline:
                 return None
@@ -495,7 +554,7 @@ class Router:
 
     def _dispatch(self, ticket: _Ticket):
         deadline = _time_mod.monotonic() + self.request_timeout_s
-        replica = self._pick(deadline)
+        replica = self._pick(deadline, ticket.request)
         if replica is None:
             self._m.requests.labels("failed").inc()
             ticket.trace.finish(error="no ready replica")

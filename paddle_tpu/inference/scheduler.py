@@ -11,6 +11,8 @@ behind ``SchedulerPolicy``:
   3. prefill packing   — the (batch, token) bucket a group of admitted
                          prompts compiles/pads into
   4. burst sizing      — the scan length of this decode dispatch
+  5. chunk budgeting   — the token width of this step's chunked-prefill
+                         continuation round (FLAGS_prefill_chunk)
 
 ``FifoSchedulerPolicy`` (the default, FLAGS_scheduler_policy="fifo")
 reproduces the pre-extraction engine bit-identically: strict
@@ -60,11 +62,17 @@ class SchedulerPolicy:
     @staticmethod
     def _fits(engine, entry) -> bool:
         """Admission takes only the context's pages (on-demand growth
-        covers decode) — same arithmetic as the engine's commit path."""
+        covers decode) — same arithmetic as the engine's commit path.
+        Counts prefix-cache evictable pages as available (the engine
+        reclaims them at commit); falls back to the raw free list for
+        engines without the accounting (test doubles)."""
         _rid, ids, _max_new, prior = entry
         ctx_len = len(ids) + len(prior)
         need = -(-ctx_len // engine.page_size)
-        return len(engine._free_pages) >= need
+        avail = engine._avail_pages() \
+            if hasattr(engine, "_avail_pages") \
+            else len(engine._free_pages)
+        return avail >= need
 
     # -- preemption ---------------------------------------------------
     def select_victim(self, engine, candidates: Sequence[int],
@@ -106,6 +114,18 @@ class SchedulerPolicy:
         if engine.decode_burst > 1 and max(rem_of.values()) > 1:
             return engine.decode_burst
         return 1
+
+    # -- chunk budgeting ----------------------------------------------
+    def prefill_chunk_budget(self, engine,
+                             prefilling: Sequence[int]) -> int:
+        """Token width of this step's chunked-prefill continuation
+        round (``prefilling`` = the slot indices mid-prefill). The
+        engine page-aligns and clamps the return to
+        [page_size, engine.prefill_chunk]; one compiled program exists
+        per distinct width, so a policy varying it trades suffix
+        latency against compile-cache pressure. Default: the
+        configured budget."""
+        return engine.prefill_chunk
 
 
 class FifoSchedulerPolicy(SchedulerPolicy):
@@ -175,6 +195,16 @@ class SloAwareSchedulerPolicy(SchedulerPolicy):
             return (rem, s.admit_seq)
 
         return max(candidates, key=_key)
+
+    def prefill_chunk_budget(self, engine,
+                             prefilling: Sequence[int]) -> int:
+        """Halve the chunk width (floor one page) while the TTFT burn
+        alert fires: smaller chunks yield the interleaved decode rounds
+        more often, trading suffix-prefill latency for the in-flight
+        requests' ITL exactly when the latency budget is burning."""
+        if self._ttft_burning():
+            return max(engine.page_size, engine.prefill_chunk // 2)
+        return engine.prefill_chunk
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,7 @@ from ..observability import slo as _slo
 from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
+from . import prefix_cache as _pc
 from . import scheduler as _sched
 
 
@@ -47,7 +48,8 @@ class _EngineMetrics:
                  "prefill_misses", "preemptions", "aborts", "tokens",
                  "finished", "poisoned", "errors", "recoveries",
                  "kv_occupancy", "kv_frag", "kv_free", "spec_proposed",
-                 "spec_accepted", "spec_acceptance")
+                 "spec_accepted", "spec_acceptance", "cache_hits",
+                 "cache_misses", "cache_evictions", "cached_ratio")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -156,6 +158,26 @@ class _EngineMetrics:
             "Per-request draft acceptance rate observed at request "
             "finish (accepted / proposed over the request's life).",
             buckets=_memwatch.RATIO_BUCKETS)
+        # prefix cache (FLAGS_prefix_cache): token-level reuse economics.
+        # hit rate = hits / (hits + misses) — the fleet report's per-rank
+        # cache_hit% column; counters only move while the cache is on
+        self.cache_hits = reg.counter(
+            "serving_prefix_cache_hits_total",
+            "Prompt tokens served from the prefix cache at admission "
+            "(page-aligned shared-page reuse; their prefill is skipped).")
+        self.cache_misses = reg.counter(
+            "serving_prefix_cache_misses_total",
+            "Prompt tokens NOT covered by a cached prefix at admission "
+            "(the suffix the engine actually prefills).")
+        self.cache_evictions = reg.counter(
+            "serving_prefix_cache_evictions_total",
+            "Cached KV pages evicted under pool pressure (zero-ref LRU; "
+            "recovery cache drops count here too).")
+        self.cached_ratio = reg.histogram(
+            "serving_prefix_cached_token_ratio",
+            "Per-request fraction of the prompt served from the prefix "
+            "cache, observed at admission (0.0 rows are cold misses).",
+            buckets=_memwatch.RATIO_BUCKETS)
 
 
 @dataclass
@@ -175,6 +197,14 @@ class _Slot:
     # observed at finish; reset at admission)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # chunked-prefill continuation: while `prefilling` the slot owns its
+    # pages and a PARTIAL context (context_len < len(_pf_ctx)) and is
+    # excluded from decode dispatches; _prefill_chunk_round advances it
+    # one scheduler-budgeted chunk per step until the suffix completes
+    prefilling: bool = False
+    _pf_ctx: object = None        # full target context (np int64)
+    _pf_chunks_done: int = 0
+    _pf_n_chunks: int = 0         # estimate at admission (trace attrs)
     # per-request sampling: only the greedy flag lives on the slot (the
     # all-greedy fast path reads it every step); numeric params stay in
     # ServingEngine._req_params — ONE source of truth across preemption
@@ -233,7 +263,8 @@ class ServingEngine:
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0, mesh=None,
                  decode_burst=1, kv_cache_quant=None, async_depth=0,
                  spec_decode=None, spec_draft_layers=None,
-                 draft_model=None, scheduler=None):
+                 draft_model=None, scheduler=None, prefix_cache=None,
+                 prefill_chunk=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         max_pos = getattr(model.config, "max_position_embeddings", None)
@@ -267,6 +298,13 @@ class ServingEngine:
         self.eos_token_id = eos_token_id
         n_pages = max_batch * self.pages_per_seq
         self._free_pages = list(range(n_pages))
+        # per-page reference counts: one ref per slot block-table entry
+        # plus one per prefix-trie node. The pool invariant
+        # sum(_page_refs) + len(_free_pages) == n_pages holds between
+        # steps whether or not the prefix cache is on (cache off: every
+        # allocated page's ref is exactly 1 and the alloc/free order
+        # matches the old exclusive-ownership pop/extend bit for bit).
+        self._page_refs = [0] * n_pages
         L = self.cfg.num_hidden_layers
         # GPT-family configs have no GQA field: kv heads == heads
         kvh = getattr(self.cfg, "num_key_value_heads",
@@ -425,6 +463,38 @@ class ServingEngine:
                 place_model(self._draft_model, self.mesh)
         else:
             self._draft_k_pages = self._draft_v_pages = None
+        # prefix-cache KV reuse + chunked prefill (README.md "Prefix
+        # cache + chunked prefill"): prefix_cache=1 shares page-aligned
+        # prompt-prefix pages across requests via a refcounted trie;
+        # prefill_chunk=N runs every prefill suffix in N-token window
+        # chunks interleaved with decode. Greedy token streams stay
+        # bit-identical to cache-off dense prefill either way.
+        pc = prefix_cache if prefix_cache is not None \
+            else _config.get_flag("FLAGS_prefix_cache", 0)
+        self.prefix_cache_enabled = bool(int(pc))
+        ck = prefill_chunk if prefill_chunk is not None \
+            else _config.get_flag("FLAGS_prefill_chunk", 0)
+        ck = int(ck)
+        # page-align the chunk budget: continuation scatters land full
+        # window positions into pages, so a ragged budget buys nothing
+        self.prefill_chunk = -(-ck // page_size) * page_size \
+            if ck > 0 else 0
+        if (self.prefix_cache_enabled or self.prefill_chunk) and \
+                self._draft_model is not None:
+            raise ValueError(
+                "prefix_cache / prefill_chunk cannot serve with a "
+                "separate draft_model: the chunked continuation fills "
+                "only the target's pages, so the draft pools would "
+                "decode against an unwritten prompt (shallow-exit "
+                "spec_decode shares the target pages and composes fine)")
+        self._prefix_cache = _pc.PrefixCache(
+            page_size, self._page_refs, self._free_pages) \
+            if self.prefix_cache_enabled else None
+        self._chunk_fns: Dict[tuple, object] = {}
+        # host-side token tallies for /statusz + bench (the metric
+        # counters are registry-global; these are THIS engine's)
+        self._prefix_hits_total = 0
+        self._prefix_misses_total = 0
         # params pytree cached across steps (round-2 verdict weak #5:
         # rebuilding it every decode step); call refresh_params() after
         # mutating model weights
@@ -595,7 +665,24 @@ class ServingEngine:
             ctx = np.concatenate([ids, np.asarray(prior, np.int64)]) \
                 if prior else ids
             need = -(-len(ctx) // self.page_size)  # ceil: prompt pages only
-            if len(self._free_pages) < need:
+            # prefix-cache match: take TENTATIVE slot refs on the
+            # matched pages first, so the LRU reclaim below can never
+            # evict the very pages this admission is about to reuse
+            cached_pages: List[int] = []
+            cached_tokens = 0
+            if self._prefix_cache is not None:
+                cached_pages, cached_tokens = \
+                    self._prefix_cache.match(ctx)
+                for p in cached_pages:
+                    self._page_refs[p] += 1
+            need_fresh = need - len(cached_pages)
+            if len(self._free_pages) < need_fresh:
+                self._reclaim_pages(need_fresh - len(self._free_pages))
+            if len(self._free_pages) < need_fresh:
+                for p in cached_pages:
+                    # roll back the tentative refs; the trie's own refs
+                    # keep the matched pages resident
+                    self._page_refs[p] -= 1
                 break
             self._pending.pop(pick)
             rp = self._req_params.get(rid)
@@ -607,29 +694,63 @@ class ServingEngine:
                 rp["qw_seen"] = True
                 self._m.queue_wait.observe(
                     _time_mod.perf_counter() - rp["t_enq"])
-            pages = [self._free_pages.pop() for _ in range(need)]
+            pages = cached_pages + [self._alloc_page()
+                                    for _ in range(need_fresh)]
             self.block_tables[slot_idx, :need] = np.asarray(pages, np.int32)
             s = self.slots[slot_idx]
             s.request_id, s.tokens = rid, list(prior)
             s.prompt_len = len(ids)
-            s.context_len = len(ctx)
             s.max_new_tokens = max_new
             s.n_pages = need
             s.greedy = self._req_params[rid]["greedy"]
             s.admit_seq = self._admit_seq
             self._admit_seq += 1
-            s.needs_first_sample = True
             s.spec_proposed = 0
             s.spec_accepted = 0
+            s._pf_chunks_done = 0
+            if self._prefix_cache is not None:
+                # token-level cache economics, observed at admission
+                suffix = len(ctx) - cached_tokens
+                self._prefix_hits_total += cached_tokens
+                self._prefix_misses_total += suffix
+                self._m.cache_hits.inc(cached_tokens)
+                self._m.cache_misses.inc(suffix)
+                self._m.cached_ratio.observe(cached_tokens / len(ctx))
+            if cached_tokens:
+                _flight.record_event("serving.prefix_cache_hit",
+                                     rid=rid, cached=cached_tokens,
+                                     ctx=len(ctx))
+            if self.prefill_chunk or cached_tokens:
+                # chunked-prefill / cache-continuation route: only the
+                # uncached suffix runs, in window-mode chunks
+                # (_prefill_chunk_round), interleaved with decode; the
+                # slot stays out of decode until the suffix completes
+                s.context_len = cached_tokens
+                s.prefilling = True
+                s._pf_ctx = ctx
+                s.needs_first_sample = False
+                cw = self.prefill_chunk or \
+                    -(-(len(ctx) - cached_tokens) // self.page_size) \
+                    * self.page_size
+                s._pf_n_chunks = -(-(len(ctx) - cached_tokens) // cw)
+            else:
+                s.context_len = len(ctx)
+                s.prefilling = False
+                s.needs_first_sample = True
+                new.append((slot_idx, ctx))
             s.active = True
             if self._traces:
                 tr = self._traces.get(rid)
                 if tr is not None:
                     # close the queue phase; the prefill span follows in
-                    # _prefill_batch on the same request track
+                    # _prefill_batch / _prefill_chunk_round on the same
+                    # request track
                     tr.end("serving.queue", slot=slot_idx)
+                    if cached_tokens:
+                        tr.instant("serving.prefix_cache_hit",
+                                   cached=cached_tokens,
+                                   prompt=len(ctx))
                     s.trace_id = tr.trace_id
-            new.append((slot_idx, ctx))
         self._m.queue_depth.set(len(self._pending))
         if new:
             self._prefill_batch(new)
@@ -747,14 +868,56 @@ class ServingEngine:
         if cb is not None:
             cb(rid, int(token))
 
+    # ------------------------------------------------------------------
+    # page accounting: alloc takes a ref, release decrefs — a page
+    # reaches the free list only at refcount zero, so a prefix page
+    # shared with the trie (or gathered into another slot's row) is
+    # never double-freed by finish/preempt/abort/OOM-preemption
+    # ------------------------------------------------------------------
+    def _alloc_page(self) -> int:
+        page = self._free_pages.pop()
+        self._page_refs[page] += 1
+        return page
+
+    def _decref_page(self, page):
+        page = int(page)
+        self._page_refs[page] -= 1
+        if self._page_refs[page] == 0:
+            self._free_pages.append(page)
+
+    def _avail_pages(self) -> int:
+        """Pages admission may count on: free now plus evictable from
+        the prefix cache (zero-ref LRU residents the reclaim below can
+        free on demand). == len(_free_pages) when the cache is off."""
+        n = len(self._free_pages)
+        if self._prefix_cache is not None:
+            n += self._prefix_cache.evictable()
+        return n
+
+    def _reclaim_pages(self, need: int) -> int:
+        """Evict up to `need` zero-ref cached pages back to the free
+        list (LRU); returns pages actually freed."""
+        if self._prefix_cache is None or need <= 0:
+            return 0
+        freed = self._prefix_cache.evict(need)
+        if freed:
+            self._m.cache_evictions.inc(freed)
+            _flight.record_event("serving.prefix_cache_evict",
+                                 pages=freed)
+        return freed
+
     def _release_slot(self, slot_idx):
-        """Return a slot's pages to the pool and deactivate it (shared by
-        finish / preempt / abort)."""
+        """Decref a slot's pages and deactivate it (shared by finish /
+        preempt / abort / OOM preemption). Pages whose refcount drops to
+        zero return to the pool; pages the prefix trie still caches stay
+        resident for the next matching admission."""
         s = self.slots[slot_idx]
-        self._free_pages.extend(
-            self.block_tables[slot_idx, :s.n_pages].tolist())
+        for page in self.block_tables[slot_idx, :s.n_pages].tolist():
+            self._decref_page(page)
         s.n_pages = 0
         s.active = False
+        s.prefilling = False
+        s._pf_ctx = None
         s.trace_id = -1  # don't leak the id into the slot's next tenant
         self._release_gen += 1
 
@@ -814,9 +977,9 @@ class ServingEngine:
         s = self.slots[slot_idx]
         need = -(-(s.context_len + steps) // self.page_size)
         while s.n_pages < need:
-            if not self._free_pages:
+            if not self._free_pages and not self._reclaim_pages(1):
                 return False
-            self.block_tables[slot_idx, s.n_pages] = self._free_pages.pop()
+            self.block_tables[slot_idx, s.n_pages] = self._alloc_page()
             s.n_pages += 1
         return True
 
@@ -979,6 +1142,12 @@ class ServingEngine:
         # re-pin: the eager scatter can drop the kv-head tp sharding, and
         # the decode jit donates pages in this layout
         self._pin_pages()
+        if self._prefix_cache is not None:
+            # cache the freshly prefilled FULL pages; the partial tail
+            # page never enters the trie (the copy-on-write guard —
+            # decode keeps appending to it exclusively)
+            for si, ids in new:
+                self._prefix_cache.insert(ids, self.block_tables[si])
         first_np = np.asarray(first)  # [nb] ints — tiny transfer
         for row, (si, _) in enumerate(new):
             self.slots[si]._first_token = int(first_np[row])
@@ -992,6 +1161,171 @@ class ServingEngine:
                 if tr is not None:
                     tr.emit("serving.prefill", t0_prefill, t1_prefill,
                             bucket=bucket, nb=nb, prompt_len=len(ids))
+
+    # ------------------------------------------------------------------
+    # chunked prefill: the uncached suffix streams through the model's
+    # paged window mode (paged_step s>1) in scheduler-budgeted chunks,
+    # interleaved with decode bursts — a long prefill no longer
+    # head-of-line-blocks every in-flight request's ITL
+    # ------------------------------------------------------------------
+    def _get_chunk_fn(self, width, all_greedy):
+        """One compiled prefill-continuation per (chunk width,
+        all-greedy?) at the full max_batch geometry: a [B, width] token
+        window lands at positions lens..lens+width-1 of the paged cache
+        (limit_lens masks each row's real take; inactive rows drop
+        their writes), and the last real position's logits sample a
+        first token — consumed only when a row's suffix completes."""
+        fn = self._chunk_fns.get((width, all_greedy))
+        if fn is not None:
+            return fn
+        _flight.record_event("serving.prefill_chunk_compile",
+                             width=width, all_greedy=all_greedy)
+        model = self.model
+        serving_mesh = self.mesh
+        from ..jit.api import _LayerScope
+        from ..models.generation import (sample_logits,
+                                         sample_logits_per_row)
+
+        def pure_chunk(params, buffers, k_pages, v_pages, k_scales,
+                       v_scales, win, tables, lens, active, limit, seed,
+                       greedy, temp, tk, tp):
+            with _tape.no_grad(), _LayerScope(model, params, buffers):
+                caches = list(zip(k_pages, v_pages, k_scales,
+                                  v_scales)) if k_scales \
+                    else list(zip(k_pages, v_pages))
+                logits, new_caches = model.forward_paged(
+                    Tensor(win), caches, tables, lens, active=active,
+                    mesh=serving_mesh, limit_lens=limit)
+                # last REAL position per row: limit - lens - 1 (clip
+                # covers inactive rows, where limit == lens == 0)
+                pos = jnp.clip(limit - lens - 1, 0, width - 1)
+                last = as_array(logits)[
+                    jnp.arange(win.shape[0]), pos, :]
+                key = jax.random.wrap_key_data(seed)
+                if all_greedy:
+                    first, _ = sample_logits(last, key, "greedy_search")
+                else:
+                    first, _ = sample_logits_per_row(last, key, greedy,
+                                                     temp, tk, tp)
+                nk = tuple(as_array(c[0]) for c in new_caches)
+                nv = tuple(as_array(c[1]) for c in new_caches)
+                nks = tuple(as_array(c[2])
+                            for c in new_caches) if k_scales else ()
+                nvs = tuple(as_array(c[3])
+                            for c in new_caches) if k_scales else ()
+            return first, nk, nv, nks, nvs
+
+        fn = self._chunk_fns[(width, all_greedy)] = _cw.watch_jit(
+            "serving.prefill_chunk",
+            jax.jit(pure_chunk, donate_argnums=(2, 3, 4, 5)),
+            tag=(width, all_greedy))
+        return fn
+
+    def _prefill_chunk_round(self, pf):
+        """One continuation chunk for every prefilling slot in a single
+        compiled window dispatch. Chunk width is the scheduler's
+        prefill_chunk_budget call (page-aligned; slo_aware shrinks it
+        under TTFT burn); with chunking OFF (a pure cache-hit
+        continuation) one chunk covers the longest remaining suffix.
+        The final chunk's sampled first token hands off to the standard
+        first-token commit path in the SAME step, so a single-chunk
+        continuation keeps dense-prefill TTFT timing. Admission already
+        allocated every prompt page, so no growth happens here."""
+        rem = {i: len(self.slots[i]._pf_ctx) - self.slots[i].context_len
+               for i in pf}
+        if self.prefill_chunk:
+            c = int(self.scheduler.prefill_chunk_budget(self, pf))
+            c = max(self.page_size, min(c, self.prefill_chunk))
+        else:
+            c = max(rem.values())
+        c = -(-c // self.page_size) * self.page_size
+        all_greedy = all(self.slots[i].greedy for i in pf)
+        fn = self._get_chunk_fn(c, all_greedy)
+        params, buffers = self._cached_params()
+        B = self.max_batch
+        win = np.zeros((B, c), np.int64)
+        lens = np.zeros((B,), np.int32)
+        limit = np.zeros((B,), np.int32)
+        act = np.zeros((B,), bool)
+        greedy = np.ones((B,), bool)
+        temp = np.ones((B,), np.float32)
+        tk = np.zeros((B,), np.int32)
+        tp_arr = np.ones((B,), np.float32)
+        for i in pf:
+            s = self.slots[i]
+            take = min(c, rem[i])
+            win[i, :take] = s._pf_ctx[s.context_len:s.context_len + take]
+            lens[i] = s.context_len
+            limit[i] = s.context_len + take
+            act[i] = True
+            rp = self._req_params[s.request_id]
+            greedy[i] = rp["greedy"]
+            temp[i] = rp["temperature"]
+            tk[i] = rp["top_k"]
+            tp_arr[i] = rp["top_p"]
+        self._key, sk = jax.random.split(self._key)
+        t0 = _time_mod.perf_counter()
+        led = _stepledger.begin()
+        try:
+            # arg prep inside the try: transfer-time OOM must reach the
+            # forensics + preempt-retry path (same rule as decode)
+            chunk_args = (
+                params, buffers, tuple(self.k_pages),
+                tuple(self.v_pages), tuple(self.k_scales or ()),
+                tuple(self.v_scales or ()), jnp.asarray(win),
+                jnp.asarray(self.block_tables), jnp.asarray(lens),
+                jnp.asarray(act), jnp.asarray(limit),
+                jax.random.key_data(sk), jnp.asarray(greedy),
+                jnp.asarray(temp), jnp.asarray(tk),
+                jnp.asarray(tp_arr))
+            first, nk, nv, nks, nvs = fn(*chunk_args)
+        except BaseException as e:
+            if _memwatch.is_oom(e) and \
+                    self._handle_decode_oom(e, "prefill_chunk"):
+                return
+            self._poison_if_donated(
+                "prefill chunk fn raised after donating the KV pages",
+                self.k_pages, self.v_pages)
+            raise
+        if led is not None:
+            _stepledger.end(led, "serving.prefill_chunk",
+                            _time_mod.perf_counter(),
+                            out=(nk, nv, first))
+            _stepledger.register_from_lowered(
+                "serving.prefill_chunk", fn, chunk_args,
+                quant=self._quant_algo,
+                quant_bytes_delta=self._quant_bytes_correction())
+        self.k_pages, self.v_pages = list(nk), list(nv)
+        if self.k_scales is not None:
+            self.k_scales, self.v_scales = list(nks), list(nvs)
+        first_np = np.asarray(first)
+        t1 = _time_mod.perf_counter()
+        for i in pf:
+            s = self.slots[i]
+            if not s.active or not s.prefilling:
+                continue
+            take = min(c, rem[i])
+            s.context_len += take
+            s._pf_chunks_done += 1
+            if self._traces:
+                tr = self._traces.get(s.request_id)
+                if tr is not None:
+                    tr.emit("serving.prefill_chunk", t0, t1,
+                            chunk=s._pf_chunks_done,
+                            n_chunks=s._pf_n_chunks, width=c,
+                            tokens=take)
+            if s.context_len >= len(s._pf_ctx):
+                # suffix complete: cache the full pages, then hand the
+                # sampled first token to the standard commit path
+                if self._prefix_cache is not None:
+                    self._prefix_cache.insert(s._pf_ctx,
+                                              self.block_tables[i])
+                s._first_token = int(first_np[i])
+                s.needs_first_sample = True
+                s.prefilling = False
+                s._pf_ctx = None
+        _flight.record_event("serving.prefill_chunk", n=len(pf),
+                             width=c)
 
     # ------------------------------------------------------------------
     # decode step: one jitted forward for all slots
@@ -1563,12 +1897,27 @@ class ServingEngine:
         free = len(self._free_pages)
         self._m.kv_free.set(free)
         self._m.kv_occupancy.observe(1.0 - free / self._n_pages_total)
-        alloc_tokens = 0
-        used_tokens = 0
-        for s in self.slots:
-            if s.active:
-                alloc_tokens += s.n_pages * self.page_size
-                used_tokens += s.context_len
+        # fragmentation over UNIQUE pages: a prefix page shared by N
+        # slots is one page of capacity holding one page of tokens —
+        # the per-slot sum would count it N times and overstate both
+        # sides (identical to the old per-slot sums when nothing is
+        # shared). Trie-only residents hold full cached pages.
+        seen: Dict[int, int] = {}
+        for i, s in enumerate(self.slots):
+            if not s.active:
+                continue
+            for j, pid in enumerate(
+                    self.block_tables[i, :s.n_pages].tolist()):
+                filled = min(self.page_size,
+                             max(s.context_len - j * self.page_size, 0))
+                if filled > seen.get(pid, -1):
+                    seen[pid] = filled
+        if self._prefix_cache is not None:
+            for pid in self._prefix_cache.pages():
+                if pid not in seen:
+                    seen[pid] = self.page_size
+        alloc_tokens = len(seen) * self.page_size
+        used_tokens = sum(seen.values())
         self._m.kv_frag.observe(
             1.0 - used_tokens / alloc_tokens if alloc_tokens else 0.0)
         _memwatch.sample()
@@ -1594,6 +1943,11 @@ class ServingEngine:
                 f"{s.n_pages} pages (waste {waste} tok), "
                 f"admit_seq {s.admit_seq}, tokens {len(s.tokens)}/"
                 f"{s.max_new_tokens}, pages {pages}")
+        if self._prefix_cache is not None:
+            lines.append(
+                f"prefix cache: {len(self._prefix_cache)} pages cached, "
+                f"{self._prefix_cache.evictable()} evictable, "
+                f"{self._prefix_cache.evictions} evicted")
         lines.append(f"pending queue: {len(self._pending)} request(s)")
         return "\n".join(lines)
 
@@ -1663,6 +2017,8 @@ class ServingEngine:
                 # from a table we are about to wipe onto the free list
                 s.active = False
                 s.n_pages = 0
+                s.prefilling = False
+                s._pf_ctx = None
                 s.trace_id = -1
             # rebuild: fresh pools — the old lists may hold deleted
             # buffers, and even live ones hold KV for contexts that
@@ -1708,6 +2064,19 @@ class ServingEngine:
                     jnp.zeros((dkvh, n_pages, self.page_size, dhd),
                               d_dtype) for _ in range(dL)]
             self._free_pages = list(range(n_pages))
+            self._page_refs = [0] * n_pages
+            if self._prefix_cache is not None:
+                # drop the cache wholesale: its nodes name pages of the
+                # pools just rebuilt; clear() leaves refs/free alone
+                # (both were reset above) and the trie rebinds to the
+                # NEW accounting lists
+                dropped = self._prefix_cache.clear()
+                self._prefix_cache = _pc.PrefixCache(
+                    self.page_size, self._page_refs, self._free_pages)
+                if dropped:
+                    self._m.cache_evictions.inc(dropped)
+                    _flight.record_event("serving.prefix_cache_drop",
+                                         pages=dropped)
             self.block_tables[:] = 0
             self._release_gen += 1
             self._oom_retried = False
@@ -1764,7 +2133,16 @@ class ServingEngine:
         finished this step."""
         self._check_poisoned()
         self._admit()  # batched prefill of everything admissible
-        active = [i for i, s in enumerate(self.slots) if s.active]
+        # chunked-prefill continuation: each prefilling slot advances
+        # one chunk per step, INTERLEAVED with the decode dispatch below
+        pf = [i for i, s in enumerate(self.slots)
+              if s.active and s.prefilling]
+        if pf:
+            self._prefill_chunk_round(pf)
+        # prefilling slots are excluded from decode (their context is
+        # partial and they have no last token yet)
+        active = [i for i, s in enumerate(self.slots)
+                  if s.active and not s.prefilling]
         if not active:
             return []
         # first step for a slot consumes the prefill-time device-side
@@ -1773,8 +2151,8 @@ class ServingEngine:
         first_done = []
         now = _time_mod.perf_counter()
         for i, s in enumerate(self.slots):
-            if not s.active:
-                continue
+            if not s.active or s.prefilling:
+                continue  # mid-chunked-prefill: no last token yet
             if s.needs_first_sample:
                 s.needs_first_sample = False
                 s.tokens.append(s._first_token)
@@ -2103,9 +2481,23 @@ class ServingEngine:
         """Run one admission round (batched prefill of everything
         admissible) WITHOUT decoding — the disaggregated prefill pool's
         step: the router prefills here, then detach_request() carries
-        the paged KV to a decode-pool engine."""
+        the paged KV to a decode-pool engine. Requests routed through
+        the chunk/continuation path (prefix-cache hit, or chunked
+        prefill on) run their rounds to completion here — a handoff
+        needs the full context and its first-token sample."""
         self._check_poisoned()
         self._admit()
+        while True:
+            pf = [i for i, s in enumerate(self.slots)
+                  if s.active and s.prefilling]
+            if not pf:
+                break
+            before = sum(self.slots[i]._pf_chunks_done for i in pf)
+            self._prefill_chunk_round(pf)
+            after = sum(self.slots[i]._pf_chunks_done
+                        for i in pf if self.slots[i].active)
+            if after <= before:  # OOM drained/preempted: no progress
+                break
 
     def detach_request(self, request_id: int) -> "KVHandoff":
         """Extract a prefilled request from this engine: gather its KV
@@ -2125,6 +2517,16 @@ class ServingEngine:
                 f"request {request_id} is not active on this engine "
                 f"(pending requests must be admitted/prefilled first)")
         s = self.slots[slot_idx]
+        if s.prefilling:
+            raise RuntimeError(
+                f"request {request_id} is mid chunked-prefill; detach "
+                f"after its prefill completes (a partial context has "
+                f"no first-token sample to hand off)")
+        # copy-or-pin: the KV gathers below HOST-COPY every page —
+        # including prefix pages shared with the trie or other slots —
+        # BEFORE _release_slot decrefs them, so the handoff owns its
+        # data outright and shared pages are neither freed twice nor
+        # mutated under the copy
         page_idx = self.block_tables[slot_idx, :s.n_pages].copy()
         k = [np.asarray(kp[:, page_idx]) for kp in self.k_pages]
         v = [np.asarray(vp[:, page_idx]) for vp in self.v_pages]
@@ -2197,10 +2599,15 @@ class ServingEngine:
         if slot_idx is None:
             raise RuntimeError("attach_request: no free slot")
         if len(self._free_pages) < n_pages:
+            self._reclaim_pages(n_pages - len(self._free_pages))
+        if len(self._free_pages) < n_pages:
             raise RuntimeError(
                 f"attach_request: needs {n_pages} pages, "
                 f"{len(self._free_pages)} free")
-        dst = np.asarray([self._free_pages.pop()
+        # fresh EXCLUSIVE pages: the handoff's KV scatters into them, so
+        # they must not alias trie-cached pages (no trie insert either —
+        # the attaching engine never saw the token stream page-aligned)
+        dst = np.asarray([self._alloc_page()
                           for _ in range(n_pages)], np.int32)
         dd = jnp.asarray(dst)
         for li in range(len(self.k_pages)):
@@ -2243,6 +2650,9 @@ class ServingEngine:
         s._first_token = handoff.first_token
         s.spec_proposed = 0
         s.spec_accepted = 0
+        s.prefilling = False
+        s._pf_ctx = None
+        s._pf_chunks_done = 0
         s.active = True
         _flight.record_event("serving.attach", rid=rid,
                              ctx=s.context_len, pages=n_pages)
@@ -2259,7 +2669,8 @@ class ServingEngine:
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
             return False
-        if any(self.slots[i].needs_first_sample for i in active):
+        if any(self.slots[i].needs_first_sample or
+               self.slots[i].prefilling for i in active):
             return False
         return max(self._rem_of(active).values()) > 1
 

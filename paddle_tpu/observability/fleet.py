@@ -753,6 +753,9 @@ def rank_table(shards: Dict[int, str],
         hb = heartbeats.get(rank, {})
         proposed = _total(samples, "spec_tokens_proposed_total")
         accepted = _total(samples, "spec_tokens_accepted_total")
+        pc_hits = _total(samples, "serving_prefix_cache_hits_total")
+        pc_miss = _total(samples, "serving_prefix_cache_misses_total")
+        pc_seen = (pc_hits or 0.0) + (pc_miss or 0.0)
         out.append({
             "rank": rank,
             "step": hb.get("step"),
@@ -768,6 +771,10 @@ def rank_table(shards: Dict[int, str],
             # ran a spec round — vanilla serving/train workloads)
             "spec_acceptance": round(accepted / proposed, 4)
             if proposed else None,
+            # prefix-cache token hit rate (None when the rank never
+            # admitted with the cache on)
+            "cache_hit_rate": round((pc_hits or 0.0) / pc_seen, 4)
+            if pc_seen else None,
         })
     return out
 
@@ -1178,10 +1185,12 @@ def format_report(report: dict) -> str:
         lines.append(f"{'rank':>5} {'step':>8} {'beat_age_s':>11} "
                      f"{'train_step_ms':>14} {'decode_step_ms':>15} "
                      f"{'ttft_ms':>9} {'coll_wait_s':>12} "
-                     f"{'spec_acc%':>10}")
+                     f"{'spec_acc%':>10} {'cache_hit%':>11}")
         for r in report["ranks"]:
             acc = r.get("spec_acceptance")
             acc_s = f"{acc * 100.0:.1f}" if acc is not None else "-"
+            hit = r.get("cache_hit_rate")
+            hit_s = f"{hit * 100.0:.1f}" if hit is not None else "-"
             lines.append(
                 f"{r['rank']:>5} {str(r['step']):>8} "
                 f"{_fmt_opt_ms(r['beat_age_s']):>11} "
@@ -1189,7 +1198,7 @@ def format_report(report: dict) -> str:
                 f"{_fmt_opt_ms(r['decode_step_ms']):>15} "
                 f"{_fmt_opt_ms(r['ttft_ms']):>9} "
                 f"{_fmt_opt_ms(r['collective_wait_s']):>12} "
-                f"{acc_s:>10}")
+                f"{acc_s:>10} {hit_s:>11}")
         lines.append("")
     for r in report["missing"]:
         lines.append(f"MISSING RANK: rank {r} declared by the job but "

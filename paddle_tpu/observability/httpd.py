@@ -236,9 +236,38 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
                   "ctx": s.context_len, "pages": s.n_pages,
                   "tokens": len(s.tokens), "max_new": s.max_new_tokens}
                  for si, s in enumerate(e.slots) if s.active]
-        alloc_tokens = sum(s.n_pages * e.page_size
-                           for s in e.slots if s.active)
-        used_tokens = sum(s.context_len for s in e.slots if s.active)
+        # count each page ONCE — prefix-cache sharing puts the same
+        # page in several rows, and per-slot sums would inflate both
+        # allocation and fragmentation
+        seen: dict = {}
+        for si, s in enumerate(e.slots):
+            if not s.active:
+                continue
+            row = e.block_tables[si]
+            for j in range(s.n_pages):
+                p = int(row[j])
+                filled = min(e.page_size,
+                             max(0, s.context_len - j * e.page_size))
+                seen[p] = max(seen.get(p, 0), filled)
+        pc = getattr(e, "_prefix_cache", None)
+        if pc is not None:
+            for p in pc.pages():
+                seen.setdefault(p, e.page_size)
+        alloc_tokens = len(seen) * e.page_size
+        used_tokens = sum(seen.values())
+        prefix = None
+        if pc is not None:
+            hits = getattr(e, "_prefix_hits_total", 0)
+            misses = getattr(e, "_prefix_misses_total", 0)
+            prefix = {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else None,
+                "cached_pages": len(pc),
+                "evictable_pages": pc.evictable(),
+                "evictions": pc.evictions,
+            }
         spec = None
         if getattr(e, "spec_decode", 0):
             proposed = getattr(e, "_spec_proposed_total", 0)
@@ -272,6 +301,7 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
                 if alloc_tokens else 0.0,
             },
             "spec": spec,
+            "prefix_cache": prefix,
             "slots": slots,
         })
     from . import fleet as _fleet

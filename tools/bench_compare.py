@@ -81,7 +81,8 @@ GEOMETRY_KEYS = ("batch", "seq", "hidden", "layers", "prompt_len",
 KNOB_KEYS_ABSENT_IS_NONE = ("quant", "kv_quant", "spec_decode",
                             "draft_layers", "overlap", "grad_bucket_mb",
                             "prefetch_depth", "replicas",
-                            "router_policy")
+                            "router_policy", "prefix_cache",
+                            "prefill_chunk")
 
 
 def _knob(extra: dict, key: str):

@@ -156,6 +156,21 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# prefix-cache + chunked-prefill gate (ISSUE 15): two sequential
+# requests share a long system prompt — the second must reuse cached KV
+# pages (hit rate > 0), greedy tokens must be BIT-EQUAL to the
+# cache-off engine (plain and chunked), serving.decode must not
+# recompile after warmup, and a long prefill admitted mid-decode must
+# run as traced serving.prefill_chunk spans with the in-flight
+# request's inter-token gap under the (liveness-level) ceiling
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/prefix_cache_smoke.py --itl-ceiling-ms 2000; then
+  echo "CI: prefix-cache smoke FAILED (parity mismatch vs cache-off," \
+       "zero cache hits, a post-warmup decode recompile, or the" \
+       "chunked-prefill ITL ceiling — see the report above)" >&2
+  rc=1
+fi
+
 # driver-parseability gate (VERDICT round-5 Weak #1 regression guard):
 # the LAST stdout line of a bench.py smoke run must parse as JSON — the
 # driver artifact tails stdout, so anything after (or inlined into) the

@@ -1,0 +1,174 @@
+"""CI prefix-cache + chunked-prefill smoke (ISSUE 15).
+
+Two sequential requests share a long system prompt, so the second
+request's admission must reuse the first's cached KV pages. Gates, in
+order:
+
+1. bit-equal tokens: the cache-on greedy streams (plain, and again
+   with chunked prefill) match the cache-off engine token for token —
+   the same golden-parity discipline spec_decode's smoke enforces
+2. hit rate > 0: serving_prefix_cache_hits_total moved, and the
+   engine-level cached-token accounting agrees
+3. zero post-warmup decode recompiles (compilewatch): prefix reuse and
+   chunk rounds must not perturb the decode program cache
+4. chunked-prefill ITL ceiling on the traced smoke: a long prefill
+   admitted MID-DECODE runs as >= 2 traced serving.prefill_chunk
+   spans, and the in-flight request's inter-token gap (measured at the
+   on_token callback) stays under --itl-ceiling-ms — the ceiling is
+   liveness-level on a noisy CI box (like the spec smoke's acceptance
+   floor); the latency bar lives in the banked bench rows
+
+Exit 0 green, 1 on any gate, matching tools/ci.sh conventions.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--itl-ceiling-ms", type=float, default=2000.0,
+                    help="max inter-token gap (ms) for the in-flight "
+                         "decode while a chunked prefill interleaves")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="also write the Chrome trace JSON here")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import compilewatch
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.observability import tracing
+
+    paddle.set_flags({"FLAGS_trace_sample": 1, "FLAGS_compilewatch": True})
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           seq=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, cfg.vocab_size, (48,))  # 6 full 8-tok pages
+    tails = [rng.randint(0, cfg.vocab_size, (n,)) for n in (5, 9)]
+    prompts = [np.concatenate([system, t]) for t in tails]
+    budgets = (10, 8)
+    kw = dict(max_batch=2, max_seq_len=96, page_size=8,
+              decode_strategy="greedy_search")
+
+    def decode_sequential(**over):
+        """One request at a time on ONE engine, so the second request's
+        admission sees the first's pages in the trie."""
+        eng = ServingEngine(model, **kw, **over)
+        eng.warmup(prompt_len=len(prompts[0]))
+        base = compilewatch.recompiles("serving.decode")
+        outs = []
+        for p, b in zip(prompts, budgets):
+            rid = eng.add_request(p, max_new_tokens=b)
+            fin = {f.request_id: f.output_ids.tolist() for f in eng.run()}
+            outs.append(fin[rid])
+        recompiles = compilewatch.recompiles("serving.decode") - base
+        return outs, eng, recompiles
+
+    ref, _eng, _ = decode_sequential()
+    cached, eng_pc, rec_pc = decode_sequential(prefix_cache=1)
+    chunked, eng_ck, rec_ck = decode_sequential(prefix_cache=1,
+                                                prefill_chunk=16)
+
+    # gate 1: bit-equal tokens vs cache-off
+    for name, got in (("prefix_cache", cached),
+                      ("prefix_cache+chunked", chunked)):
+        if got != ref:
+            print(f"prefix smoke FAILED: {name} output differs from "
+                  f"cache-off greedy decode\n  off: {ref}\n  on:  {got}",
+                  file=sys.stderr)
+            return 1
+
+    # gate 2: the second request actually reused cached pages
+    reg = om.default_registry()
+    hits = reg.value("serving_prefix_cache_hits_total")
+    for name, eng in (("prefix_cache", eng_pc), ("chunked", eng_ck)):
+        if eng._prefix_hits_total <= 0:
+            print(f"prefix smoke FAILED: {name} engine saw zero cached "
+                  f"tokens (misses {eng._prefix_misses_total}) — the "
+                  f"shared system prompt never hit", file=sys.stderr)
+            return 1
+    if not hits:
+        print("prefix smoke FAILED: serving_prefix_cache_hits_total "
+              "never moved", file=sys.stderr)
+        return 1
+
+    # gate 3: zero post-warmup decode recompiles with the cache on
+    if rec_pc or rec_ck:
+        print(f"prefix smoke FAILED: serving.decode recompiled after "
+              f"warmup (plain={rec_pc}, chunked={rec_ck})",
+              file=sys.stderr)
+        print(compilewatch.storm_report("serving.decode"),
+              file=sys.stderr)
+        return 1
+
+    # gate 4: chunked prefill interleaves with live decode under the
+    # ITL ceiling — request A decodes while B's long prefill chunks
+    eng = ServingEngine(model, prefix_cache=1, prefill_chunk=16, **kw)
+    eng.warmup(prompt_len=len(prompts[0]))
+    stamps = []
+    state = {"b_sent": False}
+
+    def on_a(rid, tok):
+        stamps.append(time.perf_counter())
+        if len(stamps) == 2 and not state["b_sent"]:
+            state["b_sent"] = True  # admit B mid-decode of A
+            eng.add_request(np.concatenate([system, tails[1]]),
+                            max_new_tokens=4)
+
+    eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                    max_new_tokens=24, on_token=on_a)
+    eng.run()
+    gaps_ms = [(b - a) * 1e3 for a, b in zip(stamps, stamps[1:])]
+    worst = max(gaps_ms) if gaps_ms else 0.0
+    events = tracing.to_chrome_trace()
+    chunk_spans = [e for e in events
+                   if e.get("name") == "serving.prefill_chunk"
+                   and e.get("ph") == "X"]
+    if args.trace:
+        import json
+
+        om.atomic_write(args.trace, json.dumps(events, indent=0))
+    if not state["b_sent"] or len(chunk_spans) < 2:
+        print(f"prefix smoke FAILED: expected >= 2 traced "
+              f"serving.prefill_chunk spans from the mid-decode "
+              f"admission (got {len(chunk_spans)}, "
+              f"b_sent={state['b_sent']})", file=sys.stderr)
+        return 1
+    if worst > args.itl_ceiling_ms:
+        print(f"prefix smoke FAILED: in-flight ITL hit {worst:.1f} ms "
+              f"(> ceiling {args.itl_ceiling_ms:.0f} ms) while a "
+              f"chunked prefill ran", file=sys.stderr)
+        return 1
+
+    print(f"prefix-cache smoke OK: outputs bit-equal cache-off, "
+          f"{int(hits)} cached tokens hit "
+          f"(engine ratios: plain "
+          f"{eng_pc._prefix_hits_total}/"
+          f"{eng_pc._prefix_hits_total + eng_pc._prefix_misses_total}, "
+          f"chunked {eng_ck._prefix_hits_total}/"
+          f"{eng_ck._prefix_hits_total + eng_ck._prefix_misses_total}), "
+          f"0 post-warmup decode recompiles, {len(chunk_spans)} chunk "
+          f"spans, worst in-flight ITL {worst:.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
