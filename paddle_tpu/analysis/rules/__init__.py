@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     jax_compat,
     jit_side_effects,
     retries,
+    trace_propagation,
     transfers,
     weak_float,
 )

@@ -1,0 +1,234 @@
+"""Rule: route-handler-trace — a broken link in the distributed trace.
+
+The X-PT-Trace contract (observability/tracing.py) stitches one routed
+request into ONE timeline across processes: the router `inject()`s its
+trace context into the request, the serving side's httpd handler
+`extract()`s it into the thread before any span opens, and every span
+the handler's frame creates inherits that trace_id. Two mistakes break
+the stitch silently — the request still serves, but the fleet-wide
+trace report shows an orphan router trace and an unrelated serving
+trace, which is exactly the regression tools/trace_stitch_smoke.py
+gates in CI:
+
+- a handler passed to `httpd.register_route` that opens spans
+  (`start_trace` / `.span(` / `.begin(`) WITHOUT calling
+  `tracing.extract()` first: the spans mint a fresh local trace_id and
+  the inbound context dies on the floor;
+- an async phase opened with `.begin("name")` that is not closed by
+  `.end("name")` (or `.finish()`) on every return path of the SAME
+  function that ends it elsewhere: the early return leaks an open
+  phase, and the trace finisher reports it `unclosed=True` with a
+  bogus duration.
+
+Deliberately clean shapes:
+
+- a handler that opens no spans (it may delegate to `submit()`, whose
+  frame inherits the extracted context) — nothing to mis-parent;
+- a cross-frame phase: `begin()` in one function, `end()` in another
+  (the router's `router.queue` opens in `submit` and closes in
+  `_dispatch`) — only functions that `.end()` a literal name somewhere
+  are held to balancing it on their own returns;
+- `try/finally` with the `.end()` in the finally block — the close
+  runs on every return;
+- generators: they suspend with phases deliberately open.
+
+An intentional exception documents itself with
+`# tpu-lint: disable=route-handler-trace`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_parts, register
+
+# leaf call names that open a span in the handler's own frame
+_OPEN_LEAVES = {"start_trace", "span", "begin"}
+
+
+def _leaf(call: ast.Call):
+    parts = dotted_parts(call.func)
+    return parts[-1] if parts else None
+
+
+def _literal_arg(call: ast.Call):
+    """The call's first positional arg when it is a string literal."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _own_frame_nodes(func):
+    """Statement-order AST walk of a function EXCLUDING nested
+    function/class bodies: spans begun or ended inside a nested def
+    belong to that frame (callback-close is a legal pattern)."""
+    stack = list(func.body)
+    while stack:
+        node = stack.pop(0)
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack[:0] = list(ast.iter_child_nodes(node))
+
+
+def _is_generator(func) -> bool:
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in _own_frame_nodes(func))
+
+
+@register
+class RouteHandlerTraceRule(Rule):
+    name = "route-handler-trace"
+    description = ("broken distributed-trace link: an httpd route "
+                   "handler opens spans without tracing.extract() "
+                   "first (the inbound X-PT-Trace context is dropped "
+                   "and the request forks into orphan timelines), or "
+                   "a .begin('phase') leaks past a return the same "
+                   "function's .end('phase') was meant to balance")
+
+    def check(self, ctx):
+        yield from self._check_handlers(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_returns(ctx, node)
+
+    # -- check A: register_route handlers must extract() before they
+    #             open spans ------------------------------------------
+
+    def _check_handlers(self, ctx):
+        mod_funcs = {n.name: n for n in ctx.tree.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+        seen = set()
+        for handler_node, cls in self._registrations(ctx.tree):
+            func = self._resolve(handler_node, mod_funcs, cls)
+            if func is None or id(func) in seen:
+                continue
+            seen.add(id(func))
+            opens = []
+            extracts = []
+            for n in ast.walk(func):
+                if not isinstance(n, ast.Call):
+                    continue
+                leaf = _leaf(n)
+                if leaf in _OPEN_LEAVES:
+                    opens.append(n)
+                elif leaf == "extract":
+                    extracts.append(n)
+            if not opens:
+                continue  # delegating handler: nothing mis-parented
+            first_open = min(o.lineno for o in opens)
+            if any(e.lineno < first_open for e in extracts):
+                continue
+            yield ctx.finding(
+                self.name, func,
+                f"route handler `{func.name}` opens spans without "
+                f"calling tracing.extract() first: the inbound "
+                f"X-PT-Trace context is dropped, so the routed "
+                f"request forks into an orphan router trace plus an "
+                f"unrelated serving trace. Call extract() before the "
+                f"first start_trace/span/begin (see "
+                f"inference/replica.py:_handle_generate)")
+
+    def _registrations(self, tree, cls=None):
+        """Yield (handler_arg_node, enclosing_class) for every
+        register_route(path, handler) call."""
+        if isinstance(tree, ast.ClassDef):
+            cls = tree
+        if isinstance(tree, ast.Call) and \
+                _leaf(tree) == "register_route" and len(tree.args) >= 2:
+            yield tree.args[1], cls
+        for child in ast.iter_child_nodes(tree):
+            yield from self._registrations(child, cls)
+
+    @staticmethod
+    def _resolve(node, mod_funcs, cls):
+        """handler expression -> its FunctionDef, when statically
+        visible: a module-level name, or `self.method` of the
+        enclosing class."""
+        if isinstance(node, ast.Name):
+            return mod_funcs.get(node.id)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and cls is not None:
+            for n in cls.body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == node.attr:
+                    return n
+        return None
+
+    # -- check B: begin/end balance on every return path ---------------
+
+    def _check_returns(self, ctx, func):
+        if _is_generator(func):
+            return  # generators suspend with phases deliberately open
+        ends_all = set()
+        for n in _own_frame_nodes(func):
+            if isinstance(n, ast.Call) and _leaf(n) == "end":
+                lit = _literal_arg(n)
+                if lit:
+                    ends_all.add(lit)
+        if not ends_all:
+            return  # cross-frame opener (or no async phases): clean
+        yield from self._linear(ctx, func.body, set(), ends_all)
+
+    def _linear(self, ctx, stmts, open_now, ends_all):
+        """Source-order walk mutating `open_now`; flags each `return`
+        reached while a phase this function ends elsewhere is open."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Return):
+                for name in sorted(open_now & ends_all):
+                    yield ctx.finding(
+                        self.name, stmt,
+                        f"return leaks open phase `{name}`: this "
+                        f"function .end(\"{name}\")s it on another "
+                        f"path, so this early return leaves the span "
+                        f"dangling (the trace finisher will report it "
+                        f"unclosed=True with a bogus duration). Close "
+                        f"it before returning or move the .end() into "
+                        f"a finally block")
+                continue
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                self._apply(stmt.value, open_now)
+                continue
+            if isinstance(stmt, ast.Try):
+                # a finally-block close runs on EVERY return inside
+                # the try, so apply it before walking the body
+                for n in stmt.finalbody:
+                    for c in ast.walk(n):
+                        if isinstance(c, ast.Call):
+                            self._apply(c, open_now)
+                yield from self._linear(ctx, stmt.body, open_now,
+                                        ends_all)
+                for h in stmt.handlers:
+                    yield from self._linear(ctx, h.body, open_now,
+                                            ends_all)
+                yield from self._linear(ctx, stmt.orelse, open_now,
+                                        ends_all)
+                yield from self._linear(ctx, stmt.finalbody, open_now,
+                                        ends_all)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner:
+                    yield from self._linear(ctx, inner, open_now,
+                                            ends_all)
+
+    @staticmethod
+    def _apply(call: ast.Call, open_now):
+        leaf = _leaf(call)
+        if leaf == "begin":
+            lit = _literal_arg(call)
+            if lit:
+                open_now.add(lit)
+        elif leaf == "end":
+            lit = _literal_arg(call)
+            if lit:
+                open_now.discard(lit)
+        elif leaf == "finish":
+            open_now.clear()

@@ -153,6 +153,16 @@ define_flag("FLAGS_telemetry_flush_s", 5.0,
             "(FLAGS_telemetry_dir). The dead-rank detector treats a "
             "heartbeat more than ~3x this behind the fleet's newest "
             "beat as a stopped rank.", type_=float)
+define_flag("FLAGS_timeseries_interval_s", 0.0,
+            "Time-series telemetry history "
+            "(observability/timeseries.py): when > 0, a per-rank "
+            "daemon thread samples load score, SLO burn rates, KV "
+            "occupancy and queue depth into a bounded ring every this "
+            "many seconds; the fleet flusher exports the ring as "
+            "rank_<i>/history.jsonl and /debug/timeseries?secs=N "
+            "serves it live (fleet_report renders the per-rank trend). "
+            "0 (default) = off: one flag read, zero allocations, "
+            "pinned by tests/test_timeseries.py.", type_=float)
 define_flag("FLAGS_memwatch", False,
             "Memory observability channel (observability/memwatch.py): "
             "per-step HBM watermark gauges from device memory_stats "

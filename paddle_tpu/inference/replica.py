@@ -30,6 +30,7 @@ import numpy as np
 
 from ..observability import flight_recorder as _flight
 from ..observability import httpd as _httpd
+from ..observability import tracing as _tracing
 
 GENERATE_ROUTE = "/v1/generate"
 
@@ -164,6 +165,10 @@ class ReplicaServer:
     def _handle_generate(self, method, query, body):
         if method != "POST":
             return (405, b"POST only\n", "text/plain; charset=utf-8")
+        # adopt the router's X-PT-Trace context (the httpd parked it as
+        # this thread's pending header) BEFORE submit: add_request runs
+        # on this thread, so the engine's trace joins the routed one
+        _tracing.extract()
         try:
             req = json.loads(body.decode() or "{}")
             prompt = req["prompt_ids"]

@@ -59,6 +59,11 @@ def _parse(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     ap.add_argument("--recovery-backoff", type=float, default=None,
                     help="FLAGS_serving_recovery_backoff_s override "
                          "(widen the drain window the smoke observes)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    help="FLAGS_trace_sample for this replica (the "
+                         "stitch smoke sets 1.0 so every routed "
+                         "request's X-PT-Trace context lands spans in "
+                         "this worker's trace.json shard)")
     ap.add_argument("--slo-ttft-ms", type=float, default=60000.0,
                     help="FLAGS_slo_ttft_p95_ms for this replica. The "
                          "default is deliberately loose: a tiny CPU "
@@ -92,6 +97,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.recovery_backoff is not None:
         flags["FLAGS_serving_recovery_backoff_s"] = \
             float(args.recovery_backoff)
+    if args.trace_sample is not None:
+        flags["FLAGS_trace_sample"] = float(args.trace_sample)
     _cfg.set_flags(flags)
 
     paddle.seed(args.seed)

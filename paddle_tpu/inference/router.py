@@ -175,9 +175,20 @@ class LocalReplica(BaseReplica):
         params = {k: request[k] for k in
                   ("decode_strategy", "temperature", "top_k", "top_p",
                    "eos_token_id") if k in request}
-        rid = self.server.submit(
-            request["prompt_ids"],
-            max_new_tokens=request.get("max_new_tokens", 32), **params)
+        # install the router's trace context on THIS thread for the
+        # duration of add_request (submit runs it on the caller), so
+        # the engine's serving.request trace joins the routed trace —
+        # the in-process equivalent of HttpReplica's X-PT-Trace header
+        ctx = _trace.parse_context(request.get("trace_ctx"))
+        prev = _trace.set_current(ctx) if ctx is not None else None
+        try:
+            rid = self.server.submit(
+                request["prompt_ids"],
+                max_new_tokens=request.get("max_new_tokens", 32),
+                **params)
+        finally:
+            if ctx is not None:
+                _trace.set_current(prev)
         out = self.server.wait(rid, timeout=timeout)
         if out is None:
             raise TimeoutError(f"{self.name}: request {rid} timed out")
@@ -219,10 +230,15 @@ class HttpReplica(BaseReplica):
 
         payload = dict(request)
         payload["timeout_s"] = timeout
+        headers = {"Content-Type": "application/json"}
+        # trace context rides the header, not the body: the replica's
+        # httpd extracts it before the route handler runs
+        trace_ctx = payload.pop("trace_ctx", None)
+        if trace_ctx:
+            headers[_trace.TRACE_HEADER] = trace_ctx
         data = json.dumps(payload).encode()
         req = Request(self.base + "/v1/generate", data=data,
-                      headers={"Content-Type": "application/json"},
-                      method="POST")
+                      headers=headers, method="POST")
         try:
             # the socket deadline outlives the server-side long-poll
             with urlopen(req, timeout=timeout + 5.0) as r:
@@ -563,9 +579,17 @@ class Router:
                                      "request timeout"})
             return
         ticket.attempts += 1
+        t_attempt = _time_mod.perf_counter()
         if ticket.t_dispatch is None:
-            ticket.t_dispatch = _time_mod.perf_counter()
+            ticket.t_dispatch = t_attempt
             ticket.trace.end("router.queue")
+        if "trace_ctx" not in ticket.request:
+            hdr = _trace.inject(ticket.trace)
+            if hdr is not None:
+                # the replica adopts this trace_id (and the router's
+                # sampling verdict), so the routed request is ONE
+                # stitched timeline across processes
+                ticket.request["trace_ctx"] = hdr
         ticket.trace.begin("router.route", replica=replica.name,
                            attempt=ticket.attempts)
         self._m.dispatches.labels(replica.name).inc()
@@ -592,11 +616,15 @@ class Router:
                                 "attempts": ticket.attempts})
             return
         now = _time_mod.perf_counter()
-        queue_s = ticket.t_dispatch - ticket.t_submit
         if out.get("ttft_s") is not None:
-            # routed TTFT = router queue wait + the replica's own
-            # submit->first-token (its queue + prefill)
-            self._m.ttft.observe(queue_s + float(out["ttft_s"]))
+            # routed TTFT = everything since the ORIGINAL submit —
+            # queue wait plus any failed attempts — plus the winning
+            # replica's own submit->first-token (its queue + prefill).
+            # t_attempt (this attempt's dispatch), not t_dispatch (the
+            # first attempt's): under failover the burn the user saw
+            # includes the attempts that died.
+            self._m.ttft.observe((t_attempt - ticket.t_submit)
+                                 + float(out["ttft_s"]))
         self._m.latency.observe(now - ticket.t_submit)
         self._m.requests.labels("ok").inc()
         ticket.trace.end("router.route", replica=replica.name,

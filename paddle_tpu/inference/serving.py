@@ -231,6 +231,10 @@ class KVHandoff:
     v: list
     k_scales: object  # per layer or None (int8 KV only)
     v_scales: object
+    # distributed-trace identity (tracing.inject() of the prefill-side
+    # trace, None when untraced): the attaching engine adopts it so
+    # prefill and decode land on ONE stitched timeline
+    trace_ctx: object = None
 
 
 @dataclass
@@ -2539,6 +2543,10 @@ class ServingEngine:
         rp.pop("t_enq", None)  # TTFT belongs to the prefill engine's
         # clock only when the first token committed there; the router
         # observes routed TTFT end to end instead
+        # capture the trace identity BEFORE _finish_trace pops it: the
+        # decode-side attach joins this id, so the handoff is one hop
+        # of one distributed timeline, not two unrelated traces
+        tr = self._traces.get(s.request_id)
         handoff = KVHandoff(
             prompt_ids=self._prompts.get(
                 s.request_id, np.zeros((0,), np.int64)),
@@ -2550,7 +2558,8 @@ class ServingEngine:
             req_params=rp,
             page_size=self.page_size,
             kv_cache_quant=self.kv_cache_quant,
-            k=k, v=v, k_scales=ks, v_scales=vs)
+            k=k, v=v, k_scales=ks, v_scales=vs,
+            trace_ctx=_trace.inject(tr) if tr is not None else None)
         self._release_slot(slot_idx)
         self._prompts.pop(s.request_id, None)
         self._req_params.pop(s.request_id, None)
@@ -2569,6 +2578,7 @@ class ServingEngine:
         page_size, KV quantization, and model geometry (the page
         shapes are checked)."""
         self._check_poisoned()
+        t_attach0 = _time_mod.perf_counter()
         if handoff.page_size != self.page_size:
             raise ValueError(
                 f"page_size mismatch: handoff {handoff.page_size} vs "
@@ -2654,8 +2664,28 @@ class ServingEngine:
         s._pf_ctx = None
         s._pf_chunks_done = 0
         s.active = True
+        trace_id = None
+        if _trace.enabled():
+            # adopt the handoff's trace identity (the prefill engine's
+            # detach injected it) — this engine's decode continues the
+            # SAME distributed timeline; without one, start_trace falls
+            # back to the thread context / local sampling as usual
+            ctx = _trace.parse_context(handoff.trace_ctx) \
+                if handoff.trace_ctx else None
+            tr = _trace.start_trace("serving.request", own_track=True,
+                                    parent=ctx, rid=rid, attached=True,
+                                    ctx_len=s.context_len)
+            if tr.trace_id is not None:
+                self._traces[rid] = tr
+                trace_id = tr.trace_id
+                # the KV scatter + slot re-admission IS this hop's
+                # handoff cost — record it with explicit endpoints
+                tr.emit("serving.attach", t_attach0,
+                        _time_mod.perf_counter(), rid=rid,
+                        pages=n_pages)
         _flight.record_event("serving.attach", rid=rid,
-                             ctx=s.context_len, pages=n_pages)
+                             ctx=s.context_len, pages=n_pages,
+                             trace_id=trace_id)
         return rid
 
     def _async_ok(self) -> bool:

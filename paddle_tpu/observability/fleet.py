@@ -65,7 +65,7 @@ from . import metrics as _metrics
 
 SHARD_FILES = ("metrics.prom", "memory.prom", "ledger.prom",
                "events.jsonl", "trace.json", "collectives.jsonl",
-               "heartbeat.json")
+               "history.jsonl", "heartbeat.json")
 
 
 def _flags():
@@ -190,8 +190,13 @@ def heartbeat(step: Optional[int] = None):
     # per-rank server lazily — FLAGS_telemetry_port can be on without
     # FLAGS_telemetry_dir, so this runs before the fleet gate
     from . import httpd as _httpd
+    from . import timeseries as _timeseries
 
     _httpd.ensure_server()
+    # the time-series recorder boots on the same liveness signal and is
+    # likewise independent of the fleet gate (history can be served
+    # live at /debug/timeseries with FLAGS_telemetry_dir unset)
+    _timeseries.ensure_recorder()
     if not enabled():
         return
     if step is None:
@@ -350,6 +355,16 @@ class FleetExporter:
         _metrics.atomic_write(
             os.path.join(self.shard_dir, "collectives.jsonl"),
             "".join(r + "\n" for r in rows))
+
+        from . import timeseries as _timeseries
+
+        # written even when the channel is off (empty file) so a shard
+        # always holds the full SHARD_FILES set; rows are wall-clock
+        # stamped, so history merges across ranks with no rebase
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "history.jsonl"),
+            "".join(json.dumps(r) + "\n"
+                    for r in _timeseries.history()))
 
         self.flushes += 1
         hb = {
@@ -910,6 +925,76 @@ def slo_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def history_table(shards: Dict[int, str], burn_threshold: float = 1.0,
+                  sustain: int = 3) -> List[dict]:
+    """One row per rank from its history.jsonl shard (the time-series
+    recorder's ring, observability/timeseries.py): sample count + span,
+    the load-score trend (first/last/mean/max), last/max KV occupancy
+    and queue depth, the worst burn per objective, and SUSTAINED burn
+    windows — >= `sustain` consecutive samples with an objective's burn
+    at or above `burn_threshold` (a point-in-time scrape cannot tell a
+    blip from a budget actively draining; a sustained window can).
+    Ranks that never sampled are omitted."""
+    out = []
+    for rank, path in sorted(shards.items()):
+        rows = _read_jsonl(os.path.join(path, "history.jsonl"))
+        rows = [r for r in rows if isinstance(r.get("ts"), (int, float))]
+        if not rows:
+            continue
+        rows.sort(key=lambda r: r["ts"])
+        loads = [float(r.get("load", 0.0)) for r in rows]
+        kv = [r["kv_occupancy"] for r in rows
+              if isinstance(r.get("kv_occupancy"), (int, float))]
+        queues = [int(r.get("queue", 0)) for r in rows]
+        burn_max: Dict[str, float] = {}
+        runs: Dict[str, List[dict]] = {}
+        open_runs: Dict[str, dict] = {}
+        for r in rows:
+            burning = set()
+            for obj, b in (r.get("burn") or {}).items():
+                b = float(b)
+                if b > burn_max.get(obj, 0.0):
+                    burn_max[obj] = b
+                if b >= burn_threshold:
+                    burning.add(obj)
+                    run = open_runs.get(obj)
+                    if run is None:
+                        run = open_runs[obj] = {
+                            "objective": obj, "samples": 0,
+                            "start_ts": r["ts"], "peak_burn": 0.0}
+                    run["samples"] += 1
+                    run["end_ts"] = r["ts"]
+                    run["peak_burn"] = max(run["peak_burn"], b)
+            for obj in list(open_runs):
+                if obj not in burning:
+                    run = open_runs.pop(obj)
+                    if run["samples"] >= sustain:
+                        runs.setdefault(obj, []).append(run)
+        for obj, run in open_runs.items():
+            if run["samples"] >= sustain:
+                runs.setdefault(obj, []).append(run)
+        sustained = [dict(r, span_s=round(r["end_ts"] - r["start_ts"],
+                                          3))
+                     for rs in runs.values() for r in rs]
+        sustained.sort(key=lambda r: -r["samples"])
+        out.append({
+            "rank": rank,
+            "samples": len(rows),
+            "span_s": round(rows[-1]["ts"] - rows[0]["ts"], 3),
+            "load_first": round(loads[0], 4),
+            "load_last": round(loads[-1], 4),
+            "load_mean": round(sum(loads) / len(loads), 4),
+            "load_max": round(max(loads), 4),
+            "kv_last": round(kv[-1], 4) if kv else None,
+            "kv_max": round(max(kv), 4) if kv else None,
+            "queue_max": max(queues) if queues else 0,
+            "burn_max": {o: round(b, 3)
+                         for o, b in sorted(burn_max.items())},
+            "sustained_burn": sustained,
+        })
+    return out
+
+
 def recoveries_table(shards: Dict[int, str]) -> List[dict]:
     """One row per rank with fault-tolerance counters from the rank's
     metrics.prom (README.md "Fault tolerance"): serving self-heals by
@@ -1122,7 +1207,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "straggler_summary": [],
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
-                    "ledger": [], "slo": [],
+                    "ledger": [], "slo": [], "history": [],
                     "artifacts": {}}
     if not shards:
         return report
@@ -1145,6 +1230,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "hbm": hbm_skew(hbm_table(shards)),
         "ledger": ledger_table(shards),
         "slo": slo_table(shards),
+        "history": history_table(shards),
         "recoveries": recoveries_table(shards),
         "artifacts": {
             "prom": prom_path,
@@ -1325,6 +1411,40 @@ def format_report(report: dict) -> str:
                     f"— this rank is burning its error budget; route "
                     f"traffic elsewhere (serving_load_score) and check "
                     f"its ledger/straggler rows above")
+        lines.append("")
+    hist_rows = report.get("history") or []
+    if hist_rows:
+        lines.append("")
+        lines.append("== telemetry history per rank (history.jsonl; "
+                     "load/burn/KV trend over the sampled window) ==")
+        lines.append(f"{'rank':>5} {'samples':>8} {'span_s':>8} "
+                     f"{'load first>last':>16} {'mean':>6} {'max':>6} "
+                     f"{'kv last':>8} {'kv max':>7} {'queue max':>10} "
+                     f"worst burn")
+        for r in hist_rows:
+            kv_last = f"{r['kv_last'] * 100.0:.1f}%" \
+                if r.get("kv_last") is not None else "-"
+            kv_max = f"{r['kv_max'] * 100.0:.1f}%" \
+                if r.get("kv_max") is not None else "-"
+            burn = ", ".join(f"{o}={b:.1f}x" for o, b in
+                             sorted(r["burn_max"].items(),
+                                    key=lambda kv_: -kv_[1])[:3]) \
+                if r.get("burn_max") else "-"
+            lines.append(
+                f"{r['rank']:>5} {r['samples']:>8} {r['span_s']:>8.1f} "
+                f"{r['load_first']:>7.2f} >{r['load_last']:>7.2f} "
+                f"{r['load_mean']:>6.2f} {r['load_max']:>6.2f} "
+                f"{kv_last:>8} {kv_max:>7} {r['queue_max']:>10} "
+                f"{burn}")
+        for r in hist_rows:
+            for s in r.get("sustained_burn", []):
+                lines.append(
+                    f"SUSTAINED BURN: rank {r['rank']} "
+                    f"{s['objective']} burned >=1.0x its error budget "
+                    f"for {s['samples']} consecutive samples "
+                    f"({s['span_s']:.1f} s, peak {s['peak_burn']:.1f}x)"
+                    f" — a trend, not a blip; drain traffic off this "
+                    f"rank before the budget empties")
         lines.append("")
     recov_rows = report.get("recoveries") or []
     if recov_rows:

@@ -27,6 +27,16 @@ per rank, serving:
   trailing flight-recorder ring (a stall dump without the stall).
 - `/debug/trace?secs=N` — window capture of the span ring as a
   Chrome-trace download (Perfetto-loadable; requires tracing on).
+- `/debug/timeseries?secs=N` — the trailing N seconds of the
+  time-series recorder's ring (observability/timeseries.py): load
+  score, SLO burn, KV occupancy and queue depth sampled every
+  FLAGS_timeseries_interval_s.
+
+Distributed tracing: inbound `X-PT-Trace` headers are parked on the
+handler thread before any registered application route runs
+(`tracing.set_pending`), so a route handler's `tracing.extract()`
+adopts the caller's trace context — and the context is always cleared
+after the request, keep-alive or not.
 
 Activation: `FLAGS_telemetry_port` > 0 starts the server lazily on
 first step telemetry (`ensure_server()`, the fleet-exporter pattern);
@@ -56,6 +66,7 @@ from urllib.parse import parse_qs, urlparse
 from . import flight_recorder as _flight
 from . import metrics as _metrics
 from . import slo as _slo
+from . import tracing as _tracing
 
 
 def _flags():
@@ -392,6 +403,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle("POST")
 
     def _handle(self, method: str):
+        trace_hdr = None
         try:
             url = urlparse(self.path)
             path = url.path.rstrip("/") or "/"
@@ -403,6 +415,14 @@ class _Handler(BaseHTTPRequestHandler):
                 except (TypeError, ValueError):
                     n = 0
                 body = self.rfile.read(n) if n > 0 else b""
+            # distributed-trace propagation: park the inbound context
+            # header on THIS handler thread; application route handlers
+            # adopt it with tracing.extract() (inference/replica.py),
+            # and the finally below guarantees a pooled keep-alive
+            # thread never leaks one request's identity into the next
+            trace_hdr = self.headers.get(_tracing.TRACE_HEADER)
+            if trace_hdr:
+                _tracing.set_pending(trace_hdr)
             handler = _registered_route(path)
             if handler is not None:
                 code, payload, ctype = handler(method, query, body)
@@ -419,6 +439,9 @@ class _Handler(BaseHTTPRequestHandler):
             # answer 500, never kill the server thread
             code, ctype, extra = 500, "text/plain; charset=utf-8", None
             payload = f"internal error: {e!r}\n".encode()
+        finally:
+            if trace_hdr:
+                _tracing.clear_context()
         try:
             self._send(code, payload, ctype, extra)
         except (BrokenPipeError, ConnectionResetError):
@@ -478,10 +501,26 @@ class _Handler(BaseHTTPRequestHandler):
                     {"Content-Disposition":
                      f'attachment; filename="trace_last_'
                      f'{int(secs)}s.json"'})
+        if path == "/debug/timeseries":
+            from . import timeseries as _timeseries
+
+            try:
+                secs = float(query.get("secs", ["300"])[0])
+            except (TypeError, ValueError):
+                secs = 300.0
+            payload = {
+                "enabled": _timeseries.enabled(),
+                "interval_s": _timeseries.interval_s(),
+                "window_s": secs,
+                "samples": _timeseries.history(since_s=secs),
+            }
+            return (200, (json.dumps(payload, indent=1) + "\n")
+                    .encode(), "application/json", None)
         if path == "/":
             index = ("paddle-tpu telemetry plane\n"
                      "endpoints: /metrics /healthz /readyz /statusz "
-                     "/debug/stacks /debug/trace?secs=N\n")
+                     "/debug/stacks /debug/trace?secs=N "
+                     "/debug/timeseries?secs=N\n")
             return (200, index.encode(),
                     "text/plain; charset=utf-8", None)
         return (404, b"not found\n", "text/plain; charset=utf-8", None)

@@ -1,0 +1,222 @@
+"""Time-series telemetry history: bounded per-rank signal rings.
+
+Every gauge the observability plane exports — ``serving_load_score``,
+SLO burn rates, KV occupancy, queue depth — is a point-in-time scrape:
+the fleet report can say a rank is loaded NOW but not whether it has
+been climbing for five minutes (the signal an autoscaler needs) or
+whether an SLO has been burning continuously (the signal an operator
+pages on). This module closes that gap with a deliberately tiny
+recorder: one daemon thread samples the cheap composite signals every
+``FLAGS_timeseries_interval_s`` seconds into a bounded ring of plain
+dict rows.
+
+Consumers:
+
+- ``/debug/timeseries?secs=N`` (observability/httpd.py) serves the
+  trailing window live;
+- the fleet flusher (observability/fleet.py) exports the ring as
+  ``rank_<i>/history.jsonl`` next to the other shard files, and
+  ``fleet.history_table`` aggregates the shards into the fleet report's
+  per-rank trend section (sustained-burn windows flagged).
+
+Channel contract (PR 1-8 discipline, alloc-guard pinned by
+tests/test_timeseries.py): off (the default, interval 0) costs one flag
+read per ``ensure_recorder()`` call and allocates NOTHING —
+``TimeSeriesRecorder.samples_created`` counts every sampled row the way
+``Registry.allocations`` / ``Tracer.spans_created`` count theirs.
+
+Rows are wall-clock stamped (``ts`` = time.time()) so windows survive
+process restarts and merge across ranks without the perf-counter rebase
+traces need; each row carries the composite load score, queue depth, KV
+occupancy, busy-slot fraction, per-objective burn rate (the max across
+the SLO engine's policy windows holding data) and the firing alert
+names.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def interval_s() -> float:
+    try:
+        return float(_flags().get_flag(
+            "FLAGS_timeseries_interval_s", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the channel when it is off."""
+    return interval_s() > 0.0
+
+
+class TimeSeriesRecorder:
+    """Bounded ring of sampled telemetry rows + the sampling thread."""
+
+    def __init__(self, capacity: int = 1024):
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # every row minted (the interval=0 alloc-guard asserts this
+        # stays flat, like Registry.allocations / Tracer.spans_created)
+        self.samples_created = 0
+
+    # -- sampling ------------------------------------------------------
+
+    def sample_now(self) -> dict:
+        """Take one sample immediately (the loop's body; tests and the
+        fleet flusher call it directly for a deterministic row)."""
+        from . import httpd as _httpd
+        from . import slo as _slo
+
+        row = {"ts": round(time.time(), 3)}
+        try:
+            row["load"] = _slo.load_score()
+        except Exception:  # noqa: BLE001 — telemetry never raises
+            row["load"] = 0.0
+        queue = active = 0
+        occ = None
+        try:
+            engines = _httpd.tracked_engines()
+            if engines:
+                queue = sum(len(e._pending) for e in engines)
+                active = sum(1 for e in engines
+                             for s in e.slots if s.active)
+                pages = sum(e._n_pages_total for e in engines)
+                free = sum(len(e._free_pages) for e in engines)
+                if pages:
+                    occ = round(1.0 - free / pages, 4)
+        except Exception:  # noqa: BLE001
+            pass
+        row["queue"] = queue
+        row["active"] = active
+        if occ is not None:
+            row["kv_occupancy"] = occ
+        try:
+            eng = _slo.default_engine()
+            eng.tick()
+            report = eng.evaluate()
+            burn = {}
+            for obj in report.get("objectives") or ():
+                rates = [w["burn_rate"]
+                         for w in obj.get("windows", {}).values()
+                         if w.get("total")]
+                if rates:
+                    burn[obj["objective"]] = max(rates)
+            if burn:
+                row["burn"] = burn
+            firing = report.get("firing") or []
+            if firing:
+                row["firing"] = list(firing)
+        except Exception:  # noqa: BLE001
+            pass
+        self.samples_created += 1
+        with self._lock:
+            self._ring.append(row)
+        return row
+
+    def _loop(self):
+        while not self._stop.is_set():
+            iv = interval_s()
+            if iv <= 0.0:
+                # flag flipped off mid-run: park cheaply, keep the ring
+                self._stop.wait(1.0)
+                continue
+            try:
+                self.sample_now()
+            except Exception:  # noqa: BLE001 — a bad sample never
+                pass           # kills the recorder thread
+            self._stop.wait(iv)
+
+    def start(self) -> "TimeSeriesRecorder":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="timeseries-recorder",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        self._stop = threading.Event()
+
+    # -- reads ---------------------------------------------------------
+
+    def history(self, since_s: Optional[float] = None) -> List[dict]:
+        """Rows in the ring, oldest first; `since_s` keeps only the
+        trailing wall-clock window (larger than the ring's span simply
+        returns everything — never an error)."""
+        with self._lock:
+            rows = list(self._ring)
+        if since_s is not None:
+            cutoff = time.time() - float(since_s)
+            rows = [r for r in rows if r.get("ts", 0.0) >= cutoff]
+        return rows
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-global recorder + module-level API
+# ---------------------------------------------------------------------------
+
+_recorder: Optional[TimeSeriesRecorder] = None
+_rec_lock = threading.Lock()
+
+
+def ensure_recorder() -> Optional[TimeSeriesRecorder]:
+    """Start the sampling thread if FLAGS_timeseries_interval_s > 0 and
+    it is not already running (idempotent — fleet.heartbeat calls this
+    every beat). Off = one flag read, nothing allocated."""
+    global _recorder
+    if not enabled():
+        return _recorder
+    with _rec_lock:
+        if _recorder is None:
+            _recorder = TimeSeriesRecorder().start()
+        elif _recorder._thread is None:
+            _recorder.start()
+    return _recorder
+
+
+def recorder() -> Optional[TimeSeriesRecorder]:
+    return _recorder
+
+
+def history(since_s: Optional[float] = None) -> List[dict]:
+    """The current rank's sampled rows (empty when the channel never
+    ran) — what /debug/timeseries and the fleet flusher read."""
+    rec = _recorder
+    return rec.history(since_s=since_s) if rec is not None else []
+
+
+def samples_taken() -> int:
+    rec = _recorder
+    return rec.samples_created if rec is not None else 0
+
+
+def _reset_for_tests():
+    global _recorder
+    with _rec_lock:
+        rec, _recorder = _recorder, None
+    if rec is not None:
+        rec.stop()

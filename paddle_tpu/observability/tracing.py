@@ -70,6 +70,13 @@ _PH_INSTANT = "i"
 # can never collide in the viewer
 _TRACE_TID_BASE = 1 << 20
 
+# trace ids carry a pid-derived salt in their high bits: ids minted by
+# different processes of one fleet (router, N replicas) must never
+# collide, because the cross-shard stitcher (tools/trace_report.py
+# --stitch) joins rank shards on trace_id alone
+_ID_SEQ_BITS = 20
+_ID_SALT = (os.getpid() & 0xFFFF) << _ID_SEQ_BITS
+
 _clock = time.perf_counter
 
 
@@ -151,6 +158,123 @@ class _NoopTrace:
 
 
 NOOP_TRACE = _NoopTrace()
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace context (inject / extract)
+# ---------------------------------------------------------------------------
+#
+# A routed request's timeline spans processes: router.queue/route live
+# in the router's ring, serving.queue/prefill/decode in the replica's,
+# and a disaggregated request's decode in a THIRD engine. The compact
+# context below is the shared identity: trace_id (pid-salted, so the
+# stitcher can join shards on it), the parent span name, and the
+# sampling verdict. The verdict is decided ONCE, where the request
+# enters the fleet (the router): sampled-at-router stays sampled on
+# every hop, and an unsampled request never leaves orphan fragments on
+# some shards but not others.
+#
+# Wire format (the X-PT-Trace header): "<trace_id hex>-<0|1>-<parent>".
+# Transport: Router/HttpReplica send it on POST /v1/generate; the
+# telemetry httpd parks the raw header on the handler thread
+# (set_pending) and the route handler adopts it with extract();
+# KVHandoff carries it across the prefill->decode detach/attach
+# boundary (inference/serving.py).
+
+TRACE_HEADER = "X-PT-Trace"
+
+
+class TraceContext:
+    """The propagated identity of one distributed trace."""
+
+    __slots__ = ("trace_id", "span", "sampled")
+
+    def __init__(self, trace_id: int, span: Optional[str],
+                 sampled: bool):
+        self.trace_id = int(trace_id)
+        self.span = span or None
+        self.sampled = bool(sampled)
+
+    def header(self) -> str:
+        return (f"{self.trace_id:x}-{1 if self.sampled else 0}-"
+                f"{self.span or ''}")
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id}, "
+                f"span={self.span!r}, sampled={self.sampled})")
+
+
+_tls = threading.local()
+
+
+def inject(trace) -> Optional[str]:
+    """The trace's context as a header value, or None for a no-op /
+    finished-anonymous trace (callers skip the header entirely —
+    downstream then samples on its own, exactly as before)."""
+    trace_id = getattr(trace, "trace_id", None)
+    if trace_id is None:
+        return None
+    return TraceContext(int(trace_id), getattr(trace, "name", None),
+                        bool(getattr(trace, "sampled", False))).header()
+
+
+def parse_context(header) -> Optional[TraceContext]:
+    """Header value -> TraceContext, or None on anything malformed (a
+    bad header degrades to an unlinked local trace, never an error)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.split("-", 2)
+    if len(parts) < 2:
+        return None
+    try:
+        trace_id = int(parts[0], 16)
+    except ValueError:
+        return None
+    return TraceContext(trace_id, parts[2] if len(parts) > 2 else None,
+                        parts[1] == "1")
+
+
+def set_pending(header: Optional[str]):
+    """Park a raw inbound header on this thread (observability/httpd.py
+    calls this before dispatching a route handler); the handler adopts
+    it with extract(). One thread-local store — no parsing until a
+    handler asks."""
+    _tls.pending = header
+
+
+def extract(header: Optional[str] = None) -> Optional[TraceContext]:
+    """Adopt an inbound trace context as THIS thread's current context:
+    parses `header` (or the pending header httpd parked here) and
+    installs it, so every start_trace() on this thread joins the
+    inherited timeline. Returns the context, or None (no/invalid
+    header, or tracing off — one flag read, nothing allocated)."""
+    if not enabled():
+        return None
+    if header is None:
+        header = getattr(_tls, "pending", None)
+    ctx = parse_context(header)
+    _tls.ctx = ctx
+    return ctx
+
+
+def current_context() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def set_current(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install `ctx` as this thread's context; returns the previous one
+    (in-process transports bracket a call with set_current/restore)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+def clear_context():
+    """Drop this thread's context AND pending header (httpd calls this
+    after every handled request so a pooled handler thread never leaks
+    one request's identity into the next)."""
+    _tls.ctx = None
+    _tls.pending = None
 
 
 # ---------------------------------------------------------------------------
@@ -382,18 +506,34 @@ class Tracer:
     # -- recording ---------------------------------------------------------
 
     def start_trace(self, name: str = "trace", own_track: bool = False,
-                    **attrs):
+                    parent=None, **attrs):
         """Begin a logical timeline; head sampling decides retention NOW.
         Returns NOOP_TRACE (not None — callers never branch) when
         tracing is off, or when the trace is unsampled and the slow
-        escape hatch is disabled (nothing could ever commit it)."""
+        escape hatch is disabled (nothing could ever commit it).
+
+        `parent` (a TraceContext, or the thread's extract()-installed
+        context when omitted) makes this trace a HOP of a distributed
+        one: it adopts the inherited trace_id and the inherited
+        sampling verdict — decided once where the request entered the
+        fleet — instead of minting/sampling its own."""
         if not enabled():
             return NOOP_TRACE
+        ctx = parent if parent is not None else current_context()
+        if ctx is not None:
+            if not ctx.sampled and slow_ms() <= 0.0:
+                return NOOP_TRACE
+            if ctx.span:
+                attrs.setdefault("parent", ctx.span)
+            self.spans_created += 1
+            return Trace(self, int(ctx.trace_id), bool(ctx.sampled),
+                         name, own_track, attrs)
         sampled = self.sample()
         if not sampled and slow_ms() <= 0.0:
             return NOOP_TRACE
         with self._lock:
-            trace_id = self._next_trace_id
+            trace_id = _ID_SALT | (self._next_trace_id
+                                   & ((1 << _ID_SEQ_BITS) - 1))
             self._next_trace_id += 1
         self.spans_created += 1
         return Trace(self, trace_id, sampled, name, own_track, attrs)
@@ -524,8 +664,10 @@ def set_default_tracer(tracer: Tracer) -> Tracer:
     return prev
 
 
-def start_trace(name: str = "trace", own_track: bool = False, **attrs):
-    return _default.start_trace(name, own_track=own_track, **attrs)
+def start_trace(name: str = "trace", own_track: bool = False,
+                parent=None, **attrs):
+    return _default.start_trace(name, own_track=own_track,
+                                parent=parent, **attrs)
 
 
 def span(name, **attrs):

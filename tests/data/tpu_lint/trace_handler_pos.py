@@ -1,0 +1,80 @@
+"""Fixture: route-handler-trace positives (and the clean delegating,
+cross-frame, finally-closed, and generator shapes that must NOT
+fire)."""
+from paddle_tpu.observability import httpd as _httpd
+from paddle_tpu.observability import tracing
+
+
+def bad_handler(qs):  # line 8: flagged — spans before extract()
+    tr = tracing.start_trace("http.request", qs=dict(qs))
+    tr.finish(ok=True)
+    return {"ok": True}
+
+
+_httpd.register_route("/v1/bad", bad_handler)
+
+
+def good_handler(qs):
+    # clean: extracts the inbound X-PT-Trace context first
+    tracing.extract()
+    tr = tracing.start_trace("http.request", qs=dict(qs))
+    tr.finish(ok=True)
+    return {"ok": True}
+
+
+_httpd.register_route("/v1/good", good_handler)
+
+
+def delegating_handler(qs):
+    # clean: opens no spans itself — submit()'s frame inherits the
+    # thread context the httpd layer parked
+    return {"rid": qs.get("rid")}
+
+
+_httpd.register_route("/v1/delegate", delegating_handler)
+
+
+class Bridge:
+    def start(self):
+        _httpd.register_route("/v1/cls", self._handle)
+        return self
+
+    def _handle(self, qs):  # line 42: flagged — method handler, no extract
+        tracer = tracing.default_tracer()
+        with tracer.span("bridge.handle"):
+            return {"ok": True}
+
+
+def leaky(trace, work):
+    # POSITIVE below: early return leaks the phase this function
+    # closes on its happy path
+    trace.begin("phase")
+    if work is None:
+        return None  # line 53: flagged — `phase` still open
+    out = work()
+    trace.end("phase")
+    return out
+
+
+def cross_frame_opener(trace):
+    # clean: the matching end lives in another frame (async phase,
+    # like router.submit's `router.queue` closed by _dispatch)
+    trace.begin("queue")
+    return trace
+
+
+def finally_closed(trace, work):
+    # clean: the finally block closes the phase on every return
+    trace.begin("phase")
+    try:
+        return work()
+    finally:
+        trace.end("phase")
+
+
+def streamer(trace, items):
+    # clean: generators suspend with phases deliberately open
+    trace.begin("stream")
+    for it in items:
+        yield it
+    trace.end("stream")

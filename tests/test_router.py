@@ -6,6 +6,7 @@ tools/router_smoke.py in CI; these tests keep the router's decision
 logic deterministic and fast."""
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -181,6 +182,57 @@ def test_all_replicas_failing_exhausts_attempts():
         assert "injected replica failure" in out["error"]
     finally:
         r.close()
+
+
+def test_retry_ttft_charges_failed_attempts():
+    """Regression: routed TTFT must include failover time. The old
+    accounting measured from the FIRST attempt's dispatch, so a slow
+    failed attempt made the histogram report a ~1 ms TTFT for a
+    request the user actually waited 250+ ms on."""
+    class SlowFail(FakeReplica):
+        def generate(self, request, timeout):
+            if self.fail_n > 0:
+                self.fail_n -= 1
+                time.sleep(0.25)
+                raise RuntimeError("slow injected failure")
+            return super().generate(request, timeout)
+
+    rep = SlowFail("flaky", fail_n=1)
+    r = Router([rep], workers=1).start()
+    # router_ttft_seconds lives in the process-default registry, so
+    # other routers in this process share the cell: assert on deltas
+    n0, s0 = r._m.ttft.count, r._m.ttft.sum
+    try:
+        out = r.generate([1, 2], timeout=20.0)
+        assert out["ok"] and out["attempts"] == 2
+        assert r._m.ttft.count == n0 + 1
+        # the 0.25 s the dead attempt burned is user-visible latency:
+        # it must land in the TTFT observation, not vanish
+        assert r._m.ttft.sum - s0 >= 0.2, (r._m.ttft.sum, s0)
+    finally:
+        r.close()
+
+
+def test_dispatch_injects_trace_context(monkeypatch):
+    from paddle_tpu.framework import config as _config
+    from paddle_tpu.observability import tracing as tr
+
+    monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"], "value",
+                        1.0)
+    prev = tr.set_default_tracer(tr.Tracer())
+    rep = FakeReplica("a")
+    r = Router([rep], workers=1).start()
+    try:
+        assert r.generate([3, 4], timeout=10.0)["ok"]
+        # the dispatched request carries the router's trace context so
+        # the replica's spans join ONE stitched timeline
+        ctx = tr.parse_context(rep.calls[0]["trace_ctx"])
+        assert ctx is not None
+        assert ctx.sampled          # sampled-at-router rides the wire
+        assert ctx.span == "router.request"
+    finally:
+        r.close()
+        tr.set_default_tracer(prev)
 
 
 def test_stats_shape():

@@ -309,6 +309,116 @@ class TestScrapeConsistency:
         assert h.bucket_counts()[float("inf")] == 3
 
 
+class TestTraceWindowEdgesAndPropagation:
+    """/debug/trace window-capture edge cases plus the X-PT-Trace
+    header -> route handler adoption path (ISSUE 16)."""
+
+    def test_empty_ring_is_valid_empty_chrome_trace(self):
+        prev_tr = tracing.set_default_tracer(tracing.Tracer())
+        _srv, base = _server()
+        try:
+            code, body = _get(base, "/debug/trace?secs=60")
+            assert code == 200
+            events = json.loads(body)   # must stay loadable by the
+            assert isinstance(events, list)  # chrome trace viewer
+            assert all(e.get("ph") == "M" for e in events)
+        finally:
+            tracing.set_default_tracer(prev_tr)
+
+    def test_window_larger_than_ring_span_returns_everything(self):
+        prev = paddle.get_flags(["FLAGS_trace_sample"])
+        paddle.set_flags({"FLAGS_trace_sample": 1.0})
+        prev_tr = tracing.set_default_tracer(tracing.Tracer())
+        _srv, base = _server()
+        try:
+            t = tracing.start_trace("edge.request", own_track=True)
+            with t.span("edge.work"):
+                pass
+            t.finish()
+            # a window absurdly wider than the ring's span must not
+            # error or drop anything
+            code, body = _get(base, "/debug/trace?secs=1e15")
+            assert code == 200
+            events = json.loads(body)
+            names = {e.get("name") for e in events
+                     if e.get("ph") == "X"}
+            assert "edge.work" in names
+            code, body600 = _get(base, "/debug/trace?secs=600")
+            n600 = sum(1 for e in json.loads(body600)
+                       if e.get("ph") == "X")
+            assert sum(1 for e in events if e.get("ph") == "X") == n600
+        finally:
+            tracing.set_default_tracer(prev_tr)
+            paddle.set_flags(prev)
+
+    def test_concurrent_scrape_during_live_decode(self):
+        eng, cfg = _tiny_engine()
+        _srv, base = _server()
+        prev = paddle.get_flags(["FLAGS_trace_sample"])
+        paddle.set_flags({"FLAGS_trace_sample": 1.0})
+        prev_tr = tracing.set_default_tracer(tracing.Tracer())
+        try:
+            rng = np.random.RandomState(1)
+            eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                            max_new_tokens=4)
+            results = []
+
+            def scraper():
+                for _ in range(20):
+                    results.append(_get(base, "/debug/trace?secs=60"))
+
+            th = threading.Thread(target=scraper)
+            th.start()
+            finished = eng.run()
+            th.join()
+            assert len(finished) == 1
+            # every response taken mid-decode must be complete JSON —
+            # never a torn ring read or a 500
+            for code, body in results:
+                assert code == 200
+                assert isinstance(json.loads(body), list)
+        finally:
+            tracing.set_default_tracer(prev_tr)
+            paddle.set_flags(prev)
+
+    def test_x_pt_trace_header_reaches_route_handler(self):
+        prev = paddle.get_flags(["FLAGS_trace_sample"])
+        paddle.set_flags({"FLAGS_trace_sample": 1.0})
+        seen = []
+
+        def handler(method, query, body):
+            seen.append(tracing.extract())
+            return 200, b"{}\n", "application/json"
+
+        httpd.register_route("/v1/ctx_echo", handler)
+        _srv, base = _server()
+        try:
+            hdr = tracing.TraceContext(0xfeed, "router.request",
+                                       True).header()
+            req = urllib.request.Request(
+                base + "/v1/ctx_echo", data=b"{}",
+                headers={tracing.TRACE_HEADER: hdr,
+                         "Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            assert seen[0] is not None
+            assert seen[0].trace_id == 0xfeed
+            assert seen[0].sampled
+            assert seen[0].span == "router.request"
+            # no identity leak: the same route without the header must
+            # extract nothing (httpd clears the parked context)
+            req2 = urllib.request.Request(base + "/v1/ctx_echo",
+                                          data=b"{}", method="POST")
+            with urllib.request.urlopen(req2, timeout=10) as r:
+                assert r.status == 200
+            assert seen[1] is None
+        finally:
+            httpd.unregister_route("/v1/ctx_echo")
+            tracing.clear_context()
+            paddle.set_flags(prev)
+
+
 class TestOffPathAndFleet:
     def test_port_zero_is_one_flag_read_no_allocs(self):
         """FLAGS_telemetry_port=0: no server, no SLO snapshots, zero

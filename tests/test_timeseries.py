@@ -1,0 +1,232 @@
+"""Time-series telemetry history (ISSUE 16:
+observability/timeseries.py + fleet.history_table): recorder row
+contents, ring bound + window reads, the interval=0 zero-overhead
+off path (alloc-guard pinned), history.jsonl export through the fleet
+flusher, per-rank trend aggregation with sustained-burn detection, the
+fleet report section, and the /debug/timeseries endpoint."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import config as _config
+from paddle_tpu.observability import fleet as fleet_mod
+from paddle_tpu.observability import httpd, slo
+from paddle_tpu.observability import timeseries as ts
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    ts._reset_for_tests()
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+    yield
+    ts._reset_for_tests()
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+
+
+def _tiny_engine(**kw):
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           seq=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("page_size", 8)
+    return ServingEngine(m, **kw), cfg
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+
+def test_off_is_one_flag_read_nothing_allocated():
+    # the channel contract every observability PR holds: default-off
+    # costs a flag read and allocates nothing
+    assert not ts.enabled()
+    assert ts.ensure_recorder() is None
+    assert ts.recorder() is None
+    assert ts.history() == []
+    assert ts.samples_taken() == 0
+
+
+def test_sample_now_row_contents():
+    eng, cfg = _tiny_engine()
+    rng = np.random.RandomState(0)
+    eng.add_request(rng.randint(0, cfg.vocab_size, (6,)),
+                    max_new_tokens=3)
+    rec = ts.TimeSeriesRecorder()
+    row = rec.sample_now()
+    assert row["queue"] == 1 and row["active"] == 0
+    assert row["load"] > 0.0            # queued request raises load
+    assert "kv_occupancy" in row        # engine pages are visible
+    assert 0.0 <= row["kv_occupancy"] <= 1.0
+    assert abs(row["ts"] - time.time()) < 5.0   # wall-clock stamped
+    assert rec.samples_created == 1 and len(rec) == 1
+    eng.run()
+    row2 = rec.sample_now()
+    assert row2["queue"] == 0 and row2["active"] == 0
+    assert rec.samples_created == 2
+
+
+def test_ring_bound_and_window_reads():
+    rec = ts.TimeSeriesRecorder(capacity=4)
+    for _ in range(10):
+        rec.sample_now()
+    assert len(rec) == 4                # bounded: old rows evicted
+    assert rec.samples_created == 10    # ...but every mint counted
+    assert len(rec.history()) == 4
+    # a window wider than the ring's span returns everything, never
+    # an error; an empty window returns nothing
+    assert rec.history(since_s=1e9) == rec.history()
+    assert rec.history(since_s=-1.0) == []
+
+
+def test_ensure_recorder_idempotent_and_samples_on_interval(
+        monkeypatch):
+    monkeypatch.setattr(_config._FLAGS["FLAGS_timeseries_interval_s"],
+                        "value", 0.02)
+    rec = ts.ensure_recorder()
+    assert rec is not None
+    assert ts.ensure_recorder() is rec
+    deadline = time.monotonic() + 10.0
+    while ts.samples_taken() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ts.samples_taken() >= 2
+    assert ts.history()
+
+
+# ---------------------------------------------------------------------------
+# fleet export + aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_flush_exports_history_shard(tmp_path, monkeypatch):
+    monkeypatch.setattr(_config._FLAGS["FLAGS_timeseries_interval_s"],
+                        "value", 60.0)   # on, but only manual samples
+    ts.ensure_recorder().sample_now()
+    fleet_mod.FleetExporter(str(tmp_path), rank=0, world_size=1).flush()
+    p = tmp_path / "rank_0" / "history.jsonl"
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert rows
+    assert {"ts", "load", "queue", "active"} <= set(rows[0])
+
+
+def test_fleet_flush_writes_empty_history_when_off(tmp_path):
+    # the shard file set is a documented contract: history.jsonl is
+    # present (empty) even when the channel never ran
+    fleet_mod.FleetExporter(str(tmp_path), rank=0, world_size=1).flush()
+    assert (tmp_path / "rank_0" / "history.jsonl").read_text() == ""
+
+
+def test_heartbeat_starts_recorder_only_when_enabled(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setattr(_config._FLAGS["FLAGS_telemetry_dir"], "value",
+                        str(tmp_path))
+    fleet_mod.heartbeat()
+    assert ts.recorder() is None        # interval 0: nothing spawned
+    monkeypatch.setattr(_config._FLAGS["FLAGS_timeseries_interval_s"],
+                        "value", 60.0)
+    fleet_mod.heartbeat()
+    assert ts.recorder() is not None
+
+
+def _write_history(shard, rows):
+    shard.mkdir(parents=True, exist_ok=True)
+    (shard / "history.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+
+
+def test_history_table_trend_and_sustained_burn(tmp_path):
+    t0 = 1000.0
+    rows = []
+    for i, load in enumerate([0.1, 0.2, 0.4, 0.8, 0.9, 0.7]):
+        r = {"ts": t0 + i, "load": load, "queue": i, "active": 1,
+             "kv_occupancy": 0.1 * i}
+        if 1 <= i <= 4:
+            r["burn"] = {"ttft_p95": 2.0 + i}   # 4 consecutive >= 1.0
+        elif i == 5:
+            r["burn"] = {"ttft_p95": 0.5}       # run closes here
+        rows.append(r)
+    _write_history(tmp_path / "rank_0", rows)
+    # rank 1: a 2-sample blip must NOT be flagged as sustained
+    blip = [{"ts": t0 + i, "load": 0.1, "queue": 0, "active": 0,
+             "burn": {"ttft_p95": 3.0}} for i in range(2)]
+    _write_history(tmp_path / "rank_1", blip)
+
+    table = fleet_mod.history_table(
+        {0: str(tmp_path / "rank_0"), 1: str(tmp_path / "rank_1")},
+        burn_threshold=1.0, sustain=3)
+    assert [r["rank"] for r in table] == [0, 1]
+    row = table[0]
+    assert row["samples"] == 6
+    assert row["span_s"] == pytest.approx(5.0)
+    assert row["load_first"] == pytest.approx(0.1)
+    assert row["load_last"] == pytest.approx(0.7)
+    assert row["load_max"] == pytest.approx(0.9)
+    assert row["queue_max"] == 5
+    assert row["kv_max"] == pytest.approx(0.5)
+    assert row["burn_max"]["ttft_p95"] == pytest.approx(6.0)
+    (sb,) = row["sustained_burn"]
+    assert sb["objective"] == "ttft_p95"
+    assert sb["samples"] == 4
+    assert sb["peak_burn"] == pytest.approx(6.0)
+    assert sb["span_s"] == pytest.approx(3.0)
+    assert table[1]["sustained_burn"] == []     # blip below `sustain`
+
+
+def test_history_table_skips_ranks_without_samples(tmp_path):
+    _write_history(tmp_path / "rank_0", [])
+    assert fleet_mod.history_table({0: str(tmp_path / "rank_0")}) == []
+
+
+def test_fleet_report_renders_history_section(tmp_path):
+    t0 = 2000.0
+    rows = [{"ts": t0 + i, "load": 0.5, "queue": 1, "active": 1,
+             "kv_occupancy": 0.25,
+             "burn": {"ttft_p95": 2.5}} for i in range(4)]
+    _write_history(tmp_path / "rank_0", rows)
+    table = fleet_mod.history_table({0: str(tmp_path / "rank_0")})
+    report = {"root": str(tmp_path), "shards": {}, "ranks": [],
+              "world_size": 1, "dead": [], "missing": [],
+              "stragglers": [], "straggler_summary": [],
+              "artifacts": {}, "history": table}
+    text = fleet_mod.format_report(report)
+    assert "telemetry history per rank" in text
+    assert "SUSTAINED BURN: rank 0 ttft_p95" in text
+    assert "drain traffic off this rank" in text
+
+
+# ---------------------------------------------------------------------------
+# live endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_debug_timeseries_endpoint_off_then_on(monkeypatch):
+    srv = httpd.start_server(port=0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{srv.port}"
+    with urllib.request.urlopen(base + "/debug/timeseries?secs=60",
+                                timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["enabled"] is False
+    assert doc["samples"] == []
+    monkeypatch.setattr(_config._FLAGS["FLAGS_timeseries_interval_s"],
+                        "value", 60.0)
+    ts.ensure_recorder().sample_now()
+    with urllib.request.urlopen(base + "/debug/timeseries?secs=300",
+                                timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["enabled"] is True
+    assert doc["interval_s"] == pytest.approx(60.0)
+    assert doc["window_s"] == pytest.approx(300.0)
+    assert doc["samples"]
+    assert "load" in doc["samples"][0]

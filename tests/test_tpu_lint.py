@@ -40,6 +40,11 @@ def lint_fixture(name, **kw):
     # and recursion-as-retry around a decode dispatch; the bounded,
     # backoff-paced, and re-raising variants below them stay clean
     ("unbounded_retry_pos.py", "unbounded-retry", [10, 23]),
+    # trace propagation: a route handler opening spans without
+    # tracing.extract() (function + method forms) and a return that
+    # leaks a begun phase; the extracting, delegating, cross-frame,
+    # finally-closed, and generator shapes below them stay clean
+    ("trace_handler_pos.py", "route-handler-trace", [8, 42, 53]),
     # sync transfers in step loops: device_put, block_until_ready,
     # np.asarray inside *step*/*loop* functions; the suppressed,
     # builder-closure, host-helper, and local-asarray twins stay clean
@@ -58,7 +63,7 @@ def test_registry_ships_all_six_rules():
         "jax-compat", "weak-float-in-kernel",
         "rank-divergent-collective", "side-effect-under-jit",
         "donated-arg-reuse", "flag-hygiene", "unbounded-retry",
-        "sync-transfer-in-step-loop"}
+        "sync-transfer-in-step-loop", "route-handler-trace"}
     for cls in RULES.values():
         assert cls.description
 

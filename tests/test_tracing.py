@@ -550,3 +550,168 @@ class TestTraceReport:
         p.write_text(json.dumps({"traceEvents": []}))
         rep = _load_trace_report()
         assert rep.load_events(str(p)) == []
+
+
+class TestContextPropagation:
+    """The X-PT-Trace context (ISSUE 16 tentpole): inject/extract
+    roundtrip, thread-local adoption, the sampled-at-router verdict
+    riding the wire, and the KVHandoff carry across a disaggregated
+    prefill -> decode boundary."""
+
+    def test_header_roundtrip(self, tracer):
+        t = tracer.start_trace("router.request", own_track=True)
+        ctx = tr.parse_context(tr.inject(t))
+        assert ctx is not None
+        assert ctx.trace_id == t.trace_id
+        assert ctx.span == "router.request"
+        assert ctx.sampled
+        t.finish()
+
+    def test_inject_noop_and_malformed_headers(self, tracer_off):
+        assert tr.inject(tr.NOOP_TRACE) is None
+        for bad in (None, "", 42, "zzz-1-x", "deadbeef", b"abc-1"):
+            assert tr.parse_context(bad) is None, bad
+
+    def test_extract_installs_then_clear_drops(self, tracer):
+        hdr = tr.TraceContext(0xabc, "router.request", True).header()
+        tr.set_pending(hdr)
+        try:
+            ctx = tr.extract()
+            assert ctx is not None and ctx.trace_id == 0xabc
+            assert tr.current_context() is ctx
+        finally:
+            tr.clear_context()
+        assert tr.current_context() is None
+        assert tr.extract() is None   # pending header dropped too
+
+    def test_extract_is_inert_when_tracing_off(self, tracer_off):
+        tr.set_pending("abc-1-router.request")
+        try:
+            assert tr.extract() is None
+            assert tr.current_context() is None
+        finally:
+            tr.clear_context()
+
+    def test_child_adopts_inherited_trace_id(self, tracer):
+        parent = tracer.start_trace("router.request", own_track=True)
+        ctx = tr.parse_context(tr.inject(parent))
+        child = tracer.start_trace("serving.request", own_track=True,
+                                   parent=ctx)
+        assert child.trace_id == parent.trace_id
+        child.finish()
+        parent.finish()
+
+    def test_thread_context_adopted_without_explicit_parent(
+            self, tracer):
+        ctx = tr.TraceContext(0x77, "router.request", True)
+        prev = tr.set_current(ctx)
+        try:
+            t = tracer.start_trace("serving.request", own_track=True)
+            assert t.trace_id == 0x77
+            t.finish()
+        finally:
+            tr.set_current(prev)
+
+    def test_sampled_verdict_overrides_local_sampler(
+            self, tracer, monkeypatch):
+        # the router sampled this request; a replica at a 1% local
+        # rate must STILL record its hops — the verdict is fleet-wide,
+        # decided once where the request entered
+        monkeypatch.setattr(_config._FLAGS["FLAGS_trace_sample"],
+                            "value", 0.01)
+        assert tracer.start_trace("local") is tr.NOOP_TRACE
+        ctx = tr.TraceContext(0x5, "router.request", True)
+        child = tracer.start_trace("serving.request", parent=ctx)
+        assert child is not tr.NOOP_TRACE
+        assert child.trace_id == 0x5
+        child.finish()
+
+    def test_unsampled_verdict_suppresses_local_spans(self, tracer):
+        # ...and an UNSAMPLED verdict wins over a local rate of 1.0,
+        # so no shard holds orphan fragments of a dropped trace
+        c0 = tracer.spans_created
+        ctx = tr.TraceContext(0x6, "router.request", False)
+        child = tracer.start_trace("serving.request", parent=ctx)
+        assert child is tr.NOOP_TRACE
+        assert tracer.spans_created == c0
+
+    def test_handoff_carries_context_across_engines(self, tracer):
+        from paddle_tpu.inference import DisaggregatedServing
+
+        pe, cfg = _tiny_engine()
+        de, _ = _tiny_engine()
+        rng = np.random.RandomState(5)
+        out = DisaggregatedServing(pe, de).generate(
+            rng.randint(0, cfg.vocab_size, (6,)), max_new_tokens=3)
+        assert out["ok"]
+        events = tracer.to_chrome_trace()
+        by_name = {}
+        for e in events:
+            if e.get("ph") == "X" and "trace_id" in e.get("args", {}):
+                by_name.setdefault(e["name"],
+                                   set()).add(e["args"]["trace_id"])
+        # prefill (engine A), the handoff attach, and decode (engine B)
+        # all land under ONE trace_id: one request, one timeline
+        assert by_name["serving.prefill"] == by_name["serving.attach"]
+        assert by_name["serving.attach"] == by_name["serving.decode"]
+        assert len(by_name["serving.prefill"]) == 1
+
+    def test_off_path_context_calls_add_no_spans(self, tracer_off):
+        c0 = tracer_off.spans_created
+        assert tr.inject(tr.NOOP_TRACE) is None
+        assert tr.extract("abc-1-x") is None
+        assert tracer_off.spans_created == c0
+
+
+class TestStitchReport:
+    """tools/trace_report.py --stitch: cross-shard grouping by
+    trace_id, per-hop table, network derivation, orphan detection."""
+
+    @staticmethod
+    def _ev(name, ts, dur, pid, trace_id):
+        return {"ph": "X", "name": name, "ts": float(ts),
+                "dur": float(dur), "pid": pid, "tid": 1,
+                "args": {"trace_id": trace_id}}
+
+    def _events(self):
+        ev = self._ev
+        return [
+            # trace 5: router (pid 1) + serving (pid 2) — stitched
+            ev("router.queue", 0, 100, 1, 5),
+            ev("router.route", 100, 900, 1, 5),
+            ev("serving.queue", 200, 50, 2, 5),
+            ev("serving.prefill", 250, 300, 2, 5),
+            ev("serving.decode", 550, 400, 2, 5),
+            # trace 9: router only — the context died on the wire
+            ev("router.queue", 0, 10, 1, 9),
+            ev("router.route", 10, 50, 1, 9),
+            # unrelated span: never grouped
+            ev("train.step", 0, 10, 1, None),
+        ]
+
+    def test_stitch_rows_hops_and_orphan(self):
+        rep = _load_trace_report()
+        rows = rep.stitch_rows(self._events())
+        assert [r["trace_id"] for r in rows] == [5, 9]
+        joined = rows[0]
+        assert joined["n_procs"] == 2 and joined["pids"] == [1, 2]
+        assert not joined["orphan"]
+        assert joined["router_queue_us"] == 100
+        assert joined["route_us"] == 900
+        # network = route wall minus the serving side's own wall
+        # (200..950 = 750 us) -> 150 us of HTTP round trip
+        assert joined["network_us"] == pytest.approx(150.0)
+        assert joined["replica_queue_us"] == 50
+        assert joined["prefill_us"] == 300
+        assert joined["decode_us"] == 400
+        assert joined["handoff_us"] == 0
+        orphan = rows[1]
+        assert orphan["orphan"] and orphan["network_us"] is None
+
+    def test_format_stitch_table_and_orphan_flag(self):
+        rep = _load_trace_report()
+        text = rep.format_stitch(rep.stitch_rows(self._events()))
+        assert "stitched distributed traces (2)" in text
+        assert "ORPHAN (injected but never extracted)" in text
+        assert "network_ms" in text and "handoff_ms" in text
+        assert "1 trace(s) span >=2 processes; 1 orphan(s)" in text

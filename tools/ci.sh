@@ -256,6 +256,23 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# distributed-trace stitch smoke (ISSUE 16, README.md "Distributed
+# tracing + telemetry history"): 2 traced replica subprocesses behind
+# the router; one request forced through an HttpReplica must stitch to
+# a SINGLE trace_id spanning >= 2 processes with the complete hop
+# table (router queue / network / replica queue / prefill / decode)
+# and no orphan spans, and one DisaggregatedServing request must carry
+# its trace context across the KVHandoff (prefill + handoff + decode
+# hops under one trace_id).
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/trace_stitch_smoke.py --dir /tmp/ci_trace_stitch; then
+  echo "CI: trace stitch smoke FAILED (a routed request's spans did" \
+       "not stitch to one trace_id across processes, a hop is missing" \
+       "from the table, or an orphan trace — X-PT-Trace propagation" \
+       "broke; worker logs in /tmp/ci_trace_stitch/)" >&2
+  rc=1
+fi
+
 # chaos drill (ISSUE 11, README.md "Fault tolerance"): scheduled
 # rank.kill (FLAGS_chaos) mid-training in a 2-rank elastic pod -> the
 # controller must restart the pod, every rank must resume from its last
@@ -276,7 +293,8 @@ if [ $rc -ne 0 ]; then
 else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
-       "/tmp/ci_chaos/, /tmp/ci_router/, /tmp/ci_bench_smoke.json," \
+       "/tmp/ci_chaos/, /tmp/ci_router/, /tmp/ci_trace_stitch/," \
+       "/tmp/ci_bench_smoke.json," \
        "/tmp/ci_overlap_ledger.prom (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi

@@ -9,9 +9,15 @@ format) and prints:
 - span duration statistics (count / p50 / p95 / max) by span name;
 - the CRITICAL PATH of the slowest request (or, in a training trace,
   the slowest train step): its phases in time order with durations,
-  percentages, and any unattributed gap.
+  percentages, and any unattributed gap;
+- with --stitch, the cross-shard distributed-trace table: rank shards
+  joined on trace_id (the X-PT-Trace propagation contract), each
+  routed request as ONE per-hop latency row — router queue / network /
+  replica queue / prefill / decode / handoff — with orphan traces
+  (injected but never extracted) called out.
 
     python tools/trace_report.py /tmp/ci_trace.json
+    python tools/trace_report.py --stitch /tmp/fleet_dir
 
 Exit codes: 0 = report printed, 2 = empty/unusable trace (CI gates on
 this — a trace that yields no critical path is a red run).
@@ -51,7 +57,8 @@ def load_events(path: str) -> List[dict]:
                     f"rank_*/trace.json inside")
             events: List[dict] = []
             for p in shards:
-                events.extend(load_events(p))
+                events.extend(_rebase_shard(load_events(p),
+                                            os.path.dirname(p)))
             return events
     with open(path) as f:
         payload = json.load(f)
@@ -60,6 +67,25 @@ def load_events(path: str) -> List[dict]:
     if not isinstance(payload, list):
         raise ValueError("not a Chrome trace: expected an event array")
     return [e for e in payload if isinstance(e, dict)]
+
+
+def _rebase_shard(events, shard_dir):
+    """Rebase one rank shard's span timestamps (process-local
+    perf_counter µs) onto wall-clock µs using the perf<->wall anchor
+    its heartbeat.json carries — the same offset fleet.merge_traces
+    applies, inlined so the tool stays dependency-free. Shards without
+    an anchor pass through unchanged (single-process reports never
+    needed it)."""
+    try:
+        with open(os.path.join(shard_dir, "heartbeat.json")) as f:
+            clock = (json.load(f) or {}).get("clock") or {}
+        off = (float(clock["wall_s"]) - float(clock["perf_s"])) * 1e6
+    except (OSError, ValueError, KeyError, TypeError):
+        return events
+    for e in events:
+        if "ts" in e:
+            e["ts"] = float(e["ts"]) + off
+    return events
 
 
 def _spans(events):
@@ -73,6 +99,7 @@ def _spans(events):
             "ts": float(e["ts"]),
             "dur": float(e.get("dur", 0.0)),
             "tid": e.get("tid"),
+            "pid": e.get("pid"),
             "args": e.get("args") or {},
         })
     out.sort(key=lambda s: s["ts"])
@@ -228,6 +255,95 @@ def critical_path(trace_spans, total_us) -> List[tuple]:
     return out
 
 
+def stitch_rows(events) -> List[dict]:
+    """Cross-shard stitch: group EVERY span (router + serving) by
+    trace_id — after inject()/extract() propagation one routed request
+    shares one id across processes — and break each distributed trace
+    into its hops:
+
+      router_queue  router.queue (submit -> dispatch)
+      route         router.route (dispatch -> result returned)
+      network       route minus the replica's serving-side wall — the
+                    HTTP round trip + serialization (0 for in-process
+                    replicas, clamped at 0 against clock jitter)
+      replica_queue serving.queue on the replica
+      prefill       serving.prefill
+      decode        serving.decode
+      handoff       serving.attach (disaggregated KV scatter on the
+                    decode engine)
+
+    A trace with router spans but NO serving spans is an ORPHAN — the
+    context was injected but never extracted (exactly what the CI
+    smoke and the route-handler-trace lint rule exist to catch)."""
+    spans = _spans(events)
+    groups = defaultdict(list)
+    for s in spans:
+        tid = s["args"].get("trace_id")
+        if tid is not None and (s["name"].startswith("router.")
+                                or s["name"].startswith("serving.")):
+            groups[tid].append(s)
+    rows = []
+    for trace_id, tspans in sorted(groups.items()):
+        router = [s for s in tspans
+                  if s["name"].startswith("router.")]
+        serving = [s for s in tspans
+                   if s["name"].startswith("serving.")]
+        pids = sorted({s["pid"] for s in tspans
+                       if s["pid"] is not None})
+        route_us = _phase_total_us(tspans, "router.route")
+        network_us = None
+        if router and serving:
+            s0, s1 = _trace_bounds(serving)
+            network_us = max(0.0, route_us - (s1 - s0))
+        t0, t1 = _trace_bounds(tspans)
+        rows.append({
+            "trace_id": trace_id,
+            "pids": pids,
+            "n_procs": len(pids),
+            "router_queue_us":
+                _phase_total_us(tspans, "router.queue"),
+            "route_us": route_us,
+            "network_us": network_us,
+            "replica_queue_us":
+                _phase_total_us(tspans, "serving.queue"),
+            "prefill_us": _phase_total_us(tspans, "serving.prefill"),
+            "decode_us": _phase_total_us(tspans, "serving.decode"),
+            "handoff_us": _phase_total_us(tspans, "serving.attach"),
+            "total_us": t1 - t0,
+            "orphan": bool(router) and not serving,
+            "spans": tspans,
+        })
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def format_stitch(rows) -> str:
+    """The per-hop latency table for stitched distributed traces."""
+    lines = [f"== stitched distributed traces ({len(rows)}) =="]
+    lines.append(f"{'trace':>10} {'procs':>6} {'rtr_queue_ms':>13} "
+                 f"{'network_ms':>11} {'rep_queue_ms':>13} "
+                 f"{'prefill_ms':>11} {'decode_ms':>10} "
+                 f"{'handoff_ms':>11} {'total_ms':>9}")
+    for r in rows:
+        net = _ms(r["network_us"]) if r["network_us"] is not None \
+            else "-"
+        flag = "  ORPHAN (injected but never extracted)" \
+            if r["orphan"] else ""
+        lines.append(
+            f"{str(r['trace_id']):>10} {r['n_procs']:>6} "
+            f"{_ms(r['router_queue_us']):>13} {net:>11} "
+            f"{_ms(r['replica_queue_us']):>13} "
+            f"{_ms(r['prefill_us']):>11} {_ms(r['decode_us']):>10} "
+            f"{_ms(r['handoff_us']):>11} {_ms(r['total_us']):>9}"
+            f"{flag}")
+    stitched = [r for r in rows if r["n_procs"] >= 2]
+    orphans = [r for r in rows if r["orphan"]]
+    lines.append("")
+    lines.append(f"{len(stitched)} trace(s) span >=2 processes; "
+                 f"{len(orphans)} orphan(s)")
+    return "\n".join(lines) + "\n"
+
+
 def find_ledger(trace_path: str) -> Optional[List[str]]:
     """Stepledger expositions sitting alongside the trace: a
     `ledger.prom` in the same directory (a fleet rank shard carries one
@@ -371,6 +487,12 @@ def main(argv=None) -> int:
                          "critical-path phases to ledger buckets "
                          "(default: a ledger.prom alongside the "
                          "trace, when present)")
+    ap.add_argument("--stitch", action="store_true",
+                    help="cross-shard stitch mode: join rank shards "
+                         "on trace_id (X-PT-Trace propagation) and "
+                         "print the per-hop latency table — router "
+                         "queue / network / replica queue / prefill / "
+                         "decode / handoff per distributed trace")
     args = ap.parse_args(argv)
     try:
         events = load_events(args.trace)
@@ -378,6 +500,14 @@ def main(argv=None) -> int:
         print(f"trace_report: cannot load {args.trace}: {e}",
               file=sys.stderr)
         return 2
+    if args.stitch:
+        rows = stitch_rows(events)
+        if not rows:
+            print("no traced router/serving spans found — nothing to "
+                  "stitch (was FLAGS_trace_sample set?)")
+            return 2
+        sys.stdout.write(format_stitch(rows))
+        return 0
     ledger_paths = [args.ledger] if args.ledger \
         else find_ledger(args.trace)
     ledger = load_ledger(ledger_paths) if ledger_paths else None

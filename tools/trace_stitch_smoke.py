@@ -1,0 +1,179 @@
+"""Distributed-trace stitch smoke (CI gate for X-PT-Trace propagation).
+
+Two phases, one assertion each about trace IDENTITY — the whole point
+of trace-context propagation (observability/tracing.py inject/extract)
+is that one request yields ONE timeline no matter how many processes
+or engines it crosses:
+
+1. HTTP hop — 2 replica worker SUBPROCESSES (FLAGS_trace_sample=1.0)
+   behind the Router; one request forced through an HttpReplica. The
+   router's shard (rank 2) and the serving worker's shard must stitch
+   on ONE trace_id spanning >= 2 pids, with the full hop table
+   (router queue / route / network / replica queue / prefill / decode)
+   and NO orphan traces (a router-side trace with no serving spans
+   means the context was injected but never extracted — the regression
+   this gate exists to catch).
+2. Disaggregated handoff — an in-process prefill-pool -> decode-pool
+   pipeline (DisaggregatedServing). The KVHandoff carries the trace
+   context across detach/attach, so prefill, handoff (serving.attach)
+   and decode must land under ONE trace_id.
+
+Run: python tools/trace_stitch_smoke.py [--dir /tmp/ci_trace_stitch]
+Outputs one JSON line + exit 0/1.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PROMPT_LEN = 8
+MAX_NEW = 8
+HOP_SPANS = {"router.queue", "router.route", "serving.queue",
+             "serving.prefill", "serving.decode"}
+
+
+def _stitch_http(root, trace_report, timeout_s: float = 30.0):
+    """Poll the fleet dir until the routed request's stitched trace
+    appears (workers flush their shards every ~1 s)."""
+    deadline = time.monotonic() + timeout_s
+    last = []
+    while time.monotonic() < deadline:
+        try:
+            rows = trace_report.stitch_rows(
+                trace_report.load_events(root))
+        except (OSError, ValueError):
+            rows = []
+        last = rows
+        multi = [r for r in rows if r["n_procs"] >= 2]
+        if multi and all(
+                HOP_SPANS <= {s["name"] for s in r["spans"]}
+                for r in multi):
+            return rows, multi
+        time.sleep(1.0)
+    return last, [r for r in last if r["n_procs"] >= 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="/tmp/ci_trace_stitch")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import trace_report
+    from paddle_tpu.framework import config as _cfg
+    from paddle_tpu.inference import (DisaggregatedServing, Router,
+                                      ServingEngine, auto_replicas)
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.observability import tracing as _tracing
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    # the router process samples every trace; the workers do the same
+    # (--trace-sample 1.0), and the sampled-at-router verdict rides the
+    # header, so every hop of the routed request commits its spans
+    _cfg.set_flags({"FLAGS_trace_sample": 1.0})
+
+    print(f"trace_stitch_smoke: spawning 2 traced replica workers "
+          f"under {args.dir}", file=sys.stderr)
+    procs = spawn_replicas(
+        2, args.dir,
+        worker_args=["--prompt-len", str(PROMPT_LEN),
+                     "--max-batch", "4", "--max-seq-len", "64",
+                     "--page-size", "8", "--trace-sample", "1.0"])
+    rng = np.random.RandomState(7)
+    result = {"ok": False}
+    try:
+        # ---- phase 1: one request through an HttpReplica -------------
+        replicas = auto_replicas(args.dir)
+        assert len(replicas) == 2, \
+            f"auto_replicas found {len(replicas)} endpoints, want 2"
+        router = Router(replicas, admission=False, workers=4).start()
+        out = router.generate(rng.randint(0, 97, (PROMPT_LEN,)),
+                              max_new_tokens=MAX_NEW, timeout=120.0)
+        assert out.get("ok"), f"routed request failed: {out}"
+        router.close()
+        # the router's own spans flush as rank 2 (the workers own 0/1)
+        _fleet.FleetExporter(args.dir, rank=2, world_size=3).flush()
+
+        rows, multi = _stitch_http(args.dir, trace_report)
+        print(trace_report.format_stitch(rows), file=sys.stderr)
+        assert len(multi) == 1, \
+            (f"want exactly 1 stitched trace spanning >=2 processes "
+             f"for the 1 routed request, got {len(multi)}: "
+             f"{[(r['trace_id'], r['pids']) for r in multi]}")
+        row = multi[0]
+        names = {s["name"] for s in row["spans"]}
+        missing = HOP_SPANS - names
+        assert not missing, \
+            f"stitched trace {row['trace_id']} lacks hops: {missing}"
+        assert row["network_us"] is not None, \
+            "network hop missing (router and serving sides not joined)"
+        orphans = [r for r in rows if r["orphan"]]
+        assert not orphans, \
+            (f"orphan trace(s) — injected but never extracted: "
+             f"{[r['trace_id'] for r in orphans]}")
+        print(f"trace_stitch_smoke: HTTP hop ok — trace "
+              f"{row['trace_id']} spans pids {row['pids']} with "
+              f"complete hop table", file=sys.stderr)
+
+        # ---- phase 2: disaggregated prefill->decode handoff ----------
+        cfg_m = LlamaConfig.tiny(vocab=97, hidden=32, layers=2,
+                                 heads=4, seq=64)
+        model = LlamaForCausalLM(cfg_m)
+        pe = ServingEngine(model, max_batch=2, max_seq_len=64,
+                           page_size=8,
+                           decode_strategy="greedy_search")
+        de = ServingEngine(model, max_batch=2, max_seq_len=64,
+                           page_size=8,
+                           decode_strategy="greedy_search")
+        pe.warmup(prompt_len=PROMPT_LEN)
+        de.warmup(prompt_len=PROMPT_LEN)
+        tracer = _tracing.default_tracer()
+        tracer.clear()  # only the handoff request in this ring
+        disagg = DisaggregatedServing(pe, de)
+        out2 = disagg.generate(rng.randint(0, 97, (PROMPT_LEN,)),
+                               max_new_tokens=MAX_NEW)
+        assert out2.get("ok"), f"disaggregated request failed: {out2}"
+        rows2 = trace_report.stitch_rows(tracer.to_chrome_trace())
+        handed = [r for r in rows2 if r["handoff_us"] > 0
+                  and r["prefill_us"] > 0 and r["decode_us"] > 0]
+        shapes = [(r["trace_id"],
+                   sorted({s["name"] for s in r["spans"]}))
+                  for r in rows2]
+        assert len(handed) == 1, \
+            (f"want exactly 1 trace_id holding prefill + handoff + "
+             f"decode hops, got {len(handed)} of {len(rows2)} rows: "
+             f"{shapes}")
+        print(f"trace_stitch_smoke: handoff ok — trace "
+              f"{handed[0]['trace_id']} carries prefill "
+              f"{handed[0]['prefill_us'] / 1e3:.2f} ms / handoff "
+              f"{handed[0]['handoff_us'] / 1e3:.2f} ms / decode "
+              f"{handed[0]['decode_us'] / 1e3:.2f} ms",
+              file=sys.stderr)
+
+        result = {"ok": True,
+                  "http_trace_id": row["trace_id"],
+                  "http_pids": row["pids"],
+                  "network_ms": round(row["network_us"] / 1e3, 3),
+                  "handoff_trace_id": handed[0]["trace_id"],
+                  "handoff_ms":
+                      round(handed[0]["handoff_us"] / 1e3, 3)}
+    finally:
+        for p in procs:
+            p.stop()
+        print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
