@@ -540,6 +540,8 @@ def bench_serving(paddle, jax, on_tpu, n_dev):
     replicas_n = int(os.environ.get("BENCH_SERVING_REPLICAS", "1"))
     if replicas_n > 1 and not on_tpu:
         return _bench_serving_router(jax, n_dev, replicas_n)
+    if os.environ.get("BENCH_SERVING_KV_TIERS", "") != "" and not on_tpu:
+        return _bench_serving_kv_tiers(paddle, jax, n_dev)
     if os.environ.get("BENCH_SERVING_PREFIX", "") != "" and not on_tpu:
         return _bench_serving_prefix(paddle, jax, n_dev)
     size = os.environ.get("BENCH_SERVING_MODEL", "base")
@@ -741,6 +743,103 @@ def _bench_serving_prefix(paddle, jax, n_dev):
     return result
 
 
+def _bench_serving_kv_tiers(paddle, jax, n_dev):
+    """The tiered-KV serving row (ISSUE 17): the shared-prefix TTFT
+    workload of `_bench_serving_prefix` at identical geometry, but the
+    arm names WHERE the warm prefix lives when the timed request
+    arrives. BENCH_SERVING_KV_TIERS selects it:
+
+      cold — no prefix cache: every request pays the full prefill
+      hbm  — resident trie hit (the PR 15 warm path)
+      host — pages force-evicted to the host-RAM tier before every
+             timed request, so each hit promotes host -> HBM
+      disk — same, with a disk-only tier (host budget 0)
+
+    `kv_tier` is a comparability key in bench_compare (absent == None),
+    so arms never baseline each other; the host arm's claim is beating
+    the cold arm's full-prefill TTFT. CPU-only: the row measures
+    recomputation avoided vs. promotion cost, not the chip."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    arm = os.environ.get("BENCH_SERVING_KV_TIERS", "cold").strip()
+    if arm not in ("cold", "hbm", "host", "disk"):
+        raise SystemExit(f"BENCH_SERVING_KV_TIERS={arm!r}: expected "
+                         "cold | hbm | host | disk")
+    cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=2,
+                           seq=256)
+    page, shared_len, tail_len, n_req = 16, 96, 16, 6
+    kw = {}
+    if arm == "host":
+        kw = {"kv_host_cache_mb": 64}
+    elif arm == "disk":
+        kw = {"kv_disk_cache_dir":
+              tempfile.mkdtemp(prefix="bench-kvtier-")}
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, max_batch=2,
+                           max_seq_len=shared_len + tail_len + page,
+                           page_size=page,
+                           decode_strategy="greedy_search",
+                           prefix_cache=0 if arm == "cold" else 1,
+                           **kw)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, (shared_len,))
+    tails = [rng.randint(0, cfg.vocab_size, (tail_len,))
+             for _ in range(n_req + 2)]
+
+    def spill_all():
+        # park every cached page in the spill tier so the next hit
+        # must promote (host->HBM or disk->HBM) instead of reusing
+        # resident pages
+        if engine._kv_tiers is not None:
+            engine._reclaim_pages(engine._n_pages_total)
+
+    def one(tail):
+        t0 = time.perf_counter()
+        rid = engine.add_request(np.concatenate([shared, tail]),
+                                 max_new_tokens=1)
+        finished = engine.run()
+        assert [f.request_id for f in finished] == [rid]
+        return time.perf_counter() - t0
+
+    # two priming requests: cold compile + trie seed, then the suffix
+    # continuation program the timed hits use (same as the prefix row)
+    one(tails[0])
+    spill_all()
+    one(tails[1])
+    ttfts = []
+    for t in tails[2:]:
+        spill_all()
+        ttfts.append(one(t))
+    st = engine._kv_tiers
+    result = {
+        "metric": "serving_kv_tier_ttft_ms",
+        "value": round(sum(ttfts) / len(ttfts) * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": {"kv_tier": arm, "requests": n_req,
+                  "shared_len": shared_len, "tail_len": tail_len,
+                  "page_size": page,
+                  "prefix_cache": 0 if arm == "cold" else 1,
+                  "tier_hits": dict(st.hits) if st else None,
+                  "tier_spills": dict(st.spills) if st else None,
+                  "ttft_p_max_ms": round(max(ttfts) * 1e3, 3),
+                  "devices": n_dev, "backend": jax.default_backend(),
+                  "replicas": 1, "router_policy": None,
+                  "prefill_chunk": None}}
+    result["extra"].update(_observability_columns())
+    result["tpu_probe_error"] = PROBE_DIAG
+    _attach_cached_evidence(result)
+    return result
+
+
 def _bench_serving_router(jax, n_dev, replicas_n):
     """The multi-replica router row: N CPU engine subprocesses at the
     router-smoke geometry (tiny llama, batch 4, single-step decode),
@@ -899,7 +998,23 @@ def _piggyback_extra_configs():
              {"BENCH_CONFIG": "serving", "BENCH_SERVING_PREFIX": "1"}),
             ("serving_prefix_chunk",
              {"BENCH_CONFIG": "serving", "BENCH_SERVING_PREFIX": "1",
-              "BENCH_SERVING_CHUNK": "32"})]
+              "BENCH_SERVING_CHUNK": "32"}),
+            # the tiered-KV matrix (ISSUE 17): where the warm prefix
+            # lives — resident HBM, host-RAM promote, disk promote,
+            # cold full prefill (CPU-only rows; `kv_tier` is the
+            # comparability key)
+            ("serving_kv_cold",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_KV_TIERS": "cold"}),
+            ("serving_kv_hbm",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_KV_TIERS": "hbm"}),
+            ("serving_kv_host",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_KV_TIERS": "host"}),
+            ("serving_kv_disk",
+             {"BENCH_CONFIG": "serving",
+              "BENCH_SERVING_KV_TIERS": "disk"})]
     for name, env_over in jobs:
         remaining = deadline - _time.monotonic()
         if remaining <= 10:

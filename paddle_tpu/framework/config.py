@@ -432,6 +432,35 @@ define_flag("FLAGS_prefill_chunk", 0,
             "dense one-shot prefill. Engine kwarg prefill_chunk "
             "overrides. Incompatible with a separate draft_model.",
             type_=int)
+define_flag("FLAGS_kv_host_cache_mb", 0,
+            "Host-RAM tier of the tiered prefix cache "
+            "(inference/prefix_cache.py TieredStore): when > 0, KV "
+            "pages the trie LRU-evicts under pool pressure spill "
+            "their bytes into a host-RAM store bounded by this many "
+            "MB instead of being dropped; a later admission matching "
+            "a spilled chunk promotes the page back into the paged "
+            "pool (scatter) and prefills only what no tier holds. "
+            "Over budget, the LRU host entries demote to the disk "
+            "tier (FLAGS_kv_disk_cache_dir) or drop. 0 (default) = "
+            "off: eviction drops pages exactly as before, zero "
+            "allocations on the serving hot path. Engine kwarg "
+            "kv_host_cache_mb overrides. Requires FLAGS_prefix_cache.",
+            type_=int)
+define_flag("FLAGS_kv_disk_cache_dir", "",
+            "Disk tier of the tiered prefix cache: directory for "
+            "spilled KV page files (one length-prefixed file per "
+            "page, content-keyed by the page's token-chunk chain "
+            "digest). Pages land here when the host tier is full or "
+            "absent; FLAGS_kv_disk_cache_mb bounds the directory "
+            "(LRU delete). A truncated/corrupt page file reads as a "
+            "clean cache miss (counted), never a crash. '' (default) "
+            "= no disk tier. Engine kwarg kv_disk_cache_dir "
+            "overrides. Requires FLAGS_prefix_cache.")
+define_flag("FLAGS_kv_disk_cache_mb", 256,
+            "Size bound (MB) of the disk tier under "
+            "FLAGS_kv_disk_cache_dir: past it the least-recently-"
+            "used page files are deleted. Only read when the disk "
+            "tier is on.", type_=int)
 define_flag("FLAGS_router_admission", True,
             "Router admission control: when every ready replica's "
             "fast TTFT burn alert is firing (or no replica is ready), "

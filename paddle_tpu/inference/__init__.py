@@ -15,7 +15,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..tensor import Tensor
-from .prefix_cache import PrefixCache, prefix_hash  # noqa: F401
+from .kv_fabric import (KV_HANDOFF_ROUTE,  # noqa: F401
+                        handoff_from_bytes, handoff_to_bytes,
+                        pack_pages, post_handoff, unpack_pages)
+from .prefix_cache import (PrefixCache,  # noqa: F401
+                           TieredStore, prefix_hash)
 from .replica import ReplicaServer  # noqa: F401
 from .router import (CacheAffinityPolicy,  # noqa: F401
                      DisaggregatedServing, HttpReplica,

@@ -1,0 +1,231 @@
+"""Networked KV fabric: one wire format for pages, two consumers.
+
+The tiered prefix cache (prefix_cache.TieredStore) and the cross-host
+prefill->decode handoff share a single length-prefixed page
+serialization, so a page spilled to disk on one replica and a
+``KVHandoff`` POSTed between hosts are the same bytes discipline:
+
+- ``pack_pages``/``unpack_pages``: per-layer K/V page arrays (+ int8
+  scales) as one self-describing blob — a JSON geometry header, then
+  each array's raw bytes behind a u64 length prefix. Geometry is
+  validated on unpack; a short buffer raises ValueError (the tier
+  store's checksum catches silent disk truncation before this).
+- ``handoff_to_bytes``/``handoff_from_bytes``: a full
+  ``serving.KVHandoff`` (context, committed tokens, the uncommitted
+  prefill-time sample, sampling params, trace context) around a packed
+  page blob.
+- ``post_handoff``: ship a handoff to another replica's
+  ``POST /v1/kv_handoff`` (mounted by ReplicaServer on the same
+  telemetry httpd that serves /v1/generate) and long-poll the decoded
+  result. Trace context rides ``X-PT-Trace`` exactly like the routed
+  /v1/generate path (PR 16), so prefill, the network hop, and decode
+  stitch to ONE trace_id across processes.
+
+The fabric composes with ``cache_affinity`` rendezvous routing: a
+prefill pool keeps its trie + spill tiers warm per prefix, decode
+pools receive only the pages a request actually needs.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+MAGIC_PAGES = b"PTKV"
+MAGIC_HANDOFF = b"PTHO"
+KV_HANDOFF_ROUTE = "/v1/kv_handoff"
+
+# sampling params that ride a handoff (on_token callables and queue
+# timestamps stay with the detaching engine)
+_REQ_PARAM_KEYS = ("greedy", "temperature", "top_k", "top_p", "eos")
+
+
+def _u32(n: int) -> bytes:
+    return int(n).to_bytes(4, "little")
+
+
+def _u64(n: int) -> bytes:
+    return int(n).to_bytes(8, "little")
+
+
+def pack_pages(k: List[np.ndarray], v: List[np.ndarray],
+               k_scales: Optional[List[np.ndarray]] = None,
+               v_scales: Optional[List[np.ndarray]] = None) -> bytes:
+    """Serialize per-layer page arrays: a JSON geometry header, then
+    every array's bytes length-prefixed (k layers, v layers, then the
+    scale layers when int8-KV)."""
+    k = [np.ascontiguousarray(a) for a in k]
+    v = [np.ascontiguousarray(a) for a in v]
+    header = {"v": 1, "layers": len(k),
+              "dtype": str(k[0].dtype) if k else "float32",
+              "shape": list(k[0].shape) if k else [],
+              "scales": k_scales is not None}
+    if k_scales is not None:
+        k_scales = [np.ascontiguousarray(a) for a in k_scales]
+        v_scales = [np.ascontiguousarray(a) for a in v_scales]
+        header["scale_dtype"] = str(k_scales[0].dtype)
+        header["scale_shape"] = list(k_scales[0].shape)
+    hb = json.dumps(header).encode()
+    parts = [MAGIC_PAGES, _u32(len(hb)), hb]
+    for arr in (*k, *v, *(k_scales or ()), *(v_scales or ())):
+        b = arr.tobytes()
+        parts.append(_u64(len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _take(buf: bytes, off: int, n: int) -> Tuple[bytes, int]:
+    if off + n > len(buf):
+        raise ValueError(
+            f"truncated page blob: need {off + n} bytes, have "
+            f"{len(buf)}")
+    return buf[off:off + n], off + n
+
+
+def unpack_pages(buf: bytes):
+    """Inverse of pack_pages -> (k, v, k_scales, v_scales); scales are
+    None for un-quantized pages. Raises ValueError on a malformed or
+    truncated blob (callers treat that as a cache miss, not a crash)."""
+    raw, off = _take(buf, 0, 4)
+    if raw != MAGIC_PAGES:
+        raise ValueError("bad page-blob magic")
+    raw, off = _take(buf, off, 4)
+    hb, off = _take(buf, off, int.from_bytes(raw, "little"))
+    header = json.loads(hb.decode())
+    layers = int(header["layers"])
+    shape = tuple(header["shape"])
+    dtype = np.dtype(header["dtype"])
+
+    def _arrays(n, shp, dt):
+        nonlocal off
+        out = []
+        for _ in range(n):
+            raw_len, off2 = _take(buf, off, 8)
+            n_bytes = int.from_bytes(raw_len, "little")
+            data, off2 = _take(buf, off2, n_bytes)
+            off = off2
+            arr = np.frombuffer(data, dtype=dt)
+            if arr.size != int(np.prod(shp, dtype=np.int64)):
+                raise ValueError(
+                    f"page blob geometry mismatch: {arr.size} "
+                    f"elements for shape {shp}")
+            out.append(arr.reshape(shp))
+        return out
+
+    k = _arrays(layers, shape, dtype)
+    v = _arrays(layers, shape, dtype)
+    ks = vs = None
+    if header.get("scales"):
+        sshape = tuple(header["scale_shape"])
+        sdtype = np.dtype(header["scale_dtype"])
+        ks = _arrays(layers, sshape, sdtype)
+        vs = _arrays(layers, sshape, sdtype)
+    return k, v, ks, vs
+
+
+# ---------------------------------------------------------------------------
+# KVHandoff <-> bytes
+# ---------------------------------------------------------------------------
+
+
+def handoff_to_bytes(handoff) -> bytes:
+    """Serialize a serving.KVHandoff for the wire (or any byte
+    transport). on_token callbacks do not ride — streaming belongs to
+    the attaching engine's caller."""
+    rp = {key: handoff.req_params.get(key)
+          for key in _REQ_PARAM_KEYS if key in handoff.req_params}
+    meta = {"v": 1,
+            "prompt_ids": np.asarray(handoff.prompt_ids,
+                                     np.int64).tolist(),
+            "tokens": [int(t) for t in handoff.tokens],
+            "context_len": int(handoff.context_len),
+            "max_new_tokens": int(handoff.max_new_tokens),
+            "needs_first_sample": bool(handoff.needs_first_sample),
+            "first_token": int(handoff.first_token),
+            "req_params": rp,
+            "page_size": int(handoff.page_size),
+            "kv_cache_quant": handoff.kv_cache_quant,
+            "trace_ctx": handoff.trace_ctx}
+    mb = json.dumps(meta).encode()
+    pages = pack_pages(handoff.k, handoff.v, handoff.k_scales,
+                       handoff.v_scales)
+    return b"".join([MAGIC_HANDOFF, _u32(len(mb)), mb, pages])
+
+
+def handoff_from_bytes(buf: bytes):
+    """Inverse of handoff_to_bytes -> serving.KVHandoff."""
+    from .serving import KVHandoff
+
+    raw, off = _take(buf, 0, 4)
+    if raw != MAGIC_HANDOFF:
+        raise ValueError("bad handoff magic")
+    raw, off = _take(buf, off, 4)
+    mb, off = _take(buf, off, int.from_bytes(raw, "little"))
+    meta = json.loads(mb.decode())
+    k, v, ks, vs = unpack_pages(buf[off:])
+    return KVHandoff(
+        prompt_ids=np.asarray(meta["prompt_ids"], np.int64),
+        tokens=list(meta["tokens"]),
+        context_len=int(meta["context_len"]),
+        max_new_tokens=int(meta["max_new_tokens"]),
+        needs_first_sample=bool(meta["needs_first_sample"]),
+        first_token=int(meta["first_token"]),
+        req_params=dict(meta.get("req_params") or {}),
+        page_size=int(meta["page_size"]),
+        kv_cache_quant=meta.get("kv_cache_quant"),
+        k=k, v=v, k_scales=ks, v_scales=vs,
+        trace_ctx=meta.get("trace_ctx"))
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (the replica's /v1/kv_handoff long-poll bridge)
+# ---------------------------------------------------------------------------
+
+
+def post_handoff(endpoint: str, handoff, timeout: float = 60.0,
+                 wait: bool = True) -> dict:
+    """Ship a detached request to another replica's decode engine over
+    POST /v1/kv_handoff. With ``wait`` (default) the call long-polls
+    the decoded result — {"ok": True, "request_id", "output_ids"};
+    wait=False returns as soon as the remote attach commits (the
+    caller collects the result from the remote's own consumers).
+    Raises RuntimeError on transport or remote errors, so a router can
+    retry/re-admit (the detaching side's spill tiers still hold the
+    prefix — re-admission promotes instead of recomputing)."""
+    from urllib.error import HTTPError, URLError
+    from urllib.request import Request, urlopen
+
+    from ..observability import fleet as _fleet
+    from ..observability import tracing as _trace
+
+    base = _fleet.normalize_endpoint(endpoint)
+    body = handoff if isinstance(handoff, (bytes, bytearray)) \
+        else handoff_to_bytes(handoff)
+    headers = {"Content-Type": "application/octet-stream"}
+    trace_ctx = None if isinstance(handoff, (bytes, bytearray)) \
+        else handoff.trace_ctx
+    if trace_ctx:
+        # the trace context rides the header too, so the remote httpd
+        # extracts it before the route handler runs (lint rule
+        # route-handler-trace) and the network hop itself is spanned
+        headers[_trace.TRACE_HEADER] = trace_ctx
+    url = (base + KV_HANDOFF_ROUTE
+           + (f"?wait=1&timeout_s={float(timeout)}" if wait
+              else "?wait=0"))
+    req = Request(url, data=bytes(body), headers=headers,
+                  method="POST")
+    try:
+        # socket deadline outlives the server-side long-poll
+        with urlopen(req, timeout=timeout + 5.0) as r:
+            out = json.loads(r.read().decode("utf-8", "replace"))
+    except HTTPError as e:
+        detail = e.read().decode("utf-8", "replace")
+        raise RuntimeError(
+            f"kv_handoff -> {e.code}: {detail[:200]}") from e
+    except (URLError, OSError) as e:
+        raise RuntimeError(f"kv_handoff transport failed: {e}") from e
+    if not out.get("ok"):
+        raise RuntimeError(
+            f"kv_handoff remote error: {out.get('error')}")
+    return out

@@ -22,10 +22,26 @@ list, and keeps hot prefixes resident under pool pressure.
 Node keys are the literal token tuples (exact, collision-free); the
 stable hash used by the router's ``cache_affinity`` policy lives in
 ``prefix_hash`` so both sides agree on what "the prefix" is.
+
+Tiered spill (README.md "Tiered KV cache + cross-host handoff"): with
+a ``TieredStore`` attached, a page ``evict()`` reclaims does not lose
+its bytes — the engine's gather callback host-copies the page payload
+and the store keeps it in pinned host RAM (``FLAGS_kv_host_cache_mb``)
+or on disk (``FLAGS_kv_disk_cache_dir``), LRU across tiers (host
+overflow demotes to disk, disk overflow drops). Spilled entries are
+keyed by the blake2b chain digest of the page's token-chunk path from
+the trie root, so ``spilled_suffix()`` can continue a resident match
+past the trie: admission promotes those pages back into the paged
+pool (scatter) and prefills only what NO tier holds. The digests are
+process-independent — a replica that lost its HBM pages re-admits
+from a surviving disk tier instead of recomputing.
 """
 from __future__ import annotations
 
 import hashlib
+import os
+import tempfile
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -44,15 +60,229 @@ def prefix_hash(ids, page_size: int, max_pages: int = 4) -> Optional[int]:
     return int.from_bytes(dig, "big")
 
 
-class _Node:
-    __slots__ = ("chunk", "page", "children", "parent", "tick")
+# chain-digest seed of the trie root: node.digest = blake2b(parent
+# digest + the node's token chunk), so a spilled page's store key is a
+# pure function of its token path — stable across processes/restarts
+_ROOT_DIGEST = b"pt-kv-root"
 
-    def __init__(self, chunk: tuple, page: int, parent):
+
+def _chain_digest(parent_digest: bytes, chunk: tuple) -> bytes:
+    return hashlib.blake2b(
+        parent_digest + np.asarray(chunk, np.int64).tobytes(),
+        digest_size=16).digest()
+
+
+class _Node:
+    __slots__ = ("chunk", "page", "children", "parent", "tick",
+                 "digest")
+
+    def __init__(self, chunk: tuple, page: int, parent,
+                 digest: bytes = b""):
         self.chunk = chunk
         self.page = page
         self.children: Dict[tuple, "_Node"] = {}
         self.parent = parent
         self.tick = 0
+        self.digest = digest
+
+
+class TieredStore:
+    """Host-RAM + disk spill tiers behind the prefix trie.
+
+    One entry per evicted KV page: an opaque payload blob (the
+    engine's length-prefixed page serialization, kv_fabric.pack_pages)
+    keyed by the page's token-chunk chain digest (hex). LRU across
+    tiers: puts land in the host tier first (bounded by
+    ``host_bytes``); host overflow demotes the least-recently-used
+    entries to disk (``disk_dir``, bounded by ``disk_bytes``, one file
+    per page); disk overflow deletes LRU files (counted in ``drops``).
+    A truncated or unreadable page file is a clean miss (``corrupt``
+    bumps, the file is removed) — never a crash.
+
+    Pre-existing page files under ``disk_dir`` are adopted at
+    construction (oldest-mtime first in LRU order): a restarted
+    replica re-admits from the disk tier it left behind.
+    """
+
+    MAGIC = b"KVP1"
+    _SUF = ".kvp"
+
+    def __init__(self, host_bytes: int = 0, disk_dir: str = "",
+                 disk_bytes: int = 0):
+        self.host_bytes = max(0, int(host_bytes))
+        self.disk_dir = str(disk_dir or "")
+        self.disk_bytes = max(0, int(disk_bytes))
+        self._host: "OrderedDict[str, bytes]" = OrderedDict()
+        self._host_used = 0
+        self._disk: "OrderedDict[str, int]" = OrderedDict()
+        self._disk_used = 0
+        # telemetry the engine mirrors into labeled registry counters
+        self.hits = {"host": 0, "disk": 0}
+        self.misses = 0
+        self.spills = {"host": 0, "disk": 0}
+        self.demotions = 0   # host -> disk LRU demotes
+        self.drops = 0       # pages that fell off the bottom tier
+        self.corrupt = 0     # truncated/unreadable disk page files
+        if self.disk_dir:
+            os.makedirs(self.disk_dir, exist_ok=True)
+            entries = []
+            for fn in os.listdir(self.disk_dir):
+                if not fn.endswith(self._SUF):
+                    continue
+                path = os.path.join(self.disk_dir, fn)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, fn[:-len(self._SUF)],
+                                st.st_size))
+            for _mt, key, size in sorted(entries):
+                self._disk[key] = size
+                self._disk_used += size
+
+    # -- introspection (statusz / fleet / timeseries read these) -------
+    def host_entries(self) -> int:
+        return len(self._host)
+
+    def disk_entries(self) -> int:
+        return len(self._disk)
+
+    def host_used_bytes(self) -> int:
+        return self._host_used
+
+    def disk_used_bytes(self) -> int:
+        return self._disk_used
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    def contains(self, key: str) -> bool:
+        return key in self._host or key in self._disk
+
+    # -- spill / lookup ------------------------------------------------
+    def put(self, key: str, payload: bytes) -> Optional[str]:
+        """Spill one page payload; returns the tier it landed in
+        ('host' | 'disk') or None when every tier is full-off (the
+        page is simply dropped, as without the store)."""
+        if self.host_bytes > 0:
+            old = self._host.pop(key, None)
+            if old is not None:
+                self._host_used -= len(old)
+            self._host[key] = payload
+            self._host_used += len(payload)
+            self.spills["host"] += 1
+            while self._host_used > self.host_bytes and self._host:
+                k, blob = self._host.popitem(last=False)
+                self._host_used -= len(blob)
+                if self.disk_dir and self._disk_put(k, blob):
+                    self.demotions += 1
+                else:
+                    self.drops += 1
+            return "host"
+        if self.disk_dir:
+            if self._disk_put(key, payload):
+                self.spills["disk"] += 1
+                return "disk"
+            self.drops += 1
+            return None
+        self.drops += 1
+        return None
+
+    def get(self, key: str) -> Tuple[Optional[str], Optional[bytes]]:
+        """(tier, payload) for a spilled page, or (None, None) on a
+        miss. A hit touches the entry's LRU position; the caller pops
+        the key after a successful promotion."""
+        blob = self._host.get(key)
+        if blob is not None:
+            self._host.move_to_end(key)
+            self.hits["host"] += 1
+            return "host", blob
+        if key in self._disk:
+            blob = self._disk_read(key)
+            if blob is not None:
+                self._disk.move_to_end(key)
+                self.hits["disk"] += 1
+                return "disk", blob
+        self.misses += 1
+        return None, None
+
+    def pop(self, key: str):
+        """Remove a spilled entry (after promotion back into the paged
+        pool, or when a fresh prefill re-created the page — a page
+        lives in exactly ONE tier, so occupancy counts it once)."""
+        blob = self._host.pop(key, None)
+        if blob is not None:
+            self._host_used -= len(blob)
+            return
+        size = self._disk.pop(key, None)
+        if size is not None:
+            self._disk_used -= size
+            try:
+                os.remove(self._path(key))
+            except OSError:
+                pass
+
+    def clear(self):
+        self._host.clear()
+        self._host_used = 0
+        for key in list(self._disk):
+            self.pop(key)
+
+    # -- disk tier -----------------------------------------------------
+    def _path(self, key: str) -> str:
+        return os.path.join(self.disk_dir, key + self._SUF)
+
+    def _disk_put(self, key: str, payload: bytes) -> bool:
+        check = hashlib.blake2b(payload, digest_size=8).digest()
+        rec = (self.MAGIC + len(payload).to_bytes(8, "little")
+               + payload + check)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.disk_dir,
+                                       suffix=".tmp")
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(rec)
+            os.replace(tmp, self._path(key))
+        except OSError:
+            return False
+        old = self._disk.pop(key, None)
+        if old is not None:
+            self._disk_used -= old
+        self._disk[key] = len(rec)
+        self._disk_used += len(rec)
+        while self._disk_used > self.disk_bytes > 0 and self._disk:
+            k, size = self._disk.popitem(last=False)
+            self._disk_used -= size
+            self.drops += 1
+            try:
+                os.remove(self._path(k))
+            except OSError:
+                pass
+        return True
+
+    def _disk_read(self, key: str) -> Optional[bytes]:
+        """Read + verify one page file; a short read, bad magic, or a
+        checksum mismatch removes the file and reads as a miss."""
+        try:
+            with open(self._path(key), "rb") as fh:
+                rec = fh.read()
+        except OSError:
+            rec = b""
+        if len(rec) >= 20 and rec[:4] == self.MAGIC:
+            n = int.from_bytes(rec[4:12], "little")
+            payload = rec[12:12 + n]
+            check = rec[12 + n:12 + n + 8]
+            if len(payload) == n and check == hashlib.blake2b(
+                    payload, digest_size=8).digest():
+                return payload
+        self.corrupt += 1
+        size = self._disk.pop(key, None)
+        if size is not None:
+            self._disk_used -= size
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+        return None
 
 
 class PrefixCache:
@@ -70,6 +300,19 @@ class PrefixCache:
         self._by_page: Dict[int, _Node] = {}
         self._clock = 0
         self.evictions = 0
+        # tiered spill (attach_tiers): store holds evicted pages'
+        # bytes; gather(page) -> payload blob is the engine's
+        # device->host page serialization. Both None = classic
+        # drop-on-evict, nothing else changes.
+        self.store: Optional[TieredStore] = None
+        self._gather = None
+
+    def attach_tiers(self, store: TieredStore, gather):
+        """Arm spill-on-evict: ``gather(page_id) -> bytes`` is called
+        for every page ``evict()`` reclaims (while its device buffer
+        is still valid), and the payload lands in ``store``."""
+        self.store = store
+        self._gather = gather
 
     # -- introspection -------------------------------------------------
     def __len__(self) -> int:
@@ -127,7 +370,9 @@ class PrefixCache:
         level = self._root
         parent = None
         added = 0
+        dig = _ROOT_DIGEST
         for j, chunk in enumerate(self._chunks(ctx, n_pages)):
+            dig = _chain_digest(dig, chunk)
             node = level.get(chunk)
             if node is None:
                 page = int(page_row[j])
@@ -135,15 +380,45 @@ class PrefixCache:
                     # the page already caches a DIFFERENT path (cannot
                     # happen from engine flow — defensive): stop here
                     break
-                node = _Node(chunk, page, parent)
+                node = _Node(chunk, page, parent, digest=dig)
                 level[chunk] = node
                 self._by_page[page] = node
                 self._refs[page] += 1
                 added += 1
+                if self.store is not None:
+                    # a fresh prefill re-created this chunk's page:
+                    # drop any spilled copy so the page is counted in
+                    # exactly one tier
+                    self.store.pop(dig.hex())
             node.tick = self._clock
             parent = node
             level = node.children
         return added
+
+    # -- tiered lookup -------------------------------------------------
+    def spilled_suffix(self, ctx, n_matched: int) -> List[str]:
+        """Store keys for the contiguous run of page chunks that
+        continue a resident ``match`` of ``n_matched`` pages into the
+        spill tiers (capped at the same (len(ctx)-1)//page_size the
+        resident match honors — the mutable tail page never spills).
+        The engine promotes these back into the paged pool; an empty
+        list means no tier holds the next chunk."""
+        if self.store is None or len(self.store) == 0:
+            return []
+        max_pages = (len(ctx) - 1) // self.page_size
+        if n_matched >= max_pages:
+            return []
+        dig = _ROOT_DIGEST
+        keys: List[str] = []
+        for j, chunk in enumerate(self._chunks(ctx, max_pages)):
+            dig = _chain_digest(dig, chunk)
+            if j < n_matched:
+                continue
+            key = dig.hex()
+            if not self.store.contains(key):
+                break
+            keys.append(key)
+        return keys
 
     # -- eviction ------------------------------------------------------
     def evict(self, need: int) -> int:
@@ -167,6 +442,19 @@ class PrefixCache:
         return freed
 
     def _drop(self, node: _Node):
+        if self.store is not None and self._gather is not None \
+                and node.digest:
+            # spill-before-free: the page's device buffer is still
+            # valid here (eviction runs between compiled calls), so
+            # the gather host-copies its bytes into the tier store.
+            # A gather failure degrades to the classic drop — losing
+            # a cache entry is never worth poisoning eviction.
+            try:
+                blob = self._gather(node.page)
+                if blob is not None:
+                    self.store.put(node.digest.hex(), blob)
+            except Exception:  # noqa: BLE001
+                pass
         level = node.parent.children if node.parent is not None \
             else self._root
         level.pop(node.chunk, None)

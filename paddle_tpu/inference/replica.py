@@ -13,6 +13,10 @@ engine with the two things the multi-replica router
   bridge, so a replica is reachable over the same port that already
   serves ``/readyz`` and ``/metrics``. One port per replica is the
   whole deployment contract.
+- ``POST /v1/kv_handoff`` on the same port: the networked
+  prefill->decode KV transport (inference/kv_fabric.py) — a remote
+  engine's ``detach_request`` bytes become this engine's
+  ``attach_request``, decoded by the same loop.
 
 The bridge rides the existing observability plane on purpose: the
 router routes on ``/readyz`` + ``serving_load_score`` (PR 8/11
@@ -31,6 +35,7 @@ import numpy as np
 from ..observability import flight_recorder as _flight
 from ..observability import httpd as _httpd
 from ..observability import tracing as _tracing
+from . import kv_fabric as _fab
 
 GENERATE_ROUTE = "/v1/generate"
 
@@ -64,6 +69,8 @@ class ReplicaServer:
     def start(self) -> "ReplicaServer":
         if self._thread is None:
             _httpd.register_route(self.route, self._handle_generate)
+            _httpd.register_route(_fab.KV_HANDOFF_ROUTE,
+                                  self._handle_kv_handoff)
             self._thread = threading.Thread(
                 target=self._loop, name="serving-replica", daemon=True)
             self._thread.start()
@@ -75,6 +82,7 @@ class ReplicaServer:
         if t is not None:
             t.join(timeout=10.0)
         _httpd.unregister_route(self.route)
+        _httpd.unregister_route(_fab.KV_HANDOFF_ROUTE)
 
     # -- submission ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -188,6 +196,51 @@ class ReplicaServer:
         except (RuntimeError, ValueError) as e:
             return (503, (json.dumps({"ok": False, "error": repr(e)})
                           + "\n").encode(), "application/json")
+        out = self.wait(rid, timeout=timeout)
+        if out is None:
+            return (504, (json.dumps({"ok": False, "error": "timeout"})
+                          + "\n").encode(), "application/json")
+        code = 200 if out.get("ok") else 500
+        return (code, (json.dumps(out) + "\n").encode(),
+                "application/json")
+
+    def _handle_kv_handoff(self, method, query, body):
+        """POST /v1/kv_handoff: adopt a detached request (serialized
+        KVHandoff bytes) into this replica's engine and decode it.
+        ?wait=1 (default) long-polls the finished result like
+        /v1/generate; ?wait=0 acks as soon as the attach commits."""
+        if method != "POST":
+            return (405, b"POST only\n", "text/plain; charset=utf-8")
+        # adopt X-PT-Trace before attach so the remote decode spans
+        # stitch to the prefill host's trace (PR 16 contract)
+        _tracing.extract()
+        if self._fatal:
+            return (503, (json.dumps({
+                "ok": False,
+                "error": f"replica is down: {self._fatal}"})
+                + "\n").encode(), "application/json")
+        try:
+            handoff = _fab.handoff_from_bytes(bytes(body))
+        except (ValueError, KeyError) as e:
+            return (400, (json.dumps({"ok": False,
+                                      "error": f"bad handoff: {e!r}"})
+                          + "\n").encode(), "application/json")
+        t_sub = _time_mod.perf_counter()
+        try:
+            with self._lock:
+                rid = self.engine.attach_request(handoff)
+        except (RuntimeError, ValueError) as e:
+            return (503, (json.dumps({"ok": False, "error": repr(e)})
+                          + "\n").encode(), "application/json")
+        with self._cv:
+            # register the rid so a fatal loop exit resolves it too
+            self._t_sub[rid] = t_sub
+            self._ttft[rid] = {}
+        if (query.get("wait") or ["1"])[0] in ("0", "false", "no"):
+            return (200, (json.dumps({"ok": True,
+                                      "request_id": int(rid)})
+                          + "\n").encode(), "application/json")
+        timeout = float((query.get("timeout_s") or ["60"])[0])
         out = self.wait(rid, timeout=timeout)
         if out is None:
             return (504, (json.dumps({"ok": False, "error": "timeout"})

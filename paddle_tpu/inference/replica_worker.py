@@ -48,6 +48,22 @@ def _parse(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     ap.add_argument("--scheduler", default=None,
                     help="SchedulerPolicy name (fifo | slo); default "
                          "follows FLAGS_scheduler_policy")
+    ap.add_argument("--prefix-cache", type=int, default=None,
+                    help="enable the prefix cache (0/1 engine kwarg "
+                         "prefix_cache); the kv-fabric smoke turns it "
+                         "on to exercise spill/promote under served "
+                         "traffic)")
+    ap.add_argument("--kv-host-cache-mb", type=int, default=None,
+                    help="host-RAM spill tier budget in MB "
+                         "(FLAGS_kv_host_cache_mb; requires "
+                         "--prefix-cache)")
+    ap.add_argument("--kv-disk-cache-dir", default=None,
+                    help="disk spill tier directory "
+                         "(FLAGS_kv_disk_cache_dir)")
+    ap.add_argument("--kv-quant", default=None,
+                    help="KV cache quantization (e.g. int8) so the "
+                         "handoff parity smoke covers quantized "
+                         "pages+scales on the wire")
     ap.add_argument("--vocab", type=int, default=97)
     ap.add_argument("--hidden", type=int, default=32)
     ap.add_argument("--layers", type=int, default=2)
@@ -106,12 +122,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            layers=args.layers, heads=args.heads,
                            seq=args.max_seq_len)
     model = LlamaForCausalLM(cfg)
+    extra = {}
+    if args.prefix_cache is not None:
+        extra["prefix_cache"] = args.prefix_cache
+    if args.kv_host_cache_mb is not None:
+        extra["kv_host_cache_mb"] = args.kv_host_cache_mb
+    if args.kv_disk_cache_dir is not None:
+        extra["kv_disk_cache_dir"] = args.kv_disk_cache_dir
+    if args.kv_quant:
+        extra["kv_cache_quant"] = args.kv_quant
     engine = ServingEngine(model, max_batch=args.max_batch,
                            max_seq_len=args.max_seq_len,
                            page_size=args.page_size,
                            decode_strategy="greedy_search",
                            decode_burst=args.decode_burst,
-                           scheduler=args.scheduler)
+                           scheduler=args.scheduler, **extra)
     engine.warmup(prompt_len=args.prompt_len)
     # requests arrive one at a time over HTTP, so admission forms
     # prefill batches at every pow2 nb up to max_batch — compile each

@@ -56,6 +56,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import metrics as _om
 from ..observability import slo as _slo
 from ..observability import tracing as _trace
+from . import kv_fabric as _fab
 from .prefix_cache import prefix_hash as _prefix_hash
 
 
@@ -661,14 +662,23 @@ class DisaggregatedServing:
     each prefilled request's pages are gathered and re-scattered into
     the decode engine, which runs the pure-decode steady state the
     burst/async programs are built for. Both engines must agree on
-    model geometry, page_size, and KV quantization. Experimental:
-    in-process pools, host-side gather/scatter — the measured handoff
-    cost is the point (it bounds what a cross-host transport must
-    beat)."""
+    model geometry, page_size, and KV quantization.
 
-    def __init__(self, prefill_engine, decode_engine):
+    ``decode_engine`` may instead be an ENDPOINT STRING
+    ("host:port" / "http://host:port") — then each detached request
+    ships over ``POST /v1/kv_handoff`` (inference/kv_fabric.py) to a
+    remote ReplicaServer's engine, one long-poll thread per in-flight
+    handoff so remote decodes overlap local prefills. That is the
+    cross-host deployment shape; the in-process form remains the
+    measured lower bound a transport must beat."""
+
+    def __init__(self, prefill_engine, decode_engine,
+                 http_timeout: float = 60.0):
         self.prefill = prefill_engine
-        self.decode = decode_engine
+        self.decode_endpoint = decode_engine \
+            if isinstance(decode_engine, str) else None
+        self.decode = None if self.decode_endpoint else decode_engine
+        self.http_timeout = float(http_timeout)
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
                  **params) -> dict:
@@ -682,6 +692,8 @@ class DisaggregatedServing:
         """Pipeline a batch through the pools: decode steps overlap
         later requests' prefills (request i can be decoding while
         request j is still queued on the prefill engine)."""
+        if self.decode_endpoint is not None:
+            return self._generate_many_http(requests, max_steps)
         pe, de = self.prefill, self.decode
         pe_rids: Dict[int, int] = {}    # prefill rid -> request index
         de_rids: Dict[int, int] = {}    # decode rid -> request index
@@ -704,7 +716,8 @@ class DisaggregatedServing:
                 # host right now; the rest stay resident and move on a
                 # later iteration (pages free up as decodes finish)
                 for s in list(pe.slots):
-                    if not s.active or s.request_id not in pe_rids:
+                    if not s.active or s.request_id not in pe_rids \
+                            or s.prefilling:
                         continue
                     if not any(not d.active for d in de.slots):
                         break
@@ -720,6 +733,8 @@ class DisaggregatedServing:
                         if handoff.k else 0,
                         s=round(_time_mod.perf_counter() - t_h0, 6))
                     de_rids[drid] = pe_rids.pop(s.request_id)
+                if any(s.active and s.prefilling for s in pe.slots):
+                    pe.step()  # drive chunked-prefill continuations
             if de.has_work():
                 for f in de.step():
                     idx = de_rids.pop(f.request_id, None)
@@ -729,6 +744,81 @@ class DisaggregatedServing:
                             "output_ids":
                                 np.asarray(f.output_ids).tolist(),
                         }
+        for idx, r in enumerate(results):
+            if r is None:
+                results[idx] = {"ok": False,
+                                "error": "disaggregated pipeline did "
+                                         "not finish the request"}
+        return results
+
+    def _generate_many_http(self, requests: List[dict],
+                            max_steps: int = 10_000) -> List[dict]:
+        """Cross-host pipeline: local prefill, remote decode. Each
+        prefilled request detaches and ships on its own long-poll
+        thread, so the remote decodes run while this process is still
+        prefilling the rest of the batch."""
+        pe = self.prefill
+        pe_rids: Dict[int, int] = {}
+        results: List[Optional[dict]] = [None] * len(requests)
+        threads: List[threading.Thread] = []
+
+        def _ship(handoff, idx, pages):
+            t0 = _time_mod.perf_counter()
+            deadline = _time_mod.monotonic() + self.http_timeout
+            try:
+                while True:
+                    try:
+                        out = _fab.post_handoff(
+                            self.decode_endpoint, handoff,
+                            timeout=self.http_timeout)
+                        break
+                    except RuntimeError as e:
+                        # 503 = the decode pool is momentarily full
+                        # (slots/pages free as decodes finish) — retry
+                        # until the deadline; anything else is final
+                        if "-> 503" not in str(e) \
+                                or _time_mod.monotonic() >= deadline:
+                            raise
+                        _time_mod.sleep(0.05)
+                results[idx] = {"ok": True,
+                                "output_ids": out["output_ids"]}
+            except RuntimeError as e:
+                results[idx] = {"ok": False, "error": str(e)}
+            _flight.record_event(
+                "router.kv_handoff", ctx=handoff.context_len,
+                pages=pages, endpoint=self.decode_endpoint,
+                ok=bool(results[idx]["ok"]),
+                s=round(_time_mod.perf_counter() - t0, 6))
+
+        for idx, req in enumerate(requests):
+            params = {k: req[k] for k in
+                      ("decode_strategy", "temperature", "top_k",
+                       "top_p", "eos_token_id") if k in req}
+            rid = pe.add_request(
+                np.asarray(req["prompt_ids"], np.int64),
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                **params)
+            pe_rids[rid] = idx
+        for _step in range(max_steps):
+            if not pe_rids:
+                break
+            pe.admit_pending()
+            for s in list(pe.slots):
+                if not s.active or s.request_id not in pe_rids \
+                        or s.prefilling:
+                    continue
+                handoff = pe.detach_request(s.request_id)
+                idx = pe_rids.pop(s.request_id)
+                pages = int(handoff.k[0].shape[1]) if handoff.k else 0
+                t = threading.Thread(
+                    target=_ship, args=(handoff, idx, pages),
+                    name="kv-handoff", daemon=True)
+                t.start()
+                threads.append(t)
+            if any(s.active and s.prefilling for s in pe.slots):
+                pe.step()  # drive chunked-prefill continuations
+        for t in threads:
+            t.join(timeout=self.http_timeout + 10.0)
         for idx, r in enumerate(results):
             if r is None:
                 results[idx] = {"ok": False,

@@ -2,7 +2,7 @@
 
 ``ServingEngine`` owns the *mechanism* of continuous batching — paged
 KV, compiled prefill/decode programs, recompute preemption, recovery —
-while the four *decisions* that shape latency and throughput live here
+while the six *decisions* that shape latency and throughput live here
 behind ``SchedulerPolicy``:
 
   1. admission order   — which pending request enters a free slot next
@@ -13,6 +13,8 @@ behind ``SchedulerPolicy``:
   4. burst sizing      — the scan length of this decode dispatch
   5. chunk budgeting   — the token width of this step's chunked-prefill
                          continuation round (FLAGS_prefill_chunk)
+  6. promotion budget  — how many spilled prefix chunks one admission
+                         may pull back from the host/disk KV tiers
 
 ``FifoSchedulerPolicy`` (the default, FLAGS_scheduler_policy="fifo")
 reproduces the pre-extraction engine bit-identically: strict
@@ -42,7 +44,7 @@ from ..framework import config as _cfg
 
 
 class SchedulerPolicy:
-    """Base policy: the four decision hooks, default = FIFO engine
+    """Base policy: the six decision hooks, default = FIFO engine
     behavior. Subclass and override; register with
     ``register_policy``. Hooks must not mutate the engine."""
 
@@ -127,6 +129,17 @@ class SchedulerPolicy:
         configured budget."""
         return engine.prefill_chunk
 
+    # -- tier promotion budgeting -------------------------------------
+    def promotion_budget(self, engine, n_candidates: int) -> int:
+        """How many spilled prefix chunks (pages) this admission may
+        promote from the host/disk KV tiers back into HBM
+        (``n_candidates`` = the contiguous spilled run extending the
+        resident match). Promotion competes with live decode for free
+        pages and host bandwidth; a policy may cap it to keep admission
+        latency bounded. Default: take everything the tiers hold — a
+        promoted page is a page admission does not have to prefill."""
+        return n_candidates
+
 
 class FifoSchedulerPolicy(SchedulerPolicy):
     """The default: inherits every base hook unchanged. Exists as a
@@ -205,6 +218,15 @@ class SloAwareSchedulerPolicy(SchedulerPolicy):
         if self._ttft_burning():
             return max(engine.page_size, engine.prefill_chunk // 2)
         return engine.prefill_chunk
+
+    def promotion_budget(self, engine, n_candidates: int) -> int:
+        """Halve the promotion pull (floor one chunk) while the TTFT
+        burn alert fires: promotion's host->HBM scatter sits on the
+        admission path, and under burn a partially promoted prefix
+        (remainder prefilled) beats a stalled admission queue."""
+        if self._ttft_burning():
+            return max(1, n_candidates // 2)
+        return n_candidates
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,7 @@ from ..observability import slo as _slo
 from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
 from ..tensor import Tensor, as_array
+from . import kv_fabric as _fab
 from . import prefix_cache as _pc
 from . import scheduler as _sched
 
@@ -49,7 +50,10 @@ class _EngineMetrics:
                  "finished", "poisoned", "errors", "recoveries",
                  "kv_occupancy", "kv_frag", "kv_free", "spec_proposed",
                  "spec_accepted", "spec_acceptance", "cache_hits",
-                 "cache_misses", "cache_evictions", "cached_ratio")
+                 "cache_misses", "cache_evictions", "cached_ratio",
+                 "tier_hits", "tier_misses", "tier_spills",
+                 "tier_demotions", "tier_drops", "tier_corrupt",
+                 "tier_promote_lat", "tier_pages")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -178,6 +182,48 @@ class _EngineMetrics:
             "Per-request fraction of the prompt served from the prefix "
             "cache, observed at admission (0.0 rows are cold misses).",
             buckets=_memwatch.RATIO_BUCKETS)
+        # tiered prefix cache (FLAGS_kv_host_cache_mb /
+        # FLAGS_kv_disk_cache_dir): handles resolve here, label
+        # children resolve once at tier construction — the counters
+        # only move while a tier is on
+        self.tier_hits = reg.counter(
+            "serving_kv_tier_hits_total",
+            "KV pages promoted back into the paged pool from a spill "
+            "tier at admission, by tier (host | disk).",
+            labels=("tier",))
+        self.tier_misses = reg.counter(
+            "serving_kv_tier_misses_total",
+            "Spill-tier lookups that found no payload (the chunk fell "
+            "off every tier — admission recomputes it).")
+        self.tier_spills = reg.counter(
+            "serving_kv_tier_spills_total",
+            "Evicted KV pages whose bytes spilled into a tier instead "
+            "of being dropped, by the tier they landed in.",
+            labels=("tier",))
+        self.tier_demotions = reg.counter(
+            "serving_kv_tier_demotions_total",
+            "LRU demotions from the host-RAM tier to the disk tier "
+            "under FLAGS_kv_host_cache_mb pressure.")
+        self.tier_drops = reg.counter(
+            "serving_kv_tier_drops_total",
+            "Spilled pages that fell off the bottom tier (disk over "
+            "FLAGS_kv_disk_cache_mb, or host overflow with no disk "
+            "tier).")
+        self.tier_corrupt = reg.counter(
+            "serving_kv_tier_corrupt_total",
+            "Disk-tier page files that failed the length/checksum "
+            "verify on read (truncated/corrupt -> clean miss, file "
+            "removed).")
+        self.tier_promote_lat = reg.histogram(
+            "serving_kv_tier_promote_seconds",
+            "Wall time of one admission's spill-tier promotion batch "
+            "(payload decode + device scatter dispatch), by source "
+            "tier.", labels=("tier",))
+        self.tier_pages = reg.gauge(
+            "serving_kv_tier_pages",
+            "KV pages currently resident per spill tier (host | "
+            "disk); the hbm tier is the trie's cached_pages.",
+            labels=("tier",))
 
 
 @dataclass
@@ -268,7 +314,8 @@ class ServingEngine:
                  decode_burst=1, kv_cache_quant=None, async_depth=0,
                  spec_decode=None, spec_draft_layers=None,
                  draft_model=None, scheduler=None, prefix_cache=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, kv_host_cache_mb=None,
+                 kv_disk_cache_dir=None):
         if max_seq_len % page_size:
             raise ValueError("max_seq_len must be a multiple of page_size")
         max_pos = getattr(model.config, "max_position_embeddings", None)
@@ -512,6 +559,42 @@ class ServingEngine:
         self._poisoned = None
         self._n_pages_total = n_pages
         self._m = _EngineMetrics()
+        # tiered spill (README.md "Tiered KV cache + cross-host
+        # handoff"): evicted prefix pages keep their bytes in host RAM
+        # (FLAGS_kv_host_cache_mb) then disk (FLAGS_kv_disk_cache_dir)
+        # and promote back on a trie hit. Off by default: _kv_tiers
+        # stays None and eviction drops pages exactly as before —
+        # nothing below allocates on the hot path.
+        hm = kv_host_cache_mb if kv_host_cache_mb is not None \
+            else _config.get_flag("FLAGS_kv_host_cache_mb", 0)
+        dd_dir = kv_disk_cache_dir if kv_disk_cache_dir is not None \
+            else _config.get_flag("FLAGS_kv_disk_cache_dir", "")
+        self._kv_tiers = None
+        self._tier_seen = None
+        self._tier_cells = None
+        if self._prefix_cache is not None and (int(hm) > 0 or dd_dir):
+            disk_mb = int(_config.get_flag("FLAGS_kv_disk_cache_mb",
+                                           256))
+            self._kv_tiers = _pc.TieredStore(
+                host_bytes=int(hm) << 20, disk_dir=str(dd_dir),
+                disk_bytes=disk_mb << 20)
+            self._prefix_cache.attach_tiers(self._kv_tiers,
+                                            self._gather_page_blob)
+            # label children resolve ONCE here, so the spill/promote
+            # paths only touch plain cells (same discipline as every
+            # other serving metric)
+            m = self._m
+            self._tier_cells = {
+                "hits_host": m.tier_hits.labels("host"),
+                "hits_disk": m.tier_hits.labels("disk"),
+                "spills_host": m.tier_spills.labels("host"),
+                "spills_disk": m.tier_spills.labels("disk"),
+                "pages_host": m.tier_pages.labels("host"),
+                "pages_disk": m.tier_pages.labels("disk"),
+                "promote_host": m.tier_promote_lat.labels("host"),
+                "promote_disk": m.tier_promote_lat.labels("disk"),
+            }
+            self._tier_seen = self._tier_snapshot()
         # stepledger quant correction (observability/stepledger.py):
         # XLA's cost_analysis bills the dequantized float weight
         # intermediate as bytes accessed, but the HBM traffic of a
@@ -679,6 +762,10 @@ class ServingEngine:
                     self._prefix_cache.match(ctx)
                 for p in cached_pages:
                     self._page_refs[p] += 1
+                if self._kv_tiers is not None:
+                    cached_pages, cached_tokens, _n_promoted = \
+                        self._promote_spilled(ctx, cached_pages,
+                                              cached_tokens)
             need_fresh = need - len(cached_pages)
             if len(self._free_pages) < need_fresh:
                 self._reclaim_pages(need_fresh - len(self._free_pages))
@@ -900,7 +987,9 @@ class ServingEngine:
 
     def _reclaim_pages(self, need: int) -> int:
         """Evict up to `need` zero-ref cached pages back to the free
-        list (LRU); returns pages actually freed."""
+        list (LRU); returns pages actually freed. With spill tiers on,
+        each evicted page's bytes land in host RAM / disk
+        (PrefixCache._drop -> TieredStore) instead of being lost."""
         if self._prefix_cache is None or need <= 0:
             return 0
         freed = self._prefix_cache.evict(need)
@@ -908,7 +997,142 @@ class ServingEngine:
             self._m.cache_evictions.inc(freed)
             _flight.record_event("serving.prefix_cache_evict",
                                  pages=freed)
+            if self._kv_tiers is not None:
+                self._sync_tier_metrics()
         return freed
+
+    # -- tiered spill / promote (README.md "Tiered KV cache") ----------
+    def _gather_page_blob(self, page: int) -> bytes:
+        """Host-copy ONE page's per-layer K/V bytes (+ int8 scales)
+        into the shared length-prefixed serialization — the trie's
+        spill gather. Runs between compiled calls, so the device
+        buffers are valid; np.asarray blocks on any in-flight dispatch
+        that still owns them."""
+        idx = np.asarray([int(page)])
+        k = [np.asarray(kp[:, idx]) for kp in self.k_pages]
+        v = [np.asarray(vp[:, idx]) for vp in self.v_pages]
+        if self.k_scales is not None:
+            ks = [np.asarray(sc[:, idx]) for sc in self.k_scales]
+            vs = [np.asarray(sc[:, idx]) for sc in self.v_scales]
+        else:
+            ks = vs = None
+        return _fab.pack_pages(k, v, ks, vs)
+
+    def _tier_snapshot(self) -> dict:
+        st = self._kv_tiers
+        return {"hits_host": st.hits["host"],
+                "hits_disk": st.hits["disk"],
+                "spills_host": st.spills["host"],
+                "spills_disk": st.spills["disk"],
+                "misses": st.misses, "demotions": st.demotions,
+                "drops": st.drops, "corrupt": st.corrupt}
+
+    def _sync_tier_metrics(self):
+        """Mirror the TieredStore's plain-int counters into the
+        registry families (delta since the last sync) and refresh the
+        per-tier page gauges. Called only on spill/promote paths —
+        never on the decode hot path."""
+        cur = self._tier_snapshot()
+        prev, self._tier_seen = self._tier_seen, cur
+        cells = self._tier_cells
+        m = self._m
+        for key in ("hits_host", "hits_disk", "spills_host",
+                    "spills_disk"):
+            d = cur[key] - prev[key]
+            if d:
+                cells[key].inc(d)
+        for key, cell in (("misses", m.tier_misses),
+                          ("demotions", m.tier_demotions),
+                          ("drops", m.tier_drops),
+                          ("corrupt", m.tier_corrupt)):
+            d = cur[key] - prev[key]
+            if d:
+                cell.inc(d)
+        cells["pages_host"].set(self._kv_tiers.host_entries())
+        cells["pages_disk"].set(self._kv_tiers.disk_entries())
+
+    def _promote_spilled(self, ctx, pages, tokens):
+        """Continue a resident prefix match into the spill tiers:
+        fetch the contiguous run of spilled chunks that extend the
+        match (bounded by the scheduler's promotion_budget hook),
+        scatter their payloads into freshly allocated pages (the
+        dispatch is async — decode work can overlap it), and re-adopt
+        the chunks into the trie. Returns the extended
+        (pages, tokens, n_promoted). Admission then prefills only the
+        suffix NO tier holds. Corrupt payloads read as clean misses."""
+        keys = self._prefix_cache.spilled_suffix(ctx, len(pages))
+        if not keys:
+            return pages, tokens, 0
+        budget = int(self.scheduler.promotion_budget(self, len(keys)))
+        keys = keys[:max(0, budget)]
+        got = []  # (tier, (k, v, ks, vs)) per chunk, in path order
+        for key in keys:
+            tier, blob = self._kv_tiers.get(key)
+            if blob is None:
+                break
+            try:
+                got.append((tier, _fab.unpack_pages(blob)))
+            except ValueError:
+                # undecodable payload: a clean miss — drop the entry
+                # and recompute from here on
+                self._kv_tiers.pop(key)
+                self._kv_tiers.corrupt += 1
+                break
+        dst: List[int] = []
+        for _ in got:
+            if not self._free_pages:
+                self._reclaim_pages(1)
+            if not self._free_pages:
+                break  # pool pinned by live slots: partial promote
+            dst.append(self._alloc_page())
+        got = got[:len(dst)]
+        if not dst:
+            self._sync_tier_metrics()
+            return pages, tokens, 0
+        t0 = _time_mod.perf_counter()
+        dd = jnp.asarray(np.asarray(dst, np.int32))
+        L = len(self.k_pages)
+        for li in range(L):
+            kcat = np.concatenate([g[1][0][li] for g in got], axis=1)
+            vcat = np.concatenate([g[1][1][li] for g in got], axis=1)
+            self.k_pages[li] = self.k_pages[li].at[:, dd].set(
+                jnp.asarray(kcat, self.k_pages[li].dtype))
+            self.v_pages[li] = self.v_pages[li].at[:, dd].set(
+                jnp.asarray(vcat, self.v_pages[li].dtype))
+            if self.k_scales is not None:
+                kscat = np.concatenate([g[1][2][li] for g in got],
+                                       axis=1)
+                vscat = np.concatenate([g[1][3][li] for g in got],
+                                       axis=1)
+                self.k_scales[li] = self.k_scales[li].at[:, dd].set(
+                    jnp.asarray(kscat))
+                self.v_scales[li] = self.v_scales[li].at[:, dd].set(
+                    jnp.asarray(vscat))
+        if self._page_sharding is not None:
+            self._pin_pages()
+        dt = _time_mod.perf_counter() - t0
+        # re-adopt into the trie: insert() increfs each promoted page
+        # (the trie's ref) and pops the spilled copies, so every page
+        # lives in exactly one tier; _alloc_page above already took
+        # the slot's tentative ref — same accounting as a resident hit
+        all_pages = list(pages) + dst
+        self._prefix_cache.insert(
+            ctx[:len(all_pages) * self.page_size], all_pages)
+        tiers = [g[0] for g in got]
+        for tier in ("host", "disk"):
+            n = tiers.count(tier)
+            if n:
+                self._tier_cells[f"hits_{tier}"].inc(n)
+                self._tier_cells[f"promote_{tier}"].observe(dt)
+        # the store's own hit counters were mirrored just above —
+        # rebase the snapshot so the next sync doesn't double-count
+        self._tier_seen = self._tier_snapshot()
+        self._sync_tier_metrics()
+        _flight.record_event("serving.kv_promote", pages=len(dst),
+                             host=tiers.count("host"),
+                             disk=tiers.count("disk"),
+                             s=round(dt, 6))
+        return all_pages, tokens + len(dst) * self.page_size, len(dst)
 
     def _release_slot(self, slot_idx):
         """Decref a slot's pages and deactivate it (shared by finish /
@@ -2077,6 +2301,13 @@ class ServingEngine:
                 dropped = self._prefix_cache.clear()
                 self._prefix_cache = _pc.PrefixCache(
                     self.page_size, self._page_refs, self._free_pages)
+                if self._kv_tiers is not None:
+                    # the spill tiers survive recovery on purpose:
+                    # their bytes were host-copied at eviction time, so
+                    # the rebuilt engine re-admits warm prefixes by
+                    # promotion instead of recomputing them
+                    self._prefix_cache.attach_tiers(
+                        self._kv_tiers, self._gather_page_blob)
                 if dropped:
                     self._m.cache_evictions.inc(dropped)
                     _flight.record_event("serving.prefix_cache_drop",
@@ -2229,9 +2460,12 @@ class ServingEngine:
                     return finished_early
             st = self._decode_launch_state(active)
             if _faults.enabled():
-                # deterministic chaos (faults/chaos.py): an injected
-                # decode OOM takes the SAME handler as an organic
-                # RESOURCE_EXHAUSTED from the compiled call
+                # deterministic chaos (faults/chaos.py): rank.kill dies
+                # HARD mid-serve (the kv-fabric drill proves the router
+                # loses zero requests when a worker vanishes); an
+                # injected decode OOM takes the SAME handler as an
+                # organic RESOURCE_EXHAUSTED from the compiled call
+                _faults.maybe_kill()
                 try:
                     _faults.maybe_decode_oom()
                 except BaseException as e:
@@ -2523,9 +2757,12 @@ class ServingEngine:
         s = self.slots[slot_idx]
         if s.prefilling:
             raise RuntimeError(
-                f"request {request_id} is mid chunked-prefill; detach "
-                f"after its prefill completes (a partial context has "
-                f"no first-token sample to hand off)")
+                f"request {request_id} is mid chunked-prefill "
+                f"({s._pf_chunks_done}/{s._pf_n_chunks} chunks done, "
+                f"{s.context_len}/{len(s._pf_ctx)} context tokens "
+                f"written); drive admit_pending()/step() until the "
+                f"final chunk completes, then detach (a partial "
+                f"context has no first-token sample to hand off)")
         # copy-or-pin: the KV gathers below HOST-COPY every page —
         # including prefix pages shared with the trie or other slots —
         # BEFORE _release_slot decrefs them, so the handoff owns its

@@ -750,6 +750,17 @@ def _total(samples, name) -> Optional[float]:
     return sum(v for _, v in rows) if rows else None
 
 
+def _total_labeled(samples, name, **match) -> Optional[float]:
+    """Sum a labeled family's samples that match the given label
+    values (e.g. tier="host"); None when no sample matches."""
+    rows = samples.get(name)
+    if not rows:
+        return None
+    vals = [v for lab, v in rows
+            if all(lab.get(k) == want for k, want in match.items())]
+    return sum(vals) if vals else None
+
+
 def rank_table(shards: Dict[int, str],
                heartbeats: Dict[int, dict]) -> List[dict]:
     """One row per rank: steps, mean train-step / decode-step / TTFT
@@ -771,6 +782,16 @@ def rank_table(shards: Dict[int, str],
         pc_hits = _total(samples, "serving_prefix_cache_hits_total")
         pc_miss = _total(samples, "serving_prefix_cache_misses_total")
         pc_seen = (pc_hits or 0.0) + (pc_miss or 0.0)
+        # spill-tier occupancy/hits — each page is in exactly one tier
+        # (the engine pops the spilled copy on promotion), so these
+        # columns never double-count against kv occupancy
+        t_host = _total_labeled(samples, "serving_kv_tier_pages",
+                                tier="host")
+        t_disk = _total_labeled(samples, "serving_kv_tier_pages",
+                                tier="disk")
+        t_hits = _total(samples, "serving_kv_tier_hits_total")
+        t_miss = _total(samples, "serving_kv_tier_misses_total")
+        t_seen = (t_hits or 0.0) + (t_miss or 0.0)
         out.append({
             "rank": rank,
             "step": hb.get("step"),
@@ -790,6 +811,14 @@ def rank_table(shards: Dict[int, str],
             # admitted with the cache on)
             "cache_hit_rate": round((pc_hits or 0.0) / pc_seen, 4)
             if pc_seen else None,
+            # spilled pages currently parked per tier (None = tiers off)
+            "kv_host_pages": int(t_host) if t_host is not None
+            else None,
+            "kv_disk_pages": int(t_disk) if t_disk is not None
+            else None,
+            # spill-tier page hit rate across host+disk lookups
+            "tier_hit_rate": round((t_hits or 0.0) / t_seen, 4)
+            if t_seen else None,
         })
     return out
 

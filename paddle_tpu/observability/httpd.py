@@ -279,6 +279,26 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
                 "evictable_pages": pc.evictable(),
                 "evictions": pc.evictions,
             }
+        # spill tiers (serving.py "tiered spill"): each page is counted
+        # in exactly ONE tier — resident trie/slot pages above are hbm;
+        # a spilled page lives in the host OR disk store until a
+        # promotion moves it back (insert() pops the spilled copy)
+        tiers = None
+        st = getattr(e, "_kv_tiers", None)
+        if st is not None:
+            tiers = {
+                "hbm_pages": len(seen),
+                "host_pages": st.host_entries(),
+                "disk_pages": st.disk_entries(),
+                "host_bytes": st.host_used_bytes(),
+                "disk_bytes": st.disk_used_bytes(),
+                "hits": dict(st.hits),
+                "misses": st.misses,
+                "spills": dict(st.spills),
+                "demotions": st.demotions,
+                "drops": st.drops,
+                "corrupt": st.corrupt,
+            }
         spec = None
         if getattr(e, "spec_decode", 0):
             proposed = getattr(e, "_spec_proposed_total", 0)
@@ -311,6 +331,7 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
                     1.0 - used_tokens / alloc_tokens, 4)
                 if alloc_tokens else 0.0,
             },
+            "kv_tiers": tiers,
             "spec": spec,
             "prefix_cache": prefix,
             "slots": slots,

@@ -86,6 +86,7 @@ class TimeSeriesRecorder:
             row["load"] = 0.0
         queue = active = 0
         occ = None
+        host_pages = disk_pages = None
         try:
             engines = _httpd.tracked_engines()
             if engines:
@@ -96,12 +97,23 @@ class TimeSeriesRecorder:
                 free = sum(len(e._free_pages) for e in engines)
                 if pages:
                     occ = round(1.0 - free / pages, 4)
+                stores = [st for st in
+                          (getattr(e, "_kv_tiers", None)
+                           for e in engines) if st is not None]
+                if stores:
+                    host_pages = sum(st.host_entries()
+                                     for st in stores)
+                    disk_pages = sum(st.disk_entries()
+                                     for st in stores)
         except Exception:  # noqa: BLE001
             pass
         row["queue"] = queue
         row["active"] = active
         if occ is not None:
             row["kv_occupancy"] = occ
+        if host_pages is not None:
+            row["kv_host_pages"] = host_pages
+            row["kv_disk_pages"] = disk_pages
         try:
             eng = _slo.default_engine()
             eng.tick()

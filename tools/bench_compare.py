@@ -82,7 +82,7 @@ KNOB_KEYS_ABSENT_IS_NONE = ("quant", "kv_quant", "spec_decode",
                             "draft_layers", "overlap", "grad_bucket_mb",
                             "prefetch_depth", "replicas",
                             "router_policy", "prefix_cache",
-                            "prefill_chunk")
+                            "prefill_chunk", "kv_tier")
 
 
 def _knob(extra: dict, key: str):

@@ -1,0 +1,227 @@
+"""CI tiered-KV + cross-host handoff smoke (ISSUE 17).
+
+Three phases over the kv fabric (inference/prefix_cache.TieredStore +
+inference/kv_fabric), gated in order:
+
+1. tier spill/promote — one engine with a host-RAM tier and one with a
+   disk-only tier: the warm prefix is force-evicted into the tier
+   before every re-hit, so admission must PROMOTE (host->HBM,
+   disk->HBM) instead of reusing resident pages. Gates: greedy tokens
+   bit-equal to a tiers-off engine, per-tier hit counters moved, and a
+   truncated disk page file reads as a clean miss (corrupt counter
+   bumps, tokens still bit-equal, no crash).
+2. networked handoff — a real decode worker SUBPROCESS (replica_worker
+   at identical seed/geometry) adopts locally prefilled requests over
+   POST /v1/kv_handoff (DisaggregatedServing with an endpoint string).
+   Gate: tokens bit-equal to a single local engine.
+3. chaos drill — Router over both workers while r0 is armed with
+   rank.kill (os._exit(137) mid-decode). Gate: ZERO lost requests —
+   every routed request resolves ok with its full token budget (the
+   router retries the died worker's in-flight requests on r1).
+
+Exit 0 green, 1 on any gate, matching tools/ci.sh conventions.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the replica_worker default geometry — the local engines must match it
+# exactly or the handoff pages would not fit the remote pools
+VOCAB, HIDDEN, LAYERS, HEADS = 97, 32, 2, 4
+SEQ, PAGE, BATCH = 64, 8, 4
+PROMPT_LEN, MAX_NEW = 8, 8
+CHAOS = "rank.kill@p=1.0:n=1"
+
+
+def _fail(msg: str) -> int:
+    print(f"kv-fabric smoke FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="/tmp/ci_kv_fabric")
+    ap.add_argument("--requests", type=int, default=12,
+                    help="routed requests in the chaos drill")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (DisaggregatedServing, Router,
+                                      ServingEngine, auto_replicas)
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.observability import metrics as om
+
+    def make_engine(**over):
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(
+            vocab=VOCAB, hidden=HIDDEN, layers=LAYERS, heads=HEADS,
+            seq=128))
+        model.eval()
+        kw = dict(max_batch=2, max_seq_len=128, page_size=PAGE,
+                  decode_strategy="greedy_search")
+        kw.update(over)
+        return ServingEngine(model, **kw)
+
+    rng = np.random.RandomState(7)
+    system = rng.randint(0, VOCAB, (48,))  # 6 full pages of prefix
+    tails = [rng.randint(0, VOCAB, (PAGE,)) for _ in range(4)]
+
+    def serve(eng, tail):
+        rid = eng.add_request(np.concatenate([system, tail]),
+                              max_new_tokens=MAX_NEW)
+        fin = {f.request_id: f.output_ids.tolist() for f in eng.run()}
+        return fin[rid]
+
+    # ---- phase 1: spill -> promote, bit-equal --------------------------
+    ref_eng = make_engine(prefix_cache=1)
+    ref = [serve(ref_eng, t) for t in tails]
+
+    disk_dir = tempfile.mkdtemp(prefix="kvfab-disk-")
+    host_eng = make_engine(prefix_cache=1, kv_host_cache_mb=32)
+    disk_eng = make_engine(prefix_cache=1, kv_disk_cache_dir=disk_dir)
+    for name, eng in (("host", host_eng), ("disk", disk_eng)):
+        outs = []
+        for t in tails:
+            outs.append(serve(eng, t))
+            # park EVERY cached page in the spill tier: the next
+            # request's warm hit must promote, not reuse residents
+            eng._reclaim_pages(eng._n_pages_total)
+        if outs != ref:
+            return _fail(f"{name}-tier promoted decode differs from "
+                         f"tiers-off greedy\n  off: {ref}\n  "
+                         f"{name}: {outs}")
+        if eng._kv_tiers.hits[name] <= 0:
+            return _fail(f"{name} tier never hit "
+                         f"(hits={eng._kv_tiers.hits}, "
+                         f"misses={eng._kv_tiers.misses})")
+    reg = om.default_registry()
+    if not reg.value("serving_kv_tier_hits_total", tier="host"):
+        return _fail("serving_kv_tier_hits_total{tier=host} never "
+                     "moved")
+
+    # corruption: truncate every spilled page file — the re-hit must
+    # degrade to a clean miss (recompute) with bit-equal tokens
+    disk_eng._reclaim_pages(disk_eng._n_pages_total)
+    files = glob.glob(os.path.join(disk_dir, "*.kvp"))
+    if not files:
+        return _fail("disk tier left no .kvp page files to corrupt")
+    for f in files:
+        data = open(f, "rb").read()
+        with open(f, "wb") as fh:
+            fh.write(data[: max(4, len(data) // 3)])
+    out = serve(disk_eng, tails[0])
+    if out != ref[0]:
+        return _fail(f"corrupt-tier decode differs from tiers-off "
+                     f"greedy: {out} != {ref[0]}")
+    if disk_eng._kv_tiers.corrupt <= 0:
+        return _fail("truncated page files never bumped the corrupt "
+                     "counter")
+    print(f"kv-fabric phase 1 ok: host/disk promote bit-equal "
+          f"(host hits {host_eng._kv_tiers.hits['host']}, disk hits "
+          f"{disk_eng._kv_tiers.hits['disk']}, corrupt "
+          f"{disk_eng._kv_tiers.corrupt} -> clean miss)",
+          file=sys.stderr)
+
+    # ---- phases 2+3 need worker subprocesses ---------------------------
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    print(f"kv-fabric: spawning 2 replica workers (chaos {CHAOS!r} "
+          f"on r0) under {args.dir}", file=sys.stderr)
+    procs = spawn_replicas(
+        2, args.dir,
+        worker_args=["--prompt-len", str(PROMPT_LEN),
+                     "--max-batch", str(BATCH),
+                     "--max-seq-len", str(SEQ),
+                     "--page-size", str(PAGE)],
+        chaos=CHAOS, chaos_replicas=(0,))
+    try:
+        replicas = auto_replicas(args.dir)
+        if len(replicas) != 2:
+            return _fail(f"auto_replicas found {len(replicas)} "
+                         f"endpoints, want 2")
+        by_ep = {_fleet.normalize_endpoint(p.endpoint): p.name
+                 for p in procs}
+        for r in replicas:
+            r.name = by_ep[r.base]
+        healthy = next(r for r in replicas if r.name == "r1")
+
+        # ---- phase 2: prefill here, decode over there ----------------
+        prng = np.random.RandomState(23)
+        prompts = [prng.randint(0, VOCAB, (PROMPT_LEN,))
+                   for _ in range(4)]
+        base_eng = make_engine(max_batch=BATCH, max_seq_len=SEQ)
+        expect = []
+        for p in prompts:
+            rid = base_eng.add_request(np.asarray(p, np.int64),
+                                       max_new_tokens=MAX_NEW)
+            fin = {f.request_id: f.output_ids.tolist()
+                   for f in base_eng.run()}
+            expect.append(fin[rid])
+        prefill_eng = make_engine(max_batch=BATCH, max_seq_len=SEQ)
+        dis = DisaggregatedServing(prefill_eng, healthy.base)
+        outs = dis.generate_many(
+            [dict(prompt_ids=p, max_new_tokens=MAX_NEW)
+             for p in prompts])
+        for i, (o, e) in enumerate(zip(outs, expect)):
+            if not o.get("ok"):
+                return _fail(f"HTTP handoff request {i} failed: "
+                             f"{o.get('error')}")
+            if list(o["output_ids"]) != list(e):
+                return _fail(f"HTTP handoff request {i} tokens differ "
+                             f"from single-engine run:\n  one-engine: "
+                             f"{e}\n  handoff:    {o['output_ids']}")
+        print(f"kv-fabric phase 2 ok: {len(prompts)} requests "
+              f"prefilled locally, decoded by subprocess r1 over "
+              f"/v1/kv_handoff, tokens bit-equal", file=sys.stderr)
+
+        # ---- phase 3: rank.kill on r0 under routed traffic -----------
+        router = Router(replicas, workers=8).start()
+        rng2 = np.random.RandomState(31)
+        tickets = [router.submit(rng2.randint(0, VOCAB, (PROMPT_LEN,)),
+                                 max_new_tokens=MAX_NEW)
+                   for _ in range(args.requests)]
+        outs = [t.result(timeout=120.0) for t in tickets]
+        lost = [(i, o) for i, o in enumerate(outs)
+                if not o.get("ok")
+                or len(o.get("output_ids") or ()) != MAX_NEW]
+        if lost:
+            i, o = lost[0]
+            return _fail(f"chaos drill lost {len(lost)}/"
+                         f"{args.requests} requests; first: #{i} "
+                         f"{o.get('error') or o}")
+        victim_proc = next(p for p in procs if p.name == "r0")
+        victim_proc.proc.wait(timeout=30.0)
+        code = victim_proc.proc.poll()
+        if code != 137:
+            return _fail(f"r0 exit code {code}, want 137 — rank.kill "
+                         f"never fired (drill proved nothing)")
+        served_by = {o.get("replica") for o in outs}
+        router.close()
+        print(f"kv-fabric phase 3 ok: r0 died hard (exit 137) under "
+              f"load, {args.requests}/{args.requests} requests "
+              f"survived via {sorted(served_by)}", file=sys.stderr)
+    finally:
+        for p in procs:
+            p.stop()
+
+    print("kv-fabric smoke OK: tiered promote bit-equal (host+disk, "
+          "corrupt->clean miss), cross-process /v1/kv_handoff "
+          "bit-equal, rank.kill drill zero lost requests")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
