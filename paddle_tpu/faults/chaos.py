@@ -16,7 +16,8 @@ Entries are ';'- (or ',')-separated `site@key=val:key=val`. Sites:
     checkpoint.torn_write torn manifest: truncated JSON, no COMMITTED marker
     rank.kill             os._exit(137) — SIGKILL-equivalent; atexit flushes
                           are deliberately skipped
-    rank.slow             sleep `delay` s in the train step (straggler)
+    rank.slow             sleep `delay` s in the train or serving
+                          decode step (straggler)
     dataloader.hang       sleep `delay` s in the dataloader fetch (bounded)
 
 Triggers (all optional; an entry with none fires on every invocation):

@@ -163,6 +163,46 @@ define_flag("FLAGS_timeseries_interval_s", 0.0,
             "serves it live (fleet_report renders the per-rank trend). "
             "0 (default) = off: one flag read, zero allocations, "
             "pinned by tests/test_timeseries.py.", type_=float)
+define_flag("FLAGS_timeseries_capacity", 1024,
+            "Samples retained per time-series history ring "
+            "(observability/timeseries.py). Each sample is one small "
+            "dict (~200-400 bytes: load, queue depth, KV occupancy, "
+            "burn rates), so the memory bound is roughly "
+            "capacity * 0.4 KiB per rank — the default 1024 holds "
+            "~85 min of history at a 5 s interval in under ~0.5 MiB. "
+            "Raise it for long-window anomaly detection "
+            "(FLAGS_anomaly) so slow leaks aren't truncated out of "
+            "the ring before the detector can see them.", type_=int)
+define_flag("FLAGS_anomaly", False,
+            "Anomaly detection over the telemetry history "
+            "(observability/anomaly.py): after each time-series "
+            "sample (requires FLAGS_timeseries_interval_s > 0) run "
+            "monotone-growth leak detection on KV/host-tier "
+            "occupancy, windowed mean-shift change-points on "
+            "TTFT/load/queue, time-to-saturation extrapolation on "
+            "queue growth and recovery-storm detection; each verdict "
+            "raises an anomaly_active{kind} gauge, a flight-recorder "
+            "breadcrumb, and shows in /debug/anomalies, /statusz and "
+            "fleet_doctor. Off (default) = one flag read per sample, "
+            "zero registry/ring allocations, pinned by "
+            "tests/test_anomaly.py.")
+define_flag("FLAGS_canary_interval_s", 0.0,
+            "Black-box canary prober (observability/canary.py): when "
+            "> 0, a daemon thread periodically sends a fixed "
+            "synthetic greedy prompt through the registered serving "
+            "target (ReplicaServer HTTP loopback or Router), "
+            "bit-compares the tokens against the golden reference "
+            "(first successful probe self-anchors when no explicit "
+            "golden is set), records canary_ttft_seconds/"
+            "canary_e2e_seconds with an always-sampled trace, and on "
+            "mismatch or timeout flips /healthz to degraded and "
+            "raises a canary anomaly verdict. 0 (default) = off: one "
+            "flag read, zero allocations, pinned by "
+            "tests/test_canary.py.", type_=float)
+define_flag("FLAGS_canary_timeout_s", 10.0,
+            "Per-probe timeout in seconds for the canary prober; a "
+            "probe exceeding this counts as a canary_timeout failure "
+            "(degraded /healthz + anomaly verdict).", type_=float)
 define_flag("FLAGS_memwatch", False,
             "Memory observability channel (observability/memwatch.py): "
             "per-step HBM watermark gauges from device memory_stats "

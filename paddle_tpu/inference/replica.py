@@ -71,6 +71,13 @@ class ReplicaServer:
             _httpd.register_route(self.route, self._handle_generate)
             _httpd.register_route(_fab.KV_HANDOFF_ROUTE,
                                   self._handle_kv_handoff)
+            # offer this replica as the black-box canary target
+            # (observability/canary.py): passive until
+            # FLAGS_canary_interval_s arms the prober
+            from ..observability import canary as _canary
+
+            _canary.register_target(f"replica{self.route}",
+                                    self._canary_send)
             self._thread = threading.Thread(
                 target=self._loop, name="serving-replica", daemon=True)
             self._thread.start()
@@ -127,6 +134,42 @@ class ReplicaServer:
         return self.wait(self.submit(prompt_ids, max_new_tokens,
                                      **params), timeout=timeout) or {
             "error": "timeout", "ok": False}
+
+    def _canary_send(self, prompt_ids, max_new, timeout_s) -> dict:
+        """Canary probe transport: loop back through our OWN
+        /v1/generate over localhost when the telemetry httpd is up (a
+        wedged HTTP plane must fail the probe — that is the point of a
+        black-box check), direct engine submit otherwise."""
+        srv = _httpd.server()
+        if srv is not None:
+            import urllib.request
+
+            url = f"http://127.0.0.1:{srv.port}{self.route}"
+            payload = json.dumps({
+                "prompt_ids": list(prompt_ids),
+                "max_new_tokens": int(max_new),
+                "decode_strategy": "greedy_search",
+                "timeout_s": float(timeout_s),
+            }).encode()
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            ctx = _tracing.current_context()
+            if ctx is not None:
+                # carry the canary's pre-sampled context so the probe's
+                # serving spans stitch into its always-kept trace
+                req.add_header(_tracing.TRACE_HEADER, ctx.header())
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=float(timeout_s) + 1.0) as resp:
+                    return json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001 — the prober turns
+                return {"ok": False, "error": repr(e)}  # this into a
+                # timeout/error verdict
+        return self.generate(list(prompt_ids),
+                             max_new_tokens=int(max_new),
+                             timeout=float(timeout_s),
+                             decode_strategy="greedy_search")
 
     # -- the drive loop -----------------------------------------------
     def _loop(self):

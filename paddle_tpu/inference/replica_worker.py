@@ -27,7 +27,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 def _parse(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
@@ -72,6 +72,14 @@ def _parse(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     ap.add_argument("--chaos", default="",
                     help="FLAGS_chaos schedule armed AFTER warmup, "
                          "e.g. 'decode.oom@p=1.0:n=2'")
+    ap.add_argument("--flag", action="append", default=[],
+                    metavar="FLAGS_name=value",
+                    help="extra FLAGS_* overrides applied before the "
+                         "engine is built (repeatable), e.g. "
+                         "--flag FLAGS_timeseries_interval_s=0.2 "
+                         "--flag FLAGS_anomaly=1 — how doctor_smoke "
+                         "arms history sampling + anomaly detection "
+                         "in its workers")
     ap.add_argument("--recovery-backoff", type=float, default=None,
                     help="FLAGS_serving_recovery_backoff_s override "
                          "(widen the drain window the smoke observes)")
@@ -115,6 +123,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             float(args.recovery_backoff)
     if args.trace_sample is not None:
         flags["FLAGS_trace_sample"] = float(args.trace_sample)
+    for pair in args.flag:
+        name, sep, val = pair.partition("=")
+        if not sep or not name.startswith("FLAGS_"):
+            raise SystemExit(f"--flag expects FLAGS_name=value, "
+                             f"got {pair!r}")
+        flags[name] = val  # set_flags coerces via the flag's type
     _cfg.set_flags(flags)
 
     paddle.seed(args.seed)
@@ -216,14 +230,18 @@ def _pump(rp: ReplicaProc):
 def spawn_replicas(n: int, fleet_dir: str, *,
                    worker_args: Sequence[str] = (),
                    chaos: str = "", chaos_replicas: Sequence[int] = (),
+                   chaos_by_replica: Optional[Dict[int, str]] = None,
                    recovery_backoff: Optional[float] = None,
                    timeout: float = 300.0,
                    log_dir: Optional[str] = None) -> List[ReplicaProc]:
     """Spawn ``n`` replica workers and block until every one prints
     READY (raises RuntimeError with the worker's log tail otherwise).
     ``chaos`` is armed only on the replica indices in
-    ``chaos_replicas``. Each worker gets a distinct PADDLE_TRAINER_ID
-    so the fleet shards (and heartbeat endpoints) don't collide."""
+    ``chaos_replicas``; ``chaos_by_replica`` maps index -> schedule
+    when different replicas need DIFFERENT faults (the doctor smoke
+    storms decode.oom on one worker and drags rank.slow on another).
+    Each worker gets a distinct PADDLE_TRAINER_ID so the fleet shards
+    (and heartbeat endpoints) don't collide."""
     procs: List[ReplicaProc] = []
     log_dir = log_dir or fleet_dir
     os.makedirs(log_dir, exist_ok=True)
@@ -233,8 +251,10 @@ def spawn_replicas(n: int, fleet_dir: str, *,
                "paddle_tpu.inference.replica_worker",
                "--name", name, "--fleet-dir", fleet_dir,
                *worker_args]
-        if chaos and i in set(chaos_replicas):
-            cmd += ["--chaos", chaos]
+        sched = (chaos_by_replica or {}).get(i) or \
+            (chaos if chaos and i in set(chaos_replicas) else "")
+        if sched:
+            cmd += ["--chaos", sched]
             if recovery_backoff is not None:
                 cmd += ["--recovery-backoff", str(recovery_backoff)]
         env = dict(os.environ)

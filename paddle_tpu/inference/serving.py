@@ -2464,8 +2464,11 @@ class ServingEngine:
                 # HARD mid-serve (the kv-fabric drill proves the router
                 # loses zero requests when a worker vanishes); an
                 # injected decode OOM takes the SAME handler as an
-                # organic RESOURCE_EXHAUSTED from the compiled call
+                # organic RESOURCE_EXHAUSTED from the compiled call;
+                # rank.slow sleeps the decode step, turning this rank
+                # into a straggler the anomaly detectors must catch
                 _faults.maybe_kill()
+                _faults.maybe_slow()
                 try:
                     _faults.maybe_decode_oom()
                 except BaseException as e:

@@ -1,0 +1,434 @@
+"""Anomaly detection over the telemetry history: signals -> verdicts.
+
+The fleet emits load scores, SLO burn rates, KV occupancy, queue depth
+and recovery counters — ROADMAP item 5's complaint is that nothing
+*consumes* them. This module is the sensing half of that control loop:
+a small detector engine that turns the per-rank time-series rings
+(observability/timeseries.py) and exported history shards
+(observability/fleet.py) into severity-ranked **verdicts** a human or
+an autoscaler can act on:
+
+- ``kv_leak`` — monotone-growth leak detection on KV / host-tier
+  occupancy ("rank 2's KV pool only ever grows");
+- ``mean_shift`` — windowed change-point detection on TTFT, load and
+  queue depth ("TTFT shifted +40% at 14:02");
+- ``queue_saturation`` — least-squares extrapolation of queue growth
+  to the admission-queue capacity ("queue saturates in ~90 s");
+- ``recovery_storm`` — a burst of engine self-heals inside one window
+  (healing is fine; healing *constantly* is an incident);
+- ``straggler_drift`` — one rank's TTFT drifting away from the fleet
+  median (cross-rank, shard-level only);
+- ``canary_mismatch`` / ``canary_timeout`` — raised externally by the
+  black-box prober (observability/canary.py).
+
+Every verdict is a plain dict ``{kind, rank, severity, metric,
+summary, evidence}`` with a deterministic severity in [0, 1] — the
+synthetic-history goldens in tests/test_anomaly.py pin exact values.
+
+Detectors are PURE functions over row lists (the history shard format:
+wall-clock ``ts`` plus the sampled signals), so ``tools/fleet_doctor``
+can run them offline over a telemetry dir with no live process. The
+live path rides the sampling cadence: ``timeseries.sample_now`` tail
+calls ``on_sample`` which — only when ``FLAGS_anomaly`` is on — scans
+the ring, exports an ``anomaly_active{kind}`` gauge per verdict kind,
+and drops a flight-recorder breadcrumb the moment a verdict becomes
+active. Off (the default) the whole channel costs ONE flag read and
+allocates nothing (alloc-guard pinned by tests/test_anomaly.py, same
+contract as every other observability channel).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+# detection thresholds — module constants so tests and the doctor CLI
+# override per-call, not via more flags
+LEAK_WINDOW = 8            # min monotone non-decreasing tail run
+LEAK_MIN_GROWTH_FRAC = 0.1  # net growth / |last| to call it a leak
+SHIFT_WINDOW = 8           # samples per side of the change-point
+SHIFT_FRAC = 0.25          # |mean2 - mean1| / |mean1| to flag
+SAT_WINDOW = 8             # samples for the queue-growth fit
+SAT_HORIZON_S = 300.0      # flag if saturation lands inside this
+STORM_WINDOW = 8           # samples for the recovery-burst window
+STORM_MIN_EVENTS = 3       # new recoveries inside it = a storm
+DRIFT_FRAC = 0.5           # rank TTFT vs fleet median to flag
+
+_EPS = 1e-9
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the channel when it is off."""
+    return bool(_flags().get_flag("FLAGS_anomaly", False))
+
+
+def _verdict(kind: str, rank: int, severity: float, metric: str,
+             summary: str, **evidence) -> dict:
+    return {
+        "kind": kind,
+        "rank": int(rank),
+        "severity": round(min(1.0, max(0.0, severity)), 3),
+        "metric": metric,
+        "summary": summary,
+        "evidence": evidence,
+    }
+
+
+def _series(rows: Sequence[dict], metric: str) -> List[float]:
+    """The metric's values from rows that carry it, oldest first."""
+    out = []
+    for r in rows:
+        v = r.get(metric)
+        if isinstance(v, (int, float)):
+            out.append(float(v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pure detectors (offline-safe: fleet_doctor runs these over shards)
+# ---------------------------------------------------------------------------
+
+def detect_leak(rows: Sequence[dict], metric: str = "kv_occupancy",
+                window: int = LEAK_WINDOW,
+                min_growth_frac: float = LEAK_MIN_GROWTH_FRAC,
+                rank: int = 0) -> Optional[dict]:
+    """Monotone-growth leak: the trailing `window`+ samples never
+    decrease and the net growth is a meaningful fraction of the final
+    value. Scale-invariant, so it works for occupancy fractions and
+    raw page counts alike."""
+    series = _series(rows, metric)
+    if len(series) < window:
+        return None
+    run = 1  # trailing non-decreasing run length
+    for i in range(len(series) - 1, 0, -1):
+        if series[i] < series[i - 1]:
+            break
+        run += 1
+    if run < window:
+        return None
+    tail = series[-run:]
+    growth = tail[-1] - tail[0]
+    frac = growth / max(abs(tail[-1]), _EPS)
+    if growth <= 0 or frac < min_growth_frac:
+        return None
+    sev = 0.3 + 0.7 * min(1.0, frac)
+    return _verdict(
+        "kv_leak", rank, sev, metric,
+        f"{metric} grew monotonically for {run} samples "
+        f"({tail[0]:g} -> {tail[-1]:g}, +{frac:.0%} of current)",
+        run=run, first=tail[0], last=tail[-1],
+        growth_frac=round(frac, 4))
+
+
+def detect_mean_shift(rows: Sequence[dict], metric: str,
+                      window: int = SHIFT_WINDOW,
+                      shift_frac: float = SHIFT_FRAC,
+                      rank: int = 0) -> Optional[dict]:
+    """Windowed mean-shift change-point: compare the mean of the last
+    `window` samples against the `window` before them. A constant
+    series (or one shorter than 2*window) never fires."""
+    series = _series(rows, metric)
+    if len(series) < 2 * window:
+        return None
+    before = series[-2 * window:-window]
+    after = series[-window:]
+    m1 = sum(before) / window
+    m2 = sum(after) / window
+    shift = (m2 - m1) / max(abs(m1), _EPS)
+    if abs(shift) < shift_frac:
+        return None
+    direction = "+" if shift >= 0 else ""
+    # shift ts: the wall clock where the after-window begins
+    ts_rows = [r for r in rows if isinstance(r.get(metric), (int, float))]
+    at = ts_rows[-window].get("ts") if len(ts_rows) >= window else None
+    return _verdict(
+        "mean_shift", rank, min(1.0, abs(shift)), metric,
+        f"{metric} mean shifted {direction}{shift:.0%} "
+        f"({m1:.3g} -> {m2:.3g} over the last {window} samples)",
+        mean_before=round(m1, 4), mean_after=round(m2, 4),
+        shift_frac=round(shift, 4), at_ts=at)
+
+
+def detect_queue_saturation(rows: Sequence[dict],
+                            window: int = SAT_WINDOW,
+                            capacity: Optional[int] = None,
+                            horizon_s: float = SAT_HORIZON_S,
+                            rank: int = 0) -> Optional[dict]:
+    """Time-to-saturation: least-squares slope of queue depth over the
+    trailing window, extrapolated to the admission-queue capacity
+    (FLAGS_router_queue_depth when not given). Fires only when the
+    queue is actually growing and saturation lands inside horizon_s."""
+    if capacity is None:
+        try:
+            capacity = int(_flags().get_flag(
+                "FLAGS_router_queue_depth", 256))
+        except (TypeError, ValueError):
+            capacity = 256
+    pts = [(float(r["ts"]), float(r["queue"])) for r in rows
+           if isinstance(r.get("ts"), (int, float))
+           and isinstance(r.get("queue"), (int, float))]
+    if len(pts) < window:
+        return None
+    pts = pts[-window:]
+    n = len(pts)
+    t0 = pts[0][0]
+    xs = [t - t0 for t, _ in pts]
+    ys = [q for _, q in pts]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= _EPS:
+        return None
+    slope = sum((x - mx) * (y - my)
+                for x, y in zip(xs, ys)) / denom  # req/s
+    last_q = ys[-1]
+    if slope <= _EPS or last_q >= capacity:
+        headroom_gone = last_q >= capacity and slope > -_EPS
+        if not headroom_gone:
+            return None
+        eta = 0.0
+    else:
+        eta = (capacity - last_q) / slope
+    if eta > horizon_s:
+        return None
+    sev = 0.3 + 0.7 * min(1.0, (horizon_s - eta) / horizon_s)
+    return _verdict(
+        "queue_saturation", rank, sev, "queue",
+        f"queue depth {last_q:g} growing {slope:.3g}/s saturates "
+        f"capacity {capacity} in ~{eta:.0f}s",
+        slope_per_s=round(slope, 4), queue=last_q,
+        capacity=capacity, eta_s=round(eta, 1))
+
+
+def detect_recovery_storm(rows: Sequence[dict],
+                          window: int = STORM_WINDOW,
+                          min_events: int = STORM_MIN_EVENTS,
+                          rank: int = 0) -> Optional[dict]:
+    """Recovery storm: `recoveries` is a cumulative counter sampled
+    into the rows (the key is absent until the first recovery, so rows
+    before it count as zero); min_events+ NEW recoveries inside ANY
+    window-sized span is a storm. The window SLIDES over the whole
+    history instead of pinning to the tail — a one-shot doctor must
+    still name a burst that happened a minute before the scrape."""
+    if not any(isinstance(r.get("recoveries"), (int, float))
+               for r in rows):
+        return None
+    series = [float(r.get("recoveries") or 0.0) for r in rows]
+    if len(series) < 2:
+        return None
+    best, at = 0.0, len(series) - 1
+    for i in range(1, len(series)):
+        new = series[i] - series[max(0, i - window + 1)]
+        if new > best:
+            best, at = new, i
+    if best < min_events:
+        return None
+    sev = 0.5 + 0.5 * min(1.0, best / (2.0 * max(min_events, 1)))
+    return _verdict(
+        "recovery_storm", rank, sev, "recoveries",
+        f"{best:g} engine recoveries inside a {window}-sample "
+        f"window (self-heal loop)",
+        new_events=best, window=window, total=series[-1],
+        at_ts=rows[at].get("ts"))
+
+
+def detect_straggler_drift(
+        history_by_rank: Dict[int, Sequence[dict]],
+        metric: str = "ttft_ms", window: int = SHIFT_WINDOW,
+        drift_frac: float = DRIFT_FRAC) -> List[dict]:
+    """Cross-rank drift: a rank whose trailing mean of `metric` sits
+    drift_frac above the fleet median is a straggler in the making.
+    Needs >= 2 ranks reporting the metric (a fleet of one has no
+    median to drift from)."""
+    means = {}
+    for rank, rows in history_by_rank.items():
+        series = _series(rows, metric)
+        if series:
+            tail = series[-window:]
+            means[int(rank)] = sum(tail) / len(tail)
+    if len(means) < 2:
+        return []
+    vals = sorted(means.values())
+    mid = len(vals) // 2
+    median = (vals[mid] if len(vals) % 2
+              else (vals[mid - 1] + vals[mid]) / 2.0)
+    out = []
+    for rank in sorted(means):
+        drift = (means[rank] - median) / max(abs(median), _EPS)
+        if drift >= drift_frac:
+            out.append(_verdict(
+                "straggler_drift", rank,
+                min(1.0, drift), metric,
+                f"rank {rank} {metric} {means[rank]:.3g} is "
+                f"+{drift:.0%} above the fleet median {median:.3g}",
+                rank_mean=round(means[rank], 4),
+                fleet_median=round(median, 4),
+                drift_frac=round(drift, 4)))
+    return out
+
+
+def detect(rows: Sequence[dict], rank: int = 0, **overrides) -> List[dict]:
+    """Run every single-rank detector over one rank's history rows;
+    verdicts sorted severity-desc. Empty/short histories simply return
+    [] — never an error."""
+    if not rows:
+        return []
+    out = []
+    for metric in ("kv_occupancy", "kv_host_pages"):
+        v = detect_leak(rows, metric=metric, rank=rank,
+                        **{k: v for k, v in overrides.items()
+                           if k in ("window", "min_growth_frac")})
+        if v:
+            out.append(v)
+    for metric in ("ttft_ms", "load", "queue"):
+        v = detect_mean_shift(rows, metric=metric, rank=rank,
+                              **{k: v for k, v in overrides.items()
+                                 if k in ("window", "shift_frac")})
+        if v:
+            out.append(v)
+    v = detect_queue_saturation(rows, rank=rank,
+                                **{k: v for k, v in overrides.items()
+                                   if k in ("window", "capacity",
+                                            "horizon_s")})
+    if v:
+        out.append(v)
+    v = detect_recovery_storm(rows, rank=rank,
+                              **{k: v for k, v in overrides.items()
+                                 if k in ("window", "min_events")})
+    if v:
+        out.append(v)
+    out.sort(key=lambda d: (-d["severity"], d["kind"], d["metric"]))
+    return out
+
+
+def detect_fleet(history_by_rank: Dict[int, Sequence[dict]],
+                 **overrides) -> List[dict]:
+    """Per-rank detectors over every rank's rows + the cross-rank
+    straggler-drift pass — what fleet_doctor and the fleet report run
+    over history shards."""
+    out = []
+    for rank in sorted(history_by_rank):
+        out.extend(detect(history_by_rank[rank], rank=rank, **overrides))
+    out.extend(detect_straggler_drift(history_by_rank))
+    out.sort(key=lambda d: (-d["severity"], d["rank"], d["kind"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live path: scan-on-sample, gauges, breadcrumbs, external verdicts
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_latest: List[dict] = []      # last scan's verdicts (detector-produced)
+_external: Dict[str, dict] = {}  # canary & friends, keyed by kind
+_active_keys: set = set()     # (kind, rank, metric) currently active
+_known_kinds: set = set()     # every kind we ever gauged (for clears)
+scans = 0                     # live scans run (test introspection)
+
+
+def raise_verdict(kind: str, rank: int, severity: float, metric: str,
+                  summary: str, **evidence):
+    """Externally assert a verdict (the canary prober's entry point —
+    black-box failures have no history row to detect from). Held until
+    `clear_verdict(kind)`; surfaced through latest()/statusz/doctor
+    and gauged+breadcrumbed like detector verdicts."""
+    v = _verdict(kind, rank, severity, metric, summary, **evidence)
+    with _lock:
+        _external[kind] = v
+    _publish()
+
+
+def clear_verdict(kind: str):
+    with _lock:
+        _external.pop(kind, None)
+    _publish()
+
+
+def latest() -> List[dict]:
+    """Current verdicts: the last live scan's plus externally-raised
+    ones, severity-desc — the /debug/anomalies payload."""
+    with _lock:
+        out = list(_latest) + list(_external.values())
+    out.sort(key=lambda d: (-d["severity"], d["rank"], d["kind"]))
+    return out
+
+
+def on_sample(recorder) -> Optional[List[dict]]:
+    """timeseries.sample_now's tail call. OFF = this one flag read and
+    nothing else — no registry lookups, no list allocations."""
+    if not enabled():
+        return None
+    return scan(recorder)
+
+
+def scan(recorder=None) -> List[dict]:
+    """Scan the live ring now: run the detectors, publish gauges and
+    breadcrumbs for newly-active verdicts. Idempotent per state — an
+    already-active verdict re-detected on the next sample does not
+    re-breadcrumb."""
+    global _latest, scans
+    if recorder is None:
+        from . import timeseries as _ts
+
+        recorder = _ts.recorder()
+    rows = recorder.history() if recorder is not None else []
+    from . import metrics as _metrics
+
+    rank, _ = _metrics.rank_world()
+    verdicts = detect(rows, rank=rank)
+    with _lock:
+        _latest = verdicts
+        scans += 1
+    _publish()
+    return verdicts
+
+
+def _publish():
+    """Gauge + breadcrumb the current verdict set. anomaly_active{kind}
+    is 1 while any verdict of that kind is live and drops to 0 when it
+    clears (kinds once seen keep their 0-series so dashboards don't
+    show gaps)."""
+    global _active_keys
+    from . import flight_recorder as _flight
+    from . import metrics as _metrics
+
+    current = latest()
+    keys = {(v["kind"], v["rank"], v["metric"]) for v in current}
+    kinds = {v["kind"] for v in current}
+    try:
+        gauge = _metrics.default_registry().gauge(
+            "anomaly_active",
+            "1 while an anomaly verdict of this kind is active "
+            "(observability/anomaly.py); see /debug/anomalies for "
+            "the ranked verdicts.", labels=("kind",))
+        with _lock:
+            _known_kinds.update(kinds)
+            known = set(_known_kinds)
+        for kind in known:
+            gauge.labels(kind=kind).set(1.0 if kind in kinds else 0.0)
+    except Exception:  # noqa: BLE001 — telemetry never raises
+        pass
+    with _lock:
+        new_keys = keys - _active_keys
+        _active_keys = keys
+    for v in current:
+        if (v["kind"], v["rank"], v["metric"]) in new_keys:
+            _flight.record_event(
+                "anomaly", verdict=v["kind"], rank=v["rank"],
+                metric=v["metric"], severity=v["severity"],
+                summary=v["summary"])
+
+
+def _reset_for_tests():
+    global _latest, _external, _active_keys, _known_kinds, scans
+    with _lock:
+        _latest = []
+        _external = {}
+        _active_keys = set()
+        _known_kinds = set()
+        scans = 0

@@ -1,0 +1,322 @@
+"""Black-box canary prober: end-to-end truth the counters can't see.
+
+Every other observability channel is white-box — it reports what the
+process *believes* about itself. A wedged HTTP plane, a silently-wrong
+decode path, a replica that answers /healthz but not /v1/generate: all
+invisible to internal counters, all instantly visible to a user. The
+canary closes that gap the way production serving stacks do: a daemon
+thread periodically sends a FIXED synthetic greedy prompt through the
+real request path (the ReplicaServer's HTTP loopback, or a Router) and
+bit-compares the returned tokens against a golden reference.
+
+Per probe:
+
+- ``canary_probes_total{result}`` (ok / mismatch / timeout / error),
+  ``canary_ttft_seconds`` / ``canary_e2e_seconds`` histograms, and a
+  ``canary_ok`` gauge;
+- an always-sampled trace (a pre-sampled ``TraceContext`` is installed
+  for the probe's duration, so head sampling can never drop a canary
+  timeline and the X-PT-Trace plumbing carries it across processes);
+- on mismatch or timeout: ``/healthz`` flips to degraded (via
+  ``healthy()``) and an anomaly verdict (``canary_mismatch`` /
+  ``canary_timeout``) is raised through observability/anomaly.py —
+  cleared again by the next green probe.
+
+Greedy decode is deterministic, so the golden reference can
+self-anchor: when no explicit golden is registered, the first
+successful probe's tokens BECOME the golden and every later probe must
+bit-match them. tools/doctor_smoke.py registers an explicit golden
+computed from an identical reference model instead.
+
+Channel contract: off (``FLAGS_canary_interval_s`` = 0, the default)
+costs one flag read per ``ensure_prober()`` call and allocates nothing
+(alloc-guard pinned by tests/test_canary.py).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+DEFAULT_PROMPT = (1, 2, 3, 4, 5, 6, 7, 8)
+DEFAULT_MAX_NEW = 4
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def interval_s() -> float:
+    try:
+        return float(_flags().get_flag("FLAGS_canary_interval_s", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the channel when it is off."""
+    return interval_s() > 0.0
+
+
+def timeout_s() -> float:
+    try:
+        return float(_flags().get_flag("FLAGS_canary_timeout_s", 10.0))
+    except (TypeError, ValueError):
+        return 10.0
+
+
+class _Target:
+    """One probe destination: a name plus a send callable
+    ``send(prompt_ids, max_new, timeout_s) -> {"ok", "output_ids",
+    "ttft_s"?}`` that pushes the probe through the real request path
+    (HTTP loopback / Router.generate)."""
+
+    __slots__ = ("name", "send", "prompt_ids", "max_new", "golden")
+
+    def __init__(self, name: str, send: Callable,
+                 prompt_ids=None, max_new: int = DEFAULT_MAX_NEW,
+                 golden=None):
+        self.name = name
+        self.send = send
+        self.prompt_ids = list(prompt_ids if prompt_ids is not None
+                               else DEFAULT_PROMPT)
+        self.max_new = int(max_new)
+        # None = self-anchor on the first successful probe
+        self.golden = None if golden is None else list(golden)
+
+
+_lock = threading.Lock()
+_target: Optional[_Target] = None
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+probes = 0  # every probe run (the alloc-guard asserts this stays flat)
+
+_state = {
+    "last_result": None,      # ok / mismatch / timeout / error
+    "last_ts": None,
+    "last_ttft_ms": None,
+    "last_e2e_ms": None,
+    "consecutive_failures": 0,
+    "probes": 0,
+    "failures": 0,
+}
+
+
+def register_target(name: str, send: Callable, *, prompt_ids=None,
+                    max_new: int = DEFAULT_MAX_NEW, golden=None):
+    """Register the probe destination (latest registration wins — a
+    Router-level canary supersedes a single replica's). Registration
+    itself is passive: nothing runs until FLAGS_canary_interval_s > 0
+    and fleet.heartbeat (or a test) calls ensure_prober()."""
+    global _target
+    with _lock:
+        _target = _Target(name, send, prompt_ids=prompt_ids,
+                          max_new=max_new, golden=golden)
+    return _target
+
+
+def target_name() -> Optional[str]:
+    t = _target
+    return t.name if t is not None else None
+
+
+def _metrics_handles():
+    from . import metrics as _metrics
+
+    reg = _metrics.default_registry()
+    return (
+        reg.counter(
+            "canary_probes_total",
+            "Black-box canary probes by result (ok / mismatch / "
+            "timeout / error); observability/canary.py.",
+            labels=("result",)),
+        reg.histogram(
+            "canary_ttft_seconds",
+            "Canary probe time-to-first-token as the serving path "
+            "reported it (black-box, includes HTTP + queueing)."),
+        reg.histogram(
+            "canary_e2e_seconds",
+            "Canary probe end-to-end latency: send to last token, "
+            "through the real request path."),
+        reg.gauge(
+            "canary_ok",
+            "1 while the last canary probe passed bit-exact within "
+            "its deadline, 0 while failing (degrades /healthz)."),
+    )
+
+
+def probe_once() -> dict:
+    """Run one probe synchronously (the loop body; tests and
+    doctor_smoke call it directly). Returns {"result", "tokens",
+    "e2e_ms", "ttft_ms"?} and updates metrics/anomaly/health state."""
+    global probes
+    t = _target
+    if t is None:
+        return {"result": "no_target"}
+    from . import anomaly as _anomaly
+    from . import metrics as _metrics
+    from . import tracing as _tracing
+
+    probes += 1
+    deadline = timeout_s()
+    rank, _ = _metrics.rank_world()
+    # pre-sampled context: head sampling must never drop a canary
+    # trace, and the X-PT-Trace plumbing inherits this verdict
+    ctx = prev = None
+    if _tracing.enabled():
+        ctx = _tracing.TraceContext(
+            (os.getpid() & 0xFFFFFF) << 24 | (probes & 0xFFFFFF),
+            "canary", True)
+        prev = _tracing.set_current(ctx)
+    tr = _tracing.start_trace("canary", own_track=True,
+                              target=t.name, probe=probes)
+    result, tokens, ttft_s = "ok", None, None
+    err = ""
+    t0 = time.perf_counter()
+    try:
+        with tr.span("canary.probe", target=t.name):
+            reply = t.send(list(t.prompt_ids), t.max_new, deadline)
+        e2e = time.perf_counter() - t0
+        if not isinstance(reply, dict) or not reply.get("ok", True):
+            result = "error"
+            err = str((reply or {}).get("error", "send failed"))
+        else:
+            tokens = list(reply.get("output_ids") or [])
+            ttft_s = reply.get("ttft_s")
+            if e2e > deadline:
+                result = "timeout"
+                err = f"probe took {e2e:.2f}s > {deadline:.2f}s"
+            elif t.golden is None:
+                t.golden = list(tokens)  # self-anchor
+            elif tokens != t.golden:
+                result = "mismatch"
+                err = (f"tokens {tokens[:8]} != golden "
+                       f"{t.golden[:8]}")
+    except Exception as e:  # noqa: BLE001 — a probe failure is a
+        e2e = time.perf_counter() - t0  # verdict, not a crash
+        result = "timeout" if "timed out" in str(e).lower() else "error"
+        err = f"{type(e).__name__}: {e}"
+    tr.finish(result=result)
+    if ctx is not None:
+        _tracing.set_current(prev)
+
+    probes_c, ttft_h, e2e_h, ok_g = _metrics_handles()
+    probes_c.labels(result=result).inc()
+    e2e_h.observe(e2e)
+    if ttft_s is not None:
+        try:
+            ttft_h.observe(float(ttft_s))
+        except (TypeError, ValueError):
+            ttft_s = None
+    ok_g.set(1.0 if result == "ok" else 0.0)
+
+    with _lock:
+        _state["probes"] += 1
+        _state["last_result"] = result
+        _state["last_ts"] = round(time.time(), 3)
+        _state["last_e2e_ms"] = round(e2e * 1000.0, 3)
+        _state["last_ttft_ms"] = (round(float(ttft_s) * 1000.0, 3)
+                                  if ttft_s is not None else None)
+        if result == "ok":
+            _state["consecutive_failures"] = 0
+        else:
+            _state["failures"] += 1
+            _state["consecutive_failures"] += 1
+    if result == "ok":
+        _anomaly.clear_verdict("canary_mismatch")
+        _anomaly.clear_verdict("canary_timeout")
+    elif result == "mismatch":
+        _anomaly.raise_verdict(
+            "canary_mismatch", rank, 0.9, "canary",
+            f"canary tokens diverged from golden on {t.name}: {err}",
+            target=t.name)
+    else:  # timeout / error: the black-box path is unreachable/wedged
+        _anomaly.raise_verdict(
+            "canary_timeout", rank, 0.7, "canary",
+            f"canary probe failed on {t.name} ({result}): {err}",
+            target=t.name, reason=result)
+    out = {"result": result, "e2e_ms": round(e2e * 1000.0, 3)}
+    if tokens is not None:
+        out["tokens"] = tokens
+    if err:
+        out["error"] = err
+    return out
+
+
+def _loop():
+    while not _stop.is_set():
+        iv = interval_s()
+        if iv <= 0.0:
+            _stop.wait(1.0)  # flag flipped off mid-run: park cheaply
+            continue
+        try:
+            probe_once()
+        except Exception:  # noqa: BLE001 — a bad probe never kills
+            pass           # the prober thread
+        _stop.wait(iv)
+
+
+def ensure_prober() -> Optional[threading.Thread]:
+    """Start the probe thread if FLAGS_canary_interval_s > 0 and a
+    target is registered (idempotent — fleet.heartbeat calls this
+    every beat). Off = one flag read, nothing allocated."""
+    global _thread
+    if not enabled():
+        return _thread
+    if _target is None:
+        return _thread
+    with _lock:
+        if _thread is None:
+            _stop.clear()
+            _thread = threading.Thread(
+                target=_loop, name="canary-prober", daemon=True)
+            _thread.start()
+    return _thread
+
+
+def healthy() -> Optional[bool]:
+    """False while the last probe failed (healthz reports degraded),
+    True after a green probe, None when the canary never ran (healthz
+    ignores the channel entirely)."""
+    with _lock:
+        last = _state["last_result"]
+    if last is None:
+        return None
+    return last == "ok"
+
+
+def status() -> dict:
+    """The /statusz canary block."""
+    t = _target
+    with _lock:
+        st = dict(_state)
+    st["enabled"] = enabled()
+    st["interval_s"] = interval_s()
+    st["target"] = t.name if t is not None else None
+    st["golden_len"] = (len(t.golden) if t is not None
+                        and t.golden is not None else None)
+    return st
+
+
+def golden() -> Optional[List[int]]:
+    t = _target
+    return list(t.golden) if t is not None and t.golden else None
+
+
+def _reset_for_tests():
+    global _target, _thread, probes
+    _stop.set()
+    th = _thread
+    if th is not None:
+        th.join(timeout=5.0)
+    with _lock:
+        _target = None
+        _thread = None
+        probes = 0
+        for k in _state:
+            _state[k] = 0 if k in ("consecutive_failures", "probes",
+                                   "failures") else None
+    _stop.clear()

@@ -197,6 +197,11 @@ def heartbeat(step: Optional[int] = None):
     # likewise independent of the fleet gate (history can be served
     # live at /debug/timeseries with FLAGS_telemetry_dir unset)
     _timeseries.ensure_recorder()
+    # the canary prober too — black-box probing needs no fleet export
+    # (one flag read when FLAGS_canary_interval_s is 0)
+    from . import canary as _canary
+
+    _canary.ensure_prober()
     if not enabled():
         return
     if step is None:
@@ -1069,6 +1074,43 @@ def recoveries_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def anomaly_table(shards: Dict[int, str]) -> List[dict]:
+    """Severity-ranked anomaly verdicts across the fleet
+    (observability/anomaly.py): the offline detectors re-run over
+    every rank's history.jsonl (leak / mean-shift / queue-saturation /
+    recovery-storm per rank, straggler drift across ranks), merged
+    with any live verdicts a scraped rank already published at
+    /debug/anomalies (canary failures live only there — a black-box
+    miss leaves no history row to detect from)."""
+    from . import anomaly as _anomaly
+
+    history_by_rank = {}
+    for rank, path in sorted(shards.items()):
+        rows = _read_jsonl(os.path.join(path, "history.jsonl"))
+        rows = [r for r in rows
+                if isinstance(r.get("ts"), (int, float))]
+        if rows:
+            rows.sort(key=lambda r: r["ts"])
+            history_by_rank[rank] = rows
+    verdicts = _anomaly.detect_fleet(history_by_rank)
+    seen = {(v["kind"], v["rank"], v["metric"]) for v in verdicts}
+    for rank, path in sorted(shards.items()):
+        live = _read_json(os.path.join(path, "anomalies.json"))
+        for v in (live.get("verdicts") or []
+                  if isinstance(live, dict) else []):
+            try:
+                key = (v["kind"], int(v.get("rank", rank)),
+                       v.get("metric", ""))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if key not in seen:
+                seen.add(key)
+                verdicts.append(dict(v, rank=key[1]))
+    verdicts.sort(key=lambda d: (-float(d.get("severity", 0.0)),
+                                 d.get("rank", 0), d.get("kind", "")))
+    return verdicts
+
+
 # ---------------------------------------------------------------------------
 # live-endpoint scraping (the pull half of the telemetry plane)
 # ---------------------------------------------------------------------------
@@ -1170,6 +1212,40 @@ def scrape_to_shards(endpoints: List[str], out_root: str,
                     json.dumps({"code": code, **payload}, indent=1))
             except Exception:  # noqa: BLE001 — optional extras
                 continue
+        # live history: /debug/timeseries -> history.jsonl, the same
+        # shard file the flusher writes — without this, live-scraped
+        # fleets get no history/sustained-burn/anomaly sections (the
+        # ring only ever reached disk via FLAGS_telemetry_dir)
+        try:
+            code, body = _http_get(
+                f"{base}/debug/timeseries?secs=86400", timeout=timeout)
+            payload = json.loads(body.decode("utf-8", "replace"))
+            rows = payload.get("samples") or []
+            if rows:
+                _metrics.atomic_write(
+                    os.path.join(shard, "history.jsonl"),
+                    "".join(json.dumps(r) + "\n" for r in rows))
+        except Exception:  # noqa: BLE001 — optional extras
+            pass
+        # debug extras for the doctor's support bundle (best-effort)
+        try:
+            code, body = _http_get(f"{base}/debug/stacks",
+                                   timeout=timeout)
+            if code == 200:
+                _metrics.atomic_write(
+                    os.path.join(shard, "stacks.txt"),
+                    body.decode("utf-8", "replace"))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            code, body = _http_get(f"{base}/debug/anomalies",
+                                   timeout=timeout)
+            if code == 200:
+                _metrics.atomic_write(
+                    os.path.join(shard, "anomalies.json"),
+                    body.decode("utf-8", "replace"))
+        except Exception:  # noqa: BLE001
+            pass
         hb = {
             "rank": rank,
             "world_size": (statusz or {}).get("world_size", 0),
@@ -1237,7 +1313,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
                     "ledger": [], "slo": [], "history": [],
-                    "artifacts": {}}
+                    "anomalies": [], "artifacts": {}}
     if not shards:
         return report
     heartbeats = load_heartbeats(shards)
@@ -1261,6 +1337,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "slo": slo_table(shards),
         "history": history_table(shards),
         "recoveries": recoveries_table(shards),
+        "anomalies": anomaly_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -1505,6 +1582,25 @@ def format_report(report: dict) -> str:
                     f"the error_rate SLO burned on these; check its "
                     f"flight recorder (serving.recovery_drop / "
                     f"serving.poisoned events)")
+        lines.append("")
+    verdicts = report.get("anomalies") or []
+    if verdicts:
+        lines.append("")
+        lines.append("== anomaly verdicts per rank (detectors over "
+                     "history.jsonl + live /debug/anomalies; "
+                     "severity-ranked) ==")
+        lines.append(f"{'sev':>5} {'rank':>5} {'kind':<18} "
+                     f"{'metric':<14} summary")
+        for v in verdicts:
+            lines.append(
+                f"{float(v.get('severity', 0.0)):>5.2f} "
+                f"{v.get('rank', '?'):>5} {v.get('kind', '?'):<18} "
+                f"{str(v.get('metric', '-')):<14} "
+                f"{v.get('summary', '')}")
+        lines.append("hint: `python tools/fleet_doctor.py <dir>` maps "
+                     "each verdict to its likely cause and fix lever, "
+                     "and `--bundle out.tar.gz` snapshots everything "
+                     "for a postmortem")
         lines.append("")
     art = report["artifacts"]
     if art:

@@ -31,6 +31,9 @@ per rank, serving:
   time-series recorder's ring (observability/timeseries.py): load
   score, SLO burn, KV occupancy and queue depth sampled every
   FLAGS_timeseries_interval_s.
+- `/debug/anomalies` — the current severity-ranked anomaly verdicts
+  (observability/anomaly.py) plus the canary prober's status block
+  (observability/canary.py).
 
 Distributed tracing: inbound `X-PT-Trace` headers are parked on the
 handler thread before any registered application route runs
@@ -192,13 +195,24 @@ def health_payload(registry: Optional[_metrics.Registry] = None
                            "step": hb["step"], "beats": hb["beats"],
                            "stale_after_s": threshold or None}
     degraded = _slo.firing()
+    # black-box canary (observability/canary.py): a failing probe means
+    # users see wrong/no answers even if every internal check is green —
+    # degrade, but don't fail liveness (the process IS alive; restarting
+    # it on a golden mismatch would mask the bug, not fix it)
+    from . import canary as _canary
+
+    canary_ok = _canary.healthy()  # None = canary never ran
     ok = all(c["ok"] for c in checks.values())
     status = "unhealthy" if not ok else (
-        "degraded" if degraded or recovered else "ok")
-    return (200 if ok else 503), {
+        "degraded" if degraded or recovered or canary_ok is False
+        else "ok")
+    payload = {
         "status": status, "checks": checks,
         "engine_recoveries": recovered,
         "slo_alerts_firing": degraded}
+    if canary_ok is not None:
+        payload["canary_ok"] = canary_ok
+    return (200 if ok else 503), payload
 
 
 def ready_payload() -> Tuple[int, dict]:
@@ -336,6 +350,8 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
             "prefix_cache": prefix,
             "slots": slots,
         })
+    from . import anomaly as _anomaly
+    from . import canary as _canary
     from . import fleet as _fleet
     from . import stepledger as _stepledger
 
@@ -359,6 +375,8 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
         "load_score": _slo.load_score(registry=reg),
         "slo": _slo.default_engine().last_report,
         "ledger": _stepledger.waterfall(),
+        "canary": _canary.status(),
+        "anomalies": _anomaly.latest(),
         "heartbeat": _fleet.last_beat(),
         "flags": {name: cfg.get_flag(name)
                   for name in sorted(cfg._FLAGS)},
@@ -537,11 +555,22 @@ class _Handler(BaseHTTPRequestHandler):
             }
             return (200, (json.dumps(payload, indent=1) + "\n")
                     .encode(), "application/json", None)
+        if path == "/debug/anomalies":
+            from . import anomaly as _anomaly
+            from . import canary as _canary
+
+            payload = {
+                "enabled": _anomaly.enabled(),
+                "verdicts": _anomaly.latest(),
+                "canary": _canary.status(),
+            }
+            return (200, (json.dumps(payload, indent=1) + "\n")
+                    .encode(), "application/json", None)
         if path == "/":
             index = ("paddle-tpu telemetry plane\n"
                      "endpoints: /metrics /healthz /readyz /statusz "
                      "/debug/stacks /debug/trace?secs=N "
-                     "/debug/timeseries?secs=N\n")
+                     "/debug/timeseries?secs=N /debug/anomalies\n")
             return (200, index.encode(),
                     "text/plain; charset=utf-8", None)
         return (404, b"not found\n", "text/plain; charset=utf-8", None)

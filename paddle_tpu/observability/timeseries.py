@@ -59,14 +59,31 @@ def enabled() -> bool:
     return interval_s() > 0.0
 
 
+def ring_capacity() -> int:
+    """Samples retained per ring (FLAGS_timeseries_capacity). Each row
+    is a small dict (~0.4 KiB), so memory is bounded by roughly
+    capacity * 0.4 KiB per rank; long-window anomaly detection
+    (FLAGS_anomaly) may need more than the default 1024."""
+    try:
+        cap = int(_flags().get_flag("FLAGS_timeseries_capacity", 1024))
+    except (TypeError, ValueError):
+        cap = 1024
+    return cap if cap > 0 else 1024
+
+
 class TimeSeriesRecorder:
     """Bounded ring of sampled telemetry rows + the sampling thread."""
 
-    def __init__(self, capacity: int = 1024):
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = ring_capacity()
         self._ring = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # histogram watermarks for the per-sample ttft_ms delta mean
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
         # every row minted (the interval=0 alloc-guard asserts this
         # stays flat, like Registry.allocations / Tracer.spans_created)
         self.samples_created = 0
@@ -132,9 +149,38 @@ class TimeSeriesRecorder:
                 row["firing"] = list(firing)
         except Exception:  # noqa: BLE001
             pass
+        try:
+            from . import metrics as _metrics
+
+            reg = _metrics.default_registry()
+            fam = reg.get("serving_ttft_seconds")
+            if fam is not None:
+                cells = [c for _, c in fam.samples()]
+                tsum = sum(c.sum for c in cells)
+                tcount = sum(c.count for c in cells)
+                d_sum = tsum - self._ttft_sum
+                d_count = tcount - self._ttft_count
+                if d_count > 0:
+                    row["ttft_ms"] = round(d_sum / d_count * 1000.0, 3)
+                self._ttft_sum, self._ttft_count = tsum, tcount
+            fam = reg.get("serving_recoveries_total")
+            if fam is not None:
+                total = sum(c.value for _, c in fam.samples())
+                if total:
+                    row["recoveries"] = int(total)
+        except Exception:  # noqa: BLE001
+            pass
         self.samples_created += 1
         with self._lock:
             self._ring.append(row)
+        # anomaly detection rides the sampling cadence: one flag read
+        # when FLAGS_anomaly is off (on_sample returns immediately)
+        try:
+            from . import anomaly as _anomaly
+
+            _anomaly.on_sample(self)
+        except Exception:  # noqa: BLE001
+            pass
         return row
 
     def _loop(self):

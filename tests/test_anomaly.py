@@ -1,0 +1,282 @@
+"""Anomaly detection over telemetry history (ISSUE 18:
+observability/anomaly.py): synthetic-history goldens pinning each
+detector's exact verdict (kind / rank / severity), constant-series and
+short-ring no-false-positive guards, the cross-rank straggler pass,
+the live scan path (gauges + breadcrumbs + /debug/anomalies), external
+canary verdicts, the sample-during-detect race, and the FLAGS_anomaly
+off-path alloc guard."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from paddle_tpu.framework import config as _config
+from paddle_tpu.observability import anomaly, httpd, slo
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import timeseries as ts
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    anomaly._reset_for_tests()
+    ts._reset_for_tests()
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+    yield
+    anomaly._reset_for_tests()
+    ts._reset_for_tests()
+    httpd._reset_for_tests()
+    slo._reset_for_tests()
+
+
+def _rows(n, **series):
+    """n history rows, ts = 0..n-1 s; series values are either scalars
+    (constant) or per-index callables."""
+    out = []
+    for i in range(n):
+        row = {"ts": float(i)}
+        for k, v in series.items():
+            val = v(i) if callable(v) else v
+            if val is not None:
+                row[k] = val
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# synthetic-history goldens: exact kind / severity per acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_leak_golden():
+    # 0.10 -> 0.55 monotone over 10 samples: frac = .45/.55, severity
+    # 0.3 + 0.7*frac = 0.873 exactly (deterministic formula)
+    rows = _rows(10, kv_occupancy=lambda i: 0.1 + 0.05 * i)
+    out = anomaly.detect(rows, rank=2)
+    assert len(out) == 1
+    v = out[0]
+    assert v["kind"] == "kv_leak"
+    assert v["metric"] == "kv_occupancy"
+    assert v["rank"] == 2
+    assert v["severity"] == 0.873
+    assert v["evidence"]["run"] == 10
+
+
+def test_mean_shift_golden():
+    # 8 samples at 100 ms then 8 at 200 ms: shift +100%, severity
+    # capped at 1.0; at_ts is where the after-window begins
+    rows = _rows(16, ttft_ms=lambda i: 100.0 if i < 8 else 200.0)
+    out = anomaly.detect(rows, rank=1)
+    assert len(out) == 1
+    v = out[0]
+    assert v["kind"] == "mean_shift"
+    assert v["metric"] == "ttft_ms"
+    assert v["severity"] == 1.0
+    assert v["evidence"]["mean_before"] == 100.0
+    assert v["evidence"]["mean_after"] == 200.0
+    assert v["evidence"]["at_ts"] == 8.0
+
+
+def test_queue_saturation_golden():
+    # queue 10 + 5/s over 8 samples, capacity 100: eta = (100-45)/5 =
+    # 11 s, severity 0.3 + 0.7*(300-11)/300 = 0.974
+    rows = _rows(8, queue=lambda i: 10 + 5 * i)
+    out = anomaly.detect(rows, capacity=100)
+    assert len(out) == 1
+    v = out[0]
+    assert v["kind"] == "queue_saturation"
+    assert v["severity"] == 0.974
+    assert v["evidence"]["eta_s"] == 11.0
+    assert v["evidence"]["slope_per_s"] == 5.0
+
+
+def test_recovery_storm_golden_and_survives_aging():
+    # cumulative counter jumps 0 -> 4 mid-history: 4 new recoveries in
+    # one window, severity 0.5 + 0.5*(4/6) = 0.833. The window SLIDES:
+    # 20 quiet samples after the burst must NOT clear the verdict (a
+    # one-shot doctor scrape happens after the storm, not during it).
+    rows = _rows(30, recoveries=lambda i: None if i < 5 else 4)
+    out = anomaly.detect(rows)
+    assert len(out) == 1
+    v = out[0]
+    assert v["kind"] == "recovery_storm"
+    assert v["severity"] == 0.833
+    assert v["evidence"]["new_events"] == 4.0
+    assert v["evidence"]["total"] == 4.0
+
+
+def test_straggler_drift_golden():
+    # rank 1 TTFT 40 ms vs rank 0's 10 ms: median 25, drift +60%
+    hist = {0: _rows(8, ttft_ms=10.0), 1: _rows(8, ttft_ms=40.0)}
+    out = anomaly.detect_fleet(hist)
+    assert len(out) == 1
+    v = out[0]
+    assert v["kind"] == "straggler_drift"
+    assert v["rank"] == 1
+    assert v["severity"] == 0.6
+    assert v["evidence"]["fleet_median"] == 25.0
+
+
+# ---------------------------------------------------------------------------
+# no false positives: constant series, short rings, edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_constant_series_produces_no_verdict():
+    rows = _rows(32, load=0.5, queue=3, kv_occupancy=0.4,
+                 ttft_ms=50.0, recoveries=2)
+    assert anomaly.detect(rows) == []
+
+
+def test_empty_and_single_sample_histories():
+    assert anomaly.detect([]) == []
+    assert anomaly.detect(_rows(1, kv_occupancy=0.9, queue=100)) == []
+    assert anomaly.detect_fleet({}) == []
+
+
+def test_ring_shorter_than_window_never_fires():
+    # 4 growing samples < LEAK_WINDOW=8; 12 shifted samples <
+    # 2*SHIFT_WINDOW=16; 3 queue points < SAT_WINDOW=8
+    assert anomaly.detect(
+        _rows(4, kv_occupancy=lambda i: 0.1 + 0.2 * i)) == []
+    assert anomaly.detect(
+        _rows(12, ttft_ms=lambda i: 10.0 if i < 6 else 1000.0)) == []
+    assert anomaly.detect(_rows(3, queue=lambda i: 50 * i)) == []
+
+
+def test_straggler_needs_two_ranks():
+    assert anomaly.detect_straggler_drift(
+        {0: _rows(8, ttft_ms=500.0)}) == []
+
+
+def test_verdicts_ranked_by_severity():
+    rows = _rows(20,
+                 kv_occupancy=lambda i: 0.05 + 0.01 * i,   # mild leak
+                 recoveries=lambda i: None if i < 10 else 9)  # hot storm
+    out = anomaly.detect(rows)
+    kinds = [v["kind"] for v in out]
+    assert kinds == ["recovery_storm", "kv_leak"]
+    assert out[0]["severity"] >= out[1]["severity"]
+
+
+# ---------------------------------------------------------------------------
+# live path: scan-on-sample, gauges, breadcrumbs, external verdicts
+# ---------------------------------------------------------------------------
+
+
+class _FakeRecorder:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def history(self):
+        return list(self._rows)
+
+
+def test_scan_publishes_gauge_and_breadcrumb(monkeypatch):
+    monkeypatch.setattr(_config._FLAGS["FLAGS_anomaly"], "value", True)
+    flight.default_recorder().clear()
+    rec = _FakeRecorder(_rows(10, kv_occupancy=lambda i: 0.1 + 0.05 * i))
+    out = anomaly.on_sample(rec)
+    assert out and out[0]["kind"] == "kv_leak"
+    assert anomaly.scans == 1
+    assert anomaly.latest()[0]["kind"] == "kv_leak"
+    g = om.default_registry().get("anomaly_active")
+    cells = {lbl["kind"]: c.value for lbl, c in g.samples()}
+    assert cells["kv_leak"] == 1.0
+    crumbs = [e for e in flight.default_recorder().tail()
+              if e[1] == "anomaly"]
+    assert len(crumbs) == 1 and crumbs[0][2]["verdict"] == "kv_leak"
+    # re-scan of the SAME active verdict: no duplicate breadcrumb
+    anomaly.on_sample(rec)
+    crumbs = [e for e in flight.default_recorder().tail()
+              if e[1] == "anomaly"]
+    assert len(crumbs) == 1
+    # healthy history clears the gauge but keeps the 0-series
+    anomaly.on_sample(_FakeRecorder(_rows(10, kv_occupancy=0.4)))
+    assert anomaly.latest() == []
+    cells = {lbl["kind"]: c.value for lbl, c in g.samples()}
+    assert cells["kv_leak"] == 0.0
+
+
+def test_external_verdicts_raise_and_clear(monkeypatch):
+    anomaly.raise_verdict("canary_mismatch", 0, 0.9, "canary",
+                          "tokens diverged", target="t")
+    got = anomaly.latest()
+    assert [v["kind"] for v in got] == ["canary_mismatch"]
+    assert got[0]["severity"] == 0.9
+    anomaly.clear_verdict("canary_mismatch")
+    assert anomaly.latest() == []
+
+
+def test_debug_anomalies_endpoint(monkeypatch):
+    srv = httpd.start_server(port=0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{srv.port}"
+    with urllib.request.urlopen(base + "/debug/anomalies",
+                                timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["enabled"] is False and doc["verdicts"] == []
+    monkeypatch.setattr(_config._FLAGS["FLAGS_anomaly"], "value", True)
+    anomaly.raise_verdict("canary_timeout", 0, 0.7, "canary", "wedged")
+    with urllib.request.urlopen(base + "/debug/anomalies",
+                                timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["enabled"] is True
+    assert [v["kind"] for v in doc["verdicts"]] == ["canary_timeout"]
+    # statusz carries the same block
+    with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+        st = json.loads(r.read())
+    assert [v["kind"] for v in st["anomalies"]] == ["canary_timeout"]
+
+
+def test_concurrent_sample_during_detect_race(monkeypatch):
+    # samples appended by one thread while another scans the same ring:
+    # no exception, every scan completes (deque snapshot under lock)
+    monkeypatch.setattr(_config._FLAGS["FLAGS_anomaly"], "value", True)
+    rec = ts.TimeSeriesRecorder(capacity=64)
+    errs = []
+
+    def _sampler():
+        try:
+            for _ in range(50):
+                rec.sample_now()   # tail-calls anomaly.on_sample
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def _scanner():
+        try:
+            for _ in range(50):
+                anomaly.scan(rec)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    workers = [threading.Thread(target=_sampler) for _ in range(2)] + \
+              [threading.Thread(target=_scanner) for _ in range(2)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join(timeout=60.0)
+    assert not errs
+    assert anomaly.scans >= 200   # 2x50 tail calls + 2x50 direct
+
+
+# ---------------------------------------------------------------------------
+# off-path: one flag read, zero allocations (channel contract)
+# ---------------------------------------------------------------------------
+
+
+def test_off_path_allocates_nothing():
+    assert not anomaly.enabled()
+    rec = ts.TimeSeriesRecorder()
+    rec.sample_now()               # warm the timeseries side's handles
+    reg = om.default_registry()
+    base_alloc = reg.allocations
+    base_scans = anomaly.scans
+    for _ in range(5):
+        rec.sample_now()           # anomaly off: one flag read per row
+    assert anomaly.scans == base_scans == 0
+    # no registry family/cell minted by the off-path (the registry is
+    # process-global, so pin the DELTA, not family absence)
+    assert reg.allocations == base_alloc
+    assert anomaly.latest() == []

@@ -287,6 +287,25 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# fleet-doctor smoke (ISSUE 18, README.md "Fleet doctor"): 2 replica
+# workers with the history/anomaly/canary channels armed and DIFFERENT
+# chaos per worker (decode.oom recovery storm on r0, rank.slow
+# straggler drag on r1). Gates: each worker's background canary must go
+# green (/healthz canary_ok) AND both replicas must bit-match a local
+# reference engine's golden greedy tokens over plain HTTP; then
+# tools/fleet_doctor.py --scrape auto must NAME both injected faults
+# (recovery_storm on rank 0 + straggler_drift on rank 1, nonzero
+# severity, each with its likely-cause/lever advice) and its --bundle
+# tarball must load back complete (per-rank metrics / history /
+# statusz / trace shards + merged fleet artifacts + diagnosis.json).
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/doctor_smoke.py --dir /tmp/ci_doctor; then
+  echo "CI: fleet-doctor smoke FAILED (canary divergence, an injected" \
+       "fault the doctor failed to name, or an incomplete bundle —" \
+       "see the phase log above; worker logs in /tmp/ci_doctor/)" >&2
+  rc=1
+fi
+
 # chaos drill (ISSUE 11, README.md "Fault tolerance"): scheduled
 # rank.kill (FLAGS_chaos) mid-training in a 2-rank elastic pod -> the
 # controller must restart the pod, every rank must resume from its last

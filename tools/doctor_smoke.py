@@ -1,0 +1,315 @@
+"""Fleet-doctor CI smoke: injected faults must be NAMED by the doctor.
+
+Spawns 2 CPU replica workers (inference/replica_worker.py; tiny LLaMA,
+seed 0) with the observability history armed (--flag
+FLAGS_timeseries_interval_s / FLAGS_anomaly / FLAGS_canary_interval_s)
+and DIFFERENT chaos on each:
+
+- replica 0: ``decode.oom@p=1.0:n=8`` — the engine's OOM handling
+  alternates preempt-and-retry with a full recovery (serving.py
+  ``_oom_retried``), so a burst of 8 back-to-back OOMs lands as 4
+  distinct recoveries; the backoff is shrunk so all 4 fit inside one
+  8-sample detector window;
+- replica 1: ``rank.slow@p=1.0:delay=...`` — every decode step drags,
+  so arrivals queue behind the sleeping step and its TTFT drifts away
+  from replica 0's (which gets LESS traffic on purpose: drift needs
+  the slow rank's TTFT > 3x the fast rank's with only two ranks).
+
+The smoke then:
+
+1. computes GOLDEN canary tokens from an identical local reference
+   engine (same config, same seed, greedy) and bit-compares what each
+   worker serves for the canary prompt over plain HTTP — the black-box
+   wrong-answer check, end to end;
+2. waits for each worker's own background canary (FLAGS_canary_interval_s)
+   to go green: /healthz must report ``canary_ok: true``;
+3. with traffic still flowing, runs ``tools/fleet_doctor.py <dir>
+   --scrape auto --json --bundle`` as a real subprocess and GATES on
+   the diagnosis: ``recovery_storm`` on rank 0 and ``straggler_drift``
+   on rank 1, both with nonzero severity — the doctor must name the
+   faults we injected, not merely print tables;
+4. loads the --bundle tarball back and asserts the postmortem is
+   complete: per-rank metrics.prom / history.jsonl / statusz.json /
+   trace.json shards, the merged fleet.prom + fleet_trace.json, and
+   the doctor's own report + diagnosis.json (whose verdicts must match
+   the CLI's).
+
+Exit 0 = all gates green. Artifacts stay under --dir
+(default /tmp/ci_doctor; worker logs are <dir>/r*.stderr.log).
+
+    python tools/doctor_smoke.py --dir /tmp/ci_doctor
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STORM_OOMS = 8          # injected OOMs on replica 0: every 2nd one
+                        # escalates preempt->recovery, so 8 OOMs = 4
+                        # recoveries (>= the detector's min_events=3)
+SLOW_DELAY_S = 0.35     # per-decode-step drag on replica 1
+PROMPT_LEN = 8
+MAX_NEW = 4
+
+
+def _post_generate(endpoint: str, prompt_ids, timeout_s=30.0) -> dict:
+    req = urllib.request.Request(
+        endpoint.rstrip("/") + "/v1/generate",
+        data=json.dumps({
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "max_new_tokens": MAX_NEW,
+            "decode_strategy": "greedy_search",
+            "timeout_s": timeout_s,
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s + 5.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _get_json(endpoint: str, path: str, timeout_s=10.0) -> dict:
+    url = endpoint.rstrip("/") + path
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _drive(endpoint: str, vocab: int, seed: int, stop: threading.Event,
+           stats: dict, jitter_s: float = 0.0):
+    """One traffic thread: serial greedy requests until told to stop.
+    The caller runs MORE of these against the slow replica (its queue
+    wait compounds into TTFT) and fewer against the fast one (whose
+    TTFT must stay near bare prefill for the drift to clear 3x).
+    `jitter_s` desynchronizes the slow replica's threads from its
+    decode-step boundaries: serial re-posts otherwise phase-lock to
+    step completion and arrive into an idle engine, hiding the very
+    queue wait the straggler detector keys on."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    while not stop.is_set():
+        prompt = rng.randint(0, vocab, (PROMPT_LEN,))
+        if jitter_s > 0:
+            time.sleep(rng.uniform(0.0, jitter_s))
+        try:
+            out = _post_generate(endpoint, prompt)
+            stats["ok" if out.get("ok") else "fail"] += 1
+        except Exception:  # noqa: BLE001 — mid-storm 503s are expected
+            stats["fail"] += 1
+            time.sleep(0.1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="/tmp/ci_doctor")
+    ap.add_argument("--traffic-s", type=float, default=6.0,
+                    help="seconds of concurrent warm traffic before "
+                         "the doctor scrape (the scrape itself runs "
+                         "with traffic still flowing)")
+    args = ap.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+    from paddle_tpu.observability import canary as _canary
+    from paddle_tpu.observability import fleet as _fleet
+
+    print("== phase 1: spawn 2 workers (r0: decode.oom storm, "
+          "r1: rank.slow straggler) ==")
+    procs = spawn_replicas(
+        2, args.dir,
+        worker_args=[
+            "--flag", "FLAGS_timeseries_interval_s=0.25",
+            "--flag", "FLAGS_anomaly=1",
+            "--flag", "FLAGS_canary_interval_s=0.5",
+            # headroom over the injected burst so the engine HEALS
+            # (a poisoned engine is the router smoke's drill, not ours)
+            "--flag", "FLAGS_serving_max_recoveries=8",
+            "--trace-sample", "1",
+        ],
+        chaos_by_replica={
+            0: f"decode.oom@p=1.0:n={STORM_OOMS}",
+            1: f"rank.slow@p=1.0:delay={SLOW_DELAY_S}",
+        },
+        recovery_backoff=0.02)
+    endpoints = [_fleet.normalize_endpoint(p.endpoint) for p in procs]
+    print(f"workers ready: {endpoints}")
+    rc = 1
+    stop = threading.Event()
+    threads = []
+    try:
+        # ---- phase 2: golden from an identical reference engine -----
+        print("== phase 2: golden canary tokens from a local "
+              "reference engine ==")
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import ServingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               seq=64)
+        ref = ServingEngine(LlamaForCausalLM(cfg), max_batch=4,
+                            max_seq_len=64, page_size=8,
+                            decode_strategy="greedy_search")
+        ref.warmup(prompt_len=PROMPT_LEN)
+        ref.add_request(np.asarray(_canary.DEFAULT_PROMPT, np.int64),
+                        max_new_tokens=MAX_NEW)
+        golden = [f.output_ids.tolist() for f in ref.run()][0]
+        print(f"golden: {golden}")
+
+        # ---- phase 3: concurrent traffic (storm + drift develop) ----
+        print(f"== phase 3: concurrent traffic for "
+              f"{args.traffic_s:.0f}s ==")
+        stats = [{"ok": 0, "fail": 0} for _ in endpoints]
+        for i, ep in enumerate(endpoints):
+            # r0: one light thread (TTFT stays near bare prefill).
+            # r1: more threads than engine slots (max_batch=4), so
+            # arrivals regularly wait for a slot through the slowed
+            # decode steps — the queue-pressure regime straggler
+            # drift keys on, not just sub-step residual wait.
+            for t in range(1 if i == 0 else 5):
+                th = threading.Thread(
+                    target=_drive, args=(ep, 97, 100 + 10 * i + t,
+                                         stop, stats[i],
+                                         0.0 if i == 0 else
+                                         SLOW_DELAY_S), daemon=True)
+                th.start()
+                threads.append(th)
+        time.sleep(args.traffic_s)
+        for i, ep in enumerate(endpoints):
+            if not stats[i]["ok"]:
+                print(f"FAILED: no successful request on replica {i} "
+                      f"({ep}): {stats[i]}", file=sys.stderr)
+                return 1
+        print(f"traffic: r0 {stats[0]}, r1 {stats[1]}")
+
+        # ---- phase 4: worker-side canary green + HTTP bit-exact -----
+        print("== phase 4: canary bit-exact through HTTP ==")
+        deadline = time.time() + 60.0
+        pending = set(range(len(endpoints)))
+        while pending and time.time() < deadline:
+            for i in sorted(pending):
+                try:
+                    hz = _get_json(endpoints[i], "/healthz")
+                except Exception:  # noqa: BLE001
+                    continue
+                if hz.get("canary_ok") is True:
+                    pending.discard(i)
+            if pending:
+                time.sleep(0.5)
+        if pending:
+            print(f"FAILED: replicas {sorted(pending)} never reported "
+                  f"canary_ok: true on /healthz (probes not running, "
+                  f"or the canary keeps failing)", file=sys.stderr)
+            return 1
+        for i, ep in enumerate(endpoints):
+            out = _post_generate(ep, list(_canary.DEFAULT_PROMPT))
+            got = out.get("output_ids")
+            if not out.get("ok") or got != golden:
+                print(f"FAILED: replica {i} canary tokens {got} != "
+                      f"reference golden {golden} — black-box decode "
+                      f"divergence", file=sys.stderr)
+                return 1
+            st = _get_json(ep, "/statusz").get("canary") or {}
+            if not st.get("probes"):
+                print(f"FAILED: replica {i} statusz canary block "
+                      f"shows zero probes: {st}", file=sys.stderr)
+                return 1
+        print("both replicas bit-match the reference golden; "
+              "worker canaries green")
+
+        # ---- phase 5: the doctor must NAME the injected faults ------
+        print("== phase 5: fleet_doctor --scrape auto (traffic still "
+              "flowing) ==")
+        bundle = os.path.join(args.dir, "bundle.tar.gz")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fleet_doctor.py"),
+             args.dir, "--scrape", "auto", "--json",
+             "--bundle", bundle],
+            capture_output=True, text=True, timeout=180)
+        if r.returncode != 0:
+            print(f"FAILED: fleet_doctor rc={r.returncode}:\n"
+                  f"{(r.stdout + r.stderr)[-3000:]}", file=sys.stderr)
+            return 1
+        doc = json.loads(r.stdout)
+        verdicts = doc.get("verdicts") or []
+        by_kind = {}
+        for v in verdicts:
+            by_kind.setdefault(v["kind"], []).append(v)
+        storm = [v for v in by_kind.get("recovery_storm", [])
+                 if v["rank"] == 0 and v["severity"] > 0.0]
+        drift = [v for v in by_kind.get("straggler_drift", [])
+                 if v["rank"] == 1 and v["severity"] > 0.0]
+        if not storm:
+            print(f"FAILED: doctor did not name the injected "
+                  f"recovery storm on rank 0; verdicts: "
+                  f"{json.dumps(verdicts, indent=1)}", file=sys.stderr)
+            return 1
+        if not drift:
+            print(f"FAILED: doctor did not name the injected "
+                  f"rank.slow straggler on rank 1; verdicts: "
+                  f"{json.dumps(verdicts, indent=1)}", file=sys.stderr)
+            return 1
+        for v in storm + drift:
+            if not v.get("likely_cause") or not v.get("lever"):
+                print(f"FAILED: verdict lacks diagnosis advice: {v}",
+                      file=sys.stderr)
+                return 1
+        print(f"doctor named both faults: "
+              f"storm sev={storm[0]['severity']:.2f} "
+              f"({storm[0]['summary']}); "
+              f"drift sev={drift[0]['severity']:.2f} "
+              f"({drift[0]['summary']})")
+
+        # ---- phase 6: the bundle must be a complete postmortem ------
+        print("== phase 6: load the --bundle tarball back ==")
+        with tarfile.open(bundle, "r:gz") as tar:
+            names = set(tar.getnames())
+            required = {"fleet/fleet.prom", "fleet/fleet_trace.json",
+                        "doctor/report.txt", "doctor/diagnosis.json"}
+            for rank in (0, 1):
+                for f in ("metrics.prom", "history.jsonl",
+                          "statusz.json", "trace.json"):
+                    required.add(f"fleet/rank_{rank}/{f}")
+            missing = sorted(required - names)
+            if missing:
+                print(f"FAILED: bundle {bundle} is missing {missing} "
+                      f"(has {len(names)} members)", file=sys.stderr)
+                return 1
+            diag = json.load(
+                tar.extractfile("doctor/diagnosis.json"))
+        kinds_in_bundle = {v["kind"] for v in diag.get("verdicts", [])}
+        if not {"recovery_storm", "straggler_drift"} <= kinds_in_bundle:
+            print(f"FAILED: bundle diagnosis.json verdicts "
+                  f"{sorted(kinds_in_bundle)} lack the injected "
+                  f"faults", file=sys.stderr)
+            return 1
+        print(f"doctor smoke OK: {len(verdicts)} verdict(s), bundle "
+              f"{bundle} ({len(names)} members) -> {args.dir}")
+        rc = 0
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10.0)
+        for p in procs:
+            p.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,7 +39,8 @@ load in Perfetto directly).
 
 Exit codes: 0 = report printed, 2 = no shards found / nothing scraped
 (or, with --require-skew, an empty skew table; with --require-slo, an
-empty SLO table — CI treats these as red).
+empty SLO table; with --require-healthy, a dead/missing rank or an
+anomaly verdict at severity >= 0.5 — CI treats these as red).
 """
 from __future__ import annotations
 
@@ -70,6 +71,12 @@ def main(argv=None) -> int:
                     help="exit 2 when no rank exported an evaluated "
                          "SLO objective (CI gate for the live "
                          "telemetry plane)")
+    ap.add_argument("--require-healthy", action="store_true",
+                    help="exit 2 when the fleet is NOT healthy: any "
+                         "dead/missing rank, or any anomaly verdict "
+                         "at severity >= 0.5 (observability/"
+                         "anomaly.py) — the deploy-gate complement of "
+                         "the CI gates above")
     ap.add_argument("--scrape", default=None, metavar="EP,EP,...",
                     help="comma-separated live telemetry endpoints "
                          "(host:port or URLs; observability/httpd.py) "
@@ -122,6 +129,23 @@ def main(argv=None) -> int:
               "evaluated SLO objective (slo_compliance samples "
               "missing from the shards)", file=sys.stderr)
         return 2
+    if args.require_healthy:
+        bad = []
+        if report["dead"]:
+            bad.append(f"{len(report['dead'])} dead rank(s)")
+        if report["missing"]:
+            bad.append(f"{len(report['missing'])} missing rank(s)")
+        severe = [v for v in report.get("anomalies") or []
+                  if float(v.get("severity", 0.0)) >= 0.5]
+        if severe:
+            bad.append(f"{len(severe)} anomaly verdict(s) at "
+                       f"severity >= 0.5 ("
+                       + ", ".join(sorted({v['kind'] for v in severe}))
+                       + ")")
+        if bad:
+            print("fleet_report: --require-healthy and the fleet is "
+                  "not: " + "; ".join(bad), file=sys.stderr)
+            return 2
     return 0
 
 
