@@ -512,6 +512,30 @@ define_flag("FLAGS_router_queue_depth", 256,
             "replicas): past it requests shed with 429 regardless of "
             "burn state — bounds memory and tail latency under "
             "overload.", type_=int)
+define_flag("FLAGS_requestlog", False,
+            "Per-request accounting ledger "
+            "(observability/requestlog.py): when on, every FINISHED "
+            "serving request appends one structured record (trace_id, "
+            "tenant from the X-PT-Tenant header, prompt/output token "
+            "counts, queue/TTFT/ITL/total latencies, prefix-cache hit "
+            "ratio, KV tier promotions, spec-decode acceptance, "
+            "retries/recoveries touched, outcome) to a bounded ring; "
+            "/debug/requests?tenant=&last=N serves it live, the fleet "
+            "flusher exports rank_<i>/requests.jsonl, and "
+            "usage_tokens_total{tenant,kind} + per-tenant latency "
+            "families + TTFT/decode trace_id exemplars land in "
+            "/metrics. Off (default) = one flag read per finished "
+            "request, zero allocations, pinned by "
+            "tests/test_requestlog.py.")
+define_flag("FLAGS_requestlog_capacity", 2048,
+            "Records retained in the per-request accounting ring "
+            "(observability/requestlog.py). Each record is one small "
+            "dict (~300 bytes: ids, tenant, token counts, latencies), "
+            "so the memory bound is roughly capacity * 0.3 KiB per "
+            "rank; the tenant usage rollup (/debug/requests, "
+            "fleet_report's usage-per-tenant section) only sees what "
+            "the ring still holds — raise it on long-lived replicas "
+            "so billing windows aren't truncated.", type_=int)
 
 
 # ---------------------------------------------------------------------------

@@ -36,9 +36,11 @@ MAGIC_PAGES = b"PTKV"
 MAGIC_HANDOFF = b"PTHO"
 KV_HANDOFF_ROUTE = "/v1/kv_handoff"
 
-# sampling params that ride a handoff (on_token callables and queue
-# timestamps stay with the detaching engine)
-_REQ_PARAM_KEYS = ("greedy", "temperature", "top_k", "top_p", "eos")
+# sampling params + accounting identity that ride a handoff (on_token
+# callables and queue timestamps stay with the detaching engine; the
+# tenant crosses so a disaggregated request bills ONE tenant)
+_REQ_PARAM_KEYS = ("greedy", "temperature", "top_k", "top_p", "eos",
+                   "tenant")
 
 
 def _u32(n: int) -> bytes:
@@ -210,6 +212,16 @@ def post_handoff(endpoint: str, handoff, timeout: float = 60.0,
         # extracts it before the route handler runs (lint rule
         # route-handler-trace) and the network hop itself is spanned
         headers[_trace.TRACE_HEADER] = trace_ctx
+    if not isinstance(handoff, (bytes, bytearray)):
+        # mirror the tenant into the header as well: the body already
+        # carries it in req_params, but the header keeps the hop
+        # consistent with every other tenant-bearing request and lets
+        # the remote account pre-parse failures to the right tenant
+        tenant = (handoff.req_params or {}).get("tenant")
+        if tenant:
+            from ..observability import requestlog as _reqlog
+
+            headers[_reqlog.TENANT_HEADER] = str(tenant)
     url = (base + KV_HANDOFF_ROUTE
            + (f"?wait=1&timeout_s={float(timeout)}" if wait
               else "?wait=0"))

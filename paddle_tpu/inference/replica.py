@@ -227,8 +227,13 @@ class ReplicaServer:
             return (400, (json.dumps({"ok": False,
                                       "error": f"bad request: {e!r}"})
                           + "\n").encode(), "application/json")
+        # tenant: an explicit body field wins; otherwise add_request
+        # (running on THIS handler thread) adopts the X-PT-Tenant
+        # header the httpd parked, so the accounting identity needs no
+        # extra plumbing here
         params = {k: req[k] for k in ("decode_strategy", "temperature",
-                                      "top_k", "top_p", "eos_token_id")
+                                      "top_k", "top_p", "eos_token_id",
+                                      "tenant")
                   if k in req}
         timeout = float(req.get("timeout_s", 60.0))
         try:

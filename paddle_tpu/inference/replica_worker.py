@@ -159,12 +159,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rng = np.random.RandomState(args.seed + 1)
     warm_nbs = sorted({1, 2, args.max_batch} & set(
         range(1, args.max_batch + 1)))
-    for nb in warm_nbs:
-        for _ in range(nb):
-            engine.add_request(
-                rng.randint(0, args.vocab, (args.prompt_len,)),
-                max_new_tokens=4)
-        engine.run()
+    # still warmup traffic: the request ledger (FLAGS_requestlog) must
+    # not bill these synthetic requests to a tenant
+    engine._warming = True
+    try:
+        for nb in warm_nbs:
+            for _ in range(nb):
+                engine.add_request(
+                    rng.randint(0, args.vocab, (args.prompt_len,)),
+                    max_new_tokens=4)
+            engine.run()
+    finally:
+        engine._warming = False
 
     _httpd.start_server(port=0)
     server = ReplicaServer(engine).start()

@@ -175,7 +175,7 @@ class LocalReplica(BaseReplica):
     def generate(self, request: dict, timeout: float) -> dict:
         params = {k: request[k] for k in
                   ("decode_strategy", "temperature", "top_k", "top_p",
-                   "eos_token_id") if k in request}
+                   "eos_token_id", "tenant") if k in request}
         # install the router's trace context on THIS thread for the
         # duration of add_request (submit runs it on the caller), so
         # the engine's serving.request trace joins the routed trace —
@@ -237,6 +237,14 @@ class HttpReplica(BaseReplica):
         trace_ctx = payload.pop("trace_ctx", None)
         if trace_ctx:
             headers[_trace.TRACE_HEADER] = trace_ctx
+        # tenant rides BOTH the body (the replica's /v1/generate param
+        # list) and the X-PT-Tenant header (the cross-process contract
+        # every other hop uses), so either side of a version skew
+        # still accounts the right tenant
+        if payload.get("tenant"):
+            from ..observability import requestlog as _reqlog
+
+            headers[_reqlog.TENANT_HEADER] = str(payload["tenant"])
         data = json.dumps(payload).encode()
         req = Request(self.base + "/v1/generate", data=data,
                       headers=headers, method="POST")
@@ -506,6 +514,16 @@ class Router:
                 raise RouterShed(
                     "every ready replica's TTFT SLO is burning — "
                     "shedding to protect in-flight requests")
+        if "tenant" not in params:
+            # a router invoked from an HTTP handler thread adopts the
+            # X-PT-Tenant header the httpd parked there, so the
+            # accounting identity survives the hop without every
+            # frontend passing tenant= explicitly
+            from ..observability import requestlog as _reqlog
+
+            tn = _reqlog.pending_tenant()
+            if tn:
+                params["tenant"] = str(tn)
         request = dict(prompt_ids=np.asarray(
             prompt_ids, np.int64).tolist(),
             max_new_tokens=int(max_new_tokens), **params)
@@ -701,7 +719,7 @@ class DisaggregatedServing:
         for idx, req in enumerate(requests):
             params = {k: req[k] for k in
                       ("decode_strategy", "temperature", "top_k",
-                       "top_p", "eos_token_id") if k in req}
+                       "top_p", "eos_token_id", "tenant") if k in req}
             rid = pe.add_request(
                 np.asarray(req["prompt_ids"], np.int64),
                 max_new_tokens=int(req.get("max_new_tokens", 32)),
@@ -793,7 +811,7 @@ class DisaggregatedServing:
         for idx, req in enumerate(requests):
             params = {k: req[k] for k in
                       ("decode_strategy", "temperature", "top_k",
-                       "top_p", "eos_token_id") if k in req}
+                       "top_p", "eos_token_id", "tenant") if k in req}
             rid = pe.add_request(
                 np.asarray(req["prompt_ids"], np.int64),
                 max_new_tokens=int(req.get("max_new_tokens", 32)),

@@ -28,6 +28,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import httpd as _httpd
 from ..observability import memwatch as _memwatch
 from ..observability import metrics as _om
+from ..observability import requestlog as _reqlog
 from ..observability import slo as _slo
 from ..observability import stepledger as _stepledger
 from ..observability import tracing as _trace
@@ -53,7 +54,8 @@ class _EngineMetrics:
                  "cache_misses", "cache_evictions", "cached_ratio",
                  "tier_hits", "tier_misses", "tier_spills",
                  "tier_demotions", "tier_drops", "tier_corrupt",
-                 "tier_promote_lat", "tier_pages")
+                 "tier_promote_lat", "tier_pages", "usage_tokens",
+                 "tenant_ttft", "tenant_total")
 
     def __init__(self, reg=None):
         reg = reg or _om.default_registry()
@@ -224,6 +226,31 @@ class _EngineMetrics:
             "KV pages currently resident per spill tier (host | "
             "disk); the hbm tier is the trie's cached_pages.",
             labels=("tier",))
+        # per-tenant accounting families (FLAGS_requestlog): fed once
+        # per FINISHED request at _finish, never on the decode path.
+        # Tenant children resolve lazily into the engine's
+        # _tenant_cells cache (tenants are dynamic — the _tier_cells
+        # resolve-once discipline, per tenant instead of per tier)
+        self.usage_tokens = reg.counter(
+            "usage_tokens_total",
+            "Tokens accounted to a tenant at request finish, by kind "
+            "(prompt | output). Tenant comes from the X-PT-Tenant "
+            "header (default \"default\") and survives the "
+            "disaggregated prefill->decode handoff; the request "
+            "ledger (observability/requestlog.py, /debug/requests) "
+            "records the same attribution per request.",
+            labels=("tenant", "kind"))
+        self.tenant_ttft = reg.histogram(
+            "tenant_ttft_seconds",
+            "Per-tenant time-to-first-token, observed at request "
+            "finish from the ledger's retained timing "
+            "(FLAGS_requestlog; answers 'which tenant burned the "
+            "TTFT budget').", labels=("tenant",))
+        self.tenant_total = reg.histogram(
+            "tenant_request_seconds",
+            "Per-tenant end-to-end request latency (enqueue/attach "
+            "to finish), observed at request finish "
+            "(FLAGS_requestlog).", labels=("tenant",))
 
 
 @dataclass
@@ -619,6 +646,14 @@ class ServingEngine:
         self._recovering = False
         self._recoveries = 0
         self._retry_counts: Dict[int, int] = {}  # rid -> requeue count
+        # per-(engine, tenant) accounting cells, resolved lazily at the
+        # first finish for each tenant (FLAGS_requestlog; tenants are
+        # dynamic, so the _tier_cells resolve-once discipline applies
+        # per tenant, cached here)
+        self._tenant_cells: Dict[str, tuple] = {}
+        # warmup()'s throwaway requests run the full finish path but
+        # are synthetic self-traffic: never billed to a tenant
+        self._warming = False
         # live telemetry plane (README.md "Live telemetry plane"):
         # /readyz is 503 until warmup() completes and while the KV pool
         # is exhausted; tracking is a weakref append — the engine never
@@ -673,7 +708,8 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
                     decode_strategy=None, temperature=None, top_k=None,
-                    top_p=None, eos_token_id=None, on_token=None) -> int:
+                    top_p=None, eos_token_id=None, on_token=None,
+                    tenant=None) -> int:
         """Queue a request. Sampling params default to the engine-level
         settings; per-request overrides ride the request through
         preemption/re-admission (one compiled decode step serves mixed
@@ -685,7 +721,10 @@ class ServingEngine:
         step). On preemption the already-streamed tokens are preserved
         with the request and NOT re-streamed — streaming resumes from the
         next new token after re-admission. Calling engine.abort() from
-        inside the callback is supported."""
+        inside the callback is supported.
+        tenant: accounting identity for the per-request ledger and
+        usage_tokens_total (falls back to the X-PT-Tenant header the
+        httpd parked on this thread, then \"default\")."""
         ids = np.asarray(as_array(prompt_ids)).reshape(-1).astype(np.int64)
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -707,6 +746,14 @@ class ServingEngine:
             eos=eos_token_id if eos_token_id is not None
             else self.eos_token_id,
             on_token=on_token,
+            # accounting identity + retained timing: t_enq is popped at
+            # the one-shot TTFT observe, so the ledger keeps its own
+            # never-popped t_start (and the recovery counter watermark)
+            tenant=_reqlog.normalize_tenant(
+                tenant if tenant is not None
+                else _reqlog.pending_tenant()),
+            t_start=_time_mod.perf_counter(),
+            recov0=self._recoveries,
             t_enq=_time_mod.perf_counter())
         # queue only — admission happens at the next step() so requests
         # arriving together prefill together in one batched compiled call
@@ -757,13 +804,14 @@ class ServingEngine:
             # evict the very pages this admission is about to reuse
             cached_pages: List[int] = []
             cached_tokens = 0
+            n_promoted = 0
             if self._prefix_cache is not None:
                 cached_pages, cached_tokens = \
                     self._prefix_cache.match(ctx)
                 for p in cached_pages:
                     self._page_refs[p] += 1
                 if self._kv_tiers is not None:
-                    cached_pages, cached_tokens, _n_promoted = \
+                    cached_pages, cached_tokens, n_promoted = \
                         self._promote_spilled(ctx, cached_pages,
                                               cached_tokens)
             need_fresh = need - len(cached_pages)
@@ -783,8 +831,14 @@ class ServingEngine:
             if rp is not None and "t_enq" in rp \
                     and not rp.get("qw_seen"):
                 rp["qw_seen"] = True
-                self._m.queue_wait.observe(
-                    _time_mod.perf_counter() - rp["t_enq"])
+                qw = _time_mod.perf_counter() - rp["t_enq"]
+                # retained for the request ledger (the histogram
+                # observation alone forgets which request it was)
+                rp["queue_s"] = qw
+                self._m.queue_wait.observe(qw)
+            if rp is not None and n_promoted:
+                rp["tier_promoted"] = \
+                    rp.get("tier_promoted", 0) + int(n_promoted)
             pages = cached_pages + [self._alloc_page()
                                     for _ in range(need_fresh)]
             self.block_tables[slot_idx, :need] = np.asarray(pages, np.int32)
@@ -807,6 +861,12 @@ class ServingEngine:
                 self._m.cache_hits.inc(cached_tokens)
                 self._m.cache_misses.inc(suffix)
                 self._m.cached_ratio.observe(cached_tokens / len(ctx))
+                if rp is not None:
+                    # a preempted request keeps its FIRST admission's
+                    # ratio (re-admission hits its own just-cached
+                    # pages, which would overstate reuse)
+                    rp.setdefault("prefix_hit_ratio",
+                                  round(cached_tokens / len(ctx), 4))
             if cached_tokens:
                 _flight.record_event("serving.prefix_cache_hit",
                                      rid=rid, cached=cached_tokens,
@@ -895,16 +955,22 @@ class ServingEngine:
         budgets = [max_new] + ([2] if self.decode_burst > 1 and
                                max_new > 2 else [])
         strategies = ["greedy_search"] + (["sampling"] if sampling else [])
-        for strategy in strategies:
-            for mx in budgets:
-                # eos -1 can never match a token id: the throwaway request
-                # is guaranteed to reach the decode step (an engine-level
-                # eos matching the first sampled token would otherwise
-                # finish at prefill and skip the decode compile entirely)
-                self.add_request(np.zeros((plen,), np.int64),
-                                 max_new_tokens=mx,
-                                 decode_strategy=strategy, eos_token_id=-1)
-                self.run()
+        self._warming = True
+        try:
+            for strategy in strategies:
+                for mx in budgets:
+                    # eos -1 can never match a token id: the throwaway
+                    # request is guaranteed to reach the decode step (an
+                    # engine-level eos matching the first sampled token
+                    # would otherwise finish at prefill and skip the
+                    # decode compile entirely)
+                    self.add_request(np.zeros((plen,), np.int64),
+                                     max_new_tokens=mx,
+                                     decode_strategy=strategy,
+                                     eos_token_id=-1)
+                    self.run()
+        finally:
+            self._warming = False
         # compile observability: from here on, any serving program
         # compile is an IN-TRAFFIC recompile (compilewatch counts them;
         # tools/ci.sh gates the smoke on zero decode recompiles)
@@ -2398,7 +2464,18 @@ class ServingEngine:
                 # preempted BEFORE it still records the true
                 # enqueue-to-first-token time, preemption delay included
                 if rp is not None and "t_enq" in rp:
-                    self._m.ttft.observe(now - rp.pop("t_enq"))
+                    ttft = now - rp.pop("t_enq")
+                    rp["ttft_s"] = ttft  # retained for the ledger
+                    ex = None
+                    if self._traces:
+                        tr0 = self._traces.get(s.request_id)
+                        if tr0 is not None and \
+                                tr0.trace_id is not None:
+                            # OpenMetrics exemplar: this observation's
+                            # trace_id, so a TTFT outlier in /metrics
+                            # links straight to its distributed trace
+                            ex = {"trace_id": f"{tr0.trace_id:x}"}
+                    self._m.ttft.observe(ttft, exemplar=ex)
                 if self._traces:
                     tr = self._traces.get(s.request_id)
                     if tr is not None:
@@ -2641,8 +2718,18 @@ class ServingEngine:
         t1 = _time_mod.perf_counter()
         dt = t1 - t0
         n_tok = self._m.tokens.value - tok0
-        self._m.step_lat.observe(dt)
-        self._m.token_lat.observe(dt / n_tok if n_tok > 0 else dt)
+        ex = None
+        if self._traces:
+            # decode-step exemplar: one traced rider of this batched
+            # step (tracing off => self._traces empty => no alloc, the
+            # overhead guard's zero-registry-allocation path)
+            for s in self.slots:
+                if s.active and s.trace_id != -1:
+                    ex = {"trace_id": f"{s.trace_id:x}"}
+                    break
+        self._m.step_lat.observe(dt, exemplar=ex)
+        self._m.token_lat.observe(dt / n_tok if n_tok > 0 else dt,
+                                  exemplar=ex)
         self._m.occupancy.set(n_active / self.max_batch)
         self._m.page_util.set(
             1.0 - len(self._free_pages) / self._n_pages_total)
@@ -2700,17 +2787,93 @@ class ServingEngine:
             if self._traces else None
         _flight.record_event("serving.finish", rid=s.request_id,
                              tokens=len(s.tokens), trace_id=trace_id)
-        self._req_params.pop(s.request_id, None)
-        self._retry_counts.pop(s.request_id, None)
+        rp = self._req_params.pop(s.request_id, None)
+        retries = self._retry_counts.pop(s.request_id, None)
         # pop with default: an on_token callback may have abort()ed the
         # request between the decode step and this finish
         prompt = self._prompts.pop(s.request_id, None)
+        if _reqlog.enabled() and not self._warming:
+            # off = this one flag read, no record; warmup's throwaway
+            # requests are not accounted (synthetic, no tenant)
+            self._account_finish(
+                s, rp, retries, trace_id,
+                0 if prompt is None else len(prompt))
         return FinishedRequest(
             request_id=s.request_id,
             prompt_ids=prompt if prompt is not None
             else np.zeros((0,), np.int64),
             output_ids=np.asarray(s.tokens, np.int64),
             trace_id=trace_id)
+
+    def _account_finish(self, s, rp, retries, trace_id, prompt_len,
+                        outcome="ok"):
+        """ONE accounting emission per finished request
+        (FLAGS_requestlog): the ledger record plus the per-tenant
+        usage/latency families. Called only by _finish — aborts emit
+        nothing (vLLM abort semantics), and a detached request is
+        accounted by the engine that finishes it, so a disaggregated
+        request yields exactly one record fleet-wide."""
+        rp = rp or {}
+        now = _time_mod.perf_counter()
+        tenant = _reqlog.normalize_tenant(rp.get("tenant"))
+        n_out = len(s.tokens)
+        ttft = rp.get("ttft_s")
+        t0 = rp.get("t_start")
+        total = max(0.0, now - t0) if t0 is not None else None
+        # inter-token latency: decode time amortized over the tokens
+        # that followed the first one
+        itl = (max(0.0, (total - ttft) / (n_out - 1))
+               if total is not None and ttft is not None and n_out > 1
+               else None)
+        rec = {
+            "rid": int(s.request_id),
+            "tenant": tenant,
+            "outcome": outcome,
+            "prompt_tokens": int(prompt_len),
+            "output_tokens": int(n_out),
+        }
+        if trace_id is not None:
+            rec["trace_id"] = f"{trace_id:x}"
+        if rp.get("queue_s") is not None:
+            rec["queue_s"] = round(rp["queue_s"], 6)
+        if ttft is not None:
+            rec["ttft_s"] = round(ttft, 6)
+        if itl is not None:
+            rec["itl_s"] = round(itl, 6)
+        if total is not None:
+            rec["total_s"] = round(total, 6)
+        if rp.get("prefix_hit_ratio") is not None:
+            rec["prefix_hit_ratio"] = rp["prefix_hit_ratio"]
+        if rp.get("tier_promoted"):
+            rec["kv_tier_promoted"] = int(rp["tier_promoted"])
+        if s.spec_proposed > 0:
+            rec["spec_acceptance"] = round(
+                s.spec_accepted / s.spec_proposed, 4)
+        if retries:
+            rec["retries"] = int(retries)
+        recov0 = rp.get("recov0")
+        if recov0 is not None and self._recoveries > recov0:
+            rec["recoveries_touched"] = int(
+                self._recoveries - recov0)
+        if rp.get("attached"):
+            rec["attached"] = True
+        _reqlog.record(rec)
+        cells = self._tenant_cells.get(tenant)
+        if cells is None:
+            m = self._m
+            cells = (m.usage_tokens.labels(tenant, "prompt"),
+                     m.usage_tokens.labels(tenant, "output"),
+                     m.tenant_ttft.labels(tenant),
+                     m.tenant_total.labels(tenant))
+            self._tenant_cells[tenant] = cells
+        if prompt_len:
+            cells[0].inc(prompt_len)
+        if n_out:
+            cells[1].inc(n_out)
+        if ttft is not None:
+            cells[2].observe(ttft)
+        if total is not None:
+            cells[3].observe(total)
 
     def has_work(self) -> bool:
         return bool(self._pending) or any(s.active for s in self.slots)
@@ -2883,6 +3046,18 @@ class ServingEngine:
         rp.setdefault("top_p", float(self.top_p))
         rp.setdefault("eos", self.eos_token_id)
         rp.setdefault("on_token", None)
+        # accounting identity: the handoff's tenant wins (one tenant
+        # across the disaggregated hop); a handoff that predates the
+        # accounting plane falls back to the X-PT-Tenant header parked
+        # on this thread, then "default". The timing watermarks restart
+        # on THIS engine's clock — perf_counter does not travel between
+        # processes — so total_s covers the decode side of the hop.
+        tn = rp.get("tenant")
+        rp["tenant"] = _reqlog.normalize_tenant(
+            tn if tn is not None else _reqlog.pending_tenant())
+        rp["t_start"] = _time_mod.perf_counter()
+        rp["recov0"] = self._recoveries
+        rp["attached"] = True
         self._req_params[rid] = rp
         self.block_tables[slot_idx, :] = 0
         self.block_tables[slot_idx, :n_pages] = dst

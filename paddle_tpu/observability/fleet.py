@@ -65,7 +65,7 @@ from . import metrics as _metrics
 
 SHARD_FILES = ("metrics.prom", "memory.prom", "ledger.prom",
                "events.jsonl", "trace.json", "collectives.jsonl",
-               "history.jsonl", "heartbeat.json")
+               "history.jsonl", "requests.jsonl", "heartbeat.json")
 
 
 def _flags():
@@ -370,6 +370,16 @@ class FleetExporter:
             os.path.join(self.shard_dir, "history.jsonl"),
             "".join(json.dumps(r) + "\n"
                     for r in _timeseries.history()))
+
+        from . import requestlog as _requestlog
+
+        # per-request accounting ledger: same discipline as history —
+        # an empty file when FLAGS_requestlog is off, so a shard always
+        # holds the full SHARD_FILES set and usage_table never guesses
+        _metrics.atomic_write(
+            os.path.join(self.shard_dir, "requests.jsonl"),
+            "".join(json.dumps(r) + "\n"
+                    for r in _requestlog.history()))
 
         self.flushes += 1
         hb = {
@@ -728,9 +738,16 @@ def _parse_prom_samples(text: str) -> Dict[str, List[Tuple[dict, float]]]:
     out: Dict[str, List[Tuple[dict, float]]] = {}
     pat = re.compile(
         r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})? (\S+)$')
+    # OpenMetrics exemplars (` # {trace_id="..."} value [ts]`) ride
+    # histogram bucket lines (metrics._fmt_exemplar). Strip them BEFORE
+    # matching: the greedy label group would otherwise swallow through
+    # the exemplar braces and capture the exemplar's value as the
+    # bucket count — silent corruption, not a skip.
+    ex_pat = re.compile(r'\s#\s\{.*?\}\s\S+(?:\s\S+)?$')
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        line = ex_pat.sub("", line)
         m = pat.match(line)
         if m is None:
             continue
@@ -1074,6 +1091,53 @@ def recoveries_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def usage_table(shards: Dict[int, str]) -> dict:
+    """Per-tenant usage rollup across every rank's requests.jsonl
+    (observability/requestlog.py, FLAGS_requestlog): request/token
+    totals, error counts and latency means per tenant, sorted hottest
+    first by total tokens — the fleet report's "usage per tenant"
+    section and the `fleet_report --require-accounting` gate. Empty
+    dict when no rank shipped any accounting records."""
+    tenants: Dict[str, dict] = {}
+    ranks = []
+    total = 0
+    for rank, path in sorted(shards.items()):
+        rows = _read_jsonl(os.path.join(path, "requests.jsonl"))
+        if not rows:
+            continue
+        ranks.append({"rank": rank, "requests": len(rows)})
+        total += len(rows)
+        for r in rows:
+            t = str(r.get("tenant") or "default")
+            u = tenants.setdefault(t, {
+                "tenant": t, "requests": 0, "prompt_tokens": 0,
+                "output_tokens": 0, "errors": 0, "ttft_sum_s": 0.0,
+                "ttft_n": 0, "total_sum_s": 0.0, "total_n": 0})
+            u["requests"] += 1
+            u["prompt_tokens"] += int(r.get("prompt_tokens") or 0)
+            u["output_tokens"] += int(r.get("output_tokens") or 0)
+            if r.get("outcome") not in (None, "ok"):
+                u["errors"] += 1
+            if isinstance(r.get("ttft_s"), (int, float)):
+                u["ttft_sum_s"] += float(r["ttft_s"])
+                u["ttft_n"] += 1
+            if isinstance(r.get("total_s"), (int, float)):
+                u["total_sum_s"] += float(r["total_s"])
+                u["total_n"] += 1
+    if not total:
+        return {}
+    rows_out = []
+    for u in tenants.values():
+        u["tokens"] = u["prompt_tokens"] + u["output_tokens"]
+        ts, tn = u.pop("ttft_sum_s"), u.pop("ttft_n")
+        u["ttft_mean_ms"] = round(ts / tn * 1e3, 3) if tn else None
+        es, en = u.pop("total_sum_s"), u.pop("total_n")
+        u["total_mean_ms"] = round(es / en * 1e3, 3) if en else None
+        rows_out.append(u)
+    rows_out.sort(key=lambda u: (-u["tokens"], u["tenant"]))
+    return {"requests": total, "tenants": rows_out, "ranks": ranks}
+
+
 def anomaly_table(shards: Dict[int, str]) -> List[dict]:
     """Severity-ranked anomaly verdicts across the fleet
     (observability/anomaly.py): the offline detectors re-run over
@@ -1227,6 +1291,21 @@ def scrape_to_shards(endpoints: List[str], out_root: str,
                     "".join(json.dumps(r) + "\n" for r in rows))
         except Exception:  # noqa: BLE001 — optional extras
             pass
+        # live accounting ledger: /debug/requests -> requests.jsonl,
+        # the same shard file the flusher writes — a live scrape and a
+        # dir-based report carry the same per-tenant attribution
+        # (usage_table, fleet_report --require-accounting)
+        try:
+            code, body = _http_get(
+                f"{base}/debug/requests?last=100000", timeout=timeout)
+            payload = json.loads(body.decode("utf-8", "replace"))
+            rows = payload.get("records") or []
+            if rows:
+                _metrics.atomic_write(
+                    os.path.join(shard, "requests.jsonl"),
+                    "".join(json.dumps(r) + "\n" for r in rows))
+        except Exception:  # noqa: BLE001 — optional extras
+            pass
         # debug extras for the doctor's support bundle (best-effort)
         try:
             code, body = _http_get(f"{base}/debug/stacks",
@@ -1313,7 +1392,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
                     "ledger": [], "slo": [], "history": [],
-                    "anomalies": [], "artifacts": {}}
+                    "anomalies": [], "usage": {}, "artifacts": {}}
     if not shards:
         return report
     heartbeats = load_heartbeats(shards)
@@ -1338,6 +1417,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "history": history_table(shards),
         "recoveries": recoveries_table(shards),
         "anomalies": anomaly_table(shards),
+        "usage": usage_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -1582,6 +1662,31 @@ def format_report(report: dict) -> str:
                     f"the error_rate SLO burned on these; check its "
                     f"flight recorder (serving.recovery_drop / "
                     f"serving.poisoned events)")
+        lines.append("")
+    usage = report.get("usage") or {}
+    if usage.get("tenants"):
+        tenants = usage["tenants"]
+        per_rank = ", ".join(
+            f"rank {r['rank']}={r['requests']}"
+            for r in usage.get("ranks", []))
+        lines.append("")
+        lines.append(f"== usage per tenant (requests.jsonl accounting "
+                     f"ledger; {usage['requests']} records: "
+                     f"{per_rank}) ==")
+        lines.append(f"{'tenant':<16} {'requests':>9} {'prompt_tok':>11} "
+                     f"{'output_tok':>11} {'errors':>7} "
+                     f"{'ttft_ms':>9} {'total_ms':>9}")
+        for u in tenants:
+            lines.append(
+                f"{u['tenant']:<16} {u['requests']:>9} "
+                f"{u['prompt_tokens']:>11} {u['output_tokens']:>11} "
+                f"{u['errors']:>7} "
+                f"{_fmt_opt_ms(u['ttft_mean_ms']):>9} "
+                f"{_fmt_opt_ms(u['total_mean_ms']):>9}")
+        top_k = tenants[:3]
+        hot = ", ".join(f"{u['tenant']} ({u['tokens']} tok, "
+                        f"{u['requests']} req)" for u in top_k)
+        lines.append(f"hot tenants (by total tokens): {hot}")
         lines.append("")
     verdicts = report.get("anomalies") or []
     if verdicts:

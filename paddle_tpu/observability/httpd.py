@@ -34,12 +34,16 @@ per rank, serving:
 - `/debug/anomalies` — the current severity-ranked anomaly verdicts
   (observability/anomaly.py) plus the canary prober's status block
   (observability/canary.py).
+- `/debug/requests?tenant=&last=N` — the trailing per-request
+  accounting ledger (observability/requestlog.py) plus its per-tenant
+  usage rollup; requires FLAGS_requestlog.
 
 Distributed tracing: inbound `X-PT-Trace` headers are parked on the
 handler thread before any registered application route runs
 (`tracing.set_pending`), so a route handler's `tracing.extract()`
 adopts the caller's trace context — and the context is always cleared
-after the request, keep-alive or not.
+after the request, keep-alive or not. The `X-PT-Tenant` accounting
+identity parks the same way (`requestlog.set_pending_tenant`).
 
 Activation: `FLAGS_telemetry_port` > 0 starts the server lazily on
 first step telemetry (`ensure_server()`, the fleet-exporter pattern);
@@ -68,6 +72,7 @@ from urllib.parse import parse_qs, urlparse
 
 from . import flight_recorder as _flight
 from . import metrics as _metrics
+from . import requestlog as _reqlog
 from . import slo as _slo
 from . import tracing as _tracing
 
@@ -443,6 +448,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle(self, method: str):
         trace_hdr = None
+        tenant_hdr = None
         try:
             url = urlparse(self.path)
             path = url.path.rstrip("/") or "/"
@@ -462,6 +468,13 @@ class _Handler(BaseHTTPRequestHandler):
             trace_hdr = self.headers.get(_tracing.TRACE_HEADER)
             if trace_hdr:
                 _tracing.set_pending(trace_hdr)
+            # tenant identity (X-PT-Tenant) parks the same way: route
+            # handlers only see (method, query, body), so the engine's
+            # add_request/attach_request read the pending tenant off
+            # this thread (observability/requestlog.py)
+            tenant_hdr = self.headers.get(_reqlog.TENANT_HEADER)
+            if tenant_hdr:
+                _reqlog.set_pending_tenant(tenant_hdr)
             handler = _registered_route(path)
             if handler is not None:
                 code, payload, ctype = handler(method, query, body)
@@ -481,6 +494,8 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             if trace_hdr:
                 _tracing.clear_context()
+            if tenant_hdr:
+                _reqlog.clear_pending_tenant()
         try:
             self._send(code, payload, ctype, extra)
         except (BrokenPipeError, ConnectionResetError):
@@ -566,11 +581,26 @@ class _Handler(BaseHTTPRequestHandler):
             }
             return (200, (json.dumps(payload, indent=1) + "\n")
                     .encode(), "application/json", None)
+        if path == "/debug/requests":
+            tenant = (query.get("tenant") or [None])[0] or None
+            try:
+                last = int((query.get("last") or ["200"])[0])
+            except (TypeError, ValueError):
+                last = 200
+            payload = {
+                "enabled": _reqlog.enabled(),
+                "tenant": tenant,
+                "records": _reqlog.history(tenant=tenant, last=last),
+                "usage": _reqlog.usage(),
+            }
+            return (200, (json.dumps(payload, indent=1) + "\n")
+                    .encode(), "application/json", None)
         if path == "/":
             index = ("paddle-tpu telemetry plane\n"
                      "endpoints: /metrics /healthz /readyz /statusz "
                      "/debug/stacks /debug/trace?secs=N "
-                     "/debug/timeseries?secs=N /debug/anomalies\n")
+                     "/debug/timeseries?secs=N /debug/anomalies "
+                     "/debug/requests?tenant=&last=N\n")
             return (200, index.encode(),
                     "text/plain; charset=utf-8", None)
         return (404, b"not found\n", "text/plain; charset=utf-8", None)

@@ -98,7 +98,7 @@ class Histogram:
 
     Buckets are upper bounds (exclusive of +Inf, which is implied)."""
 
-    __slots__ = ("buckets", "_counts", "_sum", "_count")
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_ex")
 
     def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
         b = tuple(sorted(float(x) for x in buckets))
@@ -108,11 +108,25 @@ class Histogram:
         self._counts = [0] * (len(b) + 1)  # trailing slot = +Inf
         self._sum = 0.0
         self._count = 0
+        self._ex = None  # bucket idx -> (labels, value); lazy — None
+        # until the first exemplared observe, so cells that never see
+        # one (the common case) cost nothing extra
 
-    def observe(self, value: float):
-        self._counts[bisect.bisect_left(self.buckets, value)] += 1
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None):
+        idx = bisect.bisect_left(self.buckets, value)
+        self._counts[idx] += 1
         self._sum += value
         self._count += 1
+        if exemplar:
+            if self._ex is None:
+                self._ex = {}
+            self._ex[idx] = (dict(exemplar), float(value))
+
+    def exemplars(self) -> Dict[int, tuple]:
+        """Last (labels, observed value) per bucket index — what the
+        exposition attaches as OpenMetrics `# {...} v` suffixes."""
+        return dict(self._ex) if self._ex else {}
 
     @property
     def sum(self) -> float:
@@ -371,6 +385,19 @@ def _escape(v: str) -> str:
         "\n", r"\n")
 
 
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for one bucket sample line:
+    ` # {trace_id="..."} <observed value>`, or "" when the bucket never
+    saw one. Strict text-0.0.4 parsers must strip this before reading
+    the bucket count — fleet._parse_prom_samples does."""
+    if not ex:
+        return ""
+    ex_labels, ex_value = ex
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in ex_labels.items())
+    return f" # {{{inner}}} {_fmt_float(ex_value)}"
+
+
 def _fmt_float(v: float) -> str:
     if v == math.inf:
         return "+Inf"
@@ -412,13 +439,16 @@ def to_prometheus(registry: Optional[Registry] = None,
                 # from the same snapshot, so a concurrent observe()
                 # cannot tear the invariant _bucket{+Inf} == _count
                 counts, hsum, total = cell.state()
+                exs = cell.exemplars()
                 acc = 0
-                for ub, c in zip(cell.buckets, counts):
+                for i, (ub, c) in enumerate(zip(cell.buckets, counts)):
                     acc += c
                     le = _fmt_labels(labels, f'le="{_fmt_float(ub)}"')
-                    lines.append(f"{fam.name}_bucket{le} {acc}")
+                    lines.append(f"{fam.name}_bucket{le} {acc}"
+                                 + _fmt_exemplar(exs.get(i)))
                 le = _fmt_labels(labels, 'le="+Inf"')
-                lines.append(f"{fam.name}_bucket{le} {total}")
+                lines.append(f"{fam.name}_bucket{le} {total}"
+                             + _fmt_exemplar(exs.get(len(counts) - 1)))
                 ls = _fmt_labels(labels)
                 lines.append(
                     f"{fam.name}_sum{ls} {_fmt_float(hsum)}")

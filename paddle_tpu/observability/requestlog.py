@@ -1,0 +1,247 @@
+"""Per-request accounting: trace-linked request ledger + tenant identity.
+
+Every other observability channel is *aggregate* — histograms, spans,
+time-series rings, anomaly verdicts — so the moment a request finishes
+its identity is gone and nobody can answer "which tenant burned the
+TTFT budget" or "what did this trace_id cost". This module is the
+attribution substrate: a bounded ring of structured ``RequestRecord``
+rows (plain dicts), one per FINISHED request, emitted by the serving
+engine at the single point where a request's slot is released
+(``ServingEngine._finish``). Each row links the request to its trace
+(``trace_id`` matches the distributed-tracing plane), names its tenant,
+and carries the full cost breakdown: prompt/output token counts,
+queue / TTFT / ITL / total latencies, prefix-cache hit ratio and KV
+tier promotions, spec-decode acceptance, retries and recoveries
+touched, and the outcome.
+
+Tenant identity rides the ``X-PT-Tenant`` HTTP header (default
+``"default"``). The telemetry httpd parks the raw inbound header on the
+handler thread — the same pending-header idiom tracing uses for
+``X-PT-Trace`` — so route handlers (which only see method/query/body)
+can adopt it; the router forwards it to replicas, and the KV fabric
+carries it inside ``KVHandoff.req_params`` so a disaggregated request
+keeps ONE tenant from the prefill host through ``/v1/kv_handoff`` into
+the decode host that ultimately emits the ledger record.
+
+Consumers:
+
+- ``/debug/requests?tenant=&last=N`` (observability/httpd.py) serves
+  the trailing ledger live;
+- the fleet flusher and ``fleet.scrape_to_shards`` export the ring as
+  ``rank_<i>/requests.jsonl``; ``fleet.usage_table`` rolls the shards
+  up into the fleet report's "usage per tenant" section (top-K hot
+  tenants), gated by ``fleet_report --require-accounting``;
+- ``tools/fleet_top.py`` polls the endpoint for live per-tenant token
+  rates;
+- ``usage_tokens_total{tenant,kind}`` and the per-tenant latency
+  families in /metrics are fed at the same emission point
+  (inference/serving.py), and the TTFT/decode histograms attach the
+  trace_id as an OpenMetrics exemplar.
+
+Channel contract (PR 1-8 discipline, alloc-guard pinned by
+tests/test_requestlog.py): off (the default) costs one flag read per
+finished request and allocates NOTHING — ``RequestLog.records_created``
+counts every row minted the way ``Registry.allocations`` /
+``Tracer.spans_created`` / ``TimeSeriesRecorder.samples_created`` count
+theirs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# The tenant identity header (router -> replica -> /v1/kv_handoff).
+TENANT_HEADER = "X-PT-Tenant"
+DEFAULT_TENANT = "default"
+
+
+def _flags():
+    from ..framework import config as _config
+
+    return _config
+
+
+def enabled() -> bool:
+    """One flag read — the whole cost of the channel when it is off."""
+    try:
+        return bool(_flags().get_flag("FLAGS_requestlog", False))
+    except (TypeError, ValueError):
+        return False
+
+
+def ring_capacity() -> int:
+    """Records retained per ring (FLAGS_requestlog_capacity). Each
+    record is one small dict (~0.3 KiB), so memory is bounded by
+    roughly capacity * 0.3 KiB per rank."""
+    try:
+        cap = int(_flags().get_flag("FLAGS_requestlog_capacity", 2048))
+    except (TypeError, ValueError):
+        cap = 2048
+    return cap if cap > 0 else 2048
+
+
+def normalize_tenant(value) -> str:
+    """Any caller-supplied tenant -> a non-empty label-safe string.
+    None/empty collapse to DEFAULT_TENANT so every record and every
+    usage_tokens_total cell always has a tenant."""
+    if value is None:
+        return DEFAULT_TENANT
+    s = str(value).strip()
+    return s if s else DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# pending-tenant parking (the tracing.set_pending idiom for X-PT-Tenant)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def set_pending_tenant(value: Optional[str]):
+    """Park the raw inbound X-PT-Tenant header on this thread. The
+    telemetry httpd calls this before dispatching a route handler;
+    the handler adopts it via pending_tenant()."""
+    _tls.tenant = value
+
+
+def pending_tenant() -> Optional[str]:
+    """The tenant parked on this thread, or None when no header came
+    in (callers fall back to an explicit body field, then
+    DEFAULT_TENANT)."""
+    return getattr(_tls, "tenant", None)
+
+
+def clear_pending_tenant():
+    _tls.tenant = None
+
+
+# ---------------------------------------------------------------------------
+# the ledger ring
+# ---------------------------------------------------------------------------
+
+class RequestLog:
+    """Bounded ring of finished-request accounting records."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = ring_capacity()
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        # every record minted (the off-path alloc-guard asserts this
+        # stays flat, like Registry.allocations / Tracer.spans_created)
+        self.records_created = 0
+
+    def record(self, rec: dict):
+        """Append one finished-request record (the engine builds the
+        dict only after checking enabled() — off-path allocates
+        nothing)."""
+        self.records_created += 1
+        with self._lock:
+            self._ring.append(rec)
+
+    def history(self, tenant: Optional[str] = None,
+                last: Optional[int] = None) -> List[dict]:
+        """Records in the ring, oldest first. `tenant` filters to one
+        tenant; `last` keeps only the trailing N (larger than the ring
+        simply returns everything — never an error)."""
+        with self._lock:
+            rows = list(self._ring)
+        if tenant:
+            rows = [r for r in rows if r.get("tenant") == tenant]
+        if last is not None:
+            n = int(last)
+            if n >= 0:
+                rows = rows[len(rows) - min(n, len(rows)):]
+        return rows
+
+    def usage(self) -> Dict[str, dict]:
+        """Per-tenant rollup over what the ring still holds: request
+        and token totals plus latency means — the shape fleet's
+        usage_table and fleet_top render."""
+        out: Dict[str, dict] = {}
+        for r in self.history():
+            t = r.get("tenant") or DEFAULT_TENANT
+            u = out.setdefault(t, {
+                "requests": 0, "prompt_tokens": 0, "output_tokens": 0,
+                "errors": 0, "ttft_sum_s": 0.0, "ttft_n": 0,
+                "total_sum_s": 0.0, "total_n": 0})
+            u["requests"] += 1
+            u["prompt_tokens"] += int(r.get("prompt_tokens") or 0)
+            u["output_tokens"] += int(r.get("output_tokens") or 0)
+            if r.get("outcome") not in (None, "ok"):
+                u["errors"] += 1
+            if r.get("ttft_s") is not None:
+                u["ttft_sum_s"] += float(r["ttft_s"])
+                u["ttft_n"] += 1
+            if r.get("total_s") is not None:
+                u["total_sum_s"] += float(r["total_s"])
+                u["total_n"] += 1
+        return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-global ledger + module-level API
+# ---------------------------------------------------------------------------
+
+_log: Optional[RequestLog] = None
+_log_lock = threading.Lock()
+
+
+def ensure_log() -> Optional[RequestLog]:
+    """The rank's ledger when FLAGS_requestlog is on (idempotent,
+    allocated on first use). Off = one flag read, nothing allocated."""
+    global _log
+    if not enabled():
+        return _log
+    if _log is None:
+        with _log_lock:
+            if _log is None:
+                _log = RequestLog()
+    return _log
+
+
+def log() -> Optional[RequestLog]:
+    return _log
+
+
+def record(rec: dict):
+    """Append one record to the rank's ledger (no-op when off)."""
+    lg = ensure_log()
+    if lg is not None and enabled():
+        rec.setdefault("ts", round(time.time(), 3))
+        lg.record(rec)
+
+
+def history(tenant: Optional[str] = None,
+            last: Optional[int] = None) -> List[dict]:
+    """The current rank's ledger rows (empty when the channel never
+    ran) — what /debug/requests and the fleet flusher read."""
+    lg = _log
+    return lg.history(tenant=tenant, last=last) if lg is not None \
+        else []
+
+
+def usage() -> Dict[str, dict]:
+    lg = _log
+    return lg.usage() if lg is not None else {}
+
+
+def records_taken() -> int:
+    lg = _log
+    return lg.records_created if lg is not None else 0
+
+
+def _reset_for_tests():
+    global _log
+    with _log_lock:
+        _log = None

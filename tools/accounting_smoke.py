@@ -1,0 +1,212 @@
+"""Per-request accounting smoke (CI gate for the request ledger,
+ISSUE 19 acceptance).
+
+Two phases, one assertion each about accounting IDENTITY — the point
+of the ledger (observability/requestlog.py) is that every finished
+request is billed exactly once, to the right tenant, no matter how
+many processes it crossed:
+
+1. Tenant metering through the router — 2 replica worker SUBPROCESSES
+   with FLAGS_requestlog=1 behind the Router; N requests under two
+   tenant identities (parked the way the httpd parks an inbound
+   X-PT-Tenant header). The live scrape (`fleet.scrape_to_shards`,
+   the same pull `fleet_report --scrape auto` does) must show EXACTLY
+   N ledger records fleet-wide with per-tenant prompt/output token
+   sums matching what was sent — no dropped, duplicated, or
+   cross-billed requests.
+2. Cross-process prefill->decode handoff — a LOCAL prefill engine
+   detaches each request and ships it over POST /v1/kv_handoff to a
+   worker, which decodes and emits the ONE ledger record. The record
+   must carry the tenant parked at submission on the prefill host AND
+   a trace_id equal to the prefill-side trace (the ledger row links
+   into the stitched distributed trace).
+
+Then `fleet_report --require-accounting` re-runs the rollup as the
+user-facing gate (ci.sh invokes it against this smoke's directory).
+
+Run: python tools/accounting_smoke.py [--dir /tmp/ci_accounting]
+Outputs one JSON line + exit 0/1.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PROMPT_LEN = 8
+MAX_NEW = 6
+TENANT_MIX = ["acme", "acme", "globex", "acme"]   # 3:1 hot tenant
+
+
+def _scrape_usage(fleet, root, want_records, timeout_s=30.0):
+    """Re-scrape the live endpoints until the fleet-wide ledger holds
+    `want_records` rows (workers bill at finish; the last long-poll
+    response can race the record append by a scheduler tick)."""
+    deadline = time.monotonic() + timeout_s
+    table = {}
+    while time.monotonic() < deadline:
+        eps = fleet.endpoints_from_heartbeats(root)
+        fleet.scrape_to_shards(eps, root)
+        table = fleet.usage_table(dict(fleet.discover_shards(root)))
+        if table.get("requests", 0) >= want_records:
+            return table
+        time.sleep(0.5)
+    return table
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="/tmp/ci_accounting")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from paddle_tpu.framework import config as _cfg
+    from paddle_tpu.inference import (DisaggregatedServing, Router,
+                                      ServingEngine, auto_replicas)
+    from paddle_tpu.inference.replica_worker import spawn_replicas
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.observability import requestlog as _reqlog
+    from paddle_tpu.observability import tracing as _tracing
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    # parent traces every request; the sampled-at-router verdict rides
+    # X-PT-Trace so the workers' ledger rows link the same trace ids
+    _cfg.set_flags({"FLAGS_trace_sample": 1.0})
+
+    print(f"accounting_smoke: spawning 2 ledger-armed replica workers "
+          f"under {args.dir}", file=sys.stderr)
+    procs = spawn_replicas(
+        2, args.dir,
+        worker_args=["--prompt-len", str(PROMPT_LEN),
+                     "--max-batch", "4", "--max-seq-len", "64",
+                     "--page-size", "8", "--trace-sample", "1.0",
+                     "--flag", "FLAGS_requestlog=1"])
+    rng = np.random.RandomState(7)
+    result = {"ok": False}
+    try:
+        # ---- phase 1: tenant metering through the router -------------
+        replicas = auto_replicas(args.dir)
+        assert len(replicas) == 2, \
+            f"auto_replicas found {len(replicas)} endpoints, want 2"
+        router = Router(replicas, admission=False, workers=4).start()
+        sent = {}   # tenant -> [prompt_tokens, output_tokens, n]
+        for tenant in TENANT_MIX:
+            # park the identity the way the telemetry httpd parks an
+            # inbound X-PT-Tenant header: Router.submit adopts it and
+            # forwards it as both body field and header
+            _reqlog.set_pending_tenant(tenant)
+            try:
+                out = router.generate(
+                    rng.randint(0, 97, (PROMPT_LEN,)),
+                    max_new_tokens=MAX_NEW, timeout=120.0)
+            finally:
+                _reqlog.clear_pending_tenant()
+            assert out.get("ok"), f"routed request failed: {out}"
+            n_out = len(out["output_ids"])
+            row = sent.setdefault(tenant, [0, 0, 0])
+            row[0] += PROMPT_LEN
+            row[1] += n_out
+            row[2] += 1
+        router.close()
+
+        n_sent = len(TENANT_MIX)
+        table = _scrape_usage(_fleet, args.dir, n_sent)
+        assert table.get("requests") == n_sent, \
+            (f"fleet ledger holds {table.get('requests')} records for "
+             f"{n_sent} routed requests — dropped or double-billed "
+             f"(per-rank: {table.get('ranks')})")
+        by_tenant = {u["tenant"]: u for u in table["tenants"]}
+        for tenant, (p_tok, o_tok, n) in sent.items():
+            u = by_tenant.get(tenant)
+            assert u is not None, \
+                f"tenant {tenant} missing from the rollup: {by_tenant}"
+            assert u["requests"] == n and \
+                u["prompt_tokens"] == p_tok and \
+                u["output_tokens"] == o_tok, \
+                (f"tenant {tenant} rollup {u} != sent "
+                 f"({n} req, {p_tok} prompt, {o_tok} output)")
+        assert table["tenants"][0]["tenant"] == "acme", \
+            "hot-tenant ordering: acme sent 3x the tokens"
+        print(f"accounting_smoke: router metering ok — {n_sent} "
+              f"records, per-tenant sums match "
+              f"({ {t: v[2] for t, v in sent.items()} })",
+              file=sys.stderr)
+
+        # ---- phase 2: cross-process handoff keeps tenant + trace -----
+        import paddle_tpu as paddle
+
+        paddle.seed(0)
+        cfg_m = LlamaConfig.tiny(vocab=97, hidden=32, layers=2,
+                                 heads=4, seq=64)
+        pe = ServingEngine(LlamaForCausalLM(cfg_m), max_batch=2,
+                           max_seq_len=64, page_size=8,
+                           decode_strategy="greedy_search")
+        pe.warmup(prompt_len=PROMPT_LEN)
+        tracer = _tracing.default_tracer()
+        tracer.clear()   # only the handoff request's spans in the ring
+        endpoint = _fleet.endpoints_from_heartbeats(args.dir)[0]
+        disagg = DisaggregatedServing(pe, f"http://{endpoint}")
+        _reqlog.set_pending_tenant("acme")   # the header, parked
+        try:
+            out2 = disagg.generate(rng.randint(0, 97, (PROMPT_LEN,)),
+                                   max_new_tokens=MAX_NEW)
+        finally:
+            _reqlog.clear_pending_tenant()
+        assert out2.get("ok"), f"handoff request failed: {out2}"
+
+        table2 = _scrape_usage(_fleet, args.dir, n_sent + 1)
+        assert table2.get("requests") == n_sent + 1, \
+            (f"handoff must add EXACTLY one record: "
+             f"{table2.get('requests')} != {n_sent + 1}")
+        # find the handoff record: the attached row
+        recs = []
+        for rank in _fleet.discover_shards(args.dir):
+            path = os.path.join(args.dir, f"rank_{rank}",
+                                "requests.jsonl")
+            if os.path.exists(path):
+                with open(path) as fh:
+                    recs += [json.loads(ln) for ln in fh
+                             if ln.strip()]
+        attached = [r for r in recs if r.get("attached")]
+        assert len(attached) == 1, \
+            f"want 1 attached ledger record, got {len(attached)}"
+        rec = attached[0]
+        assert rec["tenant"] == "acme", rec
+        assert rec["prompt_tokens"] == PROMPT_LEN, rec
+        assert rec["output_tokens"] == len(out2["output_ids"]), rec
+        # the record links into the stitched trace: its trace_id is
+        # the id the LOCAL prefill spans carry
+        prefill_ids = {e["args"]["trace_id"]
+                       for e in tracer.to_chrome_trace()
+                       if e.get("ph") == "X"
+                       and e["name"] == "serving.prefill"}
+        assert rec.get("trace_id"), \
+            f"handoff record carries no trace_id: {rec}"
+        assert prefill_ids == {int(rec["trace_id"], 16)}, \
+            (f"ledger trace_id {rec['trace_id']} does not match the "
+             f"prefill-side trace ids {prefill_ids}")
+        print(f"accounting_smoke: handoff ok — one record, tenant "
+              f"acme, trace {rec['trace_id']} links prefill host to "
+              f"decode worker", file=sys.stderr)
+
+        result = {"ok": True, "records": n_sent + 1,
+                  "tenants": {u["tenant"]: u["tokens"]
+                              for u in table2["tenants"]},
+                  "handoff_trace_id": rec["trace_id"]}
+    finally:
+        for p in procs:
+            p.stop()
+        print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

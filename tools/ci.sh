@@ -306,6 +306,30 @@ if ! timeout 600 env JAX_PLATFORMS=cpu \
   rc=1
 fi
 
+# per-request accounting smoke (ISSUE 19, README.md "Request
+# accounting"): 2 replica workers with FLAGS_requestlog=1 behind the
+# Router. Gates: N requests under two tenant identities must yield
+# EXACTLY N ledger records fleet-wide with per-tenant prompt/output
+# token sums matching what was sent; then one request through a
+# cross-process prefill->decode KV handoff must add exactly ONE record
+# carrying the tenant parked on the prefill host and a trace_id equal
+# to the prefill-side trace. fleet_report --require-accounting re-runs
+# the per-tenant rollup on the scraped shards as the user-facing gate.
+if ! timeout 600 env JAX_PLATFORMS=cpu \
+    python tools/accounting_smoke.py --dir /tmp/ci_accounting; then
+  echo "CI: accounting smoke FAILED (dropped/double-billed ledger" \
+       "records, a cross-billed tenant, or a handoff record that lost" \
+       "its tenant/trace link — see the phase log above; worker logs" \
+       "in /tmp/ci_accounting/)" >&2
+  rc=1
+elif ! timeout 120 env JAX_PLATFORMS=cpu \
+    python tools/fleet_report.py /tmp/ci_accounting \
+      --require-accounting >/dev/null; then
+  echo "CI: fleet_report --require-accounting on /tmp/ci_accounting" \
+       "FAILED (no accounting records in the scraped shards)" >&2
+  rc=1
+fi
+
 # chaos drill (ISSUE 11, README.md "Fault tolerance"): scheduled
 # rank.kill (FLAGS_chaos) mid-training in a 2-rank elastic pod -> the
 # controller must restart the pod, every rank must resume from its last
@@ -327,7 +351,7 @@ else
   echo "CI GREEN (mode=$MODE) — artifacts: /tmp/ci_metrics.prom," \
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
        "/tmp/ci_chaos/, /tmp/ci_router/, /tmp/ci_trace_stitch/," \
-       "/tmp/ci_bench_smoke.json," \
+       "/tmp/ci_accounting/, /tmp/ci_bench_smoke.json," \
        "/tmp/ci_overlap_ledger.prom (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi

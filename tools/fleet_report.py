@@ -40,7 +40,8 @@ load in Perfetto directly).
 Exit codes: 0 = report printed, 2 = no shards found / nothing scraped
 (or, with --require-skew, an empty skew table; with --require-slo, an
 empty SLO table; with --require-healthy, a dead/missing rank or an
-anomaly verdict at severity >= 0.5 — CI treats these as red).
+anomaly verdict at severity >= 0.5; with --require-accounting, no
+requests.jsonl accounting records — CI treats these as red).
 """
 from __future__ import annotations
 
@@ -77,6 +78,12 @@ def main(argv=None) -> int:
                          "at severity >= 0.5 (observability/"
                          "anomaly.py) — the deploy-gate complement of "
                          "the CI gates above")
+    ap.add_argument("--require-accounting", action="store_true",
+                    help="exit 2 when no rank shipped per-request "
+                         "accounting records (requests.jsonl empty "
+                         "everywhere — was FLAGS_requestlog set on "
+                         "the job?): CI gate for the tenant usage "
+                         "rollup (observability/requestlog.py)")
     ap.add_argument("--scrape", default=None, metavar="EP,EP,...",
                     help="comma-separated live telemetry endpoints "
                          "(host:port or URLs; observability/httpd.py) "
@@ -128,6 +135,12 @@ def main(argv=None) -> int:
         print("fleet_report: --require-slo and no rank exported an "
               "evaluated SLO objective (slo_compliance samples "
               "missing from the shards)", file=sys.stderr)
+        return 2
+    if args.require_accounting and \
+            not (report.get("usage") or {}).get("requests"):
+        print("fleet_report: --require-accounting and no rank shipped "
+              "accounting records (requests.jsonl empty everywhere — "
+              "was FLAGS_requestlog set on the job?)", file=sys.stderr)
         return 2
     if args.require_healthy:
         bad = []

@@ -1,0 +1,258 @@
+"""Fleet-top: a live terminal dashboard for the serving fleet — htop
+for ranks instead of processes.
+
+Polls every rank's telemetry plane (observability/httpd.py) on an
+interval and renders one composite frame from three endpoints:
+
+- `/statusz`            — readiness, load score, firing SLO burn
+  alerts, heartbeat step/age, serving slot + KV summary;
+- `/debug/timeseries`   — the trailing window of the per-rank signal
+  ring (FLAGS_timeseries_interval_s), rendered as load / KV-occupancy
+  / queue-depth sparklines so a climbing rank is visible as a shape,
+  not a number;
+- `/debug/requests`     — the per-request accounting ledger
+  (FLAGS_requestlog): per-tenant request/token totals, and token RATES
+  computed by differencing successive polls — "which tenant is hot
+  right now", not just since boot.
+
+Endpoints come from `--endpoints host:port,host:port` or are
+discovered from the shard heartbeats under `--root` (the same path
+`fleet_report --scrape auto` walks). The interactive mode redraws with
+plain ANSI (stdlib only, no curses); `--once` / `--iterations N`
+print frames to stdout for CI and for piping (`watch` works too).
+
+    python tools/fleet_top.py --endpoints 127.0.0.1:9100,127.0.0.1:9101
+    python tools/fleet_top.py --root /tmp/fleet
+    python tools/fleet_top.py --endpoints 127.0.0.1:9100 --once
+
+Exit codes: 0 = ran (frames printed), 2 = no endpoints given or
+discovered.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals, width: int = 24, vmax=None) -> str:
+    """Last `width` values as a unicode sparkline. Scale is 0..vmax
+    (vmax defaults to the window max) so shapes compare across polls."""
+    vals = [v for v in vals if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return "-" * 1
+    top = vmax if vmax else max(vals)
+    if top <= 0:
+        return SPARK[0] * len(vals)
+    out = []
+    for v in vals:
+        idx = int(round(min(max(v / top, 0.0), 1.0) * (len(SPARK) - 1)))
+        out.append(SPARK[idx])
+    return "".join(out)
+
+
+def _get_json(fleet, base: str, path: str, timeout: float):
+    try:
+        code, body = fleet._http_get(base + path, timeout=timeout)
+        if code >= 500:
+            # /statusz stays informative on 503 (degraded), but a hard
+            # server error has no useful payload
+            pass
+        return json.loads(body.decode("utf-8", "replace"))
+    except Exception:  # noqa: BLE001 — a dead rank renders as a row,
+        return None    # never kills the dashboard
+
+
+def poll_rank(fleet, endpoint: str, timeout: float,
+              window_s: float, last: int) -> dict:
+    """One rank's composite sample: statusz + timeseries + requests."""
+    base = fleet.normalize_endpoint(endpoint)
+    statusz = _get_json(fleet, base, "/statusz", timeout)
+    series = _get_json(
+        fleet, base, f"/debug/timeseries?secs={int(window_s)}", timeout)
+    requests_ = _get_json(
+        fleet, base, f"/debug/requests?last={int(last)}", timeout)
+    return {"endpoint": endpoint, "statusz": statusz,
+            "series": series, "requests": requests_}
+
+
+def render_frame(polled: dict, prev_usage: dict, now: float,
+                 prev_t, width: int = 24):
+    """One full dashboard frame -> (text, usage_snapshot).
+    `prev_usage`/`prev_t` feed the per-tenant token-rate columns
+    (None/{} on the first frame)."""
+    lines = []
+    stamp = time.strftime("%H:%M:%S", time.localtime(now))
+    lines.append(f"fleet-top  {stamp}  ranks: {len(polled)}"
+                 + (f"  poll dt: {now - prev_t:.1f}s" if prev_t else ""))
+    lines.append("")
+    lines.append(f"{'rank':>5} {'ready':>6} {'load':>6} {'queue':>6} "
+                 f"{'kv%':>6} {'step':>8} "
+                 f"{'load ' + chr(0x2581) * 3:<{width + 5}} "
+                 f"{'kv ' + chr(0x2581) * 3:<{width + 3}} "
+                 f"{'queue ' + chr(0x2581) * 3}")
+    alerts = []
+    for rank in sorted(polled):
+        p = polled[rank]
+        st = p.get("statusz") or {}
+        if not st and p.get("series") is None:
+            lines.append(f"{rank:>5} {'DOWN':>6} {'-':>6} {'-':>6} "
+                         f"{'-':>6} {'-':>8} ({p['endpoint']} "
+                         f"unreachable)")
+            continue
+        ready = (st.get("ready") or {}).get("code") == 200
+        try:
+            load = float(st.get("load_score") or 0.0)
+        except (TypeError, ValueError):
+            load = 0.0
+        hb = st.get("heartbeat") or {}
+        step = hb.get("step", "-")
+        samples = (p.get("series") or {}).get("samples") or []
+        loads = [s.get("load") for s in samples]
+        kvs = [s.get("kv_occupancy") for s in samples]
+        queues = [s.get("queue") for s in samples]
+        kv_now = next((v for v in reversed(kvs)
+                       if isinstance(v, (int, float))), None)
+        q_now = next((v for v in reversed(queues)
+                      if isinstance(v, (int, float))), 0)
+        lines.append(
+            f"{rank:>5} {'ok' if ready else 'NO':>6} {load:>6.2f} "
+            f"{int(q_now or 0):>6} "
+            f"{(f'{kv_now * 100.0:.0f}' if kv_now is not None else '-'):>6} "
+            f"{str(step):>8} "
+            f"{sparkline(loads, width, vmax=1.0):<{width + 5}} "
+            f"{sparkline(kvs, width, vmax=1.0):<{width + 3}} "
+            f"{sparkline(queues, width)}")
+        for name in (st.get("slo") or {}).get("firing") or []:
+            alerts.append((rank, str(name)))
+    # -- per-tenant token rates (accounting ledger rollup) ------------
+    usage_now: dict = {}
+    enabled_anywhere = False
+    for rank in sorted(polled):
+        req = polled[rank].get("requests") or {}
+        if req.get("enabled"):
+            enabled_anywhere = True
+        for tenant, u in (req.get("usage") or {}).items():
+            agg = usage_now.setdefault(tenant, {
+                "requests": 0, "tokens": 0, "prompt": 0, "output": 0,
+                "errors": 0, "ttft_sum": 0.0, "ttft_n": 0})
+            agg["requests"] += int(u.get("requests") or 0)
+            agg["prompt"] += int(u.get("prompt_tokens") or 0)
+            agg["output"] += int(u.get("output_tokens") or 0)
+            agg["tokens"] = agg["prompt"] + agg["output"]
+            agg["errors"] += int(u.get("errors") or 0)
+            agg["ttft_sum"] += float(u.get("ttft_sum_s") or 0.0)
+            agg["ttft_n"] += int(u.get("ttft_n") or 0)
+    lines.append("")
+    if usage_now:
+        dt = (now - prev_t) if prev_t else None
+        lines.append(f"{'tenant':<16} {'req':>6} {'tokens':>9} "
+                     f"{'tok/s':>8} {'errors':>7} {'ttft_ms':>9}")
+        hot = sorted(usage_now.items(),
+                     key=lambda kv: -kv[1]["tokens"])
+        for tenant, u in hot:
+            rate = "-"
+            if dt and dt > 0 and tenant in prev_usage:
+                d = u["tokens"] - prev_usage[tenant]["tokens"]
+                if d >= 0:
+                    rate = f"{d / dt:.1f}"
+            ttft = (f"{u['ttft_sum'] / u['ttft_n'] * 1e3:.1f}"
+                    if u["ttft_n"] else "-")
+            lines.append(f"{tenant:<16} {u['requests']:>6} "
+                         f"{u['tokens']:>9} {rate:>8} "
+                         f"{u['errors']:>7} {ttft:>9}")
+    elif enabled_anywhere:
+        lines.append("accounting ledger on, no records yet "
+                     "(no request has finished)")
+    else:
+        lines.append("no accounting data — set FLAGS_requestlog on "
+                     "the replicas for per-tenant token rates")
+    lines.append("")
+    if alerts:
+        for rank, name in alerts:
+            lines.append(f"SLO ALERT: rank {rank} {name} firing")
+    else:
+        lines.append("no SLO burn alerts firing")
+    return "\n".join(lines) + "\n", usage_now
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--endpoints", default=None, metavar="EP,EP,...",
+                    help="telemetry endpoints (host:port or URLs), "
+                         "comma-separated")
+    ap.add_argument("--root", default=None,
+                    help="FLAGS_telemetry_dir root: discover endpoints "
+                         "from the shard heartbeats (fleet_report "
+                         "--scrape auto's path)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll/redraw interval in seconds (default 2)")
+    ap.add_argument("--window", type=float, default=120.0,
+                    help="sparkline trailing window in seconds "
+                         "(default 120)")
+    ap.add_argument("--last", type=int, default=1000,
+                    help="ledger records pulled per rank per poll "
+                         "(default 1000)")
+    ap.add_argument("--once", action="store_true",
+                    help="print ONE frame to stdout and exit (CI / "
+                         "piping; no screen clearing)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = run until ^C); "
+                         "frames print without clearing, like --once")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-endpoint HTTP timeout (default 3)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import fleet
+
+    if args.endpoints:
+        eps = [e.strip() for e in args.endpoints.split(",")
+               if e.strip()]
+    elif args.root:
+        eps = fleet.endpoints_from_heartbeats(args.root)
+        if not eps:
+            print(f"fleet_top: no live endpoints in the heartbeats "
+                  f"under {args.root}", file=sys.stderr)
+            return 2
+    else:
+        print("fleet_top: pass --endpoints or --root", file=sys.stderr)
+        return 2
+
+    plain = args.once or args.iterations > 0
+    n_frames = 1 if args.once else args.iterations
+    prev_usage: dict = {}
+    prev_t = None
+    frame = 0
+    try:
+        while True:
+            polled = {i: poll_rank(fleet, ep, args.timeout,
+                                   args.window, args.last)
+                      for i, ep in enumerate(eps)}
+            now = time.time()
+            text, prev_usage = render_frame(polled, prev_usage, now,
+                                            prev_t)
+            prev_t = now
+            if plain:
+                sys.stdout.write(text)
+                sys.stdout.flush()
+            else:
+                # ANSI home+clear: stdlib-only live redraw
+                sys.stdout.write("\x1b[H\x1b[2J" + text)
+                sys.stdout.flush()
+            frame += 1
+            if n_frames and frame >= n_frames:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
