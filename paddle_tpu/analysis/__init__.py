@@ -13,11 +13,28 @@ Every rule encodes a bug this repo actually shipped (CHANGES.md):
   donated-arg-reuse        reads of buffers already donated to XLA
   flag-hygiene             FLAGS_* declared/used cross-check, both
                            directions
+  unlocked-shared-write    an attribute written from a thread-target
+                           entry path without the lock the majority
+                           of its write sites hold
+  lock-order-cycle         interprocedural nested-`with` lock-order
+                           graph cycle — the static ABBA deadlock
+  thread-lifecycle         non-daemon Thread started but never joined
+                           in any close()/stop()/atexit path
+
+The interprocedural rules ride on `core.ProjectIndex` — a cross-file
+symbol table + call graph built once per run, so rules follow helper
+calls from `threading.Thread(target=...)` launch sites into the
+attributes and locks they actually touch. The runtime companion is
+`paddle_tpu/observability/lockwatch.py` (`FLAGS_lockwatch`): its
+inversion verdicts cite `lock-order-cycle`, and the rule docs point
+back at the lockwatch telemetry.
 
 CLI: `python tools/tpu_lint.py [paths...]` — exits non-zero on any
 finding not in the committed baseline (tools/tpu_lint_baseline.json).
-Per-line suppression: `# tpu-lint: disable=<rule>`. Docs: README.md
-"Static analysis".
+Per-line suppression: `# tpu-lint: disable=<rule>`. `--changed` lints
+only git-touched files; `--jobs N` parses in parallel;
+`--emit-rules-doc` generates docs/LINT_RULES.md. Docs: README.md
+"Static analysis" + "Concurrency analysis".
 
 This package imports neither jax nor the rest of paddle_tpu, so the
 CLI loads it directly off sys.path and lint failures surface in
@@ -27,11 +44,13 @@ from .core import (  # noqa: F401
     FileContext,
     Finding,
     ImportMap,
+    ProjectIndex,
     RULES,
     Rule,
     iter_py_files,
+    load_contexts,
     register,
     repo_root,
     run,
 )
-from . import baseline, flagsdoc, reporters, rules  # noqa: F401
+from . import baseline, flagsdoc, reporters, rules, rulesdoc  # noqa: F401

@@ -13,6 +13,7 @@ from typing import List, Optional
 from . import baseline as _baseline
 from . import flagsdoc as _flagsdoc
 from . import reporters as _reporters
+from . import rulesdoc as _rulesdoc
 from .core import RULES, repo_root, run
 
 DEFAULT_BASELINE = os.path.join("tools", "tpu_lint_baseline.json")
@@ -44,12 +45,60 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--disable", default=None,
                    help="comma-separated rule names to skip")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only the .py files the git working "
+                        "tree touches vs HEAD (staged, unstaged, "
+                        "untracked) — the fast pre-commit loop")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="parse files with N threads (the full-repo "
+                        "run is parse-dominated)")
     p.add_argument("--emit-flags-doc", nargs="?", const="-",
                    metavar="PATH", default=None,
                    help="generate the FLAGS_* reference table "
                         "(markdown) to PATH (or stdout) and exit; "
                         "docs/FLAGS.md is the committed output")
+    p.add_argument("--emit-rules-doc", nargs="?", const="-",
+                   metavar="PATH", default=None,
+                   help="generate the rule catalog (markdown: name, "
+                        "hazard, example, fix) to PATH (or stdout) "
+                        "and exit; docs/LINT_RULES.md is the "
+                        "committed output")
     return p
+
+
+def _changed_files(root: str) -> Optional[List[str]]:
+    """Working-tree-touched .py files (staged + unstaged + untracked)
+    via `git status --porcelain`; None when git is unavailable.
+    tests/ is excluded to match the full-run surface (paddle_tpu/,
+    tools/, bench.py): the deliberate fixtures under tests/data/ are
+    supposed to be dirty, and a --changed run must never go red on a
+    file the full run doesn't lint."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    files: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if not path.endswith(".py"):
+            continue
+        if path.replace(os.sep, "/").startswith("tests/"):
+            continue
+        full = os.path.join(root, path)
+        if os.path.isfile(full):
+            files.append(full)
+    return files
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -78,6 +127,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"wrote {out}")
         return 0
 
+    if args.emit_rules_doc is not None:
+        md = _rulesdoc.to_markdown(RULES)
+        if args.emit_rules_doc == "-":
+            sys.stdout.write(md)
+        else:
+            out = args.emit_rules_doc
+            os.makedirs(os.path.dirname(os.path.abspath(out)),
+                        exist_ok=True)
+            with open(out, "w", encoding="utf-8") as f:
+                f.write(md)
+            print(f"wrote {out}")
+        return 0
+
     select = ({s.strip() for s in args.select.split(",") if s.strip()}
               if args.select else None)
     disable = ({s.strip() for s in args.disable.split(",") if s.strip()}
@@ -91,17 +153,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
-    paths = args.paths or [
-        os.path.join(root, "paddle_tpu"),
-        os.path.join(root, "tools"),
-        os.path.join(root, "bench.py"),
-    ]
-    paths = [p for p in paths if os.path.exists(p)]
+    if args.changed:
+        changed = _changed_files(root)
+        if changed is None:
+            print("tpu-lint: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("tpu-lint: no changed python files")
+            return 0
+        paths = changed
+    else:
+        paths = args.paths or [
+            os.path.join(root, "paddle_tpu"),
+            os.path.join(root, "tools"),
+            os.path.join(root, "bench.py"),
+        ]
+        paths = [p for p in paths if os.path.exists(p)]
     if not paths:
         print("tpu-lint: no input paths exist", file=sys.stderr)
         return 2
 
-    findings = run(paths, select=select, disable=disable, root=root)
+    findings = run(paths, select=select, disable=disable, root=root,
+                   jobs=max(1, args.jobs))
 
     baseline_path = args.baseline or os.path.join(root,
                                                   DEFAULT_BASELINE)

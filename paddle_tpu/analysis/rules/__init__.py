@@ -3,6 +3,7 @@ with `analysis.core.RULES`; a new rule is a module here with a
 `@register`-decorated `Rule` subclass — nothing else to wire."""
 from . import (  # noqa: F401
     collectives,
+    concurrency,
     donated,
     flags,
     jax_compat,

@@ -72,6 +72,14 @@ class RankDivergentCollectiveRule(Rule):
     description = ("collective call under an `if rank == ...` style "
                    "conditional — only some ranks enter it; the rest "
                    "of the fleet blocks forever (deadlock)")
+    hazard = ("Collectives are rendezvous points: every participating "
+              "rank must reach the same call. A collective under "
+              "`if rank == 0:` leaves the other ranks waiting in the "
+              "all-reduce forever — the job hangs, not errors.")
+    example = ("`if jax.process_index() == 0: psum(x, 'batch')`")
+    fix = ("Run the collective on every rank unconditionally and "
+           "branch on the *result*, or gate the whole region so no "
+           "rank enters it.")
 
     def _is_collective(self, ctx, call: ast.Call) -> bool:
         parts = dotted_parts(call.func)
@@ -92,6 +100,10 @@ class RankDivergentCollectiveRule(Rule):
         return _module_hint(path)
 
     def check(self, ctx):
+        src = ctx.source  # every rank spelling contains one of these
+        if "rank" not in src and "process_index" not in src \
+                and "trainer_id" not in src:
+            return
         yield from self._walk(ctx, ctx.tree, rank_if=None)
 
     def _walk(self, ctx, node, rank_if):

@@ -1,0 +1,624 @@
+"""Concurrency rules: lock discipline, lock ordering, thread
+lifecycle — the static half of the concurrency plane (the runtime
+half is observability/lockwatch.py; findings and verdicts cite each
+other so a live symptom points at the static cause and vice versa).
+
+All three are project rules: they need the cross-file call graph
+(core.ProjectIndex) to follow a helper from its
+`threading.Thread(target=...)` launch site into the attributes it
+touches, and to credit the caller-holds-the-lock idiom
+(`_resolve_locked` style helpers whose every call site sits inside
+`with self._cv:`).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import (FileContext, FuncInfo, ProjectIndex, dotted_parts,
+                    iter_own_frame, register, Rule)
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_LOCKWATCH_LEAVES = ("lockwatch.lock", "lockwatch.rlock",
+                     "lockwatch.condition")
+# attribute names that read as locks even when the assignment is out
+# of sight (inherited, injected): the discipline rules trust the name
+_LOCKISH_NAME = re.compile(r"(?i)(^|_)(lock|rlock|mutex|cv|cond)\w*$")
+
+_SHUTDOWNISH = ("close", "stop", "shutdown", "terminate", "finalize",
+                "cleanup", "join", "exit", "del", "atexit")
+
+
+class _Pos:
+    """Anchor findings at an explicit line/col."""
+
+    def __init__(self, lineno: int, col: int = 0):
+        self.lineno = lineno
+        self.col_offset = col
+
+
+def _is_lock_factory(ctx: FileContext, value: ast.expr) -> bool:
+    """True for `threading.Lock()` / `RLock()` / `Condition(...)` and
+    the lockwatch drop-in factories (`lockwatch.lock("name")`)."""
+    if not isinstance(value, ast.Call):
+        return False
+    dotted = ctx.imports.expand(value.func)
+    if not dotted:
+        return False
+    if dotted in _LOCK_FACTORIES:
+        return True
+    return dotted.endswith(_LOCKWATCH_LEAVES)
+
+
+def _short(lock_id: str) -> str:
+    """Display name: last two dotted components
+    ('...replica.ReplicaServer._cv' -> 'ReplicaServer._cv')."""
+    return ".".join(lock_id.rsplit(".", 2)[-2:])
+
+
+class _LockVocab:
+    """Every lock the project declares, canonically named.
+
+    Class locks: `self._x = threading.Lock()` anywhere in the class ->
+    id '<class qualname>._x' (one id per class, not per instance — a
+    discipline is a property of the class). Module locks:
+    `_x = threading.Lock()` at module scope -> '<module>._x'.
+    """
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.class_attrs: Dict[str, Set[str]] = {}
+        self.module_locks: Set[str] = set()
+        for qual, info in index.functions.items():
+            if info.cls is None:
+                continue
+            for node in iter_own_frame(info.node):
+                if (isinstance(node, ast.Assign)
+                        and _is_lock_factory(info.ctx, node.value)):
+                    for t in node.targets:
+                        parts = dotted_parts(t)
+                        if parts and len(parts) == 2 \
+                                and parts[0] == "self":
+                            self.class_attrs.setdefault(
+                                info.cls, set()).add(parts[1])
+        for ctx in index.ctxs:
+            mod = index.module_of(ctx)
+            for node in ctx.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and _is_lock_factory(ctx, node.value)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(f"{mod}.{t.id}")
+
+    def lock_id(self, ctx: FileContext, expr: ast.expr,
+                cls_qual: Optional[str]) -> Optional[str]:
+        """Canonical id for a `with <expr>:` context manager, or None
+        when it is not recognizably a lock."""
+        parts = dotted_parts(expr)
+        if not parts:
+            return None
+        if parts[0] == "self" and cls_qual and len(parts) == 2:
+            attrs = self._attrs_with_bases(cls_qual)
+            if parts[1] in attrs or _LOCKISH_NAME.search(parts[1]):
+                return f"{cls_qual}.{parts[1]}"
+            return None
+        dotted = ctx.imports.expand(expr)
+        if dotted and dotted in self.module_locks:
+            return dotted
+        if dotted and "." not in dotted:  # plain local module name
+            local = f"{self.index.module_of(ctx)}.{dotted}"
+            if local in self.module_locks:
+                return local
+        return None
+
+    def _attrs_with_bases(self, cls_qual: str,
+                          _seen: Optional[Set[str]] = None) -> Set[str]:
+        _seen = _seen if _seen is not None else set()
+        if cls_qual in _seen:
+            return set()
+        _seen.add(cls_qual)
+        out = set(self.class_attrs.get(cls_qual, ()))
+        info = self.index.classes.get(cls_qual)
+        if info:
+            for base in info.bases:
+                out |= self._attrs_with_bases(base, _seen)
+        return out
+
+    def guards(self, ctx: FileContext, with_stack: Sequence[ast.expr],
+               cls_qual: Optional[str]) -> List[str]:
+        out = []
+        for expr in with_stack:
+            lid = self.lock_id(ctx, expr, cls_qual)
+            if lid:
+                out.append(lid)
+        return out
+
+
+def _walk_with_locks(vocab: _LockVocab, info, visit):
+    """Walk `info`'s own frame calling `visit(node, held)` for every
+    node, where `held` is the ordered list of (lock_id, lineno)
+    acquired by enclosing `with` blocks — a `with` nested anywhere,
+    including as a direct body statement of another `with`, extends
+    the stack for its body."""
+
+    def walk(n, held):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            visit(n, held)
+            inner = list(held)
+            for item in n.items:
+                walk(item.context_expr, held)
+                lid = vocab.lock_id(info.ctx, item.context_expr,
+                                    info.cls)
+                if lid:
+                    inner.append((lid, item.context_expr.lineno))
+            for stmt in n.body:
+                walk(stmt, inner)
+            return
+        visit(n, held)
+        for child in ast.iter_child_nodes(n):
+            walk(child, held)
+
+    for child in ast.iter_child_nodes(info.node):
+        walk(child, [])
+
+
+@register
+class UnlockedSharedWriteRule(Rule):
+    """Infer each class's lock discipline by majority use and flag
+    thread-reachable writes that skip it."""
+
+    name = "unlocked-shared-write"
+    description = ("instance attribute mostly written under a lock is "
+                   "written lock-free on a thread-reachable path")
+    hazard = ("A field that every other writer guards with `with "
+              "self._lock:` is mutated bare on a path a thread "
+              "target or HTTP route handler can reach — the PR 8 "
+              "Histogram bucket/count tearing shape: torn or lost "
+              "updates under concurrent scrape/decode.")
+    example = ("`with self._lock: self._n += 1` at three sites, then "
+               "`self._n = 0` bare inside the `Thread(target=...)` "
+               "loop")
+    fix = ("Hold the class lock around the write (or prove the idiom "
+           "safe and add `# tpu-lint: disable=unlocked-shared-write` "
+           "with the reason); confirm live with FLAGS_lockwatch=1.")
+    project_rule = True
+
+    def check_project(self, ctxs, repo_root, index=None):
+        if index is None:
+            index = ProjectIndex(ctxs)
+        vocab = _LockVocab(index)
+        reach = index.thread_reachable()
+        for cls_qual, info in sorted(index.classes.items()):
+            if not vocab._attrs_with_bases(cls_qual):
+                continue  # no locks -> no discipline to infer
+            yield from self._check_class(index, vocab, reach, cls_qual)
+
+    def _check_class(self, index, vocab, reach, cls_qual):
+        # writes[attr] = list of (guarded, lock_id|None, func_qual,
+        #                         ctx, node)
+        writes: Dict[str, List[tuple]] = {}
+        methods = [f for f in index.functions.values()
+                   if f.cls == cls_qual
+                   and f.node.name not in ("__init__", "__new__")]
+        for info in methods:
+            caller_held = self._always_called_under_lock(index, vocab,
+                                                         info)
+
+            def visit(node, held, _info=info, _ch=caller_held):
+                for attr, target in _self_attr_writes(node):
+                    guards = [h[0] for h in held]
+                    guarded = bool(guards) or _ch
+                    writes.setdefault(attr, []).append(
+                        (guarded, guards[-1] if guards else None,
+                         _info.qualname, _info.ctx, target))
+
+            _walk_with_locks(vocab, info, visit)
+        for attr, events in sorted(writes.items()):
+            guarded = [e for e in events if e[0]]
+            bare = [e for e in events if not e[0]]
+            if len(guarded) < 2 or len(guarded) <= len(bare):
+                continue  # no majority discipline
+            locks = [e[1] for e in guarded if e[1]]
+            lock_id = max(set(locks), key=locks.count) if locks \
+                else f"{cls_qual}.<lock>"
+            site = guarded[0]
+            for _, _, func, ctx, node in bare:
+                chain = reach.get(func)
+                if chain is None:
+                    continue  # never runs off the main thread
+                ep = index.entry_points.get(chain[0])
+                kind = ep.kind if ep else "thread"
+                chain_disp = " -> ".join(
+                    q.rsplit(".", 1)[-1] for q in chain)
+                yield ctx.finding(self.name, node, (
+                    f"write to self.{attr} without holding "
+                    f"{_short(lock_id)} — {len(guarded)}/{len(events)}"
+                    f" write sites hold it (e.g. {site[3].relpath}:"
+                    f"{site[4].lineno}), and this one is reachable "
+                    f"from {kind} entry '{chain[0].rsplit('.', 1)[-1]}'"
+                    f" ({chain_disp}). Hold the lock around the "
+                    f"write; FLAGS_lockwatch=1 measures the "
+                    f"contention this guard costs at runtime."))
+
+    def _always_called_under_lock(self, index, vocab, info) -> bool:
+        """The `_resolve_locked` idiom: every resolved call site of
+        this method sits inside a `with <lock>:` block, so its writes
+        inherit the caller's guard."""
+        sites = index.callers.get(info.qualname, ())
+        if not sites:
+            return False
+        for site in sites:
+            caller = index.functions.get(site.caller)
+            caller_cls = caller.cls if caller else None
+            if not vocab.guards(site.ctx, site.with_stack, caller_cls):
+                return False
+        return True
+
+
+def _self_attr_writes(node) -> List[Tuple[str, ast.AST]]:
+    """(attr-name, anchor-node) for assignments mutating `self.<attr>`
+    — plain stores, augmented stores, and `self.<attr>[k] = v`
+    subscript stores (a dict/list field is shared state too)."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target] if node.target is not None else []
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            targets = []  # bare annotation, not a write
+    out = []
+    for t in targets:
+        for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            base = el
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                out.append((base.attr, el))
+    return out
+
+
+@register
+class LockOrderCycleRule(Rule):
+    """Build the static lock-order graph (nested `with` acquisitions,
+    followed interprocedurally through the call graph) and flag
+    cycles."""
+
+    name = "lock-order-cycle"
+    description = ("two locks are acquired in opposite nesting orders "
+                   "somewhere in the repo (static ABBA deadlock)")
+    hazard = ("Thread 1 holds A and wants B while thread 2 holds B "
+              "and wants A — both block forever. The orderings can "
+              "live files apart, stitched together by an innocent "
+              "helper call made while a lock is held.")
+    example = ("`with A: with B: ...` in one module; `with B: "
+               "helper()` elsewhere where `helper` takes `with A:`")
+    fix = ("Pick one global acquisition order (document it next to "
+           "the lock declarations) and re-nest the minority site; "
+           "FLAGS_lockwatch=1 raises a runtime inversion verdict "
+           "citing this rule if an undetected order slips through.")
+    project_rule = True
+
+    def check_project(self, ctxs, repo_root, index=None):
+        if index is None:
+            index = ProjectIndex(ctxs)
+        vocab = _LockVocab(index)
+        # edges[a][b] = (chain text, ctx, line) — first evidence of
+        # acquiring b while holding a
+        edges: Dict[str, Dict[str, tuple]] = {}
+        acq_memo: Dict[str, List[tuple]] = {}
+        for qual in sorted(index.functions):
+            self._collect_edges(index, vocab, qual, edges, acq_memo)
+        yield from self._report_cycles(edges)
+
+    # -- edge collection ---------------------------------------------
+    def _collect_edges(self, index, vocab, qual, edges, acq_memo):
+        info = index.functions[qual]
+
+        def visit(node, held):
+            if not held:
+                return
+            direct: List[tuple] = []
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = vocab.lock_id(info.ctx, item.context_expr,
+                                        info.cls)
+                    if lid:
+                        direct.append((lid, info.ctx,
+                                       item.context_expr.lineno,
+                                       f"{_loc(info.ctx, item.context_expr.lineno)} in {_fn(qual)}"))
+            elif isinstance(node, ast.Call):
+                callee = self._callee(index, info, node)
+                if callee:
+                    for lid, via in self._trans_acquires(
+                            index, vocab, callee, acq_memo):
+                        direct.append((
+                            lid, info.ctx, node.lineno,
+                            f"{_loc(info.ctx, node.lineno)} in "
+                            f"{_fn(qual)} -> {via}"))
+            for lid, ctx, line, how in direct:
+                for held_id, held_line in held:
+                    if held_id == lid:
+                        continue  # re-entrant / same lock
+                    edges.setdefault(held_id, {}).setdefault(lid, (
+                        f"{_short(held_id)} at "
+                        f"{_loc(info.ctx, held_line)} in {_fn(qual)}, "
+                        f"then {_short(lid)} at {how}",
+                        ctx, line))
+
+        _walk_with_locks(vocab, info, visit)
+
+    def _callee(self, index, info, call) -> Optional[str]:
+        return index.resolve_callable(info.ctx, call.func, info.cls,
+                                      (info.qualname,))
+
+    def _trans_acquires(self, index, vocab, qual, memo,
+                        _stack: Optional[Set[str]] = None):
+        """Locks `qual` (or anything it calls) acquires, each with a
+        human-readable 'via' chain."""
+        if qual in memo:
+            return memo[qual]
+        _stack = _stack if _stack is not None else set()
+        if qual in _stack or qual not in index.functions:
+            return []
+        _stack.add(qual)
+        info = index.functions[qual]
+        out: List[tuple] = []
+        seen: Set[str] = set()
+
+        def visit(node, held):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = vocab.lock_id(info.ctx, item.context_expr,
+                                        info.cls)
+                    if lid and lid not in seen:
+                        seen.add(lid)
+                        out.append((lid,
+                                    f"{_loc(info.ctx, item.context_expr.lineno)}"
+                                    f" in {_fn(qual)}"))
+            elif isinstance(node, ast.Call):
+                callee = self._callee(index, info, node)
+                if callee and callee != qual:
+                    for lid, via in self._trans_acquires(
+                            index, vocab, callee, memo, _stack):
+                        if lid not in seen:
+                            seen.add(lid)
+                            out.append((lid, f"{_fn(callee)} -> {via}"))
+
+        for node in iter_own_frame(info.node):
+            visit(node, None)
+        _stack.discard(qual)
+        memo[qual] = out
+        return out
+
+    # -- cycle reporting ---------------------------------------------
+    def _report_cycles(self, edges):
+        reported: Set[frozenset] = set()
+        for a in sorted(edges):
+            for b in sorted(edges[a]):
+                if a not in edges.get(b, {}):
+                    continue
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                chain_ab, ctx_ab, line_ab = edges[a][b]
+                chain_ba, _, _ = edges[b][a]
+                yield ctx_ab.finding(self.name, _Pos(line_ab), (
+                    f"lock-order cycle between {_short(a)} and "
+                    f"{_short(b)}: one path takes {chain_ab}; another "
+                    f"takes {chain_ba}. Interleaved threads deadlock. "
+                    f"Pick one global order and re-nest the minority "
+                    f"site; FLAGS_lockwatch=1 detects this live "
+                    f"(runtime ABBA verdict cites lock-order-cycle)."))
+        # longer cycles (A->B->C->A): depth-first search on what's left
+        yield from self._long_cycles(edges, reported)
+
+    def _long_cycles(self, edges, reported):
+        for start in sorted(edges):
+            path = [start]
+            on_path = {start}
+
+            def dfs(cur):
+                for nxt in sorted(edges.get(cur, {})):
+                    if nxt == start and len(path) > 2:
+                        key = frozenset(path)
+                        if key in reported:
+                            return None
+                        reported.add(key)
+                        return list(path)
+                    if nxt not in on_path and len(path) < 6:
+                        path.append(nxt)
+                        on_path.add(nxt)
+                        got = dfs(nxt)
+                        on_path.discard(nxt)
+                        path.pop()
+                        if got:
+                            return got
+                return None
+
+            cyc = dfs(start)
+            if cyc:
+                hops = []
+                for i, node in enumerate(cyc):
+                    nxt = cyc[(i + 1) % len(cyc)]
+                    hops.append(edges[node][nxt][0])
+                chain, ctx, line = edges[cyc[0]][cyc[1]]
+                yield ctx.finding(self.name, _Pos(line), (
+                    "lock-order cycle through "
+                    + " -> ".join(_short(c) for c in cyc + [cyc[0]])
+                    + ": " + "; ".join(hops)
+                    + ". Interleaved threads deadlock — pick one "
+                      "global order (FLAGS_lockwatch=1 raises the "
+                      "runtime ABBA verdict for lock-order-cycle)."))
+
+
+def _loc(ctx: FileContext, line: int) -> str:
+    return f"{ctx.relpath}:{line}"
+
+
+def _fn(qual: str) -> str:
+    return qual.rsplit(".", 1)[-1]
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    """`threading.Thread` started without `daemon=True` and without a
+    reachable `join()` in a shutdown path."""
+
+    name = "thread-lifecycle"
+    description = ("non-daemon thread with no join() in any "
+                   "close()/stop()/atexit path (shutdown hang)")
+    hazard = ("A non-daemon thread that nobody joins keeps the "
+              "interpreter alive at exit — the process hangs after "
+              "main() returns, which in CI reads as a timeout with "
+              "no traceback.")
+    example = ("`self._t = threading.Thread(target=self._loop); "
+               "self._t.start()` and no `stop()` that joins it")
+    fix = ("Pass `daemon=True` for best-effort background work, or "
+           "keep it non-daemon and `join()` it from `close()`/"
+           "`stop()`/an `atexit` hook so shutdown is deterministic.")
+    project_rule = True
+
+    def check_project(self, ctxs, repo_root, index=None):
+        if index is None:
+            index = ProjectIndex(ctxs)
+        for info in sorted(index.functions.values(),
+                           key=lambda i: i.qualname):
+            yield from self._check_func(index, info)
+        for ctx in ctxs:  # module-level spawns
+            fake = FuncInfo(f"{index.module_of(ctx)}.<module>", ctx,
+                            ctx.tree, index.module_of(ctx), None)
+            yield from self._check_func(index, fake)
+
+    def _check_func(self, index, info):
+        ctx = info.ctx
+        frame = list(iter_own_frame(info.node))
+        for node in frame:
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.imports.expand(node.func) != "threading.Thread":
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if daemon is not None:
+                if not (isinstance(daemon, ast.Constant)
+                        and daemon.value is False):
+                    continue  # daemon=True or dynamic: not our shape
+            name = self._bound_name(frame, node)
+            if name and self._handled_locally(frame, name):
+                continue
+            attr = self._bound_self_attr(frame, node) \
+                or (name and self._appended_attr(frame, name))
+            if attr and info.cls \
+                    and self._joined_in_shutdown(index, info.cls, attr):
+                continue
+            where = (f"self.{attr}" if attr
+                     else (name or "the thread object"))
+            yield ctx.finding(self.name, node, (
+                f"threading.Thread started without daemon=True and "
+                f"{where} is never join()ed from a close()/stop()/"
+                f"atexit path — a live non-daemon thread hangs "
+                f"interpreter shutdown. Pass daemon=True or join it "
+                f"in a shutdown method."))
+
+    def _bound_name(self, frame, call) -> Optional[str]:
+        for node in frame:
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        return t.id
+        return None
+
+    def _bound_self_attr(self, frame, call) -> Optional[str]:
+        for node in frame:
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        return t.attr
+        return None
+
+    def _handled_locally(self, frame, name: str) -> bool:
+        """`t.join()`, `t.daemon = True`, `t.setDaemon(True)`, or
+        `return t` (caller takes over the lifecycle) anywhere in the
+        same frame."""
+        for node in frame:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name \
+                    and node.func.attr in ("join", "setDaemon"):
+                return True
+            if isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == name:
+                return True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "daemon" \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == name:
+                        return True
+        return False
+
+    def _appended_attr(self, frame, name: str) -> Optional[str]:
+        """`self.<attr>.append(t)` — the thread joins a collection a
+        shutdown method may drain."""
+        for node in frame:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "append" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == name:
+                base = node.func.value
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    return base.attr
+        return None
+
+    def _joined_in_shutdown(self, index, cls_qual, attr) -> bool:
+        """Some shutdown-ish method (or atexit hook) of the class both
+        touches self.<attr> and calls .join() — covers the
+        `t, self._thread = self._thread, None; t.join()` swap idiom."""
+        info = index.classes.get(cls_qual)
+        if info is None:
+            return False
+        for mname, mqual in info.methods.items():
+            finfo = index.functions.get(mqual)
+            if finfo is None:
+                continue
+            shutdownish = any(s in mname.lower() for s in _SHUTDOWNISH)
+            if not shutdownish and mqual not in index.entry_points:
+                continue
+            if not shutdownish \
+                    and index.entry_points[mqual].kind != "atexit":
+                continue
+            touches = joins = False
+            for node in iter_own_frame(finfo.node):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == attr \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    touches = True
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "join":
+                    joins = True
+            if touches and joins:
+                return True
+        return False

@@ -60,9 +60,20 @@ class DonatedArgReuseRule(Rule):
                    "position of a jitted call — the buffer was handed "
                    "to XLA and deleted; reads raise or alias reused "
                    "memory")
+    hazard = ("Passing a value in a `donate_argnums` position hands "
+              "its device buffer to XLA for reuse; any later read of "
+              "the Python name raises a deleted-buffer error — or, "
+              "on some backends, silently observes the new result's "
+              "bytes.")
+    example = ("`new = step(params, batch)` with `donate_argnums=(0,)`"
+               " followed by `loss_of(params)`")
+    fix = ("Rebind immediately (`params = step(params, batch)`) or "
+           "copy before the call if the old value is still needed.")
 
     def check(self, ctx):
-        for node in ast.walk(ctx.tree):
+        if "donate" not in ctx.source:  # no donate_argnums anywhere
+            return
+        for node in ctx.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Module)):
                 yield from self._scan_scope(ctx, node)

@@ -35,37 +35,43 @@ _FLAG_EXACT = re.compile(r"^FLAGS_\w+$")
 _FLAG_TOKEN = re.compile(r"FLAGS_\w+")
 
 
-def _uses_in_tree(tree: ast.AST) -> List[Tuple[str, int, int]]:
+def _uses_in_tree(tree: ast.AST, nodes=None
+                  ) -> List[Tuple[str, int, int]]:
     """(flag, line, col) for every exact-match use in a Python AST:
     string constants (get_flag args, env/set_flags dict keys, environ
     subscripts) and FLAGS_* identifiers. Declaration sites
     (define_flag's first argument) are excluded by the caller."""
     uses: List[Tuple[str, int, int]] = []
+    const_uses: List[ast.Constant] = []
     decl_nodes = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
+    for node in (ast.walk(tree) if nodes is None else nodes):
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, str) \
+                    and _FLAG_EXACT.match(node.value):
+                const_uses.append(node)
+        elif isinstance(node, ast.Name):
+            if _FLAG_EXACT.match(node.id):
+                uses.append((node.id, node.lineno, node.col_offset))
+        elif isinstance(node, ast.Attribute):
+            if _FLAG_EXACT.match(node.attr):
+                uses.append((node.attr, node.lineno,
+                             node.col_offset))
+        elif (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)
                 and node.func.id == "define_flag" and node.args):
             decl_nodes.add(id(node.args[0]))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(
-                node.value, str):
-            if _FLAG_EXACT.match(node.value) \
-                    and id(node) not in decl_nodes:
-                uses.append((node.value, node.lineno,
-                             node.col_offset))
-        elif isinstance(node, ast.Name) and _FLAG_EXACT.match(node.id):
-            uses.append((node.id, node.lineno, node.col_offset))
-        elif isinstance(node, ast.Attribute) \
-                and _FLAG_EXACT.match(node.attr):
-            uses.append((node.attr, node.lineno, node.col_offset))
+    uses.extend((n.value, n.lineno, n.col_offset)
+                for n in const_uses if id(n) not in decl_nodes)
     return uses
 
 
-def _universe_uses(repo_root: str) -> Set[str]:
+def _universe_uses(repo_root: str, parsed=None) -> Set[str]:
     """Flag names used anywhere in the repo's code universe (Python
-    exact-match uses + shell-script tokens)."""
+    exact-match uses + shell-script tokens). `parsed` maps absolute
+    paths to already-loaded FileContexts so scanned files are not
+    parsed twice."""
     used: Set[str] = set()
+    parsed = parsed or {}
     roots = [os.path.join(repo_root, d)
              for d in ("paddle_tpu", "tools", "tests")]
     files: List[str] = []
@@ -80,6 +86,12 @@ def _universe_uses(repo_root: str) -> Set[str]:
                 if n.endswith((".py", ".sh")):
                     files.append(os.path.join(base, n))
     for f in files:
+        ctx = parsed.get(os.path.abspath(f))
+        if ctx is not None:
+            if "FLAGS_" in ctx.source:
+                used.update(u for u, _, _ in
+                            _uses_in_tree(ctx.tree, ctx.nodes))
+            continue
         try:
             with open(f, "r", encoding="utf-8") as fh:
                 src = fh.read()
@@ -87,6 +99,8 @@ def _universe_uses(repo_root: str) -> Set[str]:
             continue
         if f.endswith(".sh"):
             used.update(_FLAG_TOKEN.findall(src))
+            continue
+        if "FLAGS_" not in src:
             continue
         try:
             used.update(u for u, _, _ in _uses_in_tree(ast.parse(src)))
@@ -101,9 +115,18 @@ class FlagHygieneRule(Rule):
     description = ("FLAGS_* read but not declared in framework/"
                    "config.py (typo -> silent default), or declared "
                    "but never read anywhere (dead flag)")
+    hazard = ("A typo'd FLAGS_ read silently returns the default — "
+              "the operator sets the real flag and nothing changes; "
+              "a declared-but-never-read flag is dead weight that "
+              "docs/FLAGS.md keeps advertising.")
+    example = ("`config.flag_value('FLAGS_prefetch_dept')` (typo; "
+               "declared name is FLAGS_prefetch_depth)")
+    fix = ("Declare every flag in framework/config.py with "
+           "define_flag() and read it by the declared name; delete "
+           "declarations nothing reads.")
     project_rule = True
 
-    def check_project(self, ctxs, repo_root):
+    def check_project(self, ctxs, repo_root, index=None):
         config_path = os.path.join(repo_root, CONFIG_RELPATH)
         if not os.path.exists(config_path):
             return
@@ -116,7 +139,9 @@ class FlagHygieneRule(Rule):
         for ctx in ctxs:
             if ctx.relpath == config_rel:
                 config_ctx = ctx
-            for flag, line, col in _uses_in_tree(ctx.tree):
+            if "FLAGS_" not in ctx.source:
+                continue
+            for flag, line, col in _uses_in_tree(ctx.tree, ctx.nodes):
                 if flag not in declared:
                     node = _Pos(line, col)
                     yield ctx.finding(
@@ -129,7 +154,8 @@ class FlagHygieneRule(Rule):
 
         if config_ctx is None:
             return  # partial scan: skip the declared-unread direction
-        used = _universe_uses(repo_root)
+        used = _universe_uses(
+            repo_root, {os.path.abspath(c.path): c for c in ctxs})
         for flag, lineno in sorted(declared.items()):
             if flag not in used:
                 node = _Pos(lineno, 0)

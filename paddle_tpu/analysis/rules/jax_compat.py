@@ -60,6 +60,14 @@ class JaxCompatRule(Rule):
                    "(jax.enable_x64, jax.shard_map, ...) — raises "
                    "AttributeError at runtime, or worse, a guarded "
                    "call site silently falls back to XLA")
+    hazard = ("The repo pins jax 0.4.37; APIs that moved or landed "
+              "later (jax.enable_x64, jax.shard_map, ...) raise "
+              "AttributeError at runtime — or a hasattr-guarded call "
+              "silently takes the slow fallback path on every step.")
+    example = ("`with jax.enable_x64():` (0.4.37 spells it "
+               "`jax.experimental.enable_x64`)")
+    fix = ("Use the 0.4.37 spelling listed in the finding, or wrap "
+           "the new API behind a version probe in one shim module.")
 
     def check(self, ctx):
         imports_paddle = any(
@@ -73,7 +81,7 @@ class JaxCompatRule(Rule):
             return entry.shimmed_in_package and (in_package
                                                  or imports_paddle)
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Attribute):
                 if not isinstance(node.ctx, ast.Load):
                     continue  # shim installation / del

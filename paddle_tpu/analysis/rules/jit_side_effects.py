@@ -51,9 +51,19 @@ class SideEffectUnderJitRule(Rule):
                    "an @jax.jit function — runs once at trace time, "
                    "not per step; record from the eager wrapper or use "
                    "a trace-time-safe instant helper")
+    hazard = ("Python side effects inside an @jax.jit body run once "
+              "at trace time, then never again — the counter records "
+              "1 while the compiled step runs a million times, and "
+              "the dashboard lies.")
+    example = ("`metrics.counter('steps').inc()` inside a function "
+               "decorated with `@jax.jit`")
+    fix = ("Record from the eager caller after the jitted call "
+           "returns, or use a host-callback-style instant helper.")
 
     def check(self, ctx):
-        for node in ast.walk(ctx.tree):
+        if "jit" not in ctx.source:  # no way to decorate without it
+            return
+        for node in ctx.nodes:
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue

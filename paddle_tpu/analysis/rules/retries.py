@@ -87,8 +87,21 @@ class UnboundedRetryRule(Rule):
                    "a collective or decode dispatch with no bound, "
                    "escape, or backoff — a persistent fault becomes a "
                    "silent livelock")
+    hazard = ("A while-True / except / continue loop around a "
+              "collective or decode dispatch turns any persistent "
+              "fault into a livelock: the rank spins forever, looks "
+              "alive to health checks, and starves the fleet.")
+    example = ("`while True: try: psum(...) except Exception: "
+               "continue`")
+    fix = ("Bound the attempts (for _ in range(N)), back off between "
+           "tries, and re-raise or surface the failure after the "
+           "budget is spent.")
 
     def check(self, ctx):
+        src = ctx.source
+        if "decode" not in src and "dispatch" not in src \
+                and not any(u in src for u in UNAMBIGUOUS):
+            return  # nothing retryable to loop over
         yield from self._walk(ctx, ctx.tree, func=None)
 
     def _walk(self, ctx, node, func):

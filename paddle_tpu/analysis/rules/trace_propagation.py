@@ -86,12 +86,25 @@ class RouteHandlerTraceRule(Rule):
                    "and the request forks into orphan timelines), or "
                    "a .begin('phase') leaks past a return the same "
                    "function's .end('phase') was meant to balance")
+    hazard = ("Dropping the inbound X-PT-Trace header forks one "
+              "request into disconnected trace timelines, and an "
+              "unbalanced .begin() leaks an open span that swallows "
+              "everything after it — both corrupt the cross-rank "
+              "request view fleet_report stitches together.")
+    example = ("a register_route handler calling tracing.span(...) "
+               "without tracing.extract(headers) first")
+    fix = ("Call tracing.extract() at the top of every route handler "
+           "and balance each .begin('phase') with .end('phase') on "
+           "every return path (try/finally).")
 
     def check(self, ctx):
-        yield from self._check_handlers(ctx)
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._check_returns(ctx, node)
+        if "register_route" in ctx.source:
+            yield from self._check_handlers(ctx)
+        if "end(" in ctx.source:  # _check_returns needs an .end(...)
+            for node in ctx.nodes:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield from self._check_returns(ctx, node)
 
     # -- check A: register_route handlers must extract() before they
     #             open spans ------------------------------------------

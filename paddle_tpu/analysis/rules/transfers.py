@@ -64,6 +64,15 @@ class SyncTransferInStepLoopRule(Rule):
                    ".block_until_ready() / np.asarray) inside a "
                    "train/serving step loop — re-serializes the work "
                    "the overlap engine hides")
+    hazard = ("A blocking host<->device transfer inside the step loop "
+              "re-serializes exactly the work the async dispatch/"
+              "double-buffering engine exists to overlap — each step "
+              "stalls on PCIe instead of computing.")
+    example = ("`np.asarray(loss)` (or `.block_until_ready()`) every "
+               "iteration of the train step loop")
+    fix = ("Hoist the sync out of the loop, log every N steps, or "
+           "use the async snapshot/overlap helpers so the copy rides "
+           "behind compute.")
 
     def _classify(self, ctx, call: ast.Call):
         """Which sync-transfer kind this call is, or None."""
@@ -89,6 +98,10 @@ class SyncTransferInStepLoopRule(Rule):
         return None
 
     def check(self, ctx):
+        src = ctx.source
+        if "device_put" not in src and "block_until_ready" not in src \
+                and "asarray" not in src:
+            return  # _classify can only name those three kinds
         yield from self._walk(ctx, ctx.tree, hot=None)
 
     def _walk(self, ctx, node, hot):

@@ -55,7 +55,7 @@ def _kernel_names(ctx) -> Set[str]:
     Name value in the dict counts as reachable)."""
     partial_of: Dict[str, str] = {}
     dict_alias: Dict[str, Set[str]] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not (isinstance(node, ast.Assign)
                 and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)):
@@ -78,7 +78,7 @@ def _kernel_names(ctx) -> Set[str]:
         return dict_alias.get(name, {name})
 
     names: Set[str] = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         fn = ctx.imports.expand(node.func) or ""
@@ -103,11 +103,21 @@ class WeakFloatInKernelRule(Rule):
     description = ("bare Python float literal in arithmetic inside a "
                    "Pallas kernel body — lowers as f64 under the "
                    "package's global x64 mode; wrap it: np.float32(...)")
+    hazard = ("Under the package's global x64 mode a bare float "
+              "literal in Pallas kernel arithmetic promotes the "
+              "expression to f64 — doubling register/VMEM pressure "
+              "and halving throughput, with no error to notice.")
+    example = ("`acc = acc * 0.5 + x` inside a pl.pallas_call kernel "
+               "body")
+    fix = ("Wrap every literal: `np.float32(0.5)` (or a module-level "
+           "f32 constant) so the expression stays in f32.")
 
     def check(self, ctx):
+        if "pallas" not in ctx.source and "_kernel" not in ctx.source:
+            return  # no way to name a kernel without either token
         called = _kernel_names(ctx)
         kernels: List[ast.FunctionDef] = [
-            node for node in ast.walk(ctx.tree)
+            node for node in ctx.nodes
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
             and (node.name.endswith("_kernel") or node.name in called)]
         for fn in kernels:

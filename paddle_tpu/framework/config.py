@@ -536,6 +536,30 @@ define_flag("FLAGS_requestlog_capacity", 2048,
             "fleet_report's usage-per-tenant section) only sees what "
             "the ring still holds — raise it on long-lived replicas "
             "so billing windows aren't truncated.", type_=int)
+define_flag("FLAGS_lockwatch", 0,
+            "Runtime lock instrumentation "
+            "(observability/lockwatch.py): when on, the locks the "
+            "shared-state owners create through the lockwatch "
+            "factories (metrics registry, httpd route/engine tables, "
+            "fleet exporter, router policy, serving replica) measure "
+            "per-acquire wait and hold times "
+            "(lock_wait_seconds_total{lock} / lock_hold_seconds{lock} "
+            "appended to /metrics and fleet shards, surfaced in "
+            "/statusz and fleet_report's lock-contention section) and "
+            "maintain the runtime lock-order graph from per-thread "
+            "held-sets: an observed ABBA inversion — two locks taken "
+            "in opposite orders anywhere in the process's lifetime, "
+            "no deadlock required — raises a flight-recorder verdict "
+            "citing the static lock-order-cycle rule plus "
+            "lockwatch_inversions_total. Off (default) the factories "
+            "return plain threading primitives: one flag read at "
+            "lock creation, zero per-acquire overhead. Read at lock "
+            "CREATION time — set the env var (or set_flags) before "
+            "building the engine/server. Pinned by "
+            "tests/test_lockwatch.py; tools/lockwatch_smoke.py is "
+            "the CI gate (synthetic ABBA must be caught, real "
+            "scrape-vs-decode stress must stay inversion-free).",
+            type_=int)
 
 
 # ---------------------------------------------------------------------------

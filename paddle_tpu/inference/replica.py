@@ -34,6 +34,7 @@ import numpy as np
 
 from ..observability import flight_recorder as _flight
 from ..observability import httpd as _httpd
+from ..observability import lockwatch as _lockwatch
 from ..observability import tracing as _tracing
 from . import kv_fabric as _fab
 
@@ -56,8 +57,8 @@ class ReplicaServer:
         self.engine = engine
         self.poll_s = float(poll_s)
         self.route = route
-        self._lock = threading.RLock()   # engine access: loop vs submit
-        self._cv = threading.Condition(threading.Lock())
+        self._lock = _lockwatch.rlock("replica.engine")  # loop vs submit
+        self._cv = _lockwatch.condition("replica.results_cv")
         self._results: Dict[int, dict] = {}
         self._ttft: Dict[int, float] = {}   # rid -> perf_counter at
         self._t_sub: Dict[int, float] = {}  # first token / at submit

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..framework import config as _cfg
 from ..observability import flight_recorder as _flight
+from ..observability import lockwatch as _lockwatch
 from ..observability import metrics as _om
 from ..observability import slo as _slo
 from ..observability import tracing as _trace
@@ -455,10 +456,10 @@ class Router:
             objectives=tuple(_slo.default_objectives())
             + tuple(_slo.router_objectives()))
         self._q: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = _lockwatch.condition("router.queue_cv")
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._policy_lock = threading.Lock()
+        self._policy_lock = _lockwatch.lock("router.policy")
         # request-aware policies (cache_affinity) declare a third
         # choose() parameter; inspect ONCE so the dispatch path stays
         # a plain call either way

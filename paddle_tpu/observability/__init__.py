@@ -50,6 +50,16 @@ recorder + span tracer (SURVEY.md §5 "Metrics / logging").
 - `device_peaks` — the ONE per-chip bf16-peak-FLOPs / HBM-bandwidth
   table shared by PerfMeter's MFU gauge, bench.py, tools/mfu_sweep.py,
   and the stepledger roofline.
+- `lockwatch` — runtime deadlock detector + lock contention telemetry
+  (ninth channel, `FLAGS_lockwatch`, the dynamic half of the tpu-lint
+  concurrency rules): instrumented Lock/RLock/Condition factories
+  adopted by the metrics registry, httpd, fleet exporter, router and
+  replica; per-lock wait/hold stats, the runtime lock-order graph, and
+  ABBA-inversion verdicts (flight-recorder event + cycle chains citing
+  the static `lock-order-cycle` rule) detected from *sequential*
+  executions — no actual deadlock required. Exposition feeds /statusz
+  and the fleet report's "lock contention per rank" section; off path
+  returns plain threading primitives (flag read at creation time).
 
 The channels correlate: spans and flight-recorder breadcrumbs carry
 the same `rid`/`trace_id` fields, the watchdog stall dump appends the
@@ -79,6 +89,7 @@ from . import compilewatch  # noqa: F401  (compile counts + storm detect)
 from . import device_peaks  # noqa: F401  (the shared per-chip peak table)
 from . import fleet  # noqa: F401  (rank-sharded export + aggregation)
 from . import httpd  # noqa: F401  (per-rank HTTP exposition plane)
+from . import lockwatch  # noqa: F401  (runtime deadlock detector)
 from . import memwatch  # noqa: F401  (HBM accounting + OOM forensics)
 from . import slo  # noqa: F401  (SLO objectives + burn-rate alerts)
 from . import stepledger  # noqa: F401  (step-time ledger + roofline)

@@ -61,6 +61,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import lockwatch as _lockwatch
 from . import metrics as _metrics
 
 SHARD_FILES = ("metrics.prom", "memory.prom", "ledger.prom",
@@ -307,9 +308,16 @@ class FleetExporter:
                 _slo.collect()
             except Exception:  # noqa: BLE001 — telemetry never takes
                 pass           # the flusher down
+        text = _metrics.to_prometheus(reg, const_labels=const)
+        try:
+            # lockwatch contention/inversion families ride the shard
+            # exposition (appended outside the registry — see
+            # observability/lockwatch.py)
+            text += _lockwatch.exposition(const_labels=const)
+        except Exception:  # noqa: BLE001
+            pass
         _metrics.atomic_write(
-            os.path.join(self.shard_dir, "metrics.prom"),
-            _metrics.to_prometheus(reg, const_labels=const))
+            os.path.join(self.shard_dir, "metrics.prom"), text)
 
         from . import memwatch as _memwatch
 
@@ -416,7 +424,7 @@ class FleetExporter:
 
 
 _exporter: Optional[FleetExporter] = None
-_exporter_lock = threading.Lock()
+_exporter_lock = _lockwatch.lock("fleet.exporter")
 
 
 def exporter() -> Optional[FleetExporter]:
@@ -976,6 +984,50 @@ def slo_table(shards: Dict[int, str]) -> List[dict]:
     return out
 
 
+def lockwatch_table(shards: Dict[int, str]) -> List[dict]:
+    """One row per rank from the lockwatch families in its
+    metrics.prom shard (FLAGS_lockwatch=1 on that rank): per-lock
+    wait/acquire/hold-mean contention plus the observed ABBA
+    inversion count. Ranks that never exported a lockwatch family are
+    omitted (empty list when the fleet runs with lockwatch off)."""
+    out = []
+    for rank, path in sorted(shards.items()):
+        try:
+            with open(os.path.join(path, "metrics.prom")) as fh:
+                samples = _parse_prom_samples(fh.read())
+        except OSError:
+            continue
+        inv = _total(samples, "lockwatch_inversions_total")
+        if inv is None:
+            continue
+        waits = {labels.get("lock"): v for labels, v in
+                 samples.get("lock_wait_seconds_total", [])
+                 if labels.get("lock")}
+        acqs = {labels.get("lock"): v for labels, v in
+                samples.get("lock_acquires_total", [])
+                if labels.get("lock")}
+        hsum = {labels.get("lock"): v for labels, v in
+                samples.get("lock_hold_seconds_sum", [])
+                if labels.get("lock")}
+        hcount = {labels.get("lock"): v for labels, v in
+                  samples.get("lock_hold_seconds_count", [])
+                  if labels.get("lock")}
+        locks = []
+        for name in sorted(waits):
+            n = hcount.get(name, 0.0)
+            locks.append({
+                "lock": name,
+                "wait_s": waits[name],
+                "acquires": acqs.get(name, 0.0),
+                "hold_mean_ms": (1e3 * hsum.get(name, 0.0) / n)
+                if n else 0.0,
+            })
+        locks.sort(key=lambda r: -r["wait_s"])
+        out.append({"rank": rank, "inversions": int(inv),
+                    "locks": locks})
+    return out
+
+
 def history_table(shards: Dict[int, str], burn_threshold: float = 1.0,
                   sustain: int = 3) -> List[dict]:
     """One row per rank from its history.jsonl shard (the time-series
@@ -1392,7 +1444,8 @@ def aggregate(root: str, out_dir: Optional[str] = None,
                     "hbm": {"ranks": [], "median_frac": None,
                             "median_bytes": None, "skewed": []},
                     "ledger": [], "slo": [], "history": [],
-                    "anomalies": [], "usage": {}, "artifacts": {}}
+                    "anomalies": [], "usage": {}, "lockwatch": [],
+                    "artifacts": {}}
     if not shards:
         return report
     heartbeats = load_heartbeats(shards)
@@ -1418,6 +1471,7 @@ def aggregate(root: str, out_dir: Optional[str] = None,
         "recoveries": recoveries_table(shards),
         "anomalies": anomaly_table(shards),
         "usage": usage_table(shards),
+        "lockwatch": lockwatch_table(shards),
         "artifacts": {
             "prom": prom_path,
             "trace": trace_path,
@@ -1662,6 +1716,33 @@ def format_report(report: dict) -> str:
                     f"the error_rate SLO burned on these; check its "
                     f"flight recorder (serving.recovery_drop / "
                     f"serving.poisoned events)")
+        lines.append("")
+    lw_rows = report.get("lockwatch") or []
+    if lw_rows:
+        lines.append("")
+        lines.append("== lock contention per rank (lockwatch; "
+                     "FLAGS_lockwatch=1 on the rank) ==")
+        lines.append(f"{'rank':>5} {'lock':<22} {'acquires':>9} "
+                     f"{'wait_s':>9} {'hold_mean_ms':>13}")
+        for r in lw_rows:
+            for lk in r["locks"][:8]:
+                lines.append(
+                    f"{r['rank']:>5} {lk['lock']:<22} "
+                    f"{int(lk['acquires']):>9} "
+                    f"{lk['wait_s']:>9.4f} "
+                    f"{lk['hold_mean_ms']:>13.4f}")
+        for r in lw_rows:
+            if r["inversions"]:
+                lines.append(
+                    f"LOCK INVERSION: rank {r['rank']} observed "
+                    f"{r['inversions']} ABBA lock-order inversion(s) "
+                    f"at runtime — two locks taken in opposite orders"
+                    f"; interleaved threads deadlock there. The rank's"
+                    f" flight recorder (lockwatch.inversion events, "
+                    f"/statusz lockwatch section) names the cycle; "
+                    f"the tpu-lint rule `lock-order-cycle` finds the "
+                    f"order statically: `python tools/tpu_lint.py "
+                    f"--select lock-order-cycle paddle_tpu/`")
         lines.append("")
     usage = report.get("usage") or {}
     if usage.get("tenants"):

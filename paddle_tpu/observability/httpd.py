@@ -71,6 +71,7 @@ from typing import List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from . import flight_recorder as _flight
+from . import lockwatch as _lockwatch
 from . import metrics as _metrics
 from . import requestlog as _reqlog
 from . import slo as _slo
@@ -107,7 +108,7 @@ def stale_s() -> float:
 # ---------------------------------------------------------------------------
 
 _engines: List[weakref.ref] = []
-_engines_lock = threading.Lock()
+_engines_lock = _lockwatch.lock("httpd.engines")
 
 
 def track_engine(engine):
@@ -137,7 +138,7 @@ def tracked_engines() -> list:
 # ---------------------------------------------------------------------------
 
 _routes: dict = {}  # path -> handler(method, query, body) -> (code, bytes, ctype)
-_routes_lock = threading.Lock()
+_routes_lock = _lockwatch.lock("httpd.routes")
 
 
 def register_route(path: str, handler):
@@ -380,6 +381,7 @@ def statusz_payload(registry: Optional[_metrics.Registry] = None
         "load_score": _slo.load_score(registry=reg),
         "slo": _slo.default_engine().last_report,
         "ledger": _stepledger.waterfall(),
+        "lockwatch": _lockwatch.status(),
         "canary": _canary.status(),
         "anomalies": _anomaly.latest(),
         "heartbeat": _fleet.last_beat(),
@@ -521,6 +523,13 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
             with reg.lock:
                 text = _metrics.to_prometheus(reg)
+            # lockwatch families ride the same scrape, appended
+            # OUTSIDE the registry (the instrument that watches the
+            # registry's own lock must not create registry traffic)
+            try:
+                text += _lockwatch.exposition()
+            except Exception:  # noqa: BLE001
+                pass
             return (200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", None)
         if path == "/healthz":
@@ -654,7 +663,7 @@ class TelemetryServer:
 
 
 _server: Optional[TelemetryServer] = None
-_server_lock = threading.Lock()
+_server_lock = _lockwatch.lock("httpd.server")
 _start_failed = False
 
 

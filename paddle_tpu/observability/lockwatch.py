@@ -1,0 +1,450 @@
+"""lockwatch: runtime lock instrumentation — the dynamic half of the
+concurrency plane (the static half is tpu-lint's concurrency rules in
+paddle_tpu/analysis/rules/concurrency.py; each cites the other).
+
+``lock(name)`` / ``rlock(name)`` / ``condition(name)`` are drop-in
+factories adopted by the hot shared-state owners (metrics registry,
+httpd route/engine tables, fleet exporter, router policy, serving
+replica). Off (`FLAGS_lockwatch`, the default) they return plain
+``threading`` primitives — one flag read at construction, zero
+per-acquire overhead, zero allocations. On, every watched lock:
+
+- measures wait time (contention) and hold time per acquisition —
+  exported as ``lock_wait_seconds_total{lock}`` /
+  ``lock_hold_seconds{lock}`` appended to /metrics and the fleet
+  shard exposition, surfaced in /statusz and fleet_report's
+  "lock contention per rank" section;
+- maintains the process-wide *runtime lock-order graph* from each
+  thread's held-set: acquiring B while holding A adds edge A->B. The
+  first edge that closes a cycle is an observed ABBA inversion — no
+  actual deadlock required, the two orders just have to happen, even
+  sequentially — and raises a flight-recorder verdict
+  (``lockwatch.inversion``) citing the static `lock-order-cycle`
+  rule, plus ``lockwatch_inversions_total``.
+
+Implementation discipline: per-lock stats are mutated only by the
+thread currently *holding* that lock (single writer, no extra lock);
+the order graph and inversion list live under one internal leaf lock
+(``_guts``) that never acquires anything else, so lockwatch itself
+cannot deadlock or recurse into the registry it instruments.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+FLAG = "FLAGS_lockwatch"
+
+# hold-duration buckets (seconds): 50us .. 5s, lock holds are short
+HOLD_BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0,
+                5.0)
+_MAX_INVERSIONS = 64
+
+_guts = threading.Lock()  # leaf lock: order graph + inversion list
+_locks: Dict[str, "_LockStats"] = {}
+_edges: Dict[str, Dict[str, dict]] = {}
+_inversions: List[dict] = []
+_inversions_total = 0
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """Read FLAGS_lockwatch through framework.config when it is
+    loaded (honors set_flags at runtime), falling back to the raw
+    env var so tools can flip it before any paddle_tpu import."""
+    cfg = sys.modules.get("paddle_tpu.framework.config")
+    if cfg is not None:
+        try:
+            return bool(int(cfg.get_flag(FLAG, 0) or 0))
+        except (TypeError, ValueError):
+            return True  # set to something truthy but non-numeric
+    return os.environ.get(FLAG, "") not in ("", "0", "false", "False")
+
+
+# -- factories --------------------------------------------------------
+def lock(name: str):
+    """A Lock, watched when FLAGS_lockwatch is on at creation."""
+    if not enabled():
+        return threading.Lock()
+    return _WatchedLock(name)
+
+
+def rlock(name: str):
+    if not enabled():
+        return threading.RLock()
+    return _WatchedRLock(name)
+
+
+def condition(name: str) -> threading.Condition:
+    """A Condition whose underlying lock is watched — wait() shows up
+    as a release + re-acquire, exactly what happens."""
+    if not enabled():
+        return threading.Condition(threading.Lock())
+    return threading.Condition(_WatchedLock(name))
+
+
+# -- internals --------------------------------------------------------
+class _LockStats:
+    """Per-name stats row. Mutated only while holding the named lock
+    (single writer); readers derive count from the bucket copy, the
+    same torn-read-proof trick metrics.Histogram.state() uses."""
+
+    __slots__ = ("name", "acquires", "contended", "wait_total",
+                 "hold_sum", "hold_buckets")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.acquires = 0
+        self.contended = 0
+        self.wait_total = 0.0
+        self.hold_sum = 0.0
+        self.hold_buckets = [0] * (len(HOLD_BUCKETS) + 1)
+
+    def record_wait(self, wait: float, contended: bool):
+        self.acquires += 1
+        self.wait_total += wait
+        if contended:
+            self.contended += 1
+
+    def record_hold(self, hold: float):
+        i = 0
+        while i < len(HOLD_BUCKETS) and hold > HOLD_BUCKETS[i]:
+            i += 1
+        self.hold_buckets[i] += 1
+        self.hold_sum += hold
+
+    def snapshot(self) -> dict:
+        counts = list(self.hold_buckets)
+        count = sum(counts)
+        return {"name": self.name, "acquires": self.acquires,
+                "contended": self.contended,
+                "wait_s": self.wait_total,
+                "hold_s": self.hold_sum, "holds": count,
+                "hold_buckets": counts}
+
+
+def _stats_for(name: str) -> _LockStats:
+    with _guts:
+        return _locks.setdefault(name, _LockStats(name))
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _on_acquired(stats: _LockStats, wait: float, contended: bool):
+    """Record the acquire, extend the order graph from this thread's
+    held-set, and detect a closed cycle (= ABBA inversion)."""
+    held = _held()
+    stats.record_wait(wait, contended)
+    verdict = None
+    if held:
+        with _guts:
+            for hname, _t0, _s in held:
+                if hname != stats.name:
+                    verdict = _note_edge(hname, stats.name, held) \
+                        or verdict
+    held.append((stats.name, time.perf_counter(), stats))
+    if verdict is not None:
+        _emit_verdict(verdict)
+
+
+def _note_edge(a: str, b: str, held) -> Optional[dict]:
+    """Add edge a->b (holding a, acquiring b). Returns an inversion
+    verdict when the new edge closes a cycle. Caller holds _guts."""
+    global _inversions_total
+    row = _edges.setdefault(a, {})
+    if b in row:
+        row[b]["count"] += 1
+        return None
+    path = _find_path(b, a)
+    row[b] = {"count": 1, "thread": threading.current_thread().name,
+              "held": [h[0] for h in held]}
+    if path is None:
+        return None
+    cycle = [a] + path  # a -> b -> ... -> a (path already ends at a)
+    verdict = {
+        "locks": sorted((a, b)),
+        "cycle": " -> ".join(cycle),
+        "thread": threading.current_thread().name,
+        "held": [h[0] for h in held],
+        "acquiring": b,
+        "ts": time.time(),
+        "hint": (f"ABBA lock-order inversion observed live: this "
+                 f"thread holds {a} and acquired {b}, but the "
+                 f"opposite order {' -> '.join(path)} was also "
+                 f"taken. Interleaved threads deadlock here. The "
+                 f"static rule lock-order-cycle (tools/tpu_lint.py) "
+                 f"finds these orders at review time."),
+    }
+    _inversions_total += 1
+    if len(_inversions) < _MAX_INVERSIONS:
+        _inversions.append(verdict)
+    return verdict
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """BFS src -> dst through the order graph; path as [src, .., dst].
+    Caller holds _guts."""
+    if src not in _edges:
+        return None
+    parent = {src: None}
+    frontier = [src]
+    while frontier:
+        cur = frontier.pop(0)
+        if cur == dst:
+            path = []
+            while cur is not None:
+                path.append(cur)
+                cur = parent[cur]
+            return path[::-1]
+        for nxt in _edges.get(cur, ()):
+            if nxt not in parent:
+                parent[nxt] = cur
+                frontier.append(nxt)
+    return None
+
+
+def _emit_verdict(verdict: dict):
+    """Flight-recorder event outside _guts (leaf-lock discipline)."""
+    try:
+        from . import flight_recorder as _flight
+
+        _flight.record_event("lockwatch.inversion",
+                             locks=" <-> ".join(verdict["locks"]),
+                             cycle=verdict["cycle"],
+                             thread=verdict["thread"],
+                             hint=verdict["hint"])
+    except Exception:  # noqa: BLE001 — telemetry must not take down
+        pass           # the locking it observes
+
+
+def _on_released(stats: _LockStats, t_rel: float):
+    """Pop this thread's held entry and record the hold time (called
+    while still holding the lock, so stats writes are single-writer).
+    A lock released by a thread that never acquired it (legal for
+    Lock) just skips hold accounting."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][2] is stats:
+            _, t0, _ = held.pop(i)
+            stats.record_hold(t_rel - t0)
+            return
+
+
+class _WatchedLock:
+    """Instrumented threading.Lock."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = threading.Lock()
+        self._stats = _stats_for(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.perf_counter()
+        contended = self._inner.locked()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _on_acquired(self._stats, time.perf_counter() - t0,
+                         contended)
+        return ok
+
+    def release(self):
+        t_rel = time.perf_counter()
+        _on_released(self._stats, t_rel)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockwatch.Lock {self._name!r}>"
+
+
+class _WatchedRLock:
+    """Instrumented threading.RLock: re-entrant acquires bump a depth
+    counter and record nothing — one logical hold, no self-edges."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = threading.RLock()
+        self._stats = _stats_for(name)
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if self._owner == me:
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+            return ok
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            wait = time.perf_counter() - t0
+            self._owner = me
+            self._depth = 1
+            _on_acquired(self._stats, wait, contended=wait > 0.0001)
+        return ok
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            self._inner.release()  # raises the standard RuntimeError
+            return
+        if self._depth == 1:
+            t_rel = time.perf_counter()
+            _on_released(self._stats, t_rel)
+            self._owner = None
+            self._depth = 0
+        else:
+            self._depth -= 1
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockwatch.RLock {self._name!r}>"
+
+
+# -- views ------------------------------------------------------------
+def inversions_total() -> int:
+    return _inversions_total
+
+
+def inversions() -> List[dict]:
+    with _guts:
+        return [dict(v) for v in _inversions]
+
+
+def state() -> dict:
+    """Full dump for tests and /statusz: per-lock stats, the order
+    graph, and every recorded inversion verdict."""
+    with _guts:
+        edges = {a: {b: dict(ev) for b, ev in row.items()}
+                 for a, row in _edges.items()}
+        inv = [dict(v) for v in _inversions]
+        stats = [s.snapshot() for s in _locks.values()
+                 if s.acquires]  # zeroed-by-reset rows stay hidden
+    return {"enabled": enabled(),
+            "locks": sorted(stats, key=lambda s: -s["wait_s"]),
+            "edges": edges,
+            "inversions": inv,
+            "inversions_total": _inversions_total}
+
+
+def status() -> dict:
+    """Compact /statusz section."""
+    st = state()
+    return {
+        "enabled": st["enabled"],
+        "inversions_total": st["inversions_total"],
+        "inversions": st["inversions"][:8],
+        "edges": sum(len(r) for r in st["edges"].values()),
+        "locks": {
+            s["name"]: {
+                "acquires": s["acquires"],
+                "contended": s["contended"],
+                "wait_s": round(s["wait_s"], 6),
+                "hold_mean_ms": round(
+                    1e3 * s["hold_s"] / s["holds"], 4)
+                if s["holds"] else 0.0,
+            } for s in st["locks"]
+        },
+    }
+
+
+def exposition(const_labels: Optional[Dict[str, str]] = None) -> str:
+    """Prometheus text block appended to /metrics and the fleet shard
+    exposition (outside the metrics registry on purpose: zero
+    registry traffic from the instrument that watches the registry's
+    own lock)."""
+    with _guts:
+        stats = [s.snapshot() for s in _locks.values() if s.acquires]
+    if not stats and not enabled():
+        return ""
+    from . import metrics as _metrics
+
+    const = dict(const_labels if const_labels is not None
+                 else _metrics.fleet_labels())
+    fmt_l, fmt_f = _metrics._fmt_labels, _metrics._fmt_float
+    out = [
+        "# HELP lockwatch_inversions_total Observed ABBA lock-order "
+        "inversions (see tpu-lint rule lock-order-cycle).",
+        "# TYPE lockwatch_inversions_total counter",
+        f"lockwatch_inversions_total{fmt_l(const)} "
+        f"{fmt_f(_inversions_total)}",
+    ]
+    if stats:
+        out += ["# HELP lock_wait_seconds_total Time threads spent "
+                "blocked acquiring each watched lock.",
+                "# TYPE lock_wait_seconds_total counter"]
+        for s in sorted(stats, key=lambda s: s["name"]):
+            lbl = fmt_l({**const, "lock": s["name"]})
+            out.append(f"lock_wait_seconds_total{lbl} "
+                       f"{fmt_f(s['wait_s'])}")
+        out += ["# HELP lock_acquires_total Acquisitions per watched "
+                "lock.",
+                "# TYPE lock_acquires_total counter"]
+        for s in sorted(stats, key=lambda s: s["name"]):
+            lbl = fmt_l({**const, "lock": s["name"]})
+            out.append(f"lock_acquires_total{lbl} "
+                       f"{fmt_f(s['acquires'])}")
+        out += ["# HELP lock_hold_seconds Hold duration per watched "
+                "lock.",
+                "# TYPE lock_hold_seconds histogram"]
+        for s in sorted(stats, key=lambda s: s["name"]):
+            acc = 0
+            for i, ub in enumerate(HOLD_BUCKETS):
+                acc += s["hold_buckets"][i]
+                lbl = fmt_l({**const, "lock": s["name"],
+                             "le": fmt_f(ub)})
+                out.append(f"lock_hold_seconds_bucket{lbl} {acc}")
+            lbl = fmt_l({**const, "lock": s["name"], "le": "+Inf"})
+            out.append(f"lock_hold_seconds_bucket{lbl} {s['holds']}")
+            lbl = fmt_l({**const, "lock": s["name"]})
+            out.append(f"lock_hold_seconds_sum{lbl} "
+                       f"{fmt_f(s['hold_s'])}")
+            out.append(f"lock_hold_seconds_count{lbl} {s['holds']}")
+    return "\n".join(out) + "\n"
+
+
+def reset_for_tests():
+    """Zero all global lockwatch state IN PLACE: watched locks created
+    earlier (e.g. the default metrics registry's, at import) keep
+    their stats rows and start counting from zero again. Zeroed rows
+    drop out of state()/exposition() until re-acquired."""
+    global _inversions_total
+    with _guts:
+        _edges.clear()
+        _inversions.clear()
+        _inversions_total = 0
+        for s in _locks.values():
+            s.acquires = 0
+            s.contended = 0
+            s.wait_total = 0.0
+            s.hold_sum = 0.0
+            s.hold_buckets = [0] * (len(HOLD_BUCKETS) + 1)
+    _tls.held = []

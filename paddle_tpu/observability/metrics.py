@@ -213,7 +213,9 @@ class Registry:
     can resolve its handles independently."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        from . import lockwatch as _lockwatch  # lazy: leaf module
+
+        self._lock = _lockwatch.rlock("metrics.registry")
         self._families: Dict[str, _Family] = {}
         # counts every family AND child cell ever created — the
         # instrumentation-overhead tests assert a hot loop adds zero

@@ -1,0 +1,95 @@
+"""Negative fixture for the concurrency rules: every shape here is a
+near-miss of a hazard and must stay clean.
+
+- writes all under the lock (or no majority discipline to infer)
+- nested locks always taken in the same global order
+- threads either daemon, joined in stop(), or handed to the caller
+"""
+import threading
+
+_outer = threading.Lock()
+_inner = threading.Lock()
+
+
+def consistent_one():
+    with _outer:
+        with _inner:
+            return 1
+
+
+def consistent_two():
+    with _outer:
+        with _inner:
+            return 2
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def add(self, k):
+        with self._lock:
+            self._n += k
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._n = 0
+
+
+class NoMajority:
+    """Two bare writes, one guarded: no discipline to infer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def guarded(self):
+        with self._lock:
+            self._m += 1
+
+    def bare_a(self):
+        self._m = 1
+
+    def _loop(self):
+        self._m = 2
+
+
+def spawn_for_caller():
+    """Returning the thread hands lifecycle to the caller: clean."""
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    return t
+
+
+class JoinedOnStop:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

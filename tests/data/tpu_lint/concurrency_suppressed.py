@@ -1,0 +1,44 @@
+"""Fixture: all three concurrency hazards present and pragma'd — the
+lint must report nothing here (proving per-line suppression reaches
+project rules, whose findings are produced far from the file walk)."""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = None
+
+    def start(self):
+        # lifecycle is owned by the embedding harness, which joins it
+        self._thread = threading.Thread(target=self._loop)  # tpu-lint: disable=thread-lifecycle
+        self._thread.start()
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def add(self, k):
+        with self._lock:
+            self._n += k
+
+    def _loop(self):
+        while True:
+            # benign: torn zero is re-corrected by the next inc()
+            self._n = 0  # tpu-lint: disable=unlocked-shared-write
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:  # tpu-lint: disable=lock-order-cycle
+            return 1
+
+
+def backward():
+    with _lock_b:
+        with _lock_a:  # tpu-lint: disable=lock-order-cycle
+            return 2

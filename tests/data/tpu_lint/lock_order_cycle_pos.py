@@ -1,0 +1,22 @@
+"""Seeded positive for lock-order-cycle: A-then-B directly, B-then-A
+through an innocent helper call — the interprocedural ABBA shape."""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:  # BAD: A -> B here, B -> A below
+            return 1
+
+
+def _grab_a():
+    with _lock_a:
+        return 2
+
+
+def backward():
+    with _lock_b:
+        return _grab_a()

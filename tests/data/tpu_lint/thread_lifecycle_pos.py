@@ -1,0 +1,35 @@
+"""Seeded positive for thread-lifecycle: non-daemon thread whose
+stop() forgets to join it; the twin below joins and stays clean."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)  # BAD
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass  # forgot the join
+
+
+class GoodWorker:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

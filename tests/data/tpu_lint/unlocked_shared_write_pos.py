@@ -1,0 +1,28 @@
+"""Seeded positive for unlocked-shared-write: `_n` is written under
+`self._lock` at two sites (the majority discipline) but reset bare
+inside the thread loop — the Histogram-tearing shape."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def add(self, k):
+        with self._lock:
+            self._n += k
+
+    def _loop(self):
+        while True:
+            self._n = 0  # BAD: bare write on the thread path

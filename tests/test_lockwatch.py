@@ -1,0 +1,323 @@
+"""Lockwatch (observability/lockwatch.py): the runtime half of the
+concurrency plane. Off path returns plain threading primitives; on
+path measures wait/hold per lock, maintains the runtime lock-order
+graph, detects ABBA inversions from *sequential* executions (no
+actual deadlock needed), raises flight-recorder verdicts citing the
+static lock-order-cycle rule, and exports families the fleet
+aggregator parses into the "lock contention per rank" report section.
+"""
+import os
+import threading
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import fleet as fleet_mod
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.observability import lockwatch as lw
+
+
+@pytest.fixture
+def lockwatch_on():
+    """FLAGS_lockwatch on with global lockwatch state reset on both
+    sides (the order graph and stats are process-wide)."""
+    prev = paddle.get_flags(["FLAGS_lockwatch"])
+    paddle.set_flags({"FLAGS_lockwatch": 1})
+    lw.reset_for_tests()
+    yield
+    lw.reset_for_tests()
+    paddle.set_flags(prev)
+
+
+# ---------------------------------------------------------------------------
+# off path: plain primitives, zero instrumentation
+# ---------------------------------------------------------------------------
+
+def test_off_returns_plain_threading_primitives():
+    prev = paddle.get_flags(["FLAGS_lockwatch"])
+    paddle.set_flags({"FLAGS_lockwatch": 0})
+    try:
+        assert type(lw.lock("x")) is type(threading.Lock())
+        assert type(lw.rlock("x")) is type(threading.RLock())
+        cv = lw.condition("x")
+        assert isinstance(cv, threading.Condition)
+        assert type(cv._lock) is type(threading.Lock())
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_flag_is_read_at_creation_time(lockwatch_on):
+    watched = lw.lock("created.on")
+    assert isinstance(watched, lw._WatchedLock)
+    paddle.set_flags({"FLAGS_lockwatch": 0})
+    try:
+        assert type(lw.lock("created.off")) is type(threading.Lock())
+        # the already-created watched lock keeps working either way
+        with watched:
+            pass
+    finally:
+        paddle.set_flags({"FLAGS_lockwatch": 1})
+
+
+# ---------------------------------------------------------------------------
+# stats + order graph
+# ---------------------------------------------------------------------------
+
+def test_wait_and_hold_stats_accumulate(lockwatch_on):
+    a = lw.lock("stats.a")
+    for _ in range(5):
+        with a:
+            pass
+    st = lw.state()
+    (row,) = [s for s in st["locks"] if s["name"] == "stats.a"]
+    assert row["acquires"] == 5
+    assert row["holds"] == 5
+    assert row["hold_s"] >= 0.0
+    assert sum(row["hold_buckets"]) == row["holds"]
+
+
+def test_consistent_order_records_edge_but_no_inversion(lockwatch_on):
+    a, b = lw.lock("ord.a"), lw.lock("ord.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    st = lw.state()
+    assert st["edges"]["ord.a"]["ord.b"]["count"] == 3
+    assert st["inversions_total"] == 0
+
+
+def test_abba_inversion_detected_from_sequential_runs(lockwatch_on):
+    a, b = lw.lock("abba.a"), lw.lock("abba.b")
+    with a:
+        with b:
+            pass
+    # opposite order on the SAME thread, later: no deadlock happens,
+    # but the two orders now coexist in the graph — that is the bug
+    with b:
+        with a:
+            pass
+    assert lw.inversions_total() == 1
+    (v,) = lw.inversions()
+    assert set(v["locks"]) == {"abba.a", "abba.b"}
+    assert "abba.a" in v["cycle"] and "abba.b" in v["cycle"]
+    # the verdict closes the loop back to the static rule
+    assert "lock-order-cycle" in v["hint"]
+    assert "tools/tpu_lint.py" in v["hint"]
+
+
+def test_inversion_raises_flight_recorder_event(lockwatch_on):
+    a, b = lw.lock("fr.a"), lw.lock("fr.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    events = [e for e in flight.default_recorder().tail()
+              if e[1] == "lockwatch.inversion"]
+    assert events, "inversion must reach the flight recorder"
+    fields = events[-1][2]
+    assert "lock-order-cycle" in fields["hint"]
+    assert "fr.a" in fields["cycle"]
+
+
+def test_inversion_detected_across_threads(lockwatch_on):
+    a, b = lw.lock("xt.a"), lw.lock("xt.b")
+
+    def take_ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=take_ab, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    with b:
+        with a:
+            pass
+    assert lw.inversions_total() == 1
+
+
+def test_rlock_reentry_is_one_logical_hold(lockwatch_on):
+    r = lw.rlock("re.r")
+    with r:
+        with r:  # re-entrant: no second acquire recorded, no self-edge
+            pass
+    st = lw.state()
+    (row,) = [s for s in st["locks"] if s["name"] == "re.r"]
+    assert row["acquires"] == 1
+    assert row["holds"] == 1
+    assert "re.r" not in st["edges"]
+    assert st["inversions_total"] == 0
+
+
+def test_condition_wait_notify_roundtrip(lockwatch_on):
+    cv = lw.condition("cv.q")
+    ready = []
+
+    def consumer():
+        with cv:
+            while not ready:
+                cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    with cv:
+        ready.append(1)
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    st = lw.state()
+    (row,) = [s for s in st["locks"] if s["name"] == "cv.q"]
+    assert row["acquires"] >= 2  # producer + consumer (+ re-acquires)
+
+
+# ---------------------------------------------------------------------------
+# exposition + statusz + fleet report
+# ---------------------------------------------------------------------------
+
+def test_exposition_parses_with_fleet_parser(lockwatch_on):
+    a, b = lw.lock("exp.a"), lw.lock("exp.b")
+    for _ in range(4):
+        with a:
+            with b:
+                pass
+    text = lw.exposition(const_labels={"rank": "3"})
+    samples = fleet_mod._parse_prom_samples(text)
+    assert fleet_mod._total(samples, "lockwatch_inversions_total") == 0
+    waits = {lbl["lock"]: v for lbl, v in
+             samples["lock_wait_seconds_total"]}
+    assert set(waits) == {"exp.a", "exp.b"}
+    acquires = {lbl["lock"]: v for lbl, v in
+                samples["lock_acquires_total"]}
+    assert acquires["exp.a"] == 4.0
+    # histogram invariants: buckets cumulative, count == +Inf bucket
+    counts = {lbl["lock"]: v for lbl, v in
+              samples["lock_hold_seconds_count"]}
+    infs = {lbl["lock"]: v for lbl, v in
+            samples["lock_hold_seconds_bucket"]
+            if lbl["le"] == "+Inf"}
+    assert counts == infs
+    for lbl, _v in samples["lock_wait_seconds_total"]:
+        assert lbl["rank"] == "3"
+
+
+def test_exposition_empty_when_off_and_unused():
+    prev = paddle.get_flags(["FLAGS_lockwatch"])
+    paddle.set_flags({"FLAGS_lockwatch": 0})
+    lw.reset_for_tests()
+    try:
+        st = {s["name"] for s in lw.state()["locks"]
+              if s["acquires"]}
+        if not st:  # only meaningful when nothing has recorded yet
+            assert lw.exposition() == "" or "lockwatch" in \
+                lw.exposition()
+    finally:
+        paddle.set_flags(prev)
+
+
+def test_statusz_carries_lockwatch_section(lockwatch_on):
+    from paddle_tpu.observability import httpd
+
+    with lw.lock("statusz.l"):
+        pass
+    payload = httpd.statusz_payload()
+    sec = payload["lockwatch"]
+    assert sec["enabled"] is True
+    assert sec["inversions_total"] == 0
+    assert "statusz.l" in sec["locks"]
+    assert sec["locks"]["statusz.l"]["acquires"] == 1
+
+
+def test_fleet_lockwatch_table_and_report_section(lockwatch_on,
+                                                  tmp_path):
+    a, b = lw.lock("flt.a"), lw.lock("flt.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    shard = tmp_path / "rank0"
+    shard.mkdir()
+    (shard / "metrics.prom").write_text(
+        lw.exposition(const_labels={"rank": "0"}))
+    rows = fleet_mod.lockwatch_table({0: str(shard)})
+    (row,) = rows
+    assert row["rank"] == 0
+    assert row["inversions"] == 1
+    assert {r["lock"] for r in row["locks"]} == {"flt.a", "flt.b"}
+    report = {
+        "root": str(tmp_path), "shards": {}, "ranks": [],
+        "world_size": 1, "dead": [], "missing": [], "stragglers": [],
+        "straggler_summary": [], "artifacts": {}, "lockwatch": rows,
+    }
+    text = fleet_mod.format_report(report)
+    assert "lock contention per rank" in text
+    assert "flt.a" in text
+    assert "LOCK INVERSION: rank 0 observed 1" in text
+    assert "lock-order-cycle" in text  # report cites the static rule
+
+
+def test_lockwatch_table_skips_ranks_without_families(tmp_path):
+    shard = tmp_path / "rank1"
+    shard.mkdir()
+    (shard / "metrics.prom").write_text(
+        "# TYPE up gauge\nup 1\n")
+    assert fleet_mod.lockwatch_table({1: str(shard)}) == []
+
+
+# ---------------------------------------------------------------------------
+# adopters + stress: the real registry/scrape path stays inversion-free
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_adopts_watched_rlock(lockwatch_on):
+    from paddle_tpu.observability import metrics as om
+
+    reg = om.Registry()
+    assert isinstance(reg._lock, lw._WatchedRLock)
+    c = reg.counter("lockwatch_test_counter", "help")
+    c.inc()
+    assert "lockwatch_test_counter" in om.to_prometheus(reg)
+    st = lw.state()
+    assert any(s["name"] == "metrics.registry" and s["acquires"] > 0
+               for s in st["locks"])
+
+
+def test_scrape_vs_record_stress_is_inversion_free(lockwatch_on):
+    """Concurrent metric recording and scraping through a watched
+    registry: real contention, zero ABBA inversions — the CI gate
+    (tools/lockwatch_smoke.py) runs the same assertion against the
+    full serving smoke."""
+    from paddle_tpu.observability import metrics as om
+
+    reg = om.Registry()
+    counter = reg.counter("stress_total", "h")
+    hist = reg.histogram("stress_seconds", "h",
+                         buckets=(0.001, 0.01, 0.1))
+
+    def record():
+        for i in range(300):
+            counter.inc()
+            hist.observe(0.002 * (i % 7))
+
+    def scrape():
+        for _ in range(60):
+            om.to_prometheus(reg)
+            lw.exposition()
+
+    threads = [threading.Thread(target=record, daemon=True)
+               for _ in range(3)]
+    threads += [threading.Thread(target=scrape, daemon=True)
+                for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+    assert lw.inversions_total() == 0, lw.inversions()
+    st = lw.state()
+    reg_rows = [s for s in st["locks"]
+                if s["name"] == "metrics.registry"]
+    assert reg_rows and reg_rows[0]["acquires"] > 0

@@ -7,7 +7,7 @@ import os
 import pytest
 
 from paddle_tpu.analysis import baseline as lint_baseline
-from paddle_tpu.analysis import flagsdoc, reporters
+from paddle_tpu.analysis import flagsdoc, reporters, rulesdoc
 from paddle_tpu.analysis import run as lint_run
 from paddle_tpu.analysis.cli import main as lint_main
 from paddle_tpu.analysis.core import RULES, repo_root
@@ -50,6 +50,12 @@ def lint_fixture(name, **kw):
     # builder-closure, host-helper, and local-asarray twins stay clean
     ("sync_transfer_pos.py", "sync-transfer-in-step-loop",
      [11, 13, 14, 19]),
+    # concurrency plane: majority-lock discipline broken on the thread
+    # path; interprocedural ABBA lock order; non-daemon thread whose
+    # stop() forgets the join (the joined twin below stays clean)
+    ("unlocked_shared_write_pos.py", "unlocked-shared-write", [28]),
+    ("lock_order_cycle_pos.py", "lock-order-cycle", [11]),
+    ("thread_lifecycle_pos.py", "thread-lifecycle", [11]),
 ])
 def test_fixture_triggers_exactly_its_rule(fixture, rule, expect_lines):
     findings = lint_fixture(fixture)
@@ -58,14 +64,19 @@ def test_fixture_triggers_exactly_its_rule(fixture, rule, expect_lines):
     assert sorted({f.line for f in findings}) == expect_lines, findings
 
 
-def test_registry_ships_all_six_rules():
+def test_registry_ships_all_rules():
     assert set(RULES) >= {
         "jax-compat", "weak-float-in-kernel",
         "rank-divergent-collective", "side-effect-under-jit",
         "donated-arg-reuse", "flag-hygiene", "unbounded-retry",
-        "sync-transfer-in-step-loop", "route-handler-trace"}
+        "sync-transfer-in-step-loop", "route-handler-trace",
+        "unlocked-shared-write", "lock-order-cycle",
+        "thread-lifecycle"}
     for cls in RULES.values():
         assert cls.description
+        # every rule documents itself for docs/LINT_RULES.md
+        assert cls.example, cls.name
+        assert cls.fix, cls.name
 
 
 def test_select_and_disable_narrow_the_rule_set():
@@ -81,6 +92,35 @@ def test_select_and_disable_narrow_the_rule_set():
 
 def test_suppressed_fixture_is_clean():
     assert lint_fixture("suppressed.py") == []
+
+
+def test_concurrency_suppressed_fixture_is_clean():
+    # project-rule findings are produced far from the file walk; the
+    # per-line pragma must still reach them
+    assert lint_fixture("concurrency_suppressed.py") == []
+
+
+def test_concurrency_negative_fixture_is_clean():
+    assert lint_fixture("concurrency_neg.py") == []
+
+
+def test_unlocked_shared_write_message_cites_guard_and_entry():
+    findings = lint_fixture("unlocked_shared_write_pos.py")
+    (f,) = findings
+    assert "Counter._lock" in f.message
+    assert "2/3 write sites" in f.message
+    assert "thread-target entry" in f.message
+    assert "FLAGS_lockwatch=1" in f.message
+
+
+def test_lock_order_cycle_message_prints_both_chains():
+    findings = lint_fixture("lock_order_cycle_pos.py")
+    (f,) = findings
+    assert "one path takes" in f.message
+    assert "another takes" in f.message
+    # the B -> A chain runs through the helper interprocedurally
+    assert "_grab_a" in f.message
+    assert "lock-order-cycle" in f.message  # runtime cross-reference
 
 
 def test_unsuppressed_twin_of_suppressed_fixture_fires():
@@ -202,6 +242,75 @@ def test_emit_flags_doc_cli(tmp_path, capsys):
     text = open(out, encoding="utf-8").read()
     assert "FLAGS_use_pallas_kernels" in text
     assert text.startswith("# `FLAGS_*` reference")
+
+
+# ---------------------------------------------------------------------------
+# docs/LINT_RULES.md freshness + new CLI surface
+# ---------------------------------------------------------------------------
+
+def test_rules_doc_is_fresh():
+    expected = rulesdoc.to_markdown(RULES)
+    committed = open(os.path.join(REPO, rulesdoc.RULES_RELPATH),
+                     encoding="utf-8").read()
+    assert committed == expected, \
+        "docs/LINT_RULES.md is stale — regenerate: python " \
+        "tools/tpu_lint.py --emit-rules-doc docs/LINT_RULES.md"
+    for name in RULES:
+        assert f"`{name}`" in committed
+
+
+def test_emit_rules_doc_cli(tmp_path, capsys):
+    out = str(tmp_path / "LINT_RULES.md")
+    assert lint_main(["--emit-rules-doc", out]) == 0
+    text = open(out, encoding="utf-8").read()
+    assert text.startswith("# tpu-lint rule catalog")
+    assert "`lock-order-cycle`" in text
+    assert "| Rule | Hazard | Example | Fix |" in text
+
+
+def _git(*args, cwd):
+    import subprocess
+    return subprocess.run(["git", *args], cwd=cwd,
+                          capture_output=True, text=True)
+
+
+@pytest.fixture
+def tiny_git_repo(tmp_path):
+    if _git("--version", cwd=str(tmp_path)).returncode != 0:
+        pytest.skip("git unavailable")
+    _git("init", "-q", cwd=str(tmp_path))
+    _git("config", "user.email", "t@t", cwd=str(tmp_path))
+    _git("config", "user.name", "t", cwd=str(tmp_path))
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    _git("add", "-A", cwd=str(tmp_path))
+    _git("commit", "-qm", "seed", cwd=str(tmp_path))
+    return tmp_path
+
+
+def test_changed_mode_lints_only_touched_files(tiny_git_repo,
+                                               capsys, monkeypatch):
+    monkeypatch.chdir(tiny_git_repo)
+    monkeypatch.setenv("TPU_LINT_ROOT", str(tiny_git_repo))
+    # nothing touched: exit 0 without linting anything
+    assert lint_main(["--changed", "--no-baseline"]) == 0
+    assert "no changed python files" in capsys.readouterr().out
+    # an untracked file with a hazard: --changed picks it up
+    bad = os.path.join(FIXTURES, "compat_pos.py")
+    (tiny_git_repo / "touched.py").write_text(
+        open(bad, encoding="utf-8").read())
+    assert lint_main(["--changed", "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "touched.py" in out and "clean.py" not in out
+
+
+def test_jobs_flag_matches_serial_output():
+    fixture = os.path.join(FIXTURES, "compat_pos.py")
+    serial = lint_run([fixture], jobs=1)
+    threaded = lint_run([fixture, os.path.join(FIXTURES,
+                                               "rank_div_pos.py")],
+                        jobs=4)
+    assert [f.key() for f in serial] \
+        == [f.key() for f in threaded if "compat_pos" in f.path]
 
 
 # ---------------------------------------------------------------------------

@@ -46,10 +46,12 @@ fi
 
 # tpu-lint gate FIRST: static analysis over the source tree (jax-compat
 # APIs, weak floats in Pallas kernels, rank-divergent collectives, jit
-# side effects, donated-arg reuse, FLAGS_* hygiene). Dependency-free and
-# sub-10s, so a lint-detectable hazard fails CI in seconds instead of
-# after a full test tier (or a burned TPU reservation). Fails on any
-# finding not in tools/tpu_lint_baseline.json.
+# side effects, donated-arg reuse, FLAGS_* hygiene, and the
+# interprocedural concurrency rules: unlocked-shared-write,
+# lock-order-cycle, thread-lifecycle). Dependency-free and sub-10s, so
+# a lint-detectable hazard fails CI in seconds instead of after a full
+# test tier (or a burned TPU reservation). Fails on any finding not in
+# tools/tpu_lint_baseline.json.
 if ! timeout 120 python tools/tpu_lint.py; then
   echo "CI: tpu_lint FAILED — new static-analysis finding(s) above;" \
        "fix them (preferred) or, for a deliberate exception, add a" \
@@ -88,9 +90,13 @@ fi
 # ephemeral port and gates the endpoints: /readyz 503 before warmup /
 # 200 after, /metrics 200 + parseable exposition with at least one
 # evaluated SLO objective carrying a burn-rate gauge, /statusz JSON,
-# and /healthz flipping 200 -> 503 across an injected engine poison
+# and /healthz flipping 200 -> 503 across an injected engine poison.
+# FLAGS_lockwatch=1 (ISSUE 20) runs the whole smoke under the watched
+# locks: any ABBA lock-order inversion observed at runtime fails the
+# tool, and the lockwatch families are appended to the .prom artifact
 if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_trace_sample=1 \
     FLAGS_memwatch=1 FLAGS_compilewatch=1 FLAGS_stepledger=1 \
+    FLAGS_lockwatch=1 \
     python tools/serving_metrics_snapshot.py \
       --out /tmp/ci_metrics_traced.prom --trace /tmp/ci_trace.json \
       --mem /tmp/ci_memory.prom --http; then
@@ -114,6 +120,23 @@ elif ! timeout 120 env JAX_PLATFORMS=cpu \
   echo "CI: step_ledger on /tmp/ci_metrics_traced.prom FAILED (empty" \
        "waterfall, residual bucket >= 25% of step wall time, or" \
        "data_wait >= 5% — input starvation)" >&2
+  rc=1
+fi
+
+# lockwatch stress gate (ISSUE 20, README.md "Concurrency analysis"):
+# phase 1 plants a synthetic ABBA pair that the runtime deadlock
+# detector MUST flag (exactly one inversion, verdict citing the static
+# lock-order-cycle rule) — a blind detector fails here, not silently;
+# phase 2 re-runs the scrape-vs-decode serving smoke under
+# FLAGS_lockwatch=1 and requires ZERO observed inversions plus
+# non-trivial acquire counts on the adopted locks (the instrumentation
+# must have been on the hot path, not bypassed)
+if ! timeout 600 env JAX_PLATFORMS=cpu FLAGS_lockwatch=1 \
+    python tools/lockwatch_smoke.py --out /tmp/ci_lockwatch.prom; then
+  echo "CI: lockwatch smoke FAILED (the planted-ABBA canary went" \
+       "undetected — detector is blind — or a REAL lock-order" \
+       "inversion exists on the scrape-vs-decode path; see the cycle" \
+       "+ verdict above)" >&2
   rc=1
 fi
 
@@ -352,6 +375,7 @@ else
        "/tmp/ci_trace.json, /tmp/ci_memory.prom, /tmp/ci_fleet/," \
        "/tmp/ci_chaos/, /tmp/ci_router/, /tmp/ci_trace_stitch/," \
        "/tmp/ci_accounting/, /tmp/ci_bench_smoke.json," \
+       "/tmp/ci_lockwatch.prom," \
        "/tmp/ci_overlap_ledger.prom (ledger waterfall:" \
        "tools/step_ledger.py /tmp/ci_metrics_traced.prom)"
 fi

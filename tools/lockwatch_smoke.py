@@ -1,0 +1,189 @@
+"""CI lockwatch gate: the runtime deadlock detector must WORK, and the
+real serving/scrape path must be INVERSION-FREE under it.
+
+Phase 1 — canary (MUST-detect): a synthetic ABBA pair is taken in both
+orders on purpose; lockwatch has to flag exactly that inversion, with a
+verdict citing the static `lock-order-cycle` rule. A detector that
+cannot see a planted bug is a green light wired to nothing — this
+phase failing means lockwatch broke, not the repo.
+
+Phase 2 — real-path stress (MUST-be-clean): a tiny ServingEngine
+decodes on the CPU backend while scrape threads hammer the watched
+metrics registry (/metrics rendering, lockwatch exposition, /statusz)
+— the scrape-vs-decode interleaving that motivated the plane. Gates:
+ZERO observed inversions, and non-trivial stats on the adopted locks
+(the instrumentation must have actually been on the hot path).
+
+    FLAGS_lockwatch=1 python tools/lockwatch_smoke.py [--out PATH]
+
+Exit 0 green, 1 red. `--out` writes the final lockwatch exposition as
+a CI artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["FLAGS_lockwatch"] = "1"  # before any paddle_tpu import:
+# module-level adopter locks (httpd tables, fleet exporter) are
+# created at import time and read the flag at CREATION time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def phase1_canary() -> int:
+    """Planted ABBA must be detected — exit 1 if the detector is
+    blind."""
+    from paddle_tpu.observability import flight_recorder as flight
+    from paddle_tpu.observability import lockwatch as lw
+
+    lw.reset_for_tests()
+    a = lw.lock("canary.a")
+    b = lw.lock("canary.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # the opposite order: no deadlock, but the bug exists
+            pass
+    n = lw.inversions_total()
+    if n != 1:
+        print(f"lockwatch canary FAILED: planted ABBA yielded "
+              f"{n} inversion(s), expected exactly 1 — the detector "
+              f"is blind (or double-counting); fix "
+              f"paddle_tpu/observability/lockwatch.py before trusting "
+              f"phase 2's green", file=sys.stderr)
+        return 1
+    (verdict,) = lw.inversions()
+    if "lock-order-cycle" not in verdict["hint"]:
+        print("lockwatch canary FAILED: inversion verdict no longer "
+              "cites the static lock-order-cycle rule — the "
+              "runtime->static cross-reference is the point",
+              file=sys.stderr)
+        return 1
+    events = [e for e in flight.default_recorder().tail()
+              if e[1] == "lockwatch.inversion"]
+    if not events:
+        print("lockwatch canary FAILED: no lockwatch.inversion "
+              "flight-recorder event", file=sys.stderr)
+        return 1
+    print(f"phase 1 canary OK: planted ABBA detected "
+          f"(cycle: {verdict['cycle']})")
+    lw.reset_for_tests()  # phase 2 starts with a clean graph
+    return 0
+
+
+def phase2_stress(out: str | None) -> int:
+    """Tiny engine decode vs concurrent scrapes: zero inversions."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import httpd
+    from paddle_tpu.observability import lockwatch as lw
+    from paddle_tpu.observability import metrics as om
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=64, layers=2, heads=4,
+                           seq=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=2, max_seq_len=32,
+                        page_size=8)
+
+    reg = om.default_registry()
+    stop = threading.Event()
+    scrape_errors: list = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = om.to_prometheus(reg)
+                text += lw.exposition()
+                httpd.statusz_payload()
+                if not text:
+                    scrape_errors.append("empty exposition")
+            except Exception as e:  # noqa: BLE001
+                scrape_errors.append(repr(e))
+                return
+
+    scrapers = [threading.Thread(target=scraper, daemon=True)
+                for _ in range(2)]
+    for t in scrapers:
+        t.start()
+    try:
+        rng = np.random.RandomState(0)
+        rids = [eng.add_request(rng.randint(0, cfg.vocab_size, (n,)),
+                                max_new_tokens=m)
+                for n, m in ((6, 10), (9, 8), (4, 12), (7, 6))]
+        finished = {f.request_id for f in eng.run()}
+    finally:
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=30.0)
+
+    if finished != set(rids):
+        print(f"lockwatch stress FAILED: engine lost requests "
+              f"({len(finished)}/{len(rids)} finished)",
+              file=sys.stderr)
+        return 1
+    if scrape_errors:
+        print(f"lockwatch stress FAILED: scrape thread error(s): "
+              f"{scrape_errors[:3]}", file=sys.stderr)
+        return 1
+
+    inv = lw.inversions_total()
+    if inv != 0:
+        print(f"lockwatch stress FAILED: {inv} ABBA lock-order "
+              f"inversion(s) observed on the real scrape-vs-decode "
+              f"path:", file=sys.stderr)
+        for v in lw.inversions():
+            print(f"  cycle: {v['cycle']} (thread {v['thread']})",
+                  file=sys.stderr)
+            print(f"  {v['hint']}", file=sys.stderr)
+        return 1
+
+    st = lw.state()
+    hot = {s["name"]: s["acquires"] for s in st["locks"]
+           if s["acquires"] > 0}
+    if "metrics.registry" not in hot:
+        print("lockwatch stress FAILED: metrics.registry recorded "
+              "zero acquires — the instrumentation never saw the hot "
+              "path (flag not read at lock creation?)",
+              file=sys.stderr)
+        return 1
+
+    text = lw.exposition()
+    if "lockwatch_inversions_total" not in text \
+            or "lock_wait_seconds_total" not in text:
+        print("lockwatch stress FAILED: exposition is missing the "
+              "lockwatch families", file=sys.stderr)
+        return 1
+    if out:
+        om.atomic_write(out, text)
+    top = sorted(hot.items(), key=lambda kv: -kv[1])[:6]
+    rows = ", ".join(f"{n}={c}" for n, c in top)
+    print(f"phase 2 stress OK: {len(rids)} requests, 0 inversions, "
+          f"{len(hot)} watched locks on the hot path ({rows})"
+          + (f" -> {out}" if out else ""))
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=None,
+                   help="write the final lockwatch exposition here")
+    args = p.parse_args()
+    rc = phase1_canary()
+    if rc:
+        return rc
+    return phase2_stress(args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -383,11 +383,36 @@ def main():
         httpd_mod.stop_server()
         http_note = (f"; http gates OK ({len(objectives)} SLO "
                      f"objectives live at {http_base})")
+    lw_note = ""
+    from paddle_tpu.observability import lockwatch
+
+    if lockwatch.enabled():
+        # deadlock-risk gate: the smoke ran real decode + scrape
+        # traffic under the watched locks — any ABBA inversion here is
+        # a latent deadlock, not noise
+        n_inv = lockwatch.inversions_total()
+        if n_inv:
+            print(f"lockwatch gate FAILED: {n_inv} lock-order "
+                  f"inversion(s) observed during the serving smoke:",
+                  file=sys.stderr)
+            for v in lockwatch.inversions():
+                print(f"  cycle: {v['cycle']} (thread {v['thread']})",
+                      file=sys.stderr)
+                print(f"  {v['hint']}", file=sys.stderr)
+            return 1
+        lw_text = lockwatch.exposition()
+        if lw_text:
+            with open(args.out, "a") as f:
+                f.write(lw_text)
+        n_watched = sum(1 for s in lockwatch.state()["locks"]
+                        if s["acquires"])
+        lw_note = (f"; lockwatch: 0 inversions across "
+                   f"{n_watched} watched locks")
     n_lines = sum(1 for _ in open(args.out))
     print(f"serving smoke OK: {n_req} requests, "
           f"{int(checks['serving_tokens_total'])} tokens; "
           f"{n_lines} exposition lines -> {args.out}{trace_note}"
-          f"{mem_note}{http_note}")
+          f"{mem_note}{http_note}{lw_note}")
     return 0
 
 
